@@ -1,0 +1,133 @@
+"""Benchmark: Llama-family train step throughput on the local accelerator.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+Metric: training tokens/sec/chip on the largest pre-baked Llama config
+that fits the local chip. ``vs_baseline`` is an *MFU ratio* against the
+reference's own TPU training anchor, so it is fair across chip
+generations and model sizes:
+
+  reference anchor (BASELINE.md): Llama-3-8B PyTorch/XLA on v6e-8 at
+  0.476 samples/s. At the example's seq_len=8192 that is 487.4
+  tokens/s/chip => MFU = 487.4 * 6 * 8.03e9 / 918e12 = 2.56%.
+
+  vs_baseline = our_MFU / 0.0256.
+
+All progress chatter goes to stderr; stdout carries only the JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+# Peak dense bf16 FLOP/s per chip.
+PEAK_FLOPS = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12, "cpu": 5e11,
+}
+
+REF_MFU = 487.4 * 6 * 8.03e9 / 918e12  # 0.02558 (see module docstring)
+
+
+def peak_for(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return val
+    return PEAK_FLOPS["cpu"]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None,
+                    help="llama config name (default: sized to chip)")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import trainer
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    dev = devices[0]
+    kind = getattr(dev, "device_kind", "cpu")
+    on_cpu = jax.default_backend() == "cpu"
+    log(f"bench: {n_chips}x {kind} backend={jax.default_backend()}")
+
+    if args.config is None:
+        args.config = "llama3-tiny" if on_cpu else "llama3-400m"
+    if args.batch is None:
+        args.batch = 2 if on_cpu else 4 * max(n_chips, 1)
+    if on_cpu and args.seq > 256:
+        args.seq = 128
+
+    cfg = llama.CONFIGS[args.config]
+    seq = min(args.seq, cfg.max_seq_len)
+    mesh = mesh_lib.make_mesh() if n_chips > 1 else None
+
+    tc = trainer.TrainConfig(warmup_steps=10, total_steps=1000)
+    t0 = time.time()
+    state = trainer.create_train_state(cfg, tc, mesh)
+    step = trainer.make_train_step(cfg, tc, mesh)
+    batch = trainer.synthetic_batch(cfg, args.batch, seq)
+    state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    log(f"compile+first step: {time.time()-t0:.1f}s loss={float(metrics['loss']):.3f}")
+
+    for _ in range(args.warmup - 1):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.time()
+    for _ in range(args.steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.time() - t0) / args.steps
+
+    tokens_per_step = args.batch * seq
+    tok_s = tokens_per_step / dt
+    tok_s_chip = tok_s / n_chips
+
+    n_params = cfg.num_params()
+    # 6N per token + attention: ~6 * layers * seq * d_model per token
+    # (QK^T + AV, causal-halved, fwd+bwd).
+    flops_per_token = 6 * n_params + 6 * cfg.n_layers * seq * cfg.d_model
+    mfu = tok_s_chip * flops_per_token / peak_for(dev)
+
+    out = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tok_s_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / REF_MFU, 3),
+        "mfu": round(mfu, 4),
+        "config": args.config,
+        "n_params": n_params,
+        "batch": args.batch,
+        "seq": seq,
+        "n_chips": n_chips,
+        "device": kind,
+        "step_time_s": round(dt, 4),
+        "baseline_note": "vs_baseline = MFU ratio vs reference "
+                         "Llama-3-8B@v6e-8 anchor (MFU 2.56%, BASELINE.md)",
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

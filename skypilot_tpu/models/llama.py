@@ -1,0 +1,260 @@
+"""Llama-family decoder-only transformer, pure functional JAX.
+
+TPU-first design notes
+----------------------
+* Params are plain pytrees (nested dicts of ``jnp.ndarray``); per-layer
+  weights are *stacked* along a leading ``layer`` axis so the whole decoder
+  body runs as one ``lax.scan`` — a single traced layer, compiled once,
+  instead of ``n_layers`` unrolled HLO copies.
+* Every parameter has *logical axes* (see ``param_logical_axes``); the
+  mapping logical-axis -> mesh-axis lives in ``skypilot_tpu.parallel.sharding``
+  so the same model code runs single-chip, FSDP, TP, or any combination by
+  swapping rules (MaxText-style).
+* Compute in bfloat16 (MXU native), params kept in float32 by default;
+  activations are sharding-constrained at layer boundaries so XLA inserts
+  collectives (all-gather / reduce-scatter over ICI) instead of replicating.
+* Attention dispatches through ``skypilot_tpu.ops.attention`` which picks a
+  Pallas flash kernel on TPU and a plain XLA einsum path elsewhere.
+
+Reference parity: the reference ships Llama only as *external* workload
+recipes (reference: llm/llama-3_1-finetuning/lora.yaml, examples/tpu/v6e/
+train-llama3-8b.yaml — PyTorch/XLA + torchtune). Here the model family is
+in-tree, which is what makes the in-tree train/serve recipes (§2.11 of
+SURVEY.md) possible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    """Model hyperparameters (Llama-3 family proportions)."""
+
+    vocab_size: int = 128_256
+    d_model: int = 2048
+    n_layers: int = 16
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16       # activation / compute dtype
+    param_dtype: Any = jnp.float32  # storage dtype for parameters
+    remat: bool = True              # rematerialize each layer in the bwd pass
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        """Exact parameter count (used for MFU accounting in bench)."""
+        d, ff, v = self.d_model, self.d_ff, self.vocab_size
+        hd = self.head_dim
+        attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
+        mlp = 3 * d * ff
+        norms = 2 * d
+        per_layer = attn + mlp + norms
+        emb = v * d if self.tie_embeddings else 2 * v * d
+        return self.n_layers * per_layer + emb + d
+
+
+# Pre-baked configs. 8B mirrors Llama-3.1-8B, 1B mirrors Llama-3.2-1B.
+CONFIGS: Dict[str, LlamaConfig] = {
+    "llama3-8b": LlamaConfig(vocab_size=128_256, d_model=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=8, d_ff=14_336),
+    "llama3-1b": LlamaConfig(vocab_size=128_256, d_model=2048, n_layers=16,
+                             n_heads=32, n_kv_heads=8, d_ff=8192),
+    "llama3-tiny": LlamaConfig(vocab_size=512, d_model=128, n_layers=2,
+                               n_heads=4, n_kv_heads=2, d_ff=256,
+                               max_seq_len=256),
+    # Fits a single 16 GB v5e chip with AdamW fp32 state: ~420M params.
+    "llama3-400m": LlamaConfig(vocab_size=32_768, d_model=1536, n_layers=12,
+                               n_heads=12, n_kv_heads=4, d_ff=6144,
+                               max_seq_len=4096),
+}
+
+
+# ---------------------------------------------------------------------------
+# Parameter init + logical sharding axes
+# ---------------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    """Initialize parameters. Per-layer tensors are stacked on axis 0."""
+    d, ff, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    k = iter(jax.random.split(rng, 16))
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, cfg.param_dtype)
+                * (fan_in ** -0.5))
+
+    params: Params = {
+        "embed": jax.random.normal(next(k), (v, d), cfg.param_dtype) * 0.02,
+        "blocks": {
+            "ln1": jnp.ones((L, d), cfg.param_dtype),
+            "ln2": jnp.ones((L, d), cfg.param_dtype),
+            "wq": norm_init(next(k), (L, d, nh, hd), d),
+            "wk": norm_init(next(k), (L, d, nkv, hd), d),
+            "wv": norm_init(next(k), (L, d, nkv, hd), d),
+            "wo": norm_init(next(k), (L, nh, hd, d), nh * hd),
+            "w_gate": norm_init(next(k), (L, d, ff), d),
+            "w_up": norm_init(next(k), (L, d, ff), d),
+            "w_down": norm_init(next(k), (L, ff, d), ff),
+        },
+        "final_norm": jnp.ones((d,), cfg.param_dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm_init(next(k), (d, v), d)
+    return params
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Params:
+    """Logical axis names per parameter, same tree structure as params.
+
+    Names are resolved to mesh axes by ``parallel.sharding.logical_to_sharding``.
+    """
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "ln1": ("layer", "embed"),
+            "ln2": ("layer", "embed"),
+            "wq": ("layer", "embed", "heads", "head_dim"),
+            "wk": ("layer", "embed", "kv_heads", "head_dim"),
+            "wv": ("layer", "embed", "kv_heads", "head_dim"),
+            "wo": ("layer", "heads", "head_dim", "embed"),
+            "w_gate": ("layer", "embed", "mlp"),
+            "w_up": ("layer", "embed", "mlp"),
+            "w_down": ("layer", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + eps)).astype(dtype) * scale.astype(dtype)
+
+
+def rope_frequencies(cfg: LlamaConfig, positions: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for rotary embeddings. positions: [B, S] or [S]."""
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., S, hd/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, H, hd]; cos/sin: [B, S, hd/2] or [S, hd/2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    if cos.ndim == 2:  # [S, hd/2] -> broadcast over batch and heads
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:  # [B, S, hd/2]
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: LlamaConfig, positions=None):
+    """Grouped-query causal attention; dispatches to ops.attention."""
+    from skypilot_tpu.ops import attention as attn_ops
+    return attn_ops.gqa_attention(q, k, v, causal=True)
+
+
+def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
+                  cos: jax.Array, sin: jax.Array,
+                  constrain=lambda x, axes: x) -> jax.Array:
+    """One pre-norm decoder block. x: [B, S, D]."""
+    B, S, D = x.shape
+    h = rms_norm(x, layer["ln1"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    q = constrain(q, ("batch", "seq", "heads", "head_dim"))
+    k = constrain(k, ("batch", "seq", "kv_heads", "head_dim"))
+    o = _attention(q, k, v, cfg)
+    o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
+    x = x + constrain(o, ("batch", "seq", "embed"))
+
+    h = rms_norm(x, layer["ln2"], cfg.norm_eps)
+    g = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype))
+    u = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+    m = jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u,
+                   layer["w_down"].astype(cfg.dtype))
+    return x + constrain(m, ("batch", "seq", "embed"))
+
+
+# ---------------------------------------------------------------------------
+# Forward pass
+# ---------------------------------------------------------------------------
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+            constrain=None) -> jax.Array:
+    """Token ids [B, S] -> logits [B, S, vocab] (float32).
+
+    ``constrain`` is an optional fn(x, logical_axes) -> x applying
+    ``with_sharding_constraint``; identity when running unsharded.
+    """
+    if constrain is None:
+        constrain = lambda x, axes: x
+
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = constrain(x, ("batch", "seq", "embed"))
+    positions = jnp.arange(S)
+    cos, sin = rope_frequencies(cfg, positions)
+
+    def body(carry, layer):
+        y = decoder_layer(cfg, carry, layer, cos, sin, constrain)
+        return y, None
+
+    if cfg.remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
+    logits = constrain(logits, ("batch", "seq", "vocab"))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: LlamaConfig,
+            constrain=None) -> tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy. batch: {"tokens": [B, S] int32,
+    optionally "mask": [B, S] (1 = predict this position's *next* token)}."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens, cfg, constrain)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logps = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    mask = jnp.ones_like(ll) if mask is None else mask[:, :-1].astype(ll.dtype)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = -(ll * mask).sum() / denom
+    acc = ((jnp.argmax(logits, -1) == targets) * mask).sum() / denom
+    return loss, {"loss": loss, "accuracy": acc, "tokens": denom}

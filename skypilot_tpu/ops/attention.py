@@ -1,0 +1,68 @@
+"""Attention ops with TPU (Pallas flash) and XLA fallback paths.
+
+The XLA path is a straightforward einsum softmax attention — XLA already
+fuses the mask+softmax chain well on TPU for moderate sequence lengths.
+The Pallas flash-attention kernel (``skypilot_tpu.ops.flash_attention``)
+is used automatically on TPU backends for longer sequences where
+materializing the [B, H, S, S] score tensor would blow HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Sequence length at which the Pallas kernel wins over plain XLA (the
+# score tensor stops fitting comfortably in VMEM-friendly fusion sizes).
+_FLASH_MIN_SEQ = 1024
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+
+
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d)
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True) -> jax.Array:
+    """Reference einsum attention. q: [B, S, H, D]; k/v: [B, S, H, D]."""
+    *_, d = q.shape
+    scale = d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True, impl: str = "auto") -> jax.Array:
+    """Grouped-query attention. q: [B, S, Hq, D]; k/v: [B, S, Hkv, D]."""
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    seq = q.shape[1]
+    use_flash = (impl == "flash" or
+                 (impl == "auto" and _on_tpu() and seq >= _FLASH_MIN_SEQ))
+    if use_flash:
+        try:
+            from skypilot_tpu.ops import flash_attention as fa
+            return fa.flash_attention(q, k, v, causal=causal)
+        except Exception:
+            if impl == "flash":
+                raise
+    return xla_attention(q, k, v, causal=causal)

@@ -1,0 +1,105 @@
+"""Logical-axis -> mesh-axis sharding rules (MaxText-style).
+
+Model code annotates tensors with *logical* axis names ("embed", "heads",
+"batch", ...). A rule table maps each logical name to a mesh axis (or
+None = replicated). Swapping the table reconfigures the whole model
+between FSDP / TP / DP / hybrid without touching model code.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxis = Union[str, Tuple[str, ...], None]
+Rules = Dict[str, MeshAxis]
+
+# Default hybrid FSDP x TP rules:
+#  - params' "embed" dim sharded over fsdp (ZeRO-3 style),
+#  - heads / mlp / vocab dims over tp (Megatron style),
+#  - activations' batch dim over (dp, fsdp) jointly.
+DEFAULT_RULES: Rules = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "vocab": "tp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "mlp": "tp",
+    "head_dim": None,
+    "layer": None,
+}
+
+# Activation-side overrides: activations' "embed" stays unsharded (it is
+# the contracting dim of every matmul); sharding it would force XLA into
+# all-to-alls mid-layer.
+ACT_RULES: Rules = dict(DEFAULT_RULES, embed=None, vocab="tp")
+
+
+def _axis_size(mesh: Mesh, axis: MeshAxis) -> int:
+    if axis is None:
+        return 1
+    if isinstance(axis, tuple):
+        out = 1
+        for a in axis:
+            out *= mesh.shape[a]
+        return out
+    return mesh.shape[axis]
+
+
+def spec_for(logical_axes: Sequence[Optional[str]], rules: Rules,
+             mesh: Optional[Mesh] = None,
+             shape: Optional[Sequence[int]] = None) -> P:
+    """Resolve logical axis names to a PartitionSpec via the rule table.
+
+    When ``mesh`` and ``shape`` are given, a dim that the mapped mesh axis
+    does not divide evenly is left replicated instead of erroring — so the
+    same rules work for any slice topology (a 3-way fsdp axis simply won't
+    shard a 128-wide dim).
+    """
+    resolved = []
+    for i, a in enumerate(logical_axes):
+        axis = rules.get(a) if a is not None else None
+        if (axis is not None and mesh is not None and shape is not None
+                and shape[i] % _axis_size(mesh, axis) != 0):
+            axis = None
+        resolved.append(axis)
+    return P(*resolved)
+
+
+def logical_to_sharding(logical_tree, mesh: Mesh, rules: Rules = DEFAULT_RULES,
+                        shapes=None):
+    """Map a pytree of logical-axis tuples to NamedShardings.
+
+    ``shapes``: optional matching pytree of array shapes (or objects with
+    ``.shape``) enabling the divisibility guard in ``spec_for``.
+    """
+    is_leaf = lambda x: isinstance(x, tuple)
+    if shapes is None:
+        return jax.tree.map(
+            lambda axes: NamedSharding(mesh, spec_for(axes, rules, mesh)),
+            logical_tree, is_leaf=is_leaf)
+    return jax.tree.map(
+        lambda axes, s: NamedSharding(
+            mesh, spec_for(axes, rules, mesh, getattr(s, "shape", s))),
+        logical_tree, shapes, is_leaf=is_leaf)
+
+
+def make_constrain(mesh: Optional[Mesh], rules: Rules = ACT_RULES):
+    """Return fn(x, logical_axes) applying with_sharding_constraint.
+
+    With mesh=None returns identity (single-device path compiles to the
+    same HLO with zero overhead).
+    """
+    if mesh is None:
+        return lambda x, axes: x
+
+    def constrain(x, axes):
+        if len(axes) != x.ndim:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec_for(axes, rules, mesh, x.shape)))
+
+    return constrain

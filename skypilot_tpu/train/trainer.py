@@ -1,0 +1,151 @@
+"""Sharded training loop: optax AdamW + pjit over an explicit mesh.
+
+Everything here is a *thin* orchestration of jitted functions:
+
+  * ``create_train_state`` — params initialized *directly sharded* (init is
+    jitted with ``out_shardings``, so no host-side full copy ever exists;
+    an 8B model initializes fine on hosts with modest RAM).
+  * ``make_train_step`` — one fused step: loss -> grad -> clip -> AdamW ->
+    param update, donated state, with activation sharding constraints from
+    the rule table. XLA inserts the reduce-scatter/all-gather pattern for
+    FSDP and the per-layer all-reduces for TP.
+
+Reference parity: the reference delegates training loops to external
+workloads (reference: examples/tpu/v6e/train-llama3-8b.yaml runs
+transformers Trainer under PyTorch/XLA). In-tree trainer is the TPU-native
+replacement for that recipe layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import sharding as sh
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    beta1: float = 0.9
+    beta2: float = 0.95
+    grad_clip: float = 1.0
+    warmup_steps: int = 100
+    total_steps: int = 10_000
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=tc.learning_rate,
+        warmup_steps=tc.warmup_steps, decay_steps=max(tc.total_steps, 1),
+        end_value=tc.learning_rate * 0.1)
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(schedule, b1=tc.beta1, b2=tc.beta2,
+                    weight_decay=tc.weight_decay),
+    )
+
+
+# Train state is a plain dict {"params", "opt_state", "step"}: already a
+# pytree with no registration, and pickles trivially. (A dict *subclass*
+# would silently become a pytree leaf — do not "upgrade" this.)
+TrainState = Dict[str, Any]
+
+
+def _train_state(params, opt_state, step) -> TrainState:
+    return {"params": params, "opt_state": opt_state, "step": step}
+
+
+def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
+                    rules: sh.Rules = sh.DEFAULT_RULES):
+    """Shardings for the full train state (opt state mirrors params)."""
+    tc = TrainConfig()
+    opt = make_optimizer(tc)
+    p_shapes = jax.eval_shape(lambda: llama.init_params(jax.random.key(0), cfg))
+    opt_shapes = jax.eval_shape(opt.init, p_shapes)
+    p_sh = sh.logical_to_sharding(llama.param_logical_axes(cfg), mesh, rules,
+                                  shapes=p_shapes)
+
+    def opt_leaf_sharding(leaf):
+        # Adam moments have param shapes -> reuse the matching param
+        # sharding by shape lookup; scalars (counts) replicate.
+        if leaf.ndim == 0:
+            return NamedSharding(mesh, P())
+        for ps, pl in zip(jax.tree.leaves(p_sh), jax.tree.leaves(p_shapes)):
+            if pl.shape == leaf.shape:
+                return ps
+        return NamedSharding(mesh, P())
+
+    # Walk opt_state structurally: moments subtree matches params treedef.
+    opt_sh = jax.tree.map(
+        opt_leaf_sharding, opt_shapes,
+        is_leaf=lambda x: hasattr(x, "shape"))
+    return {"params": p_sh, "opt_state": opt_sh,
+            "step": NamedSharding(mesh, P())}
+
+
+def create_train_state(cfg: llama.LlamaConfig, tc: TrainConfig,
+                       mesh: Optional[Mesh], seed: int = 0,
+                       rules: sh.Rules = sh.DEFAULT_RULES) -> TrainState:
+    opt = make_optimizer(tc)
+
+    def init_fn(rng):
+        params = llama.init_params(rng, cfg)
+        return _train_state(params, opt.init(params),
+                            jnp.zeros((), jnp.int32))
+
+    rng = jax.random.key(seed)
+    if mesh is None:
+        return jax.jit(init_fn)(rng)
+    shardings = state_shardings(cfg, mesh, rules)
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def make_train_step(cfg: llama.LlamaConfig, tc: TrainConfig,
+                    mesh: Optional[Mesh],
+                    rules: sh.Rules = sh.DEFAULT_RULES,
+                    act_rules: sh.Rules = sh.ACT_RULES) -> Callable:
+    """Returns jitted step(state, batch) -> (state, metrics)."""
+    opt = make_optimizer(tc)
+    constrain = sh.make_constrain(mesh, act_rules)
+
+    def step(state: TrainState, batch: Dict[str, jax.Array]):
+        def lossf(params):
+            return llama.loss_fn(params, batch, cfg, constrain)
+
+        (loss, metrics), grads = jax.value_and_grad(lossf, has_aux=True)(
+            state["params"])
+        updates, new_opt = opt.update(grads, state["opt_state"],
+                                      state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        gnorm = optax.global_norm(grads)
+        new_state = _train_state(new_params, new_opt, state["step"] + 1)
+        metrics = dict(metrics, grad_norm=gnorm)
+        return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,))
+    shardings = state_shardings(cfg, mesh, rules)
+    batch_spec = NamedSharding(mesh, P(("dp", "fsdp")))
+    return jax.jit(
+        step,
+        donate_argnums=(0,),
+        in_shardings=(shardings, batch_spec),
+        out_shardings=(shardings, None),
+    )
+
+
+def synthetic_batch(cfg: llama.LlamaConfig, batch_size: int, seq_len: int,
+                    seed: int = 0) -> Dict[str, jax.Array]:
+    rng = jax.random.key(seed)
+    tokens = jax.random.randint(rng, (batch_size, seq_len), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    return {"tokens": tokens}

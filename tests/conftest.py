@@ -1,0 +1,40 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's offline-test strategy (reference:
+tests/common_test_fixtures.py — everything cloud is mocked, the logic runs
+for real). Here additionally the *device* layer is virtualized: 8 CPU
+devices stand in for a TPU slice so sharding/gang logic is exercised
+without hardware.
+
+Must run before any JAX backend initialization: the axon TPU plugin
+registers itself at interpreter start (sitecustomize), so we re-point the
+platform at import time, before any test touches jax.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.make_mesh(mesh_lib.MeshShape(dp=2, fsdp=2, tp=2))
+
+
+@pytest.fixture()
+def tiny_cfg():
+    from skypilot_tpu.models import llama
+    return llama.CONFIGS["llama3-tiny"]
