@@ -1,0 +1,120 @@
+"""Catalog query layer: pandas over checked-in CSVs.
+
+Mirrors the reference's service_catalog (reference:
+sky/clouds/service_catalog/common.py:122 LazyDataFrame + filter fns at
+:239-560) with a TPU-first schema: every row knows its chip count, host
+count and whole-slice price, so the optimizer can rank a v5p-128 slice
+against 8x A100 nodes directly.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import re
+from typing import Dict, List, Optional
+
+import pandas as pd
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+@functools.lru_cache(maxsize=None)
+def _df(cloud: str = "gcp") -> pd.DataFrame:
+    path = os.path.join(_DATA_DIR, f"{cloud}.csv")
+    if not os.path.exists(path):
+        from skypilot_tpu.catalog.fetchers import generate_static
+        generate_static.main(path)
+    df = pd.read_csv(path, keep_default_na=False)
+    return df
+
+
+def reload() -> None:
+    _df.cache_clear()
+
+
+def is_tpu(accelerator: Optional[str]) -> bool:
+    return bool(accelerator) and accelerator.lower().startswith("tpu-")
+
+
+_ACCEL_RE = re.compile(r"^(?P<name>[A-Za-z0-9-]+?)(?::(?P<count>\d+))?$")
+
+
+def parse_accelerator(spec: str) -> tuple[str, int]:
+    """'A100:8' -> ('A100', 8); 'tpu-v5e-16' -> ('tpu-v5e-16', 1)."""
+    m = _ACCEL_RE.match(spec.strip())
+    if not m:
+        raise ValueError(f"invalid accelerator spec: {spec!r}")
+    name = m.group("name")
+    count = int(m.group("count") or 1)
+    return name, count
+
+
+def list_accelerators(name_filter: Optional[str] = None,
+                      cloud: str = "gcp") -> pd.DataFrame:
+    df = _df(cloud)
+    df = df[df["accelerator"] != ""]
+    if name_filter:
+        df = df[df["accelerator"].str.contains(name_filter, case=False,
+                                               regex=False)]
+    return df.reset_index(drop=True)
+
+
+def offerings(accelerator: Optional[str] = None,
+              accelerator_count: Optional[int] = None,
+              instance_type: Optional[str] = None,
+              region: Optional[str] = None,
+              zone: Optional[str] = None,
+              cloud: str = "gcp") -> pd.DataFrame:
+    """All catalog rows matching the partial spec (case-insensitive)."""
+    df = _df(cloud)
+    if accelerator is not None:
+        df = df[df["accelerator"].str.lower() == accelerator.lower()]
+        if accelerator_count is not None and not is_tpu(accelerator):
+            df = df[df["accelerator_count"] == accelerator_count]
+    elif instance_type is not None:
+        df = df[df["instance_type"] == instance_type]
+    if region is not None:
+        df = df[df["region"] == region]
+    if zone is not None:
+        df = df[df["zone"] == zone]
+    return df.reset_index(drop=True)
+
+
+def get_hourly_cost(accelerator: str, use_spot: bool = False,
+                    region: Optional[str] = None, zone: Optional[str] = None,
+                    cloud: str = "gcp") -> float:
+    """Cheapest matching offering's whole-slice/VM hourly price."""
+    df = offerings(accelerator, region=region, zone=zone, cloud=cloud)
+    if df.empty:
+        raise ValueError(f"no offering for {accelerator} "
+                         f"(region={region}, zone={zone})")
+    col = "spot_price" if use_spot else "price"
+    return float(df[col].min())
+
+
+def tpu_slice_info(accelerator: str, cloud: str = "gcp") -> Dict[str, int]:
+    """{'chips': N, 'hosts': M} for a TPU slice accelerator name."""
+    df = offerings(accelerator, cloud=cloud)
+    if df.empty:
+        raise ValueError(f"unknown TPU accelerator {accelerator!r}")
+    row = df.iloc[0]
+    return {"chips": int(row["chips"]), "hosts": int(row["hosts"])}
+
+
+def cpu_instance_types(min_cpus: float = 0, min_memory_gb: float = 0,
+                       cloud: str = "gcp") -> pd.DataFrame:
+    df = _df(cloud)
+    df = df[(df["accelerator"] == "")
+            & (df["vcpus"] >= min_cpus)
+            & (df["memory_gb"] >= min_memory_gb)]
+    return df.sort_values("price").reset_index(drop=True)
+
+
+def validate_region_zone(region: Optional[str], zone: Optional[str],
+                         cloud: str = "gcp") -> None:
+    df = _df(cloud)
+    if region is not None and region not in set(df["region"]):
+        raise ValueError(f"unknown region {region!r} for {cloud}")
+    if zone is not None and zone not in set(df["zone"]):
+        raise ValueError(f"unknown zone {zone!r} for {cloud}")

@@ -1,0 +1,129 @@
+"""Generate the static GCP TPU/GPU catalog CSV.
+
+Mirrors the reference's data-fetcher pattern (reference:
+sky/clouds/service_catalog/data_fetchers/fetch_gcp.py — pulls SKU prices
+into CSVs consumed by a pandas query layer). This environment has zero
+egress, so the fetcher emits a checked-in snapshot of public GCP pricing
+(approximate, 2025) instead of calling the SKUs API; the query layer is
+identical either way.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.generate_static
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+# (generation, $/chip/hr on-demand, chips_per_host, cores_per_chip,
+#  slice sizes in *chips*, zones)
+TPU_GENERATIONS = {
+    "v2": (1.125, 4, 2, [8 // 2 * s for s in (1, 4, 8, 16, 32, 64)],
+           ["us-central1-b", "us-central1-c", "europe-west4-a",
+            "asia-east1-c"]),
+    "v3": (1.00, 4, 2, [4, 16, 32, 64, 128, 256, 512],
+           ["us-central1-a", "europe-west4-a"]),
+    "v4": (3.22, 4, 2, [4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048],
+           ["us-central2-b"]),
+    "v5e": (1.20, 8, 1, [1, 4, 8, 16, 32, 64, 128, 256],
+            ["us-central1-a", "us-west4-a", "us-west4-b", "us-east1-c",
+             "us-east5-b", "europe-west4-b", "asia-southeast1-b"]),
+    "v5p": (4.20, 4, 2, [4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 3072],
+            ["us-east5-a", "europe-west4-b"]),
+    "v6e": (2.70, 8, 1, [1, 4, 8, 16, 32, 64, 128, 256],
+            ["us-east1-d", "us-east5-a", "us-east5-b", "europe-west4-a",
+             "asia-northeast1-b", "us-south1-a"]),
+}
+
+# Suffix convention follows GCP acceleratorType: chip count for v5e/v6e
+# (v5litepod-N / v6e-N), TensorCore count for v2-v4 and v5p (v5p-N).
+CORE_SUFFIX = {"v2", "v3", "v4", "v5p"}
+
+SPOT_DISCOUNT = 0.40  # spot price = on-demand * 0.40
+
+REGION_MULT = {"us": 1.0, "europe": 1.10, "asia": 1.15}
+
+# name, accel per VM, $/hr per VM on-demand, vcpus, mem GB, zones
+GPU_VMS = [
+    ("A100", 8, "a2-highgpu-8g", 29.39, 96, 680,
+     ["us-central1-a", "us-central1-c", "europe-west4-a", "asia-northeast1-a"]),
+    ("A100", 1, "a2-highgpu-1g", 3.67, 12, 85,
+     ["us-central1-a", "us-central1-c", "europe-west4-a"]),
+    ("A100-80GB", 8, "a2-ultragpu-8g", 40.55, 96, 1360,
+     ["us-central1-a", "us-east4-c", "europe-west4-a"]),
+    ("H100", 8, "a3-highgpu-8g", 88.25, 208, 1872,
+     ["us-central1-a", "us-east5-a", "europe-west4-b"]),
+    ("L4", 1, "g2-standard-8", 0.85, 8, 32,
+     ["us-central1-a", "us-east1-b", "europe-west4-a"]),
+    ("V100", 4, "n1-highmem-32+v100x4", 10.22, 32, 208,
+     ["us-central1-a", "europe-west4-a"]),
+    ("T4", 1, "n1-standard-8+t4", 0.73, 8, 30,
+     ["us-central1-a", "us-east1-c", "asia-east1-c"]),
+]
+
+# CPU-only types (controllers, data prep).
+CPU_VMS = [
+    ("n2-standard-4", 0.194, 4, 16),
+    ("n2-standard-8", 0.389, 8, 32),
+    ("n2-standard-16", 0.777, 16, 64),
+    ("n2-standard-32", 1.554, 32, 128),
+    ("n2-highmem-8", 0.524, 8, 64),
+]
+CPU_ZONES = ["us-central1-a", "us-central1-b", "us-east1-c", "us-east5-a",
+             "europe-west4-a", "asia-northeast1-b"]
+
+HEADER = ["accelerator", "accelerator_count", "cloud", "instance_type",
+          "chips", "hosts", "region", "zone", "price", "spot_price",
+          "vcpus", "memory_gb"]
+
+
+def _mult(zone: str) -> float:
+    for prefix, m in REGION_MULT.items():
+        if zone.startswith(prefix):
+            return m
+    return 1.0
+
+
+def rows():
+    for gen, (chip_price, chips_per_host, cores_per_chip, sizes,
+              zones) in TPU_GENERATIONS.items():
+        for chips in sizes:
+            hosts = max(1, chips // chips_per_host)
+            suffix = (chips * cores_per_chip if gen in CORE_SUFFIX else chips)
+            name = f"tpu-{gen}-{suffix}"
+            for zone in zones:
+                price = chip_price * chips * _mult(zone)
+                # Per-host vCPU/mem: TPU-VMs are beefy fixed shapes.
+                vcpus, mem = (96, 192) if chips_per_host == 8 else (208, 400)
+                yield [name, 1, "gcp", f"tpu-{gen}", chips, hosts,
+                       zone.rsplit("-", 1)[0], zone, round(price, 2),
+                       round(price * SPOT_DISCOUNT, 2), vcpus * hosts,
+                       mem * hosts]
+    for accel, count, itype, price, vcpus, mem, zones in GPU_VMS:
+        for zone in zones:
+            p = price * _mult(zone)
+            yield [accel, count, "gcp", itype, 0, 1,
+                   zone.rsplit("-", 1)[0], zone, round(p, 2),
+                   round(p * SPOT_DISCOUNT, 2), vcpus, mem]
+    for itype, price, vcpus, mem in CPU_VMS:
+        for zone in CPU_ZONES:
+            p = price * _mult(zone)
+            yield ["", 0, "gcp", itype, 0, 1, zone.rsplit("-", 1)[0], zone,
+                   round(p, 2), round(p * SPOT_DISCOUNT, 2), vcpus, mem]
+
+
+def main(out_path: str | None = None) -> str:
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "data", "gcp.csv")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(HEADER)
+        for r in rows():
+            w.writerow(r)
+    return out_path
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,62 @@
+"""Task DAG (chains fully supported, mirroring the reference's actual
+support surface — reference: sky/dag.py + execution.py:188 asserts one
+task per launch; chains are consumed by the optimizer's DP)."""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import networkx as nx
+
+_CURRENT = threading.local()
+
+
+class Dag:
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.graph = nx.DiGraph()
+        self._prev: Optional["Dag"] = None
+
+    @property
+    def tasks(self) -> List:
+        return list(self.graph.nodes)
+
+    def add(self, task) -> None:
+        self.graph.add_node(task)
+
+    def remove(self, task) -> None:
+        self.graph.remove_node(task)
+
+    def add_edge(self, a, b) -> None:
+        self.graph.add_node(a)
+        self.graph.add_node(b)
+        self.graph.add_edge(a, b)
+
+    def is_chain(self) -> bool:
+        n = len(self.graph)
+        if n <= 1:
+            return True
+        degrees_ok = all(self.graph.in_degree(v) <= 1
+                         and self.graph.out_degree(v) <= 1
+                         for v in self.graph)
+        return (degrees_ok and nx.is_directed_acyclic_graph(self.graph)
+                and nx.number_weakly_connected_components(self.graph) == 1)
+
+    def topological_order(self) -> List:
+        return list(nx.topological_sort(self.graph))
+
+    def __enter__(self) -> "Dag":
+        self._prev = getattr(_CURRENT, "dag", None)
+        _CURRENT.dag = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _CURRENT.dag = self._prev
+
+    def __len__(self) -> int:
+        return len(self.graph)
+
+
+def get_current_dag() -> Optional[Dag]:
+    return getattr(_CURRENT, "dag", None)

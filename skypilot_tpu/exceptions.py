@@ -1,0 +1,88 @@
+"""Error taxonomy. Mirrors the failover-driving design of the reference
+(reference: sky/exceptions.py — ResourcesUnavailableError carries
+failover_history / no_failover so the provisioner can re-optimize)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """A candidate (cloud, region, zone, accelerator) could not be
+    provisioned. Drives the failover loop: the provisioner adds the
+    candidate to the blocklist and re-optimizes."""
+
+    def __init__(self, message: str, no_failover: bool = False,
+                 failover_history: Optional[List[Exception]] = None):
+        super().__init__(message)
+        self.no_failover = no_failover
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(self, history):
+        self.failover_history = list(history)
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not fit an existing cluster."""
+
+
+class QuotaExceededError(ResourcesUnavailableError):
+    """Provider quota error — block the whole region, not just a zone."""
+
+
+class CapacityError(ResourcesUnavailableError):
+    """Stockout / capacity error — block the zone."""
+
+
+class ClusterNotUpError(SkyTpuError):
+    pass
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    pass
+
+
+class CommandError(SkyTpuError):
+    """Remote command failed."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str = "",
+                 detailed_reason: str = ""):
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        super().__init__(
+            f"Command failed with code {returncode}: {command}\n{error_msg}")
+
+
+class JobNotFoundError(SkyTpuError):
+    pass
+
+
+class ProvisionTimeoutError(ResourcesUnavailableError):
+    pass
+
+
+class NoCloudAccessError(SkyTpuError):
+    pass
+
+
+class StorageError(SkyTpuError):
+    pass
+
+
+class ServeError(SkyTpuError):
+    pass
+
+
+class ManagedJobError(SkyTpuError):
+    pass
+
+
+class InvalidTaskError(SkyTpuError):
+    pass

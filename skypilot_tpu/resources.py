@@ -1,0 +1,244 @@
+"""Declarative resource spec — immutable, catalog-backed.
+
+Reference parity: sky/resources.py (Resources:31 — accelerator
+canonicalization :563, TPU defaults :605-629, feasibility via catalog,
+cost :1040, less_demanding_than :1146, yaml io :1348). TPU-first deltas:
+a TPU *slice* (``tpu-v5p-128``) is one logical resource whose host count
+comes from the catalog (the reference bolts this on via
+``num_ips_per_node``); topology-aware placement is native, not an
+accelerator_args dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog import catalog
+
+_COUNT_RE = re.compile(r"^(\d+(?:\.\d+)?)(\+?)$")
+
+
+def parse_count(value, what: str) -> Tuple[Optional[float], bool]:
+    """'8' -> (8, False); '8+' -> (8, True); None -> (None, False)."""
+    if value is None:
+        return None, False
+    m = _COUNT_RE.match(str(value).strip())
+    if not m:
+        raise ValueError(f"invalid {what} spec: {value!r} "
+                         f"(expected e.g. '8' or '8+')")
+    return float(m.group(1)), m.group(2) == "+"
+
+
+@dataclasses.dataclass(frozen=True)
+class Resources:
+    """Partial or concrete resource requirement. Immutable; use ``copy``."""
+
+    cloud: Optional[str] = None          # "gcp" | "local"
+    region: Optional[str] = None
+    zone: Optional[str] = None
+    accelerators: Optional[str] = None   # "tpu-v5e-8" | "A100:8"
+    cpus: Optional[str] = None           # "8" | "8+"
+    memory: Optional[str] = None         # GB, "32" | "32+"
+    instance_type: Optional[str] = None
+    use_spot: bool = False
+    disk_size: int = 256
+    image_id: Optional[str] = None
+    ports: Optional[Tuple[int, ...]] = None
+    labels: Optional[Tuple[Tuple[str, str], ...]] = None
+    job_recovery: Optional[str] = None   # managed-jobs strategy name
+    # TPU-specific: software version for the runtime (None = per-gen default)
+    runtime_version: Optional[str] = None
+    _price: Optional[float] = None       # filled on launchable resources
+
+    def __post_init__(self):
+        if self.accelerators is not None:
+            catalog.parse_accelerator(self.accelerators)  # validate
+        parse_count(self.cpus, "cpus")
+        parse_count(self.memory, "memory")
+        if self.cloud not in (None, "gcp", "local"):
+            raise ValueError(f"unknown cloud {self.cloud!r}")
+        if self.is_tpu() and self.runtime_version is None:
+            object.__setattr__(self, "runtime_version",
+                               default_tpu_runtime(self.accelerators))
+
+    # -- classification ----------------------------------------------------
+    def is_tpu(self) -> bool:
+        return catalog.is_tpu(self.accelerators)
+
+    @property
+    def accelerator_name(self) -> Optional[str]:
+        if self.accelerators is None:
+            return None
+        return catalog.parse_accelerator(self.accelerators)[0]
+
+    @property
+    def accelerator_count(self) -> int:
+        if self.accelerators is None:
+            return 0
+        return catalog.parse_accelerator(self.accelerators)[1]
+
+    def tpu_info(self) -> Dict[str, int]:
+        """{'chips', 'hosts'} for a TPU slice."""
+        if not self.is_tpu():
+            raise ValueError(f"{self} is not a TPU resource")
+        return catalog.tpu_slice_info(self.accelerator_name)
+
+    @property
+    def hosts_per_node(self) -> int:
+        """Physical hosts behind one logical node (TPU pods: >1)."""
+        if self.is_tpu() and self.cloud != "local":
+            return self.tpu_info()["hosts"]
+        return 1
+
+    # -- lifecycle ---------------------------------------------------------
+    def copy(self, **overrides) -> "Resources":
+        return dataclasses.replace(self, **overrides)
+
+    # -- feasibility / cost -------------------------------------------------
+    def launchables(self, blocked: Optional[set] = None) -> List["Resources"]:
+        """Concrete per-zone candidates, cheapest first.
+
+        Each returned Resources has cloud/region/zone/instance_type/_price
+        filled. ``blocked`` is a set of (cloud, region, zone) triples (zone
+        or region may be None = whole region/cloud blocked).
+        """
+        blocked = blocked or set()
+        if self.cloud == "local":
+            r = self.copy(region="local", zone="local", _price=0.0)
+            return [] if _is_blocked("local", "local", "local", blocked) else [r]
+        out = []
+        min_cpus, cpus_plus = parse_count(self.cpus, "cpus")
+        min_mem, mem_plus = parse_count(self.memory, "memory")
+        if self.accelerators is None and self.instance_type is None:
+            df = catalog.cpu_instance_types(min_cpus or 0, min_mem or 0)
+        else:
+            name, count = (catalog.parse_accelerator(self.accelerators)
+                           if self.accelerators else (None, None))
+            df = catalog.offerings(name, count, self.instance_type,
+                                   self.region, self.zone)
+            if min_cpus is not None:
+                df = df[df["vcpus"] >= min_cpus] if cpus_plus else \
+                    df[df["vcpus"] == min_cpus]
+            if min_mem is not None:
+                df = df[df["memory_gb"] >= min_mem] if mem_plus else df
+        if self.region is not None:
+            df = df[df["region"] == self.region]
+        if self.zone is not None:
+            df = df[df["zone"] == self.zone]
+        price_col = "spot_price" if self.use_spot else "price"
+        for _, row in df.sort_values(price_col).iterrows():
+            if _is_blocked("gcp", row["region"], row["zone"], blocked):
+                continue
+            out.append(self.copy(
+                cloud="gcp", region=row["region"], zone=row["zone"],
+                instance_type=row["instance_type"],
+                _price=float(row[price_col])))
+        return out
+
+    def get_cost(self, seconds: float) -> float:
+        if self._price is None:
+            raise ValueError("cost is only defined on launchable resources")
+        return self._price * seconds / 3600.0
+
+    @property
+    def price(self) -> Optional[float]:
+        return self._price
+
+    def less_demanding_than(self, other: "Resources") -> bool:
+        """Can a cluster with ``other`` run a task asking for ``self``?"""
+        if self.cloud is not None and self.cloud != other.cloud:
+            return False
+        if self.region is not None and self.region != other.region:
+            return False
+        if self.zone is not None and self.zone != other.zone:
+            return False
+        if self.accelerators is not None:
+            if other.accelerators is None:
+                return False
+            sn, sc = catalog.parse_accelerator(self.accelerators)
+            on, oc = catalog.parse_accelerator(other.accelerators)
+            if sn.lower() != on.lower() or sc > oc:
+                return False
+        if self.use_spot and not other.use_spot:
+            return False
+        return True
+
+    # -- serialization -----------------------------------------------------
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for f in ("cloud", "region", "zone", "accelerators", "cpus",
+                  "memory", "instance_type", "image_id", "runtime_version",
+                  "job_recovery"):
+            v = getattr(self, f)
+            if v is not None:
+                out[f] = v
+        if self.use_spot:
+            out["use_spot"] = True
+        if self.disk_size != 256:
+            out["disk_size"] = self.disk_size
+        if self.ports:
+            out["ports"] = list(self.ports)
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        return out
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> "Resources":
+        config = dict(config or {})
+        ports = config.pop("ports", None)
+        labels = config.pop("labels", None)
+        accel = config.pop("accelerators", None)
+        if isinstance(accel, dict):  # {"A100": 8} form
+            (name, cnt), = accel.items()
+            accel = f"{name}:{cnt}"
+        known = {f.name for f in dataclasses.fields(cls) if f.name != "_price"}
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f"unknown resources fields: {sorted(unknown)}")
+        for k in ("cpus", "memory"):
+            if k in config and config[k] is not None:
+                config[k] = str(config[k])
+        return cls(
+            accelerators=accel,
+            ports=tuple(ports) if ports else None,
+            labels=tuple(sorted(labels.items())) if labels else None,
+            **config)
+
+    def __repr__(self) -> str:
+        bits = [self.cloud or "any"]
+        if self.accelerators:
+            bits.append(self.accelerators)
+        if self.instance_type:
+            bits.append(self.instance_type)
+        if self.zone:
+            bits.append(self.zone)
+        elif self.region:
+            bits.append(self.region)
+        if self.use_spot:
+            bits.append("[spot]")
+        if self._price is not None:
+            bits.append(f"${self._price:.2f}/h")
+        return f"Resources({', '.join(bits)})"
+
+
+def _is_blocked(cloud: str, region: str, zone: str, blocked: set) -> bool:
+    return ((cloud, None, None) in blocked
+            or (cloud, region, None) in blocked
+            or (cloud, region, zone) in blocked)
+
+
+def default_tpu_runtime(accelerator: Optional[str]) -> str:
+    """Per-generation TPU VM runtime version (reference:
+    sky/resources.py:605-629 fills v2-alpha-tpuv5 etc.)."""
+    a = (accelerator or "").lower()
+    if "v6e" in a:
+        return "v2-alpha-tpuv6e"
+    if "v5p" in a:
+        return "v2-alpha-tpuv5"
+    if "v5e" in a:
+        return "v2-alpha-tpuv5-lite"
+    return "tpu-ubuntu2204-base"

@@ -1,0 +1,148 @@
+"""Task: the user-facing workload declaration (YAML or Python).
+
+Reference parity: sky/task.py (Task:192, from_yaml_config:432,
+set_resources:717, file_mounts :798, storage mounts :1004, ``>>``
+chaining :1263). TPU-first deltas: ``num_nodes`` counts *logical* nodes
+(a whole TPU slice is one node; the runtime fans out to its hosts), and
+the run command receives the ``jax.distributed`` env contract instead of
+the torchrun MASTER_ADDR one.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.resources import Resources
+
+_NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9._-]*$")
+
+RunCmd = Union[str, Callable[[int, List[str]], Optional[str]], None]
+
+
+class Task:
+    def __init__(self,
+                 name: Optional[str] = None,
+                 *,
+                 setup: Optional[str] = None,
+                 run: RunCmd = None,
+                 envs: Optional[Dict[str, str]] = None,
+                 workdir: Optional[str] = None,
+                 num_nodes: int = 1,
+                 file_mounts: Optional[Dict[str, str]] = None,
+                 storage_mounts: Optional[Dict[str, Any]] = None):
+        if name is not None and not _NAME_RE.match(name):
+            raise exceptions.InvalidTaskError(f"invalid task name {name!r}")
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.envs = dict(envs or {})
+        self.workdir = workdir
+        self.num_nodes = num_nodes
+        self.file_mounts = dict(file_mounts or {})
+        self.storage_mounts = dict(storage_mounts or {})
+        self.resources: List[Resources] = [Resources()]
+        self.service: Optional[Any] = None  # serve.SkyServiceSpec
+        self.estimated_runtime_seconds: Optional[float] = None
+
+    # -- builder API -------------------------------------------------------
+    def set_resources(self, resources: Union[Resources, List[Resources]]):
+        self.resources = ([resources] if isinstance(resources, Resources)
+                          else list(resources))
+        return self
+
+    def set_file_mounts(self, mounts: Optional[Dict[str, str]]):
+        self.file_mounts = dict(mounts or {})
+        return self
+
+    def update_envs(self, envs: Dict[str, str]):
+        self.envs.update(envs)
+        return self
+
+    def set_service(self, service):
+        self.service = service
+        return self
+
+    # -- yaml --------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> "Task":
+        config = dict(config or {})
+        resources_cfg = config.pop("resources", None)
+        service_cfg = config.pop("service", None)
+        task = cls(
+            name=config.pop("name", None),
+            setup=config.pop("setup", None),
+            run=config.pop("run", None),
+            envs={k: "" if v is None else str(v)
+                  for k, v in (config.pop("envs", None) or {}).items()},
+            workdir=config.pop("workdir", None),
+            num_nodes=int(config.pop("num_nodes", 1) or 1),
+            file_mounts=config.pop("file_mounts", None),
+        )
+        if config:
+            raise exceptions.InvalidTaskError(
+                f"unknown task fields: {sorted(config)}")
+        if resources_cfg is not None:
+            if isinstance(resources_cfg, list):
+                task.set_resources(
+                    [Resources.from_yaml_config(r) for r in resources_cfg])
+            else:
+                task.set_resources(Resources.from_yaml_config(resources_cfg))
+        if service_cfg is not None:
+            from skypilot_tpu.serve import service_spec
+            task.set_service(
+                service_spec.SkyServiceSpec.from_yaml_config(service_cfg))
+        return task
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "Task":
+        with open(os.path.expanduser(path)) as f:
+            config = yaml.safe_load(f)
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f"{path} did not parse to a task dict")
+        return cls.from_yaml_config(config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.name:
+            out["name"] = self.name
+        if self.workdir:
+            out["workdir"] = self.workdir
+        if self.num_nodes != 1:
+            out["num_nodes"] = self.num_nodes
+        if len(self.resources) == 1:
+            out["resources"] = self.resources[0].to_yaml_config()
+        else:
+            out["resources"] = [r.to_yaml_config() for r in self.resources]
+        if self.envs:
+            out["envs"] = dict(self.envs)
+        if self.setup:
+            out["setup"] = self.setup
+        if isinstance(self.run, str):
+            out["run"] = self.run
+        if self.file_mounts:
+            out["file_mounts"] = dict(self.file_mounts)
+        if self.service is not None:
+            out["service"] = self.service.to_yaml_config()
+        return out
+
+    def to_yaml(self, path: str) -> None:
+        with open(os.path.expanduser(path), "w") as f:
+            yaml.safe_dump(self.to_yaml_config(), f, sort_keys=False)
+
+    # -- dag chaining ------------------------------------------------------
+    def __rshift__(self, other: "Task") -> "Task":
+        from skypilot_tpu import dag as dag_lib
+        dag = dag_lib.get_current_dag()
+        if dag is not None:
+            dag.add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        r = self.resources[0] if self.resources else None
+        return f"Task({self.name or '<unnamed>'}, {r}, nodes={self.num_nodes})"

@@ -1,0 +1,134 @@
+"""Offline optimizer/resources/catalog tests (the reference's dryrun-suite
+pattern: real catalog data, no cloud calls)."""
+
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions, optimizer
+from skypilot_tpu.catalog import catalog
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+def _task(accel=None, **kw):
+    t = Task(name="t")
+    t.set_resources(Resources(accelerators=accel, **kw))
+    return t
+
+
+def test_catalog_tpu_info():
+    info = catalog.tpu_slice_info("tpu-v5e-16")
+    assert info == {"chips": 16, "hosts": 2}
+    info = catalog.tpu_slice_info("tpu-v5p-16")  # 16 cores = 8 chips
+    assert info == {"chips": 8, "hosts": 2}
+
+
+def test_catalog_prices_scale_with_chips():
+    c8 = catalog.get_hourly_cost("tpu-v5e-8")
+    c16 = catalog.get_hourly_cost("tpu-v5e-16")
+    assert abs(c16 - 2 * c8) < 1e-6
+
+
+def test_optimizer_picks_cheapest_zone():
+    r = optimizer.optimize_task(_task("tpu-v5e-8"))
+    assert r.cloud == "gcp"
+    assert r.region.startswith("us")  # us cheaper than europe/asia
+    assert r.price == catalog.get_hourly_cost("tpu-v5e-8")
+
+
+def test_optimizer_spot_cheaper():
+    on_demand = optimizer.optimize_task(_task("tpu-v5e-8"))
+    spot = optimizer.optimize_task(_task("tpu-v5e-8", use_spot=True))
+    assert spot.price < on_demand.price
+
+
+def test_optimizer_blocklist_failover():
+    first = optimizer.optimize_task(_task("tpu-v5e-8"))
+    blocked = {("gcp", first.region, first.zone)}
+    second = optimizer.optimize_task(_task("tpu-v5e-8"), blocked)
+    assert (second.region, second.zone) != (first.region, first.zone)
+    assert second.price >= first.price
+
+    # Block the whole cloud -> unavailable.
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        optimizer.optimize_task(_task("tpu-v5e-8"), {("gcp", None, None)})
+
+
+def test_optimizer_region_pin():
+    r = optimizer.optimize_task(_task("tpu-v6e-8", region="europe-west4"))
+    assert r.region == "europe-west4"
+
+
+def test_optimizer_gpu_and_cpu():
+    r = optimizer.optimize_task(_task("A100:8"))
+    assert r.instance_type == "a2-highgpu-8g"
+    r = optimizer.optimize_task(_task(None, cpus="8+"))
+    assert r.instance_type.startswith("n2-")
+
+
+def test_chain_dag_prefers_same_region():
+    """Downstream task should co-locate with upstream when prices tie."""
+    a, b = _task("tpu-v5e-8"), Task(name="b")
+    b.set_resources(Resources(accelerators="tpu-v5e-8"))
+    d = dag_lib.Dag()
+    with d:
+        a >> b
+    plan = optimizer.optimize(d)
+    assert plan[a].cloud == plan[b].cloud == "gcp"
+
+
+def test_resources_yaml_roundtrip():
+    r = Resources.from_yaml_config({
+        "accelerators": "tpu-v5p-16", "use_spot": True,
+        "region": "us-east5"})
+    assert r.accelerators == "tpu-v5p-16"
+    assert r.runtime_version == "v2-alpha-tpuv5"
+    cfg = r.to_yaml_config()
+    r2 = Resources.from_yaml_config(cfg)
+    assert r2 == r
+
+
+def test_resources_dict_accelerator_form():
+    r = Resources.from_yaml_config({"accelerators": {"A100": 8}})
+    assert r.accelerators == "A100:8"
+
+
+def test_resources_rejects_unknown_fields():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Resources.from_yaml_config({"acelerators": "tpu-v5e-8"})
+
+
+def test_less_demanding_than():
+    small = Resources(accelerators="A100:4")
+    big = Resources(accelerators="A100:8", cloud="gcp")
+    assert small.less_demanding_than(big)
+    assert not big.less_demanding_than(small)
+
+
+def test_task_yaml_roundtrip(tmp_path):
+    cfg = {
+        "name": "train",
+        "resources": {"accelerators": "tpu-v5e-8"},
+        "num_nodes": 1,
+        "setup": "echo setup",
+        "run": "echo run",
+        "envs": {"FOO": "bar"},
+    }
+    t = Task.from_yaml_config(cfg)
+    assert t.resources[0].accelerators == "tpu-v5e-8"
+    p = tmp_path / "task.yaml"
+    t.to_yaml(str(p))
+    t2 = Task.from_yaml(str(p))
+    assert t2.name == "train"
+    assert t2.envs == {"FOO": "bar"}
+    assert t2.resources[0].accelerators == "tpu-v5e-8"
+
+
+def test_task_rejects_unknown_fields():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml_config({"name": "x", "nodes": 2})
+
+
+def test_hosts_per_node():
+    assert Resources(accelerators="tpu-v5e-32").hosts_per_node == 4
+    assert Resources(accelerators="A100:8").hosts_per_node == 1
