@@ -1,0 +1,24 @@
+"""skypilot_tpu: a TPU-native sky orchestration + compute framework.
+
+Public API surface mirrors the reference's (reference:
+sky/__init__.py:83-220) with the TPU-first additions (mesh/sharding,
+in-tree models and trainers).
+"""
+
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.execution import exec, launch  # noqa: A004
+from skypilot_tpu.core import (autostop, cancel, cost_report, down,
+                               job_status, queue, start, status, stop,
+                               tail_logs)
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dag", "Resources", "Task",
+    "launch", "exec",
+    "status", "start", "stop", "down", "autostop",
+    "queue", "cancel", "tail_logs", "job_status", "cost_report",
+    "__version__",
+]

@@ -1,0 +1,390 @@
+"""TpuVmBackend: provision -> sync -> exec -> logs -> down, with the
+failover-retry provisioner.
+
+Reference parity: sky/backends/cloud_vm_ray_backend.py — but the 5,100-LoC
+monolith decomposes here because two big reference subsystems vanish by
+design: (a) no Ray codegen (runtime/driver.py is a real program, not
+generated source), (b) no SSH-string-codegen RPC (job queue is accessed
+as a library locally / over the runner for remote clusters).
+
+The failover engine (RetryingProvisioner) keeps the reference's proven
+shape (reference :1988 provision_with_retries): iterate candidates from
+the optimizer, convert provider errors to blocklist entries at the right
+scope (zone for capacity, region for quota), re-optimize, and optionally
+loop until up.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, optimizer, provision, state
+from skypilot_tpu.provision.common import ProvisionConfig
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.runtime import constants, job_queue
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import paths
+
+
+class ClusterHandle(dict):
+    """JSON-serializable cluster descriptor stored in the state DB."""
+
+    @property
+    def cluster_name(self) -> str:
+        return self["cluster_name"]
+
+    @property
+    def provider(self) -> str:
+        return self["provider"]
+
+    @property
+    def zone(self) -> str:
+        return self["zone"]
+
+    @property
+    def resources(self) -> Resources:
+        return Resources.from_yaml_config(self["resources"])
+
+    @classmethod
+    def create(cls, cluster_name: str, launchable: Resources,
+               num_nodes: int) -> "ClusterHandle":
+        return cls(
+            cluster_name=cluster_name,
+            provider=launchable.cloud,
+            zone=launchable.zone,
+            region=launchable.region,
+            num_nodes=num_nodes,
+            hosts_per_node=launchable.hosts_per_node,
+            resources=launchable.to_yaml_config(),
+            price_per_hour=(launchable.price or 0.0) * num_nodes,
+        )
+
+
+def _blocklist_scope(err: exceptions.ResourcesUnavailableError,
+                     launchable: Resources):
+    """Error type -> blocklist granularity (reference:
+    FailoverCloudErrorHandlerV2 semantics, cloud_vm_ray_backend.py:940)."""
+    if isinstance(err, exceptions.QuotaExceededError):
+        return (launchable.cloud, launchable.region, None)
+    return (launchable.cloud, launchable.region, launchable.zone)
+
+
+class RetryingProvisioner:
+    """Optimize -> provision -> on failure, blocklist + re-optimize."""
+
+    def __init__(self, retry_until_up: bool = False,
+                 backoff_seconds: float = 5.0,
+                 max_rounds: int = 3):
+        self.retry_until_up = retry_until_up
+        self.backoff_seconds = backoff_seconds
+        self.max_rounds = max_rounds
+
+    def provision(self, task: Task, cluster_name: str) -> ClusterHandle:
+        blocked: set = set()
+        history: List[Exception] = []
+        rounds = 0
+        while True:
+            try:
+                launchable = optimizer.optimize_task(task, blocked)
+            except exceptions.ResourcesUnavailableError as e:
+                rounds += 1
+                if self.retry_until_up and rounds < self.max_rounds:
+                    # All candidates blocked: clear blocklist, back off,
+                    # and sweep the full candidate list again.
+                    blocked.clear()
+                    time.sleep(self.backoff_seconds)
+                    continue
+                raise e.with_failover_history(history)
+            try:
+                return self._provision_one(task, cluster_name, launchable)
+            except exceptions.ResourcesUnavailableError as e:
+                history.append(e)
+                blocked.add(_blocklist_scope(e, launchable))
+                print(f"Provision failed on {launchable}: {e}; "
+                      f"failing over ({len(blocked)} blocked)",
+                      file=sys.stderr)
+
+    def _provision_one(self, task: Task, cluster_name: str,
+                       launchable: Resources) -> ClusterHandle:
+        handle = ClusterHandle.create(cluster_name, launchable,
+                                      task.num_nodes)
+        state.set_cluster(cluster_name, dict(handle), state.ClusterStatus.INIT,
+                          handle["price_per_hour"])
+        config = ProvisionConfig(
+            cluster_name=cluster_name,
+            num_nodes=task.num_nodes,
+            hosts_per_node=launchable.hosts_per_node,
+            zone=launchable.zone,
+            region=launchable.region,
+            accelerator=launchable.accelerator_name,
+            accelerator_count=launchable.accelerator_count,
+            instance_type=launchable.instance_type,
+            use_spot=launchable.use_spot,
+            runtime_version=launchable.runtime_version,
+            disk_size=launchable.disk_size,
+            image_id=launchable.image_id,
+            ports=list(launchable.ports) if launchable.ports else None,
+        )
+        provision.run_instances(handle.provider, config)
+        provision.wait_instances(handle.provider, cluster_name, handle.zone)
+        # Persist cluster.json so the (possibly remote) driver is
+        # self-sufficient.
+        cdir = paths.cluster_dir(cluster_name)
+        with open(os.path.join(cdir, "cluster.json"), "w") as f:
+            json.dump({"provider": handle.provider,
+                       "cluster_name": cluster_name,
+                       "zone": handle.zone,
+                       "num_nodes": task.num_nodes,
+                       "hosts_per_node": launchable.hosts_per_node}, f)
+        state.set_cluster(cluster_name, dict(handle), state.ClusterStatus.UP,
+                          handle["price_per_hour"])
+        _spawn_skylet(cluster_name)
+        return handle
+
+
+def _spawn_skylet(cluster_name: str) -> None:
+    """One autostop daemon per cluster (pidfile-deduplicated)."""
+    cdir = paths.cluster_dir(cluster_name)
+    pidfile = os.path.join(cdir, "skylet.pid")
+    if os.path.exists(pidfile):
+        try:
+            os.kill(int(open(pidfile).read().strip()), 0)
+            return  # still alive
+        except (OSError, ValueError):
+            pass
+    log = os.path.join(cdir, "skylet.log")
+    with open(log, "ab") as f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.runtime.skylet",
+             "--cluster-name", cluster_name],
+            stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
+            env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
+    with open(pidfile, "w") as f:
+        f.write(str(proc.pid))
+
+
+class TpuVmBackend:
+    """The production backend (name kept honest: it drives TPU-VM slices
+    on GCP and plain VMs/local hosts through the same path)."""
+
+    # -- provisioning ------------------------------------------------------
+    def provision(self, task: Task, cluster_name: str,
+                  retry_until_up: bool = False) -> ClusterHandle:
+        existing = state.get_cluster(cluster_name)
+        if existing is not None:
+            handle = ClusterHandle(existing["handle"])
+            if existing["status"] == state.ClusterStatus.UP:
+                self.check_resources_fit(task, handle)
+                return handle
+            if existing["status"] == state.ClusterStatus.STOPPED:
+                return self.start(cluster_name)
+        return RetryingProvisioner(retry_until_up).provision(
+            task, cluster_name)
+
+    def check_resources_fit(self, task: Task, handle: ClusterHandle) -> None:
+        cluster_res = handle.resources
+        for r in task.resources:
+            if r.less_demanding_than(cluster_res):
+                return
+        raise exceptions.ResourcesMismatchError(
+            f"task {task} does not fit cluster {handle.cluster_name} "
+            f"({cluster_res})")
+
+    # -- sync --------------------------------------------------------------
+    def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
+        info = provision.get_cluster_info(handle.provider,
+                                          handle.cluster_name, handle.zone)
+        for runner, host in zip(provision.get_command_runners(info),
+                                info.hosts):
+            dst = (os.path.join(host.workspace, "sky_workdir")
+                   if host.workspace else "~/sky_workdir")
+            runner.rsync(workdir, dst, up=True)
+
+    def sync_file_mounts(self, handle: ClusterHandle,
+                         file_mounts: Dict[str, str]) -> None:
+        if not file_mounts:
+            return
+        info = provision.get_cluster_info(handle.provider,
+                                          handle.cluster_name, handle.zone)
+        runners = provision.get_command_runners(info)
+        for dst, src in file_mounts.items():
+            if src.startswith(("gs://", "s3://")):
+                from skypilot_tpu.data import storage as storage_lib
+                storage_lib.mount_or_copy(handle, dst, src)
+                continue
+            for runner, host in zip(runners, info.hosts):
+                tgt = (os.path.join(host.workspace, dst.lstrip("/~"))
+                       if host.workspace else dst)
+                runner.rsync(os.path.expanduser(src), tgt, up=True)
+
+    # -- execution ---------------------------------------------------------
+    def execute(self, handle: ClusterHandle, task: Task,
+                detach_run: bool = True) -> int:
+        cdir = paths.cluster_dir(handle.cluster_name)
+        db = os.path.join(cdir, constants.JOB_DB)
+        setup = f"{task.setup}\n" if task.setup else ""
+        if task.run is None:
+            run_cmd = "true"
+        elif isinstance(task.run, str):
+            run_cmd = task.run
+        else:
+            raise exceptions.InvalidTaskError(
+                "callable run is resolved by execution.launch before "
+                "reaching the backend")
+        env_exports = "".join(
+            f"export {k}={shlex.quote(str(v))}\n"
+            for k, v in task.envs.items())
+        script = f"{env_exports}{setup}{run_cmd}"
+        job_id = job_queue.add_job(db, task.name, "",
+                                   metadata={"num_nodes": task.num_nodes})
+        script_path = os.path.join(
+            cdir, constants.RUN_SCRIPT.format(job_id=job_id))
+        with open(script_path, "w") as f:
+            f.write(script)
+        job_queue.set_run_cmd(db, job_id,
+                              f"bash {shlex.quote(script_path)}")
+        self._spawn_driver(handle, job_id)
+        if not detach_run:
+            self.wait_job(handle, job_id)
+        return job_id
+
+    def _spawn_driver(self, handle: ClusterHandle, job_id: int) -> None:
+        cdir = paths.cluster_dir(handle.cluster_name)
+        log = os.path.join(cdir, "logs", f"driver-{job_id}.log")
+        os.makedirs(os.path.dirname(log), exist_ok=True)
+        with open(log, "ab") as f:
+            subprocess.Popen(
+                [sys.executable, "-m", "skypilot_tpu.runtime.driver",
+                 "--cluster-dir", cdir, "--job-id", str(job_id)],
+                stdout=f, stderr=subprocess.STDOUT,
+                start_new_session=True,
+                env={**os.environ,
+                     "SKYPILOT_TPU_HOME": paths.home()})
+
+    def wait_job(self, handle: ClusterHandle, job_id: int,
+                 timeout: float = 3600) -> job_queue.JobStatus:
+        db = os.path.join(paths.cluster_dir(handle.cluster_name),
+                          constants.JOB_DB)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            job = job_queue.get_job(db, job_id)
+            if job and job["status"].is_terminal():
+                return job["status"]
+            time.sleep(0.2)
+        raise TimeoutError(f"job {job_id} did not finish in {timeout}s")
+
+    # -- job ops -----------------------------------------------------------
+    def queue(self, handle: ClusterHandle) -> List[Dict[str, Any]]:
+        db = os.path.join(paths.cluster_dir(handle.cluster_name),
+                          constants.JOB_DB)
+        return job_queue.list_jobs(db)
+
+    def cancel(self, handle: ClusterHandle, job_id: int) -> None:
+        db = os.path.join(paths.cluster_dir(handle.cluster_name),
+                          constants.JOB_DB)
+        job = job_queue.get_job(db, job_id)
+        if job is None:
+            raise exceptions.JobNotFoundError(f"no job {job_id}")
+        job_queue.set_status(db, job_id, job_queue.JobStatus.CANCELLED)
+        # Drivers poll for CANCELLED; also kill job processes directly.
+        info = provision.get_cluster_info(handle.provider,
+                                          handle.cluster_name, handle.zone)
+        runners = provision.get_command_runners(info)
+        for runner, pid in zip(runners, job["pids"]):
+            runner.kill(pid)
+
+    def job_log_paths(self, handle: ClusterHandle, job_id: int) -> List[str]:
+        d = os.path.join(paths.cluster_dir(handle.cluster_name), "logs",
+                         constants.LOG_DIR.format(job_id=job_id))
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            os.path.join(d, f) for f in os.listdir(d)
+            if f.startswith("rank-"))
+
+    def tail_logs(self, handle: ClusterHandle, job_id: int,
+                  follow: bool = False, out=None) -> None:
+        out = out if out is not None else sys.stdout
+        db = os.path.join(paths.cluster_dir(handle.cluster_name),
+                          constants.JOB_DB)
+        if job_queue.get_job(db, job_id) is None:
+            raise exceptions.JobNotFoundError(
+                f"no job {job_id} on {handle.cluster_name}")
+        log_paths = self.job_log_paths(handle, job_id)
+        offsets = {p: 0 for p in log_paths}
+        while True:
+            for p in list(offsets):
+                if os.path.exists(p):
+                    with open(p) as f:
+                        f.seek(offsets[p])
+                        chunk = f.read()
+                        offsets[p] = f.tell()
+                    if chunk:
+                        prefix = os.path.basename(p).replace(".log", "")
+                        for line in chunk.splitlines():
+                            print(f"({prefix}) {line}", file=out)
+            job = job_queue.get_job(db, job_id)
+            if not follow or (job and job["status"].is_terminal()):
+                if follow:  # final drain
+                    continue_once = any(
+                        os.path.getsize(p) > offsets[p]
+                        for p in offsets if os.path.exists(p))
+                    if continue_once:
+                        continue
+                return
+            # Pick up late-created log files.
+            for p in self.job_log_paths(handle, job_id):
+                offsets.setdefault(p, 0)
+            time.sleep(0.2)
+
+    # -- lifecycle ---------------------------------------------------------
+    def stop(self, handle: ClusterHandle) -> None:
+        provision.stop_instances(handle.provider, handle.cluster_name,
+                                 handle.zone)
+        state.set_cluster_status(handle.cluster_name,
+                                 state.ClusterStatus.STOPPED)
+
+    def start(self, cluster_name: str) -> ClusterHandle:
+        rec = state.get_cluster(cluster_name)
+        if rec is None:
+            raise exceptions.ClusterNotUpError(f"no cluster {cluster_name}")
+        handle = ClusterHandle(rec["handle"])
+        config = ProvisionConfig(
+            cluster_name=cluster_name,
+            num_nodes=handle["num_nodes"],
+            hosts_per_node=handle["hosts_per_node"],
+            zone=handle.zone, region=handle["region"])
+        provision.run_instances(handle.provider, config)
+        provision.wait_instances(handle.provider, cluster_name, handle.zone)
+        state.set_cluster_status(cluster_name, state.ClusterStatus.UP)
+        _spawn_skylet(cluster_name)
+        return handle
+
+    def teardown(self, handle: ClusterHandle) -> None:
+        provision.terminate_instances(handle.provider, handle.cluster_name,
+                                      handle.zone)
+        state.remove_cluster(handle.cluster_name)
+
+    def refresh_status(self, cluster_name: str) -> Optional[state.ClusterStatus]:
+        rec = state.get_cluster(cluster_name)
+        if rec is None:
+            return None
+        handle = ClusterHandle(rec["handle"])
+        raw = provision.query_instances(handle.provider, cluster_name,
+                                        handle.zone)
+        mapping = {
+            "UP": state.ClusterStatus.UP,
+            "STOPPED": state.ClusterStatus.STOPPED,
+        }
+        if raw == "NOT_FOUND":
+            state.remove_cluster(cluster_name)
+            return None
+        new = mapping.get(raw, state.ClusterStatus.INIT)
+        state.set_cluster_status(cluster_name, new)
+        return new

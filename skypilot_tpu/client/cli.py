@@ -1,0 +1,256 @@
+"""The `skytpu` CLI.
+
+Reference parity: sky/client/cli.py (launch/exec/status/stop/down/start/
+autostop/queue/logs/cancel/check/show-gpus/cost-report, cli.py:1006-5131).
+Invoke as ``python -m skypilot_tpu.client.cli`` (or the ``skytpu``
+console script once installed).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+import click
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+@click.group()
+@click.version_option(sky.__version__, prog_name="skytpu")
+def cli():
+    """skypilot_tpu: run tasks on TPU slices (and VMs) in the sky."""
+
+
+def _load_task(yaml_path: Optional[str], command: Optional[str],
+               accelerators: Optional[str], cloud: Optional[str],
+               num_nodes: Optional[int], use_spot: bool,
+               name: Optional[str]) -> Task:
+    if yaml_path:
+        task = Task.from_yaml(yaml_path)
+    else:
+        task = Task(run=command)
+    if name:
+        task.name = name
+    if num_nodes:
+        task.num_nodes = num_nodes
+    overrides = {}
+    if accelerators:
+        overrides["accelerators"] = accelerators
+    if cloud:
+        overrides["cloud"] = cloud
+    if use_spot:
+        overrides["use_spot"] = True
+    if overrides:
+        task.set_resources(task.resources[0].copy(**overrides))
+    return task
+
+
+@cli.command()
+@click.argument("yaml_or_command", required=False)
+@click.option("--cluster", "-c", default=None, help="Cluster name.")
+@click.option("--gpus", "--accelerators", "accelerators", default=None,
+              help="e.g. tpu-v5e-8, A100:8")
+@click.option("--cloud", default=None)
+@click.option("--num-nodes", type=int, default=None)
+@click.option("--use-spot", is_flag=True, default=False)
+@click.option("--name", "-n", default=None)
+@click.option("--retry-until-up", is_flag=True, default=False)
+@click.option("--idle-minutes-to-autostop", "-i", type=int, default=None)
+@click.option("--down", is_flag=True, default=False,
+              help="Tear down after the job finishes.")
+@click.option("--detach-run", "-d", is_flag=True, default=False)
+@click.option("--dryrun", is_flag=True, default=False)
+def launch(yaml_or_command, cluster, accelerators, cloud, num_nodes,
+           use_spot, name, retry_until_up, idle_minutes_to_autostop, down,
+           detach_run, dryrun):
+    """Launch a task (YAML file or inline command)."""
+    is_yaml = yaml_or_command and (
+        yaml_or_command.endswith((".yaml", ".yml"))
+        or os.path.exists(yaml_or_command))
+    task = _load_task(yaml_or_command if is_yaml else None,
+                      None if is_yaml else yaml_or_command,
+                      accelerators, cloud, num_nodes, use_spot, name)
+    job_id, handle = sky.launch(
+        task, cluster_name=cluster, retry_until_up=retry_until_up,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+        detach_run=True, dryrun=dryrun)
+    if dryrun:
+        return
+    click.echo(f"Launched job {job_id} on cluster "
+               f"{handle.cluster_name!r}.")
+    if not detach_run and job_id is not None:
+        sky.tail_logs(handle.cluster_name, job_id, follow=True)
+
+
+@cli.command(name="exec")
+@click.argument("cluster")
+@click.argument("yaml_or_command")
+@click.option("--name", "-n", default=None)
+@click.option("--detach-run", "-d", is_flag=True, default=False)
+def exec_cmd(cluster, yaml_or_command, name, detach_run):
+    """Run a task on an existing cluster (skips provisioning)."""
+    is_yaml = yaml_or_command.endswith((".yaml", ".yml")) or os.path.exists(
+        yaml_or_command)
+    task = _load_task(yaml_or_command if is_yaml else None,
+                      None if is_yaml else yaml_or_command,
+                      None, None, None, False, name)
+    job_id, handle = sky.exec(task, cluster_name=cluster)
+    click.echo(f"Job {job_id} submitted to {cluster!r}.")
+    if not detach_run:
+        sky.tail_logs(cluster, job_id, follow=True)
+
+
+@cli.command()
+@click.option("--refresh", "-r", is_flag=True, default=False)
+@click.argument("clusters", nargs=-1)
+def status(refresh, clusters):
+    """Show clusters."""
+    records = sky.status(list(clusters) or None, refresh=refresh)
+    if not records:
+        click.echo("No existing clusters.")
+        return
+    fmt = "{:<16}{:<10}{:<28}{:<8}{:>10}"
+    click.echo(fmt.format("NAME", "STATUS", "RESOURCES", "NODES", "$/HR"))
+    for r in records:
+        h = r["handle"]
+        res = h.get("resources", {})
+        desc = res.get("accelerators") or res.get("instance_type") or "-"
+        click.echo(fmt.format(
+            r["name"], r["status"].value,
+            f"{h.get('provider')}:{desc}@{h.get('zone')}",
+            h.get("num_nodes", 1), f"{r['price_per_hour']:.2f}"))
+
+
+@cli.command()
+@click.argument("cluster")
+def queue(cluster):
+    """Show the job queue of a cluster."""
+    jobs = sky.queue(cluster)
+    fmt = "{:<6}{:<18}{:<12}{:>10}"
+    click.echo(fmt.format("ID", "NAME", "STATUS", "DUR(S)"))
+    for j in jobs:
+        dur = (j["ended_at"] or __import__("time").time()) - \
+            (j["started_at"] or j["submitted_at"])
+        click.echo(fmt.format(j["job_id"], j["name"] or "-",
+                              j["status"].value, f"{dur:.1f}"))
+
+
+@cli.command()
+@click.argument("cluster")
+@click.argument("job_id", type=int, required=False)
+@click.option("--follow/--no-follow", default=True)
+def logs(cluster, job_id, follow):
+    """Tail job logs (all ranks, prefixed)."""
+    sky.tail_logs(cluster, job_id, follow=follow)
+
+
+@cli.command()
+@click.argument("cluster")
+@click.argument("job_ids", type=int, nargs=-1, required=True)
+def cancel(cluster, job_ids):
+    """Cancel job(s)."""
+    for jid in job_ids:
+        sky.cancel(cluster, jid)
+        click.echo(f"Cancelled job {jid}.")
+
+
+@cli.command()
+@click.argument("clusters", nargs=-1, required=True)
+def stop(clusters):
+    """Stop cluster(s) (restartable with `start`)."""
+    for c in clusters:
+        sky.stop(c)
+        click.echo(f"Stopped {c!r}.")
+
+
+@cli.command()
+@click.argument("clusters", nargs=-1, required=True)
+def start(clusters):
+    """Restart stopped cluster(s)."""
+    for c in clusters:
+        sky.start(c)
+        click.echo(f"Started {c!r}.")
+
+
+@cli.command()
+@click.argument("clusters", nargs=-1, required=True)
+@click.option("--purge", is_flag=True, default=False)
+def down(clusters, purge):
+    """Tear down cluster(s)."""
+    for c in clusters:
+        sky.down(c, purge=purge)
+        click.echo(f"Terminated {c!r}.")
+
+
+@cli.command()
+@click.argument("cluster")
+@click.option("--idle-minutes", "-i", type=int, required=True)
+@click.option("--down", "down_", is_flag=True, default=False)
+def autostop(cluster, idle_minutes, down_):
+    """Schedule autostop/autodown after idle minutes (-1 cancels)."""
+    sky.autostop(cluster, idle_minutes, down_)
+    click.echo(f"Autostop set on {cluster!r}: {idle_minutes} min"
+               f"{' (down)' if down_ else ''}.")
+
+
+@cli.command(name="show-gpus")
+@click.argument("name_filter", required=False)
+def show_gpus(name_filter):
+    """List accelerators (TPU slices and GPUs) with prices."""
+    from skypilot_tpu.catalog import catalog
+    df = catalog.list_accelerators(name_filter)
+    seen = set()
+    fmt = "{:<16}{:<8}{:<8}{:>10}{:>12}  {}"
+    click.echo(fmt.format("ACCELERATOR", "CHIPS", "HOSTS", "$/HR",
+                          "SPOT $/HR", "REGIONS"))
+    for _, row in df.iterrows():
+        key = row["accelerator"]
+        if key in seen:
+            continue
+        seen.add(key)
+        sub = df[df["accelerator"] == key]
+        regions = sorted(sub["region"].unique())
+        click.echo(fmt.format(
+            key, row["chips"] or row["accelerator_count"], row["hosts"],
+            f"{sub['price'].min():.2f}", f"{sub['spot_price'].min():.2f}",
+            ",".join(regions[:3]) + ("…" if len(regions) > 3 else "")))
+
+
+@cli.command()
+def check():
+    """Check cloud access (local always; gcp if credentials present)."""
+    from skypilot_tpu.provision import gcp_auth
+    click.echo("  local: enabled")
+    ok, why = gcp_auth.check_credentials()
+    click.echo(f"  gcp: {'enabled' if ok else f'disabled ({why})'}")
+
+
+@cli.command(name="cost-report")
+def cost_report():
+    """Show accumulated cost of terminated clusters."""
+    rows = sky.cost_report()
+    if not rows:
+        click.echo("No cost history.")
+        return
+    fmt = "{:<16}{:>12}{:>10}"
+    click.echo(fmt.format("NAME", "DUR(MIN)", "COST($)"))
+    for r in rows:
+        click.echo(fmt.format(r["name"], f"{r['duration_s']/60:.1f}",
+                              f"{r['cost']:.2f}"))
+
+
+def main():
+    try:
+        cli()
+    except exceptions.SkyTpuError as e:
+        click.echo(f"Error: {e}", err=True)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

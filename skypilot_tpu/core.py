@@ -1,0 +1,89 @@
+"""Server-side implementations of non-launch operations.
+
+Reference parity: sky/core.py (status:48, start:349, down:421, stop:456,
+autostop:516, queue:625, cancel:688, tail_logs:783, storage_ls:910).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
+
+
+def _handle(cluster_name: str) -> ClusterHandle:
+    rec = state.get_cluster(cluster_name)
+    if rec is None:
+        raise exceptions.ClusterNotUpError(
+            f"cluster {cluster_name!r} not found")
+    return ClusterHandle(rec["handle"])
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    backend = TpuVmBackend()
+    records = state.list_clusters()
+    if cluster_names:
+        records = [r for r in records if r["name"] in cluster_names]
+    if refresh:
+        for r in records:
+            backend.refresh_status(r["name"])
+        records = [r2 for r in records
+                   if (r2 := state.get_cluster(r["name"])) is not None]
+    return records
+
+
+def start(cluster_name: str) -> None:
+    TpuVmBackend().start(cluster_name)
+
+
+def stop(cluster_name: str) -> None:
+    TpuVmBackend().stop(_handle(cluster_name))
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    try:
+        TpuVmBackend().teardown(_handle(cluster_name))
+    except exceptions.ClusterNotUpError:
+        if not purge:
+            raise
+        state.remove_cluster(cluster_name)
+
+
+def autostop(cluster_name: str, idle_minutes: int, down_: bool = False) -> None:
+    _handle(cluster_name)  # existence check
+    state.set_autostop(cluster_name, idle_minutes, down_)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    return TpuVmBackend().queue(_handle(cluster_name))
+
+
+def cancel(cluster_name: str, job_id: int) -> None:
+    TpuVmBackend().cancel(_handle(cluster_name), job_id)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = False, out=None) -> None:
+    backend = TpuVmBackend()
+    handle = _handle(cluster_name)
+    if job_id is None:
+        jobs = backend.queue(handle)
+        if not jobs:
+            raise exceptions.JobNotFoundError("no jobs on cluster")
+        job_id = jobs[0]["job_id"]
+    backend.tail_logs(handle, job_id, follow=follow, out=out)
+
+
+def job_status(cluster_name: str, job_id: int):
+    jobs = TpuVmBackend().queue(_handle(cluster_name))
+    for j in jobs:
+        if j["job_id"] == job_id:
+            return j["status"]
+    raise exceptions.JobNotFoundError(f"no job {job_id} on {cluster_name}")
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    return state.cost_report()

@@ -1,0 +1,16 @@
+"""Bucket storage (GCS-first). COPY/MOUNT lifecycle lands with the data
+layer milestone; this module currently carries the backend-facing hook.
+
+Reference parity target: sky/data/storage.py (Storage:468, GcsStore:1786)
++ mounting_utils (gcsfuse).
+"""
+
+from __future__ import annotations
+
+from skypilot_tpu import exceptions
+
+
+def mount_or_copy(handle, dst: str, src: str) -> None:
+    raise exceptions.StorageError(
+        f"bucket file mounts ({src} -> {dst}) require the storage layer; "
+        f"not yet available in this build")

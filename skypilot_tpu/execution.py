@@ -1,0 +1,93 @@
+"""The launch/exec stage machine.
+
+Reference parity: sky/execution.py (Stage enum :35, _execute:99 —
+OPTIMIZE -> PROVISION -> SYNC_WORKDIR -> SYNC_FILE_MOUNTS -> SETUP ->
+PRE_EXEC -> EXEC -> DOWN). Setup is folded into the job script (the
+reference's detached-setup default), and PRE_EXEC autostop wiring is a
+state-DB write consumed by the autostop event loop.
+"""
+
+from __future__ import annotations
+
+import enum
+import uuid
+from typing import Optional, Tuple
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
+from skypilot_tpu.task import Task
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = "OPTIMIZE"
+    PROVISION = "PROVISION"
+    SYNC_WORKDIR = "SYNC_WORKDIR"
+    SYNC_FILE_MOUNTS = "SYNC_FILE_MOUNTS"
+    PRE_EXEC = "PRE_EXEC"
+    EXEC = "EXEC"
+    DOWN = "DOWN"
+
+
+def _generate_cluster_name() -> str:
+    return f"sky-{uuid.uuid4().hex[:6]}"
+
+
+def launch(task: Task,
+           cluster_name: Optional[str] = None,
+           retry_until_up: bool = False,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False,
+           detach_run: bool = True,
+           dryrun: bool = False) -> Tuple[Optional[int], Optional[ClusterHandle]]:
+    """Provision (or reuse) a cluster and run the task on it."""
+    cluster_name = cluster_name or _generate_cluster_name()
+    backend = TpuVmBackend()
+
+    if dryrun:
+        from skypilot_tpu import optimizer
+        launchable = optimizer.optimize_task(task)
+        print(f"Dryrun: would launch {cluster_name} with {launchable}")
+        return None, None
+
+    handle = backend.provision(task, cluster_name,
+                               retry_until_up=retry_until_up)
+
+    if task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    if task.file_mounts:
+        backend.sync_file_mounts(handle, task.file_mounts)
+
+    if idle_minutes_to_autostop is not None:
+        state.set_autostop(cluster_name, idle_minutes_to_autostop, down)
+
+    job_id = None
+    if task.run is not None or task.setup is not None:
+        job_id = backend.execute(handle, task, detach_run=detach_run)
+
+    if down and idle_minutes_to_autostop is None:
+        if job_id is not None:
+            # No deadline: --down must tear down after the job however
+            # long it runs.
+            backend.wait_job(handle, job_id, timeout=float("inf"))
+        backend.teardown(handle)
+    return job_id, handle
+
+
+def exec(task: Task,  # noqa: A001 — mirrors the public API name
+         cluster_name: str,
+         detach_run: bool = True) -> Tuple[int, ClusterHandle]:
+    """Run a task on an existing cluster, skipping provisioning."""
+    rec = state.get_cluster(cluster_name)
+    if rec is None:
+        raise exceptions.ClusterNotUpError(
+            f"cluster {cluster_name!r} does not exist; use launch")
+    if rec["status"] != state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f"cluster {cluster_name!r} is {rec['status'].value}")
+    backend = TpuVmBackend()
+    handle = ClusterHandle(rec["handle"])
+    backend.check_resources_fit(task, handle)
+    if task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    job_id = backend.execute(handle, task, detach_run=detach_run)
+    return job_id, handle

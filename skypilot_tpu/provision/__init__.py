@@ -1,0 +1,57 @@
+"""Uniform functional provision API, dispatched per provider.
+
+Reference parity: sky/provision/__init__.py (_route_to_cloud_impl:38 —
+``run_instances(provider, ...)`` resolves to
+``sky.provision.<provider>.<fn>``). Same shape here: every provider
+module exports the same function set; the backend never imports a
+provider directly.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
+                                           ProvisionConfig, ProvisionRecord)
+
+_PROVIDERS = {}
+
+
+def _impl(provider: str):
+    if provider not in _PROVIDERS:
+        _PROVIDERS[provider] = importlib.import_module(
+            f"skypilot_tpu.provision.{provider}")
+    return _PROVIDERS[provider]
+
+
+def run_instances(provider: str, config: ProvisionConfig) -> ProvisionRecord:
+    """Create (or resume) the cluster's instances. Idempotent."""
+    return _impl(provider).run_instances(config)
+
+
+def stop_instances(provider: str, cluster_name: str, zone: str) -> None:
+    return _impl(provider).stop_instances(cluster_name, zone)
+
+
+def terminate_instances(provider: str, cluster_name: str, zone: str) -> None:
+    return _impl(provider).terminate_instances(cluster_name, zone)
+
+
+def query_instances(provider: str, cluster_name: str, zone: str) -> str:
+    """'UP' | 'STOPPED' | 'PARTIAL' | 'NOT_FOUND' (cloud ground truth)."""
+    return _impl(provider).query_instances(cluster_name, zone)
+
+
+def wait_instances(provider: str, cluster_name: str, zone: str,
+                   timeout: float = 600) -> None:
+    return _impl(provider).wait_instances(cluster_name, zone, timeout)
+
+
+def get_cluster_info(provider: str, cluster_name: str,
+                     zone: str) -> ClusterInfo:
+    return _impl(provider).get_cluster_info(cluster_name, zone)
+
+
+def get_command_runners(info: ClusterInfo) -> list:
+    return _impl(info.provider).get_command_runners(info)

@@ -1,0 +1,66 @@
+"""Shared provision-layer types. Reference parity: sky/provision/common.py
+(ProvisionConfig/ProvisionRecord/ClusterInfo)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class HostInfo:
+    """One physical host (a TPU slice worker VM or a GPU/CPU VM)."""
+    host_id: int          # global host index across the cluster
+    node_id: int          # logical node (slice) this host belongs to
+    worker_id: int        # index within the slice (TPU_WORKER_ID)
+    internal_ip: str
+    external_ip: Optional[str] = None
+    ssh_user: Optional[str] = None
+    ssh_port: int = 22
+    workspace: Optional[str] = None   # local provider: host directory
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    cluster_name: str
+    provider: str                      # "local" | "gcp"
+    zone: str
+    hosts: List[HostInfo] = dataclasses.field(default_factory=list)
+    ssh_key_path: Optional[str] = None
+    metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def head(self) -> HostInfo:
+        return self.hosts[0]
+
+    def hosts_of_node(self, node_id: int) -> List[HostInfo]:
+        return [h for h in self.hosts if h.node_id == node_id]
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a provider needs to create instances."""
+    cluster_name: str
+    num_nodes: int                     # logical nodes (slices)
+    hosts_per_node: int
+    zone: str
+    region: str
+    accelerator: Optional[str] = None  # "tpu-v5e-16" | "A100"
+    accelerator_count: int = 0
+    instance_type: Optional[str] = None
+    use_spot: bool = False
+    runtime_version: Optional[str] = None
+    disk_size: int = 256
+    image_id: Optional[str] = None
+    ports: Optional[List[int]] = None
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    user_data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    provider: str
+    cluster_name: str
+    zone: str
+    created_instance_ids: List[str] = dataclasses.field(default_factory=list)
+    resumed: bool = False

@@ -1,0 +1,72 @@
+"""GCP credential discovery (no network).
+
+Reference parity: sky/check.py + sky/clouds/gcp.py check_credentials —
+validates local credentials and caches enabled clouds. Token acquisition
+for REST calls lives here so provision/gcp.py stays transport-only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from typing import Optional, Tuple
+
+ADC_PATH = "~/.config/gcloud/application_default_credentials.json"
+
+
+def check_credentials() -> Tuple[bool, str]:
+    """(enabled, reason). Enabled iff ADC or gcloud auth is present."""
+    if os.environ.get("GOOGLE_APPLICATION_CREDENTIALS"):
+        p = os.environ["GOOGLE_APPLICATION_CREDENTIALS"]
+        if os.path.exists(p):
+            return True, "GOOGLE_APPLICATION_CREDENTIALS"
+        return False, f"GOOGLE_APPLICATION_CREDENTIALS points to missing {p}"
+    if os.path.exists(os.path.expanduser(ADC_PATH)):
+        return True, "application-default credentials"
+    if shutil.which("gcloud"):
+        try:
+            out = subprocess.run(
+                ["gcloud", "auth", "list", "--format=json"],
+                capture_output=True, text=True, timeout=10)
+            if out.returncode == 0 and json.loads(out.stdout or "[]"):
+                return True, "gcloud auth"
+        except Exception:  # noqa: BLE001
+            pass
+    return False, "no application-default credentials or gcloud auth"
+
+
+def get_project() -> Optional[str]:
+    for env in ("GOOGLE_CLOUD_PROJECT", "GCP_PROJECT", "CLOUDSDK_CORE_PROJECT"):
+        if os.environ.get(env):
+            return os.environ[env]
+    adc = os.path.expanduser(ADC_PATH)
+    if os.path.exists(adc):
+        with open(adc) as f:
+            data = json.load(f)
+        if data.get("quota_project_id"):
+            return data["quota_project_id"]
+    if shutil.which("gcloud"):
+        try:
+            out = subprocess.run(
+                ["gcloud", "config", "get-value", "project"],
+                capture_output=True, text=True, timeout=10)
+            proj = out.stdout.strip()
+            if out.returncode == 0 and proj and proj != "(unset)":
+                return proj
+        except Exception:  # noqa: BLE001
+            pass
+    return None
+
+
+def get_access_token() -> str:
+    """Access token for REST calls, via gcloud (no SDK dependency)."""
+    if shutil.which("gcloud"):
+        out = subprocess.run(["gcloud", "auth", "print-access-token"],
+                             capture_output=True, text=True, timeout=30)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    raise RuntimeError(
+        "cannot obtain GCP access token: gcloud not available or not "
+        "authenticated")

@@ -1,0 +1,128 @@
+"""Local fake cloud: hosts are directories, instances are metadata files.
+
+Serves two purposes, mirroring the reference's offline-test strategy
+(reference: tests/common_test_fixtures.py + LocalDockerBackend):
+
+1. Offline end-to-end tests — launch/exec/logs/cancel/down run for real
+   on one machine, with a multi-"host" cluster simulated as one
+   workspace directory per host.
+2. Fault injection for the failover loop — a ``fail_marker`` file in the
+   cluster dir (or SKYTPU_LOCAL_FAIL_ATTEMPTS env) makes the next N
+   ``run_instances`` calls raise CapacityError, exercising
+   blocklist/re-optimize/retry paths without a cloud.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import List
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
+                                           ProvisionConfig, ProvisionRecord)
+from skypilot_tpu.utils import command_runner, paths
+
+_META = "local_meta.json"
+
+
+def _cluster_root(cluster_name: str) -> str:
+    return os.path.join(paths.home(), "local_clusters", cluster_name)
+
+
+def _meta_path(cluster_name: str) -> str:
+    return os.path.join(_cluster_root(cluster_name), _META)
+
+
+def _maybe_inject_failure(config: ProvisionConfig) -> None:
+    # Env-based: fail the next N attempts globally (counter in a file).
+    n = int(os.environ.get("SKYTPU_LOCAL_FAIL_ATTEMPTS", "0"))
+    if n > 0:
+        counter_file = os.path.join(paths.home(), "local_fail_counter")
+        used = 0
+        if os.path.exists(counter_file):
+            used = int(open(counter_file).read().strip() or 0)
+        if used < n:
+            with open(counter_file, "w") as f:
+                f.write(str(used + 1))
+            raise exceptions.CapacityError(
+                f"[fault-injection] no capacity for {config.accelerator} "
+                f"in {config.zone} (attempt {used + 1}/{n})")
+
+
+def run_instances(config: ProvisionConfig) -> ProvisionRecord:
+    _maybe_inject_failure(config)
+    root = _cluster_root(config.cluster_name)
+    n_hosts = config.num_nodes * config.hosts_per_node
+    ids = []
+    for h in range(n_hosts):
+        ws = os.path.join(root, f"host{h}")
+        os.makedirs(ws, exist_ok=True)
+        ids.append(f"local-{config.cluster_name}-{h}")
+    meta = {
+        "zone": config.zone,
+        "num_nodes": config.num_nodes,
+        "hosts_per_node": config.hosts_per_node,
+        "status": "UP",
+        "instance_ids": ids,
+    }
+    with open(_meta_path(config.cluster_name), "w") as f:
+        json.dump(meta, f)
+    return ProvisionRecord(provider="local",
+                           cluster_name=config.cluster_name,
+                           zone=config.zone, created_instance_ids=ids)
+
+
+def _load_meta(cluster_name: str) -> dict:
+    p = _meta_path(cluster_name)
+    if not os.path.exists(p):
+        raise exceptions.ClusterNotUpError(
+            f"local cluster {cluster_name!r} not found")
+    with open(p) as f:
+        return json.load(f)
+
+
+def stop_instances(cluster_name: str, zone: str) -> None:
+    meta = _load_meta(cluster_name)
+    meta["status"] = "STOPPED"
+    with open(_meta_path(cluster_name), "w") as f:
+        json.dump(meta, f)
+
+
+def terminate_instances(cluster_name: str, zone: str) -> None:
+    shutil.rmtree(_cluster_root(cluster_name), ignore_errors=True)
+
+
+def query_instances(cluster_name: str, zone: str) -> str:
+    try:
+        return _load_meta(cluster_name)["status"]
+    except exceptions.ClusterNotUpError:
+        return "NOT_FOUND"
+
+
+def wait_instances(cluster_name: str, zone: str, timeout: float = 600) -> None:
+    _load_meta(cluster_name)  # local instances are ready instantly
+
+
+def get_cluster_info(cluster_name: str, zone: str) -> ClusterInfo:
+    meta = _load_meta(cluster_name)
+    meta_status = meta.get("status")
+    if meta_status == "STOPPED":
+        # Resuming a stopped local cluster is a run_instances away; info
+        # still describes the (stopped) topology.
+        pass
+    hosts: List[HostInfo] = []
+    hpn = meta["hosts_per_node"]
+    for h in range(meta["num_nodes"] * hpn):
+        hosts.append(HostInfo(
+            host_id=h, node_id=h // hpn, worker_id=h % hpn,
+            internal_ip="127.0.0.1",
+            workspace=os.path.join(_cluster_root(cluster_name), f"host{h}")))
+    return ClusterInfo(cluster_name=cluster_name, provider="local",
+                       zone=meta["zone"], hosts=hosts)
+
+
+def get_command_runners(info: ClusterInfo) -> List[command_runner.CommandRunner]:
+    return [command_runner.LocalRunner(h.host_id, h.internal_ip, h.workspace)
+            for h in info.hosts]

@@ -1,0 +1,30 @@
+"""The env contract injected into every job process.
+
+Reference parity: the torchrun-oriented contract at
+sky/skylet/constants.py:319-322 (SKYPILOT_NODE_RANK / NODE_IPS /
+NUM_NODES / NUM_GPUS_PER_NODE). TPU-native replacement: the variables a
+JAX program needs for ``jax.distributed.initialize`` — coordinator
+address, process count, process id — are injected directly, so user code
+can simply call ``jax.distributed.initialize()`` with no arguments.
+"""
+
+# Framework-level contract (node = logical node; host = slice worker VM).
+ENV_NODE_RANK = "SKYTPU_NODE_RANK"
+ENV_NODE_IPS = "SKYTPU_NODE_IPS"          # newline-separated head IPs
+ENV_NUM_NODES = "SKYTPU_NUM_NODES"
+ENV_HOST_ID = "SKYTPU_HOST_ID"            # global host index
+ENV_NUM_HOSTS = "SKYTPU_NUM_HOSTS"
+ENV_WORKER_ID = "SKYTPU_WORKER_ID"        # index within the slice
+ENV_CLUSTER = "SKYTPU_CLUSTER_NAME"
+ENV_JOB_ID = "SKYTPU_INTERNAL_JOB_ID"
+
+# jax.distributed contract — read natively by jax.distributed.initialize.
+ENV_COORDINATOR = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "JAX_PROCESS_ID"
+
+COORDINATOR_PORT = 8476
+
+JOB_DB = "jobs.db"            # per-cluster job queue (head host)
+RUN_SCRIPT = "job_{job_id}.sh"
+LOG_DIR = "job_{job_id}"      # per-job log dir, rank-<host>.log inside

@@ -1,0 +1,161 @@
+"""Gang-execution driver: runs one job across all hosts of a cluster.
+
+TPU-native replacement for the reference's generated Ray driver program
+(reference: sky/backends/cloud_vm_ray_backend.py:225-714 — RayCodeGen
+emits a per-job python file that gang-schedules via a STRICT_SPREAD
+placement group). A TPU slice is *already* a gang: every host must run
+the same program simultaneously, so no placement-group machinery is
+needed — the driver simply
+
+  1. starts the job script on every host (detached, own process group),
+     with the rank/coordinator env contract injected,
+  2. polls per-host rc files,
+  3. on any nonzero rc kills all other hosts (fail-one-kill-all — the
+     gang semantics of get_or_fail at reference :318-355),
+  4. records the final JobStatus in the cluster job queue.
+
+One driver process per job, spawned detached by the backend (the role
+the skylet FIFOScheduler plays at reference sky/skylet/job_lib.py:276).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import sys
+import time
+from typing import Dict, List
+
+from skypilot_tpu import provision
+from skypilot_tpu.runtime import constants, job_queue
+
+
+def _load_cluster_meta(cluster_dir: str) -> dict:
+    with open(os.path.join(cluster_dir, "cluster.json")) as f:
+        return json.load(f)
+
+
+def build_job_env(cluster_name: str, job_id: int, info,
+                  host) -> Dict[str, str]:
+    """The full injected env for one host's job process."""
+    node_heads = {}
+    for h in info.hosts:
+        node_heads.setdefault(h.node_id, h.internal_ip)
+    node_ips = [node_heads[n] for n in sorted(node_heads)]
+    coordinator = f"{info.hosts[0].internal_ip}:{constants.COORDINATOR_PORT}"
+    return {
+        constants.ENV_CLUSTER: cluster_name,
+        constants.ENV_JOB_ID: str(job_id),
+        constants.ENV_NODE_RANK: str(host.node_id),
+        constants.ENV_NUM_NODES: str(len(node_ips)),
+        constants.ENV_NODE_IPS: "\n".join(node_ips),
+        constants.ENV_HOST_ID: str(host.host_id),
+        constants.ENV_NUM_HOSTS: str(len(info.hosts)),
+        constants.ENV_WORKER_ID: str(host.worker_id),
+        constants.ENV_COORDINATOR: coordinator,
+        constants.ENV_NUM_PROCESSES: str(len(info.hosts)),
+        constants.ENV_PROCESS_ID: str(host.host_id),
+    }
+
+
+def run_job(cluster_dir: str, job_id: int, poll_interval: float = 0.2) -> int:
+    meta = _load_cluster_meta(cluster_dir)
+    db = os.path.join(cluster_dir, constants.JOB_DB)
+    job = job_queue.get_job(db, job_id)
+    if job is None:
+        print(f"job {job_id} not found", file=sys.stderr)
+        return 1
+    if job["status"] == job_queue.JobStatus.CANCELLED:
+        return 0
+
+    # FIFO gate (the reference's skylet FIFOScheduler role, job_lib.py:276):
+    # proceed only when nothing is active and this job is the oldest
+    # pending. Only the driver whose id matches next_pending advances, so
+    # concurrent drivers serialize — one job at a time on the slice.
+    while True:
+        nxt = job_queue.next_pending(db)
+        if nxt is not None and nxt["job_id"] == job_id:
+            break
+        cur = job_queue.get_job(db, job_id)
+        if cur is None or cur["status"] != job_queue.JobStatus.PENDING:
+            return 0  # cancelled (or externally transitioned) while queued
+        time.sleep(poll_interval)
+
+    info = provision.get_cluster_info(meta["provider"], meta["cluster_name"],
+                                      meta["zone"])
+    runners = provision.get_command_runners(info)
+    log_dir = os.path.join(cluster_dir, "logs",
+                           constants.LOG_DIR.format(job_id=job_id))
+    os.makedirs(log_dir, exist_ok=True)
+    rc_dir = os.path.join(log_dir, "rc")
+    os.makedirs(rc_dir, exist_ok=True)
+
+    job_queue.set_status(db, job_id, job_queue.JobStatus.RUNNING)
+
+    pids: List[int] = []
+    started = []  # (runner, pid) pairs for gang-kill
+    try:
+        for host, runner in zip(info.hosts, runners):
+            env = build_job_env(meta["cluster_name"], job_id, info, host)
+            rc_file = os.path.join(rc_dir, f"{host.host_id}")
+            # Wrap: run the script, then record its rc atomically.
+            wrapped = (f"{job['run_cmd']}; rc=$?; "
+                       f"echo $rc > {shlex.quote(rc_file + '.tmp')} && "
+                       f"mv {shlex.quote(rc_file + '.tmp')} "
+                       f"{shlex.quote(rc_file)}; exit $rc")
+            log_path = os.path.join(log_dir, f"rank-{host.host_id}.log")
+            pid = runner.run_detached(wrapped, env=env, cwd=host.workspace,
+                                      log_path=log_path)
+            pids.append(pid)
+            started.append((runner, pid))
+        job_queue.set_pids(db, job_id, pids)
+
+        # Poll rc files; fail-one-kill-all.
+        done: Dict[int, int] = {}
+        while len(done) < len(info.hosts):
+            for host in info.hosts:
+                if host.host_id in done:
+                    continue
+                rc_file = os.path.join(rc_dir, f"{host.host_id}")
+                if os.path.exists(rc_file):
+                    with open(rc_file) as f:
+                        done[host.host_id] = int(f.read().strip() or 1)
+            cur = job_queue.get_job(db, job_id)
+            if cur and cur["status"] == job_queue.JobStatus.CANCELLED:
+                _kill_all(started)
+                return 0
+            if any(rc != 0 for rc in done.values()):
+                break
+            time.sleep(poll_interval)
+
+        failed = [h for h, rc in done.items() if rc != 0]
+        if failed:
+            _kill_all(started)
+            job_queue.set_status(db, job_id, job_queue.JobStatus.FAILED)
+            return 1
+        job_queue.set_status(db, job_id, job_queue.JobStatus.SUCCEEDED)
+        return 0
+    except Exception as e:  # noqa: BLE001 — driver must record failure
+        print(f"driver error: {e}", file=sys.stderr)
+        _kill_all(started)
+        job_queue.set_status(db, job_id, job_queue.JobStatus.FAILED)
+        return 1
+
+
+def _kill_all(started) -> None:
+    for runner, pid in started:
+        runner.kill(pid)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cluster-dir", required=True)
+    ap.add_argument("--job-id", type=int, required=True)
+    args = ap.parse_args()
+    sys.exit(run_job(args.cluster_dir, args.job_id))
+
+
+if __name__ == "__main__":
+    main()

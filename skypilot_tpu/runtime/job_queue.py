@@ -1,0 +1,159 @@
+"""Per-cluster job queue (sqlite), FIFO-scheduled.
+
+Reference parity: sky/skylet/job_lib.py (JobStatus :121, FIFOScheduler
+:276, sqlite jobs.db). Differences: no codegen-over-SSH RPC — the
+client talks to this module through the backend's typed calls, and the
+DB lives in the cluster dir (local provider) or on the head host (gcp),
+accessed via the command runner.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus(enum.Enum):
+    INIT = "INIT"
+    PENDING = "PENDING"
+    SETTING_UP = "SETTING_UP"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    FAILED_SETUP = "FAILED_SETUP"
+    CANCELLED = "CANCELLED"
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                        JobStatus.FAILED_SETUP, JobStatus.CANCELLED)
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT,
+    submitted_at REAL,
+    started_at REAL,
+    ended_at REAL,
+    status TEXT,
+    run_cmd TEXT,
+    metadata TEXT,
+    pids TEXT
+);
+"""
+
+
+@contextlib.contextmanager
+def _db(db_path: str):
+    os.makedirs(os.path.dirname(db_path), exist_ok=True)
+    conn = sqlite3.connect(db_path, timeout=10)
+    conn.executescript(_SCHEMA)
+    try:
+        yield conn
+        conn.commit()
+    finally:
+        conn.close()
+
+
+def add_job(db_path: str, name: Optional[str], run_cmd: str,
+            metadata: Optional[Dict[str, Any]] = None) -> int:
+    with _db(db_path) as c:
+        cur = c.execute(
+            "INSERT INTO jobs (name, submitted_at, status, run_cmd, metadata)"
+            " VALUES (?,?,?,?,?)",
+            (name, time.time(), JobStatus.PENDING.value, run_cmd,
+             json.dumps(metadata or {})))
+        return int(cur.lastrowid)
+
+
+def set_status(db_path: str, job_id: int, status: JobStatus) -> None:
+    now = time.time()
+    with _db(db_path) as c:
+        if status == JobStatus.RUNNING:
+            c.execute("UPDATE jobs SET status=?, started_at=? WHERE job_id=?",
+                      (status.value, now, job_id))
+        elif status.is_terminal():
+            c.execute("UPDATE jobs SET status=?, ended_at=? WHERE job_id=?",
+                      (status.value, now, job_id))
+        else:
+            c.execute("UPDATE jobs SET status=? WHERE job_id=?",
+                      (status.value, job_id))
+
+
+def set_run_cmd(db_path: str, job_id: int, run_cmd: str) -> None:
+    with _db(db_path) as c:
+        c.execute("UPDATE jobs SET run_cmd=? WHERE job_id=?",
+                  (run_cmd, job_id))
+
+
+def set_pids(db_path: str, job_id: int, pids: List[int]) -> None:
+    with _db(db_path) as c:
+        c.execute("UPDATE jobs SET pids=? WHERE job_id=?",
+                  (json.dumps(pids), job_id))
+
+
+def get_job(db_path: str, job_id: int) -> Optional[Dict[str, Any]]:
+    with _db(db_path) as c:
+        row = c.execute(
+            "SELECT job_id, name, submitted_at, started_at, ended_at, status,"
+            " run_cmd, metadata, pids FROM jobs WHERE job_id=?",
+            (job_id,)).fetchone()
+    return _to_rec(row) if row else None
+
+
+def list_jobs(db_path: str) -> List[Dict[str, Any]]:
+    with _db(db_path) as c:
+        rows = c.execute(
+            "SELECT job_id, name, submitted_at, started_at, ended_at, status,"
+            " run_cmd, metadata, pids FROM jobs ORDER BY job_id DESC"
+        ).fetchall()
+    return [_to_rec(r) for r in rows]
+
+
+def next_pending(db_path: str) -> Optional[Dict[str, Any]]:
+    """FIFO: oldest PENDING job, only if nothing is currently active."""
+    with _db(db_path) as c:
+        active = c.execute(
+            "SELECT COUNT(*) FROM jobs WHERE status IN (?,?)",
+            (JobStatus.RUNNING.value, JobStatus.SETTING_UP.value)).fetchone()[0]
+        if active:
+            return None
+        row = c.execute(
+            "SELECT job_id, name, submitted_at, started_at, ended_at, status,"
+            " run_cmd, metadata, pids FROM jobs WHERE status=?"
+            " ORDER BY job_id ASC LIMIT 1",
+            (JobStatus.PENDING.value,)).fetchone()
+    return _to_rec(row) if row else None
+
+
+def is_idle(db_path: str) -> bool:
+    with _db(db_path) as c:
+        n = c.execute(
+            "SELECT COUNT(*) FROM jobs WHERE status IN (?,?,?)",
+            (JobStatus.PENDING.value, JobStatus.SETTING_UP.value,
+             JobStatus.RUNNING.value)).fetchone()[0]
+    return n == 0
+
+
+def last_activity_time(db_path: str) -> float:
+    with _db(db_path) as c:
+        row = c.execute(
+            "SELECT MAX(COALESCE(ended_at, started_at, submitted_at))"
+            " FROM jobs").fetchone()
+    return float(row[0]) if row and row[0] else 0.0
+
+
+def _to_rec(row) -> Dict[str, Any]:
+    (job_id, name, sub, start, end, status, run_cmd, meta, pids) = row
+    return {
+        "job_id": job_id, "name": name, "submitted_at": sub,
+        "started_at": start, "ended_at": end,
+        "status": JobStatus(status), "run_cmd": run_cmd,
+        "metadata": json.loads(meta or "{}"),
+        "pids": json.loads(pids) if pids else [],
+    }

@@ -1,0 +1,59 @@
+"""Per-cluster skylet daemon: the autostop event loop.
+
+Reference parity: sky/skylet/skylet.py + events.py (AutostopEvent :102 —
+idle-minutes tracking, invoking stop/down from the cluster itself).
+Spawned detached by the backend at provision/start time, one per
+cluster; exits when the cluster record disappears or stops.
+
+Currently runs client-side next to the state DB (correct for the local
+provider and for client-managed GCP clusters); moving it onto the head
+host alongside a synced config is the multi-host hardening step tracked
+for the GCP runtime milestone.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def run(cluster_name: str, poll_interval: float) -> int:
+    from skypilot_tpu import core, state
+    from skypilot_tpu.runtime import constants, job_queue
+    from skypilot_tpu.utils import paths
+
+    while True:
+        rec = state.get_cluster(cluster_name)
+        if rec is None or rec["status"] != state.ClusterStatus.UP:
+            return 0
+        idle_minutes = rec["autostop_minutes"]
+        if idle_minutes is not None and idle_minutes >= 0:
+            db = os.path.join(paths.cluster_dir(cluster_name),
+                              constants.JOB_DB)
+            last = max(job_queue.last_activity_time(db), rec["launched_at"])
+            if job_queue.is_idle(db) and \
+                    time.time() - last > idle_minutes * 60:
+                try:
+                    if rec["autostop_down"]:
+                        core.down(cluster_name)
+                    else:
+                        core.stop(cluster_name)
+                except Exception as e:  # noqa: BLE001
+                    print(f"autostop failed: {e}", file=sys.stderr)
+                return 0
+        time.sleep(poll_interval)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cluster-name", required=True)
+    ap.add_argument("--poll-interval", type=float,
+                    default=float(os.environ.get("SKYTPU_SKYLET_POLL", "10")))
+    args = ap.parse_args()
+    sys.exit(run(args.cluster_name, args.poll_interval))
+
+
+if __name__ == "__main__":
+    main()

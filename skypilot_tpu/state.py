@@ -1,0 +1,138 @@
+"""Cluster state DB (sqlite). Reference parity: sky/global_user_state.py
+(clusters table :56, cluster_history :88). The handle is JSON, not a
+pickle — the reference pickles ResourceHandle objects into sqlite, which
+makes schema evolution painful; JSON keeps it greppable and versioned."""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+
+class ClusterStatus(enum.Enum):
+    INIT = "INIT"
+    UP = "UP"
+    STOPPED = "STOPPED"
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS clusters (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    handle TEXT,
+    status TEXT,
+    autostop_minutes INTEGER DEFAULT -1,
+    autostop_down INTEGER DEFAULT 0,
+    price_per_hour REAL DEFAULT 0
+);
+CREATE TABLE IF NOT EXISTS cluster_history (
+    name TEXT,
+    launched_at INTEGER,
+    duration_s REAL,
+    price_per_hour REAL,
+    resources TEXT,
+    num_nodes INTEGER
+);
+CREATE TABLE IF NOT EXISTS storage (
+    name TEXT PRIMARY KEY,
+    handle TEXT,
+    created_at INTEGER
+);
+"""
+
+
+@contextlib.contextmanager
+def _db():
+    conn = sqlite3.connect(paths.state_db(), timeout=10)
+    conn.executescript(_SCHEMA)
+    try:
+        yield conn
+        conn.commit()
+    finally:
+        conn.close()
+
+
+def set_cluster(name: str, handle: Dict[str, Any], status: ClusterStatus,
+                price_per_hour: float = 0.0) -> None:
+    with _db() as c:
+        c.execute(
+            "INSERT INTO clusters (name, launched_at, handle, status,"
+            " price_per_hour) VALUES (?,?,?,?,?) ON CONFLICT(name) DO UPDATE"
+            " SET handle=excluded.handle, status=excluded.status,"
+            " price_per_hour=excluded.price_per_hour",
+            (name, int(time.time()), json.dumps(handle), status.value,
+             price_per_hour))
+
+
+def set_cluster_status(name: str, status: ClusterStatus) -> None:
+    with _db() as c:
+        c.execute("UPDATE clusters SET status=? WHERE name=?",
+                  (status.value, name))
+
+
+def get_cluster(name: str) -> Optional[Dict[str, Any]]:
+    with _db() as c:
+        row = c.execute(
+            "SELECT name, launched_at, handle, status, autostop_minutes,"
+            " autostop_down, price_per_hour FROM clusters WHERE name=?",
+            (name,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def list_clusters() -> List[Dict[str, Any]]:
+    with _db() as c:
+        rows = c.execute(
+            "SELECT name, launched_at, handle, status, autostop_minutes,"
+            " autostop_down, price_per_hour FROM clusters"
+            " ORDER BY launched_at DESC").fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def remove_cluster(name: str) -> None:
+    rec = get_cluster(name)
+    with _db() as c:
+        if rec is not None:
+            c.execute(
+                "INSERT INTO cluster_history (name, launched_at, duration_s,"
+                " price_per_hour, resources, num_nodes) VALUES (?,?,?,?,?,?)",
+                (name, rec["launched_at"],
+                 time.time() - rec["launched_at"], rec["price_per_hour"],
+                 json.dumps(rec["handle"].get("resources")),
+                 rec["handle"].get("num_nodes", 1)))
+        c.execute("DELETE FROM clusters WHERE name=?", (name,))
+
+
+def set_autostop(name: str, idle_minutes: int, down: bool) -> None:
+    with _db() as c:
+        c.execute("UPDATE clusters SET autostop_minutes=?, autostop_down=?"
+                  " WHERE name=?", (idle_minutes, int(down), name))
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    with _db() as c:
+        rows = c.execute(
+            "SELECT name, launched_at, duration_s, price_per_hour,"
+            " num_nodes FROM cluster_history").fetchall()
+    # price_per_hour is already the whole-cluster rate (all nodes).
+    return [{"name": n, "launched_at": la, "duration_s": d,
+             "cost": d / 3600.0 * p, "num_nodes": nn or 1}
+            for n, la, d, p, nn in rows]
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    name, launched_at, handle, status, am, ad, price = row
+    return {
+        "name": name,
+        "launched_at": launched_at,
+        "handle": json.loads(handle),
+        "status": ClusterStatus(status),
+        "autostop_minutes": am,
+        "autostop_down": bool(ad),
+        "price_per_hour": price,
+    }

@@ -1,0 +1,191 @@
+"""Command runners: the single funnel for running commands on cluster
+hosts, local or over SSH.
+
+Reference parity: sky/utils/command_runner.py (CommandRunner ABC :165,
+SSHCommandRunner :435 with ControlMaster multiplexing). The local runner
+doubles as the fake-cloud execution path so the whole stack is testable
+on one machine.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+class CommandRunner:
+    """Runs shell commands on one host."""
+
+    def __init__(self, host_id: int = 0, ip: str = "127.0.0.1"):
+        self.host_id = host_id
+        self.ip = ip
+
+    def run(self, cmd: str, env: Optional[Dict[str, str]] = None,
+            cwd: Optional[str] = None, timeout: Optional[float] = None,
+            log_path: Optional[str] = None) -> Tuple[int, str, str]:
+        """Run to completion. Returns (rc, stdout, stderr); when
+        ``log_path`` is given, output is tee'd there instead."""
+        raise NotImplementedError
+
+    def run_detached(self, cmd: str, env: Optional[Dict[str, str]],
+                     cwd: Optional[str], log_path: str) -> int:
+        """Start without waiting; returns a PID (new process group so the
+        whole tree can be killed for gang-cancel)."""
+        raise NotImplementedError
+
+    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+        raise NotImplementedError
+
+    def kill(self, pid: int) -> None:
+        """Terminate the process group started by ``run_detached``."""
+        raise NotImplementedError
+
+
+class LocalRunner(CommandRunner):
+    """Executes on the local machine (fake-cloud hosts = directories)."""
+
+    def __init__(self, host_id: int = 0, ip: str = "127.0.0.1",
+                 workspace: Optional[str] = None):
+        super().__init__(host_id, ip)
+        self.workspace = workspace
+
+    def _env(self, env):
+        full = dict(os.environ)
+        if env:
+            full.update(env)
+        return full
+
+    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None):
+        cwd = cwd or self.workspace
+        if log_path:
+            os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            with open(log_path, "ab") as f:
+                proc = subprocess.run(
+                    ["bash", "-c", cmd], env=self._env(env), cwd=cwd,
+                    stdout=f, stderr=subprocess.STDOUT, timeout=timeout)
+            return proc.returncode, "", ""
+        proc = subprocess.run(
+            ["bash", "-c", cmd], env=self._env(env), cwd=cwd,
+            capture_output=True, text=True, timeout=timeout)
+        return proc.returncode, proc.stdout, proc.stderr
+
+    def run_detached(self, cmd, env=None, cwd=None, log_path="/dev/null"):
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        with open(log_path, "ab") as f:
+            proc = subprocess.Popen(
+                ["bash", "-c", cmd], env=self._env(env),
+                cwd=cwd or self.workspace, stdout=f,
+                stderr=subprocess.STDOUT, start_new_session=True)
+        return proc.pid
+
+    def kill(self, pid: int) -> None:
+        import signal
+        try:
+            os.killpg(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+
+    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+        src = os.path.expanduser(src)
+        dst = os.path.expanduser(dst)
+        os.makedirs(dst if os.path.isdir(src) else os.path.dirname(dst),
+                    exist_ok=True)
+        # rsync if available, else cp (keeps the zero-dep property).
+        # Both paths copy a directory's *contents* into dst (src/. form).
+        if os.path.isdir(src):
+            copy = (f"command -v rsync >/dev/null && "
+                    f"rsync -a {shlex.quote(src.rstrip('/') + '/')} "
+                    f"{shlex.quote(dst)} || "
+                    f"cp -r {shlex.quote(os.path.join(src, '.'))} "
+                    f"{shlex.quote(dst)}")
+        else:
+            copy = (f"command -v rsync >/dev/null && "
+                    f"rsync -a {shlex.quote(src)} {shlex.quote(dst)} || "
+                    f"cp {shlex.quote(src)} {shlex.quote(dst)}")
+        rc = subprocess.run(["bash", "-c", copy],
+                            capture_output=True).returncode
+        if rc != 0:
+            raise RuntimeError(f"rsync {src} -> {dst} failed")
+
+
+class SSHRunner(CommandRunner):
+    """SSH with ControlMaster multiplexing (one handshake per host)."""
+
+    def __init__(self, ip: str, user: str, key_path: str, host_id: int = 0,
+                 port: int = 22, proxy_command: Optional[str] = None):
+        super().__init__(host_id, ip)
+        self.user = user
+        self.key_path = key_path
+        self.port = port
+        self.proxy_command = proxy_command
+
+    def _ssh_base(self) -> List[str]:
+        ctrl = os.path.expanduser("~/.skypilot_tpu/ssh_control")
+        os.makedirs(ctrl, exist_ok=True)
+        base = [
+            "ssh", "-i", os.path.expanduser(self.key_path),
+            "-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null",
+            "-o", "IdentitiesOnly=yes",
+            "-o", "ConnectTimeout=30",
+            "-o", f"ControlPath={ctrl}/%C",
+            "-o", "ControlMaster=auto",
+            "-o", "ControlPersist=120s",
+            "-p", str(self.port),
+        ]
+        if self.proxy_command:
+            base += ["-o", f"ProxyCommand={self.proxy_command}"]
+        return base + [f"{self.user}@{self.ip}"]
+
+    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None):
+        env_prefix = "".join(
+            f"export {k}={shlex.quote(v)}; " for k, v in (env or {}).items())
+        cd = f"cd {shlex.quote(cwd)} && " if cwd else ""
+        full = self._ssh_base() + [f"{env_prefix}{cd}{cmd}"]
+        if log_path:
+            os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            with open(log_path, "ab") as f:
+                proc = subprocess.run(full, stdout=f,
+                                      stderr=subprocess.STDOUT,
+                                      timeout=timeout)
+            return proc.returncode, "", ""
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              timeout=timeout)
+        return proc.returncode, proc.stdout, proc.stderr
+
+    def run_detached(self, cmd, env=None, cwd=None, log_path="/dev/null"):
+        env_prefix = "".join(
+            f"export {k}={shlex.quote(v)}; " for k, v in (env or {}).items())
+        cd = f"cd {shlex.quote(cwd)} && " if cwd else ""
+        # setsid makes the remote bash a process-group leader so kill()
+        # can take down the whole tree (children included); nohup alone
+        # leaves children orphaned on cancel.
+        remote = (f"nohup setsid bash -c {shlex.quote(env_prefix + cd + cmd)} "
+                  f">> {shlex.quote(log_path)} 2>&1 & echo $!")
+        rc, out, err = LocalRunner().run(
+            " ".join(shlex.quote(a) for a in self._ssh_base())
+            + " " + shlex.quote(f"mkdir -p $(dirname {shlex.quote(log_path)}); {remote}"))
+        if rc != 0:
+            raise RuntimeError(f"ssh detach failed: {err}")
+        return int(out.strip().splitlines()[-1])
+
+    def kill(self, pid: int) -> None:
+        # Kill the remote process group (run_detached used nohup+bash).
+        self.run(f"kill -TERM -- -{pid} 2>/dev/null || "
+                 f"kill -TERM {pid} 2>/dev/null || true")
+
+    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+        ssh_cmd = " ".join(self._ssh_base()[:-1])
+        remote = f"{self.user}@{self.ip}"
+        pair = ([src, f"{remote}:{dst}"] if up else [f"{remote}:{src}", dst])
+        proc = subprocess.run(
+            ["rsync", "-az", "-e", ssh_cmd, "--mkpath", *pair],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"rsync failed: {proc.stderr}")

@@ -1,0 +1,33 @@
+"""Filesystem layout. Everything lives under $SKYPILOT_TPU_HOME
+(default ~/.skypilot_tpu), overridable so tests run in tmp dirs."""
+
+from __future__ import annotations
+
+import os
+
+
+def home() -> str:
+    root = os.environ.get("SKYPILOT_TPU_HOME",
+                          os.path.expanduser("~/.skypilot_tpu"))
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def state_db() -> str:
+    return os.path.join(home(), "state.db")
+
+
+def cluster_dir(cluster_name: str) -> str:
+    d = os.path.join(home(), "clusters", cluster_name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def logs_dir() -> str:
+    d = os.path.join(home(), "logs")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def requests_db() -> str:
+    return os.path.join(home(), "requests.db")

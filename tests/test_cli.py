@@ -1,0 +1,79 @@
+"""CLI tests via click's CliRunner (reference pattern: tests/test_cli.py)."""
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu.client import cli as cli_mod
+
+
+@pytest.fixture(autouse=True)
+def sky_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+
+
+@pytest.fixture()
+def runner():
+    return CliRunner()
+
+
+def test_status_empty(runner):
+    res = runner.invoke(cli_mod.cli, ["status"])
+    assert res.exit_code == 0
+    assert "No existing clusters" in res.output
+
+
+def test_launch_dryrun(runner):
+    res = runner.invoke(cli_mod.cli, [
+        "launch", "echo hi", "--gpus", "tpu-v5e-8", "--dryrun"])
+    assert res.exit_code == 0, res.output
+    assert "would launch" in res.output
+    assert "tpu-v5e-8" in res.output
+
+
+def test_launch_local_roundtrip(runner):
+    res = runner.invoke(cli_mod.cli, [
+        "launch", "echo cli-test", "--cloud", "local", "-c", "clic"])
+    assert res.exit_code == 0, res.output
+    res = runner.invoke(cli_mod.cli, ["status"])
+    assert "clic" in res.output
+    res = runner.invoke(cli_mod.cli, ["queue", "clic"])
+    assert res.exit_code == 0, res.output
+    res = runner.invoke(cli_mod.cli, ["logs", "clic", "1"])
+    assert res.exit_code == 0, res.output
+    assert "cli-test" in res.output
+    res = runner.invoke(cli_mod.cli, ["down", "clic"])
+    assert res.exit_code == 0, res.output
+    res = runner.invoke(cli_mod.cli, ["status"])
+    assert "clic" not in res.output
+
+
+def test_launch_from_yaml(runner, tmp_path):
+    yaml_file = tmp_path / "task.yaml"
+    yaml_file.write_text(
+        "name: yamltask\nresources:\n  cloud: local\nrun: echo from-yaml\n")
+    res = runner.invoke(cli_mod.cli, [
+        "launch", str(yaml_file), "-c", "cyaml"])
+    assert res.exit_code == 0, res.output
+    res = runner.invoke(cli_mod.cli, ["logs", "cyaml", "1"])
+    assert "from-yaml" in res.output
+    runner.invoke(cli_mod.cli, ["down", "cyaml"])
+
+
+def test_show_gpus(runner):
+    res = runner.invoke(cli_mod.cli, ["show-gpus", "v5p"])
+    assert res.exit_code == 0, res.output
+    assert "tpu-v5p-16" in res.output
+    res = runner.invoke(cli_mod.cli, ["show-gpus", "A100"])
+    assert "A100" in res.output
+
+
+def test_check(runner):
+    res = runner.invoke(cli_mod.cli, ["check"])
+    assert res.exit_code == 0, res.output
+    assert "local: enabled" in res.output
+    assert "gcp:" in res.output
+
+
+def test_unknown_cluster_errors(runner):
+    res = runner.invoke(cli_mod.cli, ["queue", "nope"])
+    assert res.exit_code != 0

@@ -1,0 +1,161 @@
+"""End-to-end offline tests on the local fake cloud: launch -> exec ->
+queue/logs/cancel -> stop/start -> down, gang semantics, failover."""
+
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.backend import TpuVmBackend
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.runtime.job_queue import JobStatus
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture(autouse=True)
+def sky_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    yield str(tmp_path / "skyhome")
+
+
+def _local_task(run, name="t", num_nodes=1, hosts_per_node=1, **task_kw):
+    t = Task(name=name, run=run, num_nodes=num_nodes, **task_kw)
+    t.set_resources(Resources(cloud="local"))
+    return t
+
+
+def _wait(handle, job_id, timeout=30):
+    return TpuVmBackend().wait_job(handle, job_id, timeout)
+
+
+def test_launch_end_to_end():
+    t = _local_task("echo hello-from-$SKYTPU_NODE_RANK")
+    job_id, handle = sky.launch(t, cluster_name="c1")
+    assert _wait(handle, job_id) == JobStatus.SUCCEEDED
+
+    rec = state.get_cluster("c1")
+    assert rec["status"] == state.ClusterStatus.UP
+
+    logs = TpuVmBackend().job_log_paths(handle, job_id)
+    assert len(logs) == 1
+    assert "hello-from-0" in open(logs[0]).read()
+
+
+def test_env_contract_injected():
+    t = _local_task(
+        'echo "rank=$SKYTPU_NODE_RANK hosts=$SKYTPU_NUM_HOSTS '
+        'coord=$JAX_COORDINATOR_ADDRESS pid=$JAX_PROCESS_ID"')
+    job_id, handle = sky.launch(t, cluster_name="c2")
+    assert _wait(handle, job_id) == JobStatus.SUCCEEDED
+    content = open(TpuVmBackend().job_log_paths(handle, job_id)[0]).read()
+    assert "rank=0 hosts=1 coord=127.0.0.1:8476 pid=0" in content
+
+
+def test_exec_on_existing_cluster_and_queue():
+    t = _local_task("echo one")
+    job1, handle = sky.launch(t, cluster_name="c3")
+    _wait(handle, job1)
+    t2 = _local_task("echo two", name="second")
+    job2, _ = sky.exec(t2, cluster_name="c3")
+    assert _wait(handle, job2) == JobStatus.SUCCEEDED
+    q = sky.queue("c3")
+    assert [j["job_id"] for j in q] == [job2, job1]
+    assert all(j["status"] == JobStatus.SUCCEEDED for j in q)
+
+
+def test_gang_fail_one_kills_all():
+    # Host 0 fails fast; host 1 would run for 30s. Gang semantics must
+    # kill host 1 and fail the job quickly.
+    t = _local_task(
+        'if [ "$SKYTPU_HOST_ID" = "0" ]; then exit 3; else sleep 30; fi',
+        num_nodes=2)
+    start_t = time.time()
+    job_id, handle = sky.launch(t, cluster_name="c4")
+    status = _wait(handle, job_id, timeout=20)
+    assert status == JobStatus.FAILED
+    assert time.time() - start_t < 15
+
+
+def test_cancel_running_job():
+    t = _local_task("sleep 60")
+    job_id, handle = sky.launch(t, cluster_name="c5")
+    deadline = time.time() + 10
+    while sky.job_status("c5", job_id) != JobStatus.RUNNING:
+        assert time.time() < deadline
+        time.sleep(0.1)
+    sky.cancel("c5", job_id)
+    assert sky.job_status("c5", job_id) == JobStatus.CANCELLED
+
+
+def test_setup_and_envs():
+    t = _local_task("cat marker.txt", name="with-setup")
+    t.setup = "echo from-setup-$MYVAR > marker.txt"
+    t.update_envs({"MYVAR": "42"})
+    job_id, handle = sky.launch(t, cluster_name="c6")
+    assert _wait(handle, job_id) == JobStatus.SUCCEEDED
+    content = open(TpuVmBackend().job_log_paths(handle, job_id)[0]).read()
+    assert "from-setup-42" in content
+
+
+def test_stop_start_down():
+    t = _local_task("echo x")
+    job_id, handle = sky.launch(t, cluster_name="c7")
+    _wait(handle, job_id)
+    sky.stop("c7")
+    assert state.get_cluster("c7")["status"] == state.ClusterStatus.STOPPED
+    with pytest.raises(exceptions.ClusterNotUpError):
+        sky.exec(_local_task("echo y"), cluster_name="c7")
+    sky.start("c7")
+    assert state.get_cluster("c7")["status"] == state.ClusterStatus.UP
+    sky.down("c7")
+    assert state.get_cluster("c7") is None
+    report = sky.cost_report()
+    assert any(r["name"] == "c7" for r in report)
+
+
+def test_failover_retry_until_up(monkeypatch):
+    # First 2 provision attempts hit injected CapacityError; since the
+    # local cloud has one candidate, retry_until_up sweeps again.
+    monkeypatch.setenv("SKYTPU_LOCAL_FAIL_ATTEMPTS", "2")
+    t = _local_task("echo recovered")
+    job_id, handle = sky.launch(t, cluster_name="c8", retry_until_up=True)
+    assert _wait(handle, job_id) == JobStatus.SUCCEEDED
+
+
+def test_failover_exhausted_raises(monkeypatch):
+    monkeypatch.setenv("SKYTPU_LOCAL_FAIL_ATTEMPTS", "99")
+    t = _local_task("echo never")
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        sky.launch(t, cluster_name="c9")
+
+
+def test_multihost_rank_assignment():
+    # 2 logical nodes x 2 hosts each = 4 hosts; check the rank math.
+    t = Task(name="ranks",
+             run='echo "h=$SKYTPU_HOST_ID n=$SKYTPU_NODE_RANK '
+                 'w=$SKYTPU_WORKER_ID np=$JAX_NUM_PROCESSES"',
+             num_nodes=2)
+    t.set_resources(Resources(cloud="local"))
+    job_id, handle = sky.launch(t, cluster_name="c10")
+    # Local provider: hosts_per_node comes from resources (1 for local);
+    # num_nodes=2 -> 2 hosts, ranks 0/1.
+    assert _wait(handle, job_id) == JobStatus.SUCCEEDED
+    logs = TpuVmBackend().job_log_paths(handle, job_id)
+    assert len(logs) == 2
+    combined = "".join(open(p).read() for p in logs)
+    assert "h=0 n=0 w=0 np=2" in combined
+    assert "h=1 n=1 w=0 np=2" in combined
+
+
+def test_refresh_detects_external_teardown():
+    t = _local_task("echo z")
+    job_id, handle = sky.launch(t, cluster_name="c11")
+    _wait(handle, job_id)
+    # Simulate out-of-band deletion (cloud console teardown).
+    from skypilot_tpu.provision import local as local_provider
+    local_provider.terminate_instances("c11", "local")
+    records = sky.status(["c11"], refresh=True)
+    assert records == []
+    assert state.get_cluster("c11") is None

@@ -1,0 +1,109 @@
+"""Regression tests for review findings: FIFO serialization, autostop
+daemon, cost accounting, log tailing of unknown jobs."""
+
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.backend import TpuVmBackend
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.runtime.job_queue import JobStatus
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture(autouse=True)
+def sky_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    monkeypatch.setenv("SKYTPU_SKYLET_POLL", "0.2")
+
+
+def _local_task(run, name=None):
+    t = Task(name=name, run=run)
+    t.set_resources(Resources(cloud="local"))
+    return t
+
+
+def test_jobs_run_fifo_one_at_a_time():
+    """Two jobs on one cluster must serialize, not run concurrently."""
+    marker = "fifo_marker"
+    # Job 1 sleeps then writes its end time; job 2 writes its start time.
+    j1, handle = sky.launch(
+        _local_task(f"sleep 1; date +%s.%N > {marker}.end1"),
+        cluster_name="fifo")
+    j2, _ = sky.exec(_local_task(f"date +%s.%N > {marker}.start2"),
+                     cluster_name="fifo")
+    backend = TpuVmBackend()
+    assert backend.wait_job(handle, j1, 30) == JobStatus.SUCCEEDED
+    assert backend.wait_job(handle, j2, 30) == JobStatus.SUCCEEDED
+    from skypilot_tpu.provision import local as lp
+    ws = lp.get_cluster_info("fifo", "local").hosts[0].workspace
+    end1 = float(open(os.path.join(ws, f"{marker}.end1")).read())
+    start2 = float(open(os.path.join(ws, f"{marker}.start2")).read())
+    assert start2 >= end1, "job 2 started before job 1 finished"
+
+
+def test_cancel_pending_job():
+    j1, handle = sky.launch(_local_task("sleep 5"), cluster_name="cpend")
+    j2, _ = sky.exec(_local_task("echo never"), cluster_name="cpend")
+    sky.cancel("cpend", j2)
+    sky.cancel("cpend", j1)
+    backend = TpuVmBackend()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        q = {j["job_id"]: j["status"] for j in sky.queue("cpend")}
+        if q[j1] == JobStatus.CANCELLED and q[j2] == JobStatus.CANCELLED:
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"jobs not cancelled: {q}")
+
+
+def test_autostop_daemon_stops_idle_cluster():
+    j, handle = sky.launch(_local_task("echo done"), cluster_name="auto1",
+                           idle_minutes_to_autostop=0)
+    TpuVmBackend().wait_job(handle, j, 30)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        rec = state.get_cluster("auto1")
+        if rec and rec["status"] == state.ClusterStatus.STOPPED:
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"autostop did not stop cluster: {rec}")
+
+
+def test_autodown_daemon_removes_cluster():
+    j, handle = sky.launch(_local_task("echo done"), cluster_name="auto2",
+                           idle_minutes_to_autostop=0, down=True)
+    TpuVmBackend().wait_job(handle, j, 30)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if state.get_cluster("auto2") is None:
+            return
+        time.sleep(0.2)
+    raise AssertionError("autodown did not remove cluster")
+
+
+def test_cost_report_whole_cluster_price():
+    t = Task(name="multi", run="echo x", num_nodes=4)
+    t.set_resources(Resources(cloud="local"))
+    j, handle = sky.launch(t, cluster_name="cost4")
+    TpuVmBackend().wait_job(handle, j, 30)
+    # Fake a known price then tear down.
+    rec = state.get_cluster("cost4")
+    state.set_cluster("cost4", rec["handle"], state.ClusterStatus.UP,
+                      price_per_hour=36.0)  # whole-cluster $/hr
+    sky.down("cost4")
+    report = {r["name"]: r for r in sky.cost_report()}
+    r = report["cost4"]
+    # cost must be duration * 36/3600, NOT additionally * num_nodes.
+    expected = r["duration_s"] / 3600.0 * 36.0
+    assert abs(r["cost"] - expected) < 1e-6
+
+
+def test_tail_logs_unknown_job_raises():
+    j, handle = sky.launch(_local_task("echo x"), cluster_name="logx")
+    TpuVmBackend().wait_job(handle, j, 30)
+    with pytest.raises(exceptions.JobNotFoundError):
+        sky.tail_logs("logx", 999, follow=True)
