@@ -133,6 +133,12 @@ class RetryingProvisioner:
         )
         provision.run_instances(handle.provider, config)
         provision.wait_instances(handle.provider, cluster_name, handle.zone)
+        if handle.provider != "local":
+            from skypilot_tpu.provision import instance_setup
+            info = provision.get_cluster_info(handle.provider, cluster_name,
+                                              handle.zone)
+            instance_setup.wait_for_ssh(info)
+            instance_setup.setup_runtime_on_cluster(info)
         # Persist cluster.json so the (possibly remote) driver is
         # self-sufficient.
         cdir = paths.cluster_dir(cluster_name)

@@ -1,0 +1,79 @@
+"""Post-provision runtime setup on cluster hosts.
+
+Reference parity: sky/provision/instance_setup.py (wait_for_ssh via
+provisioner.py:349, internal_file_mounts :536, setup_runtime_on_cluster
+:202) — minus the Ray/venv bootstrap, which has no TPU-native
+equivalent: TPU-VM runtime images ship JAX/libtpu matched to the chip,
+and gang scheduling needs no cluster manager (runtime/driver.py). What
+remains is: wait for SSH, push the framework + workspace dirs, verify
+the JAX runtime imports.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import time
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.utils import command_runner
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SETUP_COMMANDS = (
+    "mkdir -p ~/sky_workdir ~/.skypilot_tpu",
+    # Verify the TPU runtime python can import jax (the runtime image's
+    # venv); tolerate CPU-only hosts (controllers).
+    "python3 -c 'import jax' 2>/dev/null || true",
+)
+
+
+def wait_for_ssh(info: ClusterInfo, timeout: float = 600,
+                 poll: float = 5.0) -> None:
+    """Block until every host accepts commands."""
+    runners = _runners(info)
+    deadline = time.time() + timeout
+    pending = list(range(len(runners)))
+    while pending and time.time() < deadline:
+        still = []
+        for i in pending:
+            rc, _, _ = runners[i].run("true", timeout=15)
+            if rc != 0:
+                still.append(i)
+        pending = still
+        if pending:
+            time.sleep(poll)
+    if pending:
+        raise exceptions.ProvisionTimeoutError(
+            f"hosts {pending} unreachable after {timeout}s")
+
+
+def setup_runtime_on_cluster(info: ClusterInfo,
+                             sync_framework: bool = True,
+                             max_workers: int = 32) -> None:
+    """Run setup on all hosts in parallel (reference: per-node parallel
+    SSH with result cache, instance_setup.py:135)."""
+    runners = _runners(info)
+
+    def setup_one(runner: command_runner.CommandRunner) -> None:
+        for cmd in SETUP_COMMANDS:
+            rc, _, err = runner.run(cmd, timeout=120)
+            if rc != 0:
+                raise exceptions.CommandError(rc, cmd, err)
+        if sync_framework and not runner.is_local:
+            # Self-replication: push this package so in-tree recipes can
+            # `import skypilot_tpu` on the hosts (the role of the
+            # reference's wheel build, backends/wheel_utils.py:140).
+            runner.rsync(_PKG_ROOT, "~/.skypilot_tpu/pkg/skypilot_tpu",
+                         up=True)
+
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(max_workers, max(len(runners), 1))) as ex:
+        list(ex.map(setup_one, runners))
+
+
+def _runners(info: ClusterInfo) -> List[command_runner.CommandRunner]:
+    from skypilot_tpu import provision
+    return provision.get_command_runners(info)

@@ -89,39 +89,55 @@ def run_job(cluster_dir: str, job_id: int, poll_interval: float = 0.2) -> int:
     log_dir = os.path.join(cluster_dir, "logs",
                            constants.LOG_DIR.format(job_id=job_id))
     os.makedirs(log_dir, exist_ok=True)
-    rc_dir = os.path.join(log_dir, "rc")
-    os.makedirs(rc_dir, exist_ok=True)
 
     job_queue.set_status(db, job_id, job_queue.JobStatus.RUNNING)
 
     pids: List[int] = []
-    started = []  # (runner, pid) pairs for gang-kill
+    started = []   # (runner, pid) pairs for gang-kill
+    hostpaths = {}  # host_id -> (runner, remote rc path, remote log path)
     try:
         for host, runner in zip(info.hosts, runners):
             env = build_job_env(meta["cluster_name"], job_id, info, host)
-            rc_file = os.path.join(rc_dir, f"{host.host_id}")
+            local_log = os.path.join(log_dir, f"rank-{host.host_id}.log")
+            if runner.is_local:
+                # Head-local host: rc + log written straight into log_dir.
+                scratch = log_dir
+                rc_file = os.path.join(scratch, f"rc-{host.host_id}")
+                log_path = local_log
+            else:
+                # Remote slice worker: rc + log live on the worker; the
+                # poll loop reads rc and mirrors log bytes via the runner.
+                scratch = f"~/.skypilot_tpu/job_{job_id}"
+                runner.run(f"mkdir -p {scratch}")
+                rc_file = f"{scratch}/rc"
+                log_path = f"{scratch}/out.log"
             # Wrap: run the script, then record its rc atomically.
             wrapped = (f"{job['run_cmd']}; rc=$?; "
                        f"echo $rc > {shlex.quote(rc_file + '.tmp')} && "
                        f"mv {shlex.quote(rc_file + '.tmp')} "
                        f"{shlex.quote(rc_file)}; exit $rc")
-            log_path = os.path.join(log_dir, f"rank-{host.host_id}.log")
             pid = runner.run_detached(wrapped, env=env, cwd=host.workspace,
                                       log_path=log_path)
             pids.append(pid)
             started.append((runner, pid))
+            hostpaths[host.host_id] = (runner, rc_file, log_path, local_log)
         job_queue.set_pids(db, job_id, pids)
 
-        # Poll rc files; fail-one-kill-all.
+        # Poll rc files (via runner: local read or `cat` over SSH) and
+        # mirror remote logs head-local; fail-one-kill-all.
         done: Dict[int, int] = {}
+        offsets: Dict[int, int] = {}
         while len(done) < len(info.hosts):
             for host in info.hosts:
-                if host.host_id in done:
+                hid = host.host_id
+                runner, rc_file, log_path, local_log = hostpaths[hid]
+                if not runner.is_local:
+                    _mirror_log(runner, log_path, local_log, offsets, hid)
+                if hid in done:
                     continue
-                rc_file = os.path.join(rc_dir, f"{host.host_id}")
-                if os.path.exists(rc_file):
-                    with open(rc_file) as f:
-                        done[host.host_id] = int(f.read().strip() or 1)
+                content = runner.read_file(rc_file)
+                if content is not None and content.strip():
+                    done[hid] = int(content.strip())
             cur = job_queue.get_job(db, job_id)
             if cur and cur["status"] == job_queue.JobStatus.CANCELLED:
                 _kill_all(started)
@@ -129,6 +145,13 @@ def run_job(cluster_dir: str, job_id: int, poll_interval: float = 0.2) -> int:
             if any(rc != 0 for rc in done.values()):
                 break
             time.sleep(poll_interval)
+
+        # Final log drain for remote hosts.
+        for host in info.hosts:
+            runner, _, log_path, local_log = hostpaths[host.host_id]
+            if not runner.is_local:
+                _mirror_log(runner, log_path, local_log, offsets,
+                            host.host_id)
 
         failed = [h for h, rc in done.items() if rc != 0]
         if failed:
@@ -142,6 +165,18 @@ def run_job(cluster_dir: str, job_id: int, poll_interval: float = 0.2) -> int:
         _kill_all(started)
         job_queue.set_status(db, job_id, job_queue.JobStatus.FAILED)
         return 1
+
+
+def _mirror_log(runner, remote_path: str, local_path: str,
+                offsets: Dict[int, int], host_id: int) -> None:
+    """Append new remote log bytes to the head-local rank log."""
+    off = offsets.get(host_id, 0)
+    rc, out, _ = runner.run(
+        f"tail -c +{off + 1} {shlex.quote(remote_path)} 2>/dev/null")
+    if rc == 0 and out:
+        offsets[host_id] = off + len(out.encode())
+        with open(local_path, "a") as f:
+            f.write(out)
 
 
 def _kill_all(started) -> None:

@@ -43,6 +43,16 @@ class CommandRunner:
         """Terminate the process group started by ``run_detached``."""
         raise NotImplementedError
 
+    def read_file(self, path: str) -> Optional[str]:
+        """Contents of a file on the host, or None if absent. Used by the
+        gang driver to poll per-host rc files uniformly (local FS read or
+        a `cat` over SSH)."""
+        raise NotImplementedError
+
+    @property
+    def is_local(self) -> bool:
+        return isinstance(self, LocalRunner)
+
 
 class LocalRunner(CommandRunner):
     """Executes on the local machine (fake-cloud hosts = directories)."""
@@ -80,6 +90,13 @@ class LocalRunner(CommandRunner):
                 cwd=cwd or self.workspace, stdout=f,
                 stderr=subprocess.STDOUT, start_new_session=True)
         return proc.pid
+
+    def read_file(self, path: str) -> Optional[str]:
+        try:
+            with open(os.path.expanduser(path)) as f:
+                return f.read()
+        except OSError:
+            return None
 
     def kill(self, pid: int) -> None:
         import signal
@@ -175,8 +192,12 @@ class SSHRunner(CommandRunner):
             raise RuntimeError(f"ssh detach failed: {err}")
         return int(out.strip().splitlines()[-1])
 
+    def read_file(self, path: str) -> Optional[str]:
+        rc, out, _ = self.run(f"cat {shlex.quote(path)} 2>/dev/null")
+        return out if rc == 0 else None
+
     def kill(self, pid: int) -> None:
-        # Kill the remote process group (run_detached used nohup+bash).
+        # Kill the remote process group (run_detached used setsid).
         self.run(f"kill -TERM -- -{pid} 2>/dev/null || "
                  f"kill -TERM {pid} 2>/dev/null || true")
 

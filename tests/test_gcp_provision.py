@@ -1,0 +1,212 @@
+"""GCP TPU provisioning against a fake TPU REST API (offline).
+
+The fake transport models the queuedResources/nodes state machine:
+create -> WAITING -> ACTIVE (+node READY), plus injectable stockouts and
+quota errors — the seam the reference tests at the codegen boundary,
+here tested at the HTTP boundary."""
+
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import gcp
+from skypilot_tpu.provision.common import ProvisionConfig
+
+
+class FakeTpuApi:
+    def __init__(self, stockout_zones=(), quota_zones=(), ready_after=1):
+        self.nodes = {}        # (zone, name) -> node dict
+        self.qrs = {}          # (zone, name) -> qr dict
+        self.stockout_zones = set(stockout_zones)
+        self.quota_zones = set(quota_zones)
+        self.ready_after = ready_after  # GETs until node turns READY
+        self.calls = []
+
+    def __call__(self, method, url, body):
+        self.calls.append((method, url))
+        m = re.search(r"locations/([^/]+)/(queuedResources|nodes)"
+                      r"(?:/([^/:?]+))?(?::(\w+))?(?:\?(.*))?$", url)
+        zone, kind, name, verb, query = m.groups()
+        if query and not name:
+            name = re.search(r"(?:queuedResourceId|nodeId)=([\w-]+)",
+                             query).group(1)
+        key = (zone, name)
+        if method == "POST" and verb is None:
+            if zone in self.quota_zones:
+                raise exceptions.QuotaExceededError("quota exceeded for zone")
+            if zone in self.stockout_zones:
+                raise exceptions.CapacityError("no more capacity in zone")
+            if kind == "queuedResources":
+                self.qrs[key] = {"state": {"state": "WAITING"}, "body": body}
+                node_body = body["tpu"]["nodeSpec"][0]["node"]
+                self.nodes[key] = dict(node_body, state="CREATING",
+                                       _gets=0)
+            else:
+                self.nodes[key] = dict(body, state="CREATING", _gets=0)
+            return {"name": f"op-{name}"}
+        if method == "GET" and kind == "nodes":
+            node = self.nodes.get(key)
+            if node is None:
+                raise exceptions.ClusterNotUpError("not found")
+            node["_gets"] += 1
+            if node["state"] == "CREATING" and node["_gets"] >= self.ready_after:
+                node["state"] = "READY"
+                n_hosts = self._n_hosts(node["acceleratorType"])
+                node["networkEndpoints"] = [
+                    {"ipAddress": f"10.0.0.{i+1}",
+                     "accessConfig": {"externalIp": f"34.0.0.{i+1}"}}
+                    for i in range(n_hosts)]
+            return {k: v for k, v in node.items() if not k.startswith("_")}
+        if method == "GET" and kind == "queuedResources":
+            qr = self.qrs.get(key)
+            if qr is None:
+                raise exceptions.ClusterNotUpError("not found")
+            return qr
+        if method == "POST" and verb == "stop":
+            self.nodes[key]["state"] = "STOPPED"
+            return {}
+        if method == "POST" and verb == "start":
+            self.nodes[key]["state"] = "READY"
+            return {}
+        if method == "DELETE":
+            store = self.nodes if kind == "nodes" else self.qrs
+            if key not in store:
+                raise exceptions.ClusterNotUpError("not found")
+            del store[key]
+            return {}
+        raise AssertionError(f"unhandled {method} {url}")
+
+    @staticmethod
+    def _n_hosts(accel_type):
+        gen, _, size = accel_type.partition("-")
+        size = int(size)
+        if gen == "v5litepod" or gen == "v6e":
+            return max(1, size // 8)
+        return max(1, size // 8)  # core-suffixed gens: 8 cores/host
+
+
+@pytest.fixture()
+def fake_api(monkeypatch):
+    api = FakeTpuApi()
+    gcp.set_transport(api)
+    monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "test-proj")
+    yield api
+    gcp.set_transport(None)
+
+
+def _config(accel="tpu-v5e-16", zone="us-west4-a", **kw):
+    from skypilot_tpu.catalog import catalog
+    info = catalog.tpu_slice_info(accel)
+    return ProvisionConfig(
+        cluster_name="tputest", num_nodes=1, hosts_per_node=info["hosts"],
+        zone=zone, region=zone.rsplit("-", 1)[0], accelerator=accel,
+        runtime_version="v2-alpha-tpuv5-lite", **kw)
+
+
+def test_accelerator_type_mapping():
+    assert gcp.to_gcp_accelerator_type("tpu-v5e-16") == "v5litepod-16"
+    assert gcp.to_gcp_accelerator_type("tpu-v5p-128") == "v5p-128"
+    assert gcp.to_gcp_accelerator_type("tpu-v6e-8") == "v6e-8"
+    assert gcp.to_gcp_accelerator_type("tpu-v3-32") == "v3-32"
+
+
+def test_v5e_goes_through_queued_resources(fake_api):
+    gcp.run_instances(_config())
+    assert ("us-west4-a", "tputest") in fake_api.qrs
+    gcp.wait_instances("tputest", "us-west4-a", timeout=5, poll=0.01)
+    assert gcp.query_instances("tputest", "us-west4-a") == "UP"
+
+
+def test_v3_goes_direct_node_create(fake_api):
+    gcp.run_instances(_config(accel="tpu-v3-32", zone="us-central1-a"))
+    assert not fake_api.qrs
+    assert ("us-central1-a", "tputest") in fake_api.nodes
+
+
+def test_spot_queued_resource(fake_api):
+    gcp.run_instances(_config(use_spot=True))
+    qr = fake_api.qrs[("us-west4-a", "tputest")]
+    assert "spot" in qr["body"]
+
+
+def test_cluster_info_enumerates_slice_hosts(fake_api):
+    gcp.run_instances(_config())  # v5e-16 = 2 hosts
+    gcp.wait_instances("tputest", "us-west4-a", timeout=5, poll=0.01)
+    info = gcp.get_cluster_info("tputest", "us-west4-a")
+    assert len(info.hosts) == 2
+    assert info.hosts[0].internal_ip == "10.0.0.1"
+    assert info.hosts[1].external_ip == "34.0.0.2"
+    assert info.hosts[1].worker_id == 1
+    runners = gcp.get_command_runners(info)
+    assert len(runners) == 2
+
+
+def test_stockout_raises_capacity_error(fake_api):
+    fake_api.stockout_zones.add("us-west4-a")
+    with pytest.raises(exceptions.CapacityError):
+        gcp.run_instances(_config())
+
+
+def test_quota_error(fake_api):
+    fake_api.quota_zones.add("us-west4-a")
+    with pytest.raises(exceptions.QuotaExceededError):
+        gcp.run_instances(_config())
+
+
+def test_terminate_removes_node_and_qr(fake_api):
+    gcp.run_instances(_config())
+    gcp.terminate_instances("tputest", "us-west4-a")
+    assert not fake_api.nodes and not fake_api.qrs
+    assert gcp.query_instances("tputest", "us-west4-a") == "NOT_FOUND"
+
+
+def test_multihost_stop_rejected(fake_api):
+    gcp.run_instances(_config())
+    gcp.wait_instances("tputest", "us-west4-a", timeout=5, poll=0.01)
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        gcp.stop_instances("tputest", "us-west4-a")
+
+
+def test_failed_queued_resource_fails_over(fake_api):
+    gcp.run_instances(_config())
+    # Node never materializes; QR flips to FAILED.
+    key = ("us-west4-a", "tputest")
+    del fake_api.nodes[key]
+    fake_api.qrs[key]["state"]["state"] = "FAILED"
+    with pytest.raises(exceptions.CapacityError):
+        gcp.wait_instances("tputest", "us-west4-a", timeout=5, poll=0.01)
+
+
+def test_http_error_mapping():
+    err = gcp._map_http_error(429, "RESOURCE_EXHAUSTED")
+    assert isinstance(err, exceptions.CapacityError)
+    err = gcp._map_http_error(403, "Quota 'TPUS' exceeded")
+    assert isinstance(err, exceptions.QuotaExceededError)
+    err = gcp._map_http_error(404, "nope")
+    assert isinstance(err, exceptions.ClusterNotUpError)
+    err = gcp._map_http_error(500, "boom")
+    assert isinstance(err, exceptions.ResourcesUnavailableError)
+
+
+def test_end_to_end_failover_across_zones(fake_api, tmp_path, monkeypatch):
+    """Full backend failover: us-west4-a stocked out -> lands elsewhere."""
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "home"))
+    from skypilot_tpu.provision import instance_setup
+    monkeypatch.setattr(instance_setup, "wait_for_ssh",
+                        lambda info, **kw: None)
+    monkeypatch.setattr(instance_setup, "setup_runtime_on_cluster",
+                        lambda info, **kw: None)
+    from skypilot_tpu.backend import RetryingProvisioner
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    # Cheapest v5e zones are us-*; stock out the two cheapest.
+    fake_api.stockout_zones |= {"us-central1-a", "us-east1-c", "us-east5-b",
+                                "us-west4-a", "us-west4-b"}
+    fake_api.ready_after = 1
+    t = Task(name="t", run="echo x")
+    t.set_resources(Resources(accelerators="tpu-v5e-16", cloud="gcp"))
+    handle = RetryingProvisioner().provision(t, "tputest")
+    assert handle.zone not in fake_api.stockout_zones
+    assert handle.provider == "gcp"
