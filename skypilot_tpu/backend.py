@@ -85,8 +85,9 @@ class RetryingProvisioner:
         self.backoff_seconds = backoff_seconds
         self.max_rounds = max_rounds
 
-    def provision(self, task: Task, cluster_name: str) -> ClusterHandle:
-        blocked: set = set()
+    def provision(self, task: Task, cluster_name: str,
+                  initial_blocked: Optional[set] = None) -> ClusterHandle:
+        blocked: set = set(initial_blocked or set())
         history: List[Exception] = []
         rounds = 0
         while True:
@@ -376,6 +377,11 @@ class TpuVmBackend:
         provision.terminate_instances(handle.provider, handle.cluster_name,
                                       handle.zone)
         state.remove_cluster(handle.cluster_name)
+        # Clear the client-side cluster dir (job queue, logs, scripts) so
+        # a future cluster reusing the name starts clean.
+        import shutil
+        shutil.rmtree(paths.cluster_dir(handle.cluster_name),
+                      ignore_errors=True)
 
     def refresh_status(self, cluster_name: str) -> Optional[state.ClusterStatus]:
         rec = state.get_cluster(cluster_name)
@@ -390,6 +396,9 @@ class TpuVmBackend:
         }
         if raw == "NOT_FOUND":
             state.remove_cluster(cluster_name)
+            import shutil
+            shutil.rmtree(paths.cluster_dir(cluster_name),
+                          ignore_errors=True)
             return None
         new = mapping.get(raw, state.ClusterStatus.INIT)
         state.set_cluster_status(cluster_name, new)

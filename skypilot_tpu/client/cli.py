@@ -230,6 +230,75 @@ def check():
     click.echo(f"  gcp: {'enabled' if ok else f'disabled ({why})'}")
 
 
+@cli.group()
+def jobs():
+    """Managed jobs: auto-recovery for preemptible TPU slices."""
+
+
+@jobs.command(name="launch")
+@click.argument("yaml_or_command")
+@click.option("--name", "-n", default=None)
+@click.option("--gpus", "--accelerators", "accelerators", default=None)
+@click.option("--cloud", default=None)
+@click.option("--use-spot/--no-use-spot", default=True,
+              help="Managed jobs default to spot slices.")
+@click.option("--recovery", default=None,
+              help="FAILOVER | EAGER_NEXT_ZONE (default)")
+def jobs_launch(yaml_or_command, name, accelerators, cloud, use_spot,
+                recovery):
+    """Submit a managed job with slice-preemption auto-recovery."""
+    from skypilot_tpu.jobs import core as jobs_core
+    is_yaml = yaml_or_command.endswith((".yaml", ".yml")) or os.path.exists(
+        yaml_or_command)
+    task = _load_task(yaml_or_command if is_yaml else None,
+                      None if is_yaml else yaml_or_command,
+                      accelerators, cloud, None, use_spot, name)
+    if recovery:
+        task.set_resources(task.resources[0].copy(job_recovery=recovery))
+    job_id = jobs_core.launch(task, name=name)
+    click.echo(f"Managed job {job_id} submitted "
+               f"(controller log: jobs-controller-{job_id}.log).")
+
+
+@jobs.command(name="queue")
+def jobs_queue():
+    """List managed jobs."""
+    from skypilot_tpu.jobs import core as jobs_core
+    rows = jobs_core.queue()
+    fmt = "{:<6}{:<16}{:<20}{:<10}{:<18}"
+    click.echo(fmt.format("ID", "NAME", "STATUS", "#RECOV", "CLUSTER"))
+    for r in rows:
+        click.echo(fmt.format(r["job_id"], r["name"] or "-",
+                              r["status"].value, r["recovery_count"],
+                              r["cluster_name"] or "-"))
+
+
+@jobs.command(name="cancel")
+@click.argument("job_ids", type=int, nargs=-1, required=True)
+def jobs_cancel(job_ids):
+    """Cancel managed job(s)."""
+    from skypilot_tpu.jobs import core as jobs_core
+    for jid in job_ids:
+        jobs_core.cancel(jid)
+        click.echo(f"Cancelling managed job {jid}.")
+
+
+@jobs.command(name="logs")
+@click.argument("job_id", type=int)
+@click.option("--controller", is_flag=True, default=False)
+def jobs_logs(job_id, controller):
+    """Show a managed job's (controller) logs."""
+    from skypilot_tpu.jobs import core as jobs_core, state as jobs_state
+    if controller:
+        jobs_core.tail_controller_log(job_id)
+        return
+    rec = jobs_state.get(job_id)
+    if rec is None or not rec["cluster_name"]:
+        click.echo("No cluster yet for this job.", err=True)
+        return
+    sky.tail_logs(rec["cluster_name"], None, follow=False)
+
+
 @cli.command(name="cost-report")
 def cost_report():
     """Show accumulated cost of terminated clusters."""

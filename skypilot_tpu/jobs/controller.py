@@ -1,0 +1,156 @@
+"""Managed-job controller: one monitor process per job.
+
+Reference parity: sky/jobs/controller.py (JobsController:53,
+_run_one_task:120 — launch via strategy, poll, detect preemption,
+recover, cleanup). Runs as a detached local process per job (the
+reference runs it on a jobs-controller *cluster*; controller-as-task
+recursion is wired through jobs/core.py the same way once a remote
+controller cluster is configured — the control logic here is identical
+either way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Optional
+
+from skypilot_tpu import exceptions, provision
+from skypilot_tpu import state as cluster_state
+from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
+from skypilot_tpu.jobs import recovery_strategy, state
+from skypilot_tpu.runtime.job_queue import JobStatus
+from skypilot_tpu.task import Task
+
+POLL_SECONDS = float(os.environ.get("SKYTPU_JOBS_POLL", "2"))
+
+
+class JobsController:
+    def __init__(self, managed_job_id: int):
+        self.job_id = managed_job_id
+        rec = state.get(managed_job_id)
+        if rec is None:
+            raise exceptions.ManagedJobError(f"no managed job {managed_job_id}")
+        self.task = Task.from_yaml_config(rec["task_config"])
+        self.cluster_name = f"sky-jobs-{managed_job_id}"
+        self.strategy = recovery_strategy.StrategyExecutor.make(
+            rec["recovery_strategy"], self.task, self.cluster_name)
+        self.backend = TpuVmBackend()
+
+    def run(self) -> None:
+        try:
+            state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
+            state.set_cluster(self.job_id, self.cluster_name)
+            job_id, handle = self.strategy.launch()
+            state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
+            self._monitor(job_id, handle)
+        except exceptions.ResourcesUnavailableError as e:
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                             error=str(e))
+        except Exception as e:  # noqa: BLE001 — controller records failure
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.FAILED_CONTROLLER,
+                             error=f"{type(e).__name__}: {e}")
+        finally:
+            self._cleanup()
+
+    # -- monitor loop ------------------------------------------------------
+    def _monitor(self, job_id: int, handle: ClusterHandle) -> None:
+        while True:
+            time.sleep(POLL_SECONDS)
+            rec = state.get(self.job_id)
+            if rec["status"] == state.ManagedJobStatus.CANCELLING:
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.CANCELLED)
+                return
+            js = self._cluster_job_status(handle, job_id)
+            if js == JobStatus.SUCCEEDED:
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.SUCCEEDED)
+                return
+            if js == JobStatus.CANCELLED:
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.CANCELLED)
+                return
+            if js is None or js in (JobStatus.FAILED,
+                                    JobStatus.FAILED_SETUP):
+                # Cluster gone (slice preempted) or job died with the
+                # cluster unhealthy -> recover; genuine user failure on a
+                # healthy cluster -> FAILED.
+                if js == JobStatus.FAILED and self._cluster_healthy(handle):
+                    state.set_status(self.job_id,
+                                     state.ManagedJobStatus.FAILED,
+                                     error="task failed on healthy cluster")
+                    return
+                recovered = self._recover()
+                if recovered is None:
+                    return
+                job_id, handle = recovered
+
+    def _recover(self):
+        """Recover the cluster+job; returns (job_id, handle) or None if
+        the managed job reached a terminal state instead."""
+        n = state.bump_recovery(self.job_id)
+        if n > recovery_strategy.MAX_RECOVERY_ATTEMPTS:
+            state.set_status(self.job_id, state.ManagedJobStatus.FAILED,
+                             error="max recovery attempts exceeded")
+            return None
+        state.set_status(self.job_id, state.ManagedJobStatus.RECOVERING)
+        try:
+            job_id, handle = self.strategy.recover()
+        except exceptions.ResourcesUnavailableError as e:
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                             error=str(e))
+            return None
+        state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
+        return job_id, handle
+
+    # -- probes ------------------------------------------------------------
+    def _cluster_healthy(self, handle: ClusterHandle) -> bool:
+        try:
+            return provision.query_instances(
+                handle.provider, handle.cluster_name, handle.zone) == "UP"
+        except exceptions.SkyTpuError:
+            return False
+
+    def _cluster_job_status(self, handle: ClusterHandle,
+                            job_id: int) -> Optional[JobStatus]:
+        if not self._cluster_healthy(handle):
+            return None
+        try:
+            for j in self.backend.queue(handle):
+                if j["job_id"] == job_id:
+                    return j["status"]
+        except exceptions.SkyTpuError:
+            return None
+        return None
+
+    def _cleanup(self) -> None:
+        rec = cluster_state.get_cluster(self.cluster_name)
+        if rec is not None:
+            try:
+                self.backend.teardown(ClusterHandle(rec["handle"]))
+            except exceptions.SkyTpuError:
+                cluster_state.remove_cluster(self.cluster_name)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--job-id", type=int, required=True)
+    args = ap.parse_args()
+    try:
+        controller = JobsController(args.job_id)
+    except Exception as e:  # noqa: BLE001 — init errors must be recorded
+        state.set_status(args.job_id,
+                         state.ManagedJobStatus.FAILED_CONTROLLER,
+                         error=f"{type(e).__name__}: {e}")
+        raise
+    controller.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Managed-jobs client ops: launch/queue/cancel/logs.
+
+Reference parity: sky/jobs/server/core.py + scheduler limits
+(sky/jobs/scheduler.py:66-72 — launching <= 4x CPUs, alive <= mem/350MB,
+hard cap 2000).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import state
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import paths
+
+MAX_JOB_LIMIT = 2000  # reference: sky/jobs/scheduler.py:70
+
+
+def _alive_limit() -> int:
+    try:
+        mem_bytes = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+        by_mem = int(mem_bytes / (350 * 1024 * 1024))
+    except (ValueError, OSError):
+        by_mem = MAX_JOB_LIMIT
+    return min(by_mem, MAX_JOB_LIMIT)
+
+
+def launch(task: Task, name: Optional[str] = None) -> int:
+    """Submit a managed job; a detached controller process owns it."""
+    if state.count_alive() >= _alive_limit():
+        raise exceptions.ManagedJobError(
+            f"managed-job limit reached ({_alive_limit()}); wait for "
+            f"running jobs to finish")
+    strategy = None
+    for r in task.resources:
+        strategy = r.job_recovery or strategy
+    job_id = state.add(name or task.name, task.to_yaml_config(),
+                       strategy or "EAGER_NEXT_ZONE")
+    log = os.path.join(paths.logs_dir(), f"jobs-controller-{job_id}.log")
+    with open(log, "ab") as f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.jobs.controller",
+             "--job-id", str(job_id)],
+            stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
+            env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
+    state.set_controller_pid(job_id, proc.pid)
+    state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
+    return job_id
+
+
+def queue() -> List[Dict[str, Any]]:
+    return state.list_jobs()
+
+
+def cancel(job_id: int) -> None:
+    rec = state.get(job_id)
+    if rec is None:
+        raise exceptions.ManagedJobError(f"no managed job {job_id}")
+    if rec["status"].is_terminal():
+        return
+    state.set_status(job_id, state.ManagedJobStatus.CANCELLING)
+    # Controller notices CANCELLING and tears the cluster down; if the
+    # controller itself died, finalize here.
+    pid = rec["controller_pid"]
+    if pid is not None:
+        try:
+            os.kill(pid, 0)
+            return  # alive; it will finish the cancellation
+        except OSError:
+            pass
+    state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+
+
+def wait(job_id: int, timeout: float = 600) -> state.ManagedJobStatus:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rec = state.get(job_id)
+        if rec and rec["status"].is_terminal():
+            return rec["status"]
+        time.sleep(0.3)
+    raise TimeoutError(f"managed job {job_id} not terminal in {timeout}s")
+
+
+def tail_controller_log(job_id: int, out=None) -> None:
+    out = out or sys.stdout
+    p = os.path.join(paths.logs_dir(), f"jobs-controller-{job_id}.log")
+    if os.path.exists(p):
+        out.write(open(p).read())

@@ -1,0 +1,161 @@
+"""Managed-jobs state DB.
+
+Reference parity: sky/jobs/state.py (sqlite spot_jobs DB,
+ManagedJobStatus :202-235).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = "PENDING"
+    SUBMITTED = "SUBMITTED"
+    STARTING = "STARTING"
+    RUNNING = "RUNNING"
+    RECOVERING = "RECOVERING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    FAILED_PRECHECKS = "FAILED_PRECHECKS"
+    FAILED_NO_RESOURCE = "FAILED_NO_RESOURCE"
+    FAILED_CONTROLLER = "FAILED_CONTROLLER"
+    CANCELLING = "CANCELLING"
+    CANCELLED = "CANCELLED"
+
+    def is_terminal(self) -> bool:
+        return self in (ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+                        ManagedJobStatus.FAILED_PRECHECKS,
+                        ManagedJobStatus.FAILED_NO_RESOURCE,
+                        ManagedJobStatus.FAILED_CONTROLLER,
+                        ManagedJobStatus.CANCELLED)
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS managed_jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT,
+    task_config TEXT,
+    status TEXT,
+    submitted_at REAL,
+    started_at REAL,
+    ended_at REAL,
+    cluster_name TEXT,
+    recovery_count INTEGER DEFAULT 0,
+    recovery_strategy TEXT,
+    controller_pid INTEGER,
+    last_error TEXT
+);
+"""
+
+
+def _db_path() -> str:
+    return os.path.join(paths.home(), "managed_jobs.db")
+
+
+@contextlib.contextmanager
+def _db():
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.executescript(_SCHEMA)
+    try:
+        yield conn
+        conn.commit()
+    finally:
+        conn.close()
+
+
+def add(name: Optional[str], task_config: Dict[str, Any],
+        recovery_strategy: str) -> int:
+    with _db() as c:
+        cur = c.execute(
+            "INSERT INTO managed_jobs (name, task_config, status,"
+            " submitted_at, recovery_strategy) VALUES (?,?,?,?,?)",
+            (name, json.dumps(task_config),
+             ManagedJobStatus.PENDING.value, time.time(),
+             recovery_strategy))
+        return int(cur.lastrowid)
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               error: Optional[str] = None) -> None:
+    with _db() as c:
+        if status == ManagedJobStatus.RUNNING:
+            c.execute("UPDATE managed_jobs SET status=?, started_at="
+                      "COALESCE(started_at, ?) WHERE job_id=?",
+                      (status.value, time.time(), job_id))
+        elif status.is_terminal():
+            c.execute("UPDATE managed_jobs SET status=?, ended_at=?,"
+                      " last_error=COALESCE(?, last_error) WHERE job_id=?",
+                      (status.value, time.time(), error, job_id))
+        else:
+            c.execute("UPDATE managed_jobs SET status=?,"
+                      " last_error=COALESCE(?, last_error) WHERE job_id=?",
+                      (status.value, error, job_id))
+
+
+def set_cluster(job_id: int, cluster_name: str) -> None:
+    with _db() as c:
+        c.execute("UPDATE managed_jobs SET cluster_name=? WHERE job_id=?",
+                  (cluster_name, job_id))
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    with _db() as c:
+        c.execute("UPDATE managed_jobs SET controller_pid=? WHERE job_id=?",
+                  (pid, job_id))
+
+
+def bump_recovery(job_id: int) -> int:
+    with _db() as c:
+        c.execute("UPDATE managed_jobs SET recovery_count=recovery_count+1"
+                  " WHERE job_id=?", (job_id,))
+        return int(c.execute("SELECT recovery_count FROM managed_jobs"
+                             " WHERE job_id=?", (job_id,)).fetchone()[0])
+
+
+def get(job_id: int) -> Optional[Dict[str, Any]]:
+    with _db() as c:
+        row = c.execute(_SELECT + " WHERE job_id=?", (job_id,)).fetchone()
+    return _rec(row) if row else None
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    with _db() as c:
+        rows = c.execute(_SELECT + " ORDER BY job_id DESC").fetchall()
+    return [_rec(r) for r in rows]
+
+
+def count_alive() -> int:
+    with _db() as c:
+        return int(c.execute(
+            "SELECT COUNT(*) FROM managed_jobs WHERE status IN (?,?,?,?,?)",
+            (ManagedJobStatus.SUBMITTED.value,
+             ManagedJobStatus.STARTING.value,
+             ManagedJobStatus.RUNNING.value,
+             ManagedJobStatus.RECOVERING.value,
+             ManagedJobStatus.CANCELLING.value)).fetchone()[0])
+
+
+_SELECT = ("SELECT job_id, name, task_config, status, submitted_at,"
+           " started_at, ended_at, cluster_name, recovery_count,"
+           " recovery_strategy, controller_pid, last_error FROM managed_jobs")
+
+
+def _rec(row) -> Dict[str, Any]:
+    (jid, name, cfg, status, sub, start, end, cluster, rec_n, strat, pid,
+     err) = row
+    return {"job_id": jid, "name": name,
+            "task_config": json.loads(cfg),
+            "status": ManagedJobStatus(status),
+            "submitted_at": sub, "started_at": start, "ended_at": end,
+            "cluster_name": cluster, "recovery_count": rec_n,
+            "recovery_strategy": strat, "controller_pid": pid,
+            "last_error": err}

@@ -28,7 +28,7 @@ import sys
 import time
 from typing import Dict, List
 
-from skypilot_tpu import provision
+from skypilot_tpu import exceptions, provision
 from skypilot_tpu.runtime import constants, job_queue
 
 
@@ -144,6 +144,14 @@ def run_job(cluster_dir: str, job_id: int, poll_interval: float = 0.2) -> int:
                 return 0
             if any(rc != 0 for rc in done.values()):
                 break
+            # Slice preempted / terminated out-of-band? rc files will
+            # never appear — detect and fail the gang.
+            if provision.query_instances(
+                    meta["provider"], meta["cluster_name"],
+                    meta["zone"]) == "NOT_FOUND":
+                raise exceptions.ClusterNotUpError(
+                    "cluster disappeared while job was running "
+                    "(slice preempted or externally terminated)")
             time.sleep(poll_interval)
 
         # Final log drain for remote hosts.

@@ -1,0 +1,29 @@
+"""Simple name->class registries (reference: sky/utils/registry.py:16)."""
+
+from __future__ import annotations
+
+from typing import Dict, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    def __init__(self, name: str):
+        self.name = name
+        self._items: Dict[str, T] = {}
+
+    def register(self, key: str, value: T) -> T:
+        self._items[key.upper()] = value
+        return value
+
+    def get(self, key: str) -> Optional[T]:
+        return self._items.get(key.upper())
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __contains__(self, key: str) -> bool:
+        return key.upper() in self._items
+
+
+JOBS_RECOVERY_STRATEGY_REGISTRY: Registry = Registry("jobs_recovery_strategy")

@@ -1,0 +1,108 @@
+"""Managed jobs: submit/succeed, preemption recovery, cancel, strategies.
+
+Preemption is simulated by terminating the job's cluster out-of-band
+(the reference does the same with real instance termination in its smoke
+tests, tests/smoke_tests/test_managed_job.py — here against the local
+fake cloud)."""
+
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import state as cluster_state
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture(autouse=True)
+def sky_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    monkeypatch.setenv("SKYTPU_JOBS_POLL", "0.2")
+
+
+def _task(run, name=None):
+    t = Task(name=name, run=run)
+    t.set_resources(Resources(cloud="local"))
+    return t
+
+
+def test_managed_job_succeeds():
+    jid = jobs_core.launch(_task("echo managed-ok"), name="mj1")
+    status = jobs_core.wait(jid, timeout=60)
+    assert status == ManagedJobStatus.SUCCEEDED
+    rec = jobs_state.get(jid)
+    assert rec["recovery_count"] == 0
+    _wait_cluster_gone(rec["cluster_name"])
+
+
+def _wait_cluster_gone(cluster_name, timeout=15):
+    """Terminal status lands before the controller's finally-cleanup."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cluster_state.get_cluster(cluster_name) is None:
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"cluster {cluster_name} not cleaned up")
+
+
+def test_managed_job_user_failure_no_recovery():
+    """A task that fails on a healthy cluster must NOT be retried."""
+    jid = jobs_core.launch(_task("exit 7"), name="mj2")
+    status = jobs_core.wait(jid, timeout=60)
+    assert status == ManagedJobStatus.FAILED
+    assert jobs_state.get(jid)["recovery_count"] == 0
+
+
+def test_managed_job_recovers_from_preemption():
+    jid = jobs_core.launch(_task("sleep 4 && echo survived"), name="mj3")
+    # Wait for RUNNING, then preempt: terminate the cluster out-of-band.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        rec = jobs_state.get(jid)
+        if rec["status"] == ManagedJobStatus.RUNNING and rec["cluster_name"]:
+            if cluster_state.get_cluster(rec["cluster_name"]):
+                break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(f"job never reached RUNNING: {rec}")
+    from skypilot_tpu.provision import local as local_provider
+    time.sleep(0.5)  # let the task actually start
+    local_provider.terminate_instances(rec["cluster_name"], "local")
+
+    status = jobs_core.wait(jid, timeout=90)
+    rec = jobs_state.get(jid)
+    assert status == ManagedJobStatus.SUCCEEDED, rec
+    assert rec["recovery_count"] >= 1
+
+
+def test_managed_job_cancel():
+    jid = jobs_core.launch(_task("sleep 60"), name="mj4")
+    deadline = time.time() + 30
+    while jobs_state.get(jid)["status"] not in (
+            ManagedJobStatus.RUNNING,):
+        assert time.time() < deadline
+        time.sleep(0.1)
+    jobs_core.cancel(jid)
+    status = jobs_core.wait(jid, timeout=60)
+    assert status == ManagedJobStatus.CANCELLED
+    rec = jobs_state.get(jid)
+    _wait_cluster_gone(rec["cluster_name"])
+
+
+def test_unknown_strategy_rejected():
+    t = _task("echo x")
+    t.set_resources(Resources(cloud="local", job_recovery="NOPE"))
+    jid = jobs_core.launch(t)
+    status = jobs_core.wait(jid, timeout=30)
+    assert status == ManagedJobStatus.FAILED_CONTROLLER
+
+
+def test_queue_lists_jobs():
+    j1 = jobs_core.launch(_task("echo a"), name="qa")
+    jobs_core.wait(j1, timeout=60)
+    rows = jobs_core.queue()
+    assert any(r["job_id"] == j1 and r["name"] == "qa" for r in rows)
