@@ -299,6 +299,47 @@ def jobs_logs(job_id, controller):
     sky.tail_logs(rec["cluster_name"], None, follow=False)
 
 
+@cli.group()
+def serve():
+    """SkyServe: autoscaled serving behind a load balancer."""
+
+
+@serve.command(name="up")
+@click.argument("yaml_path")
+@click.option("--service-name", "-n", required=True)
+@click.option("--lb-port", type=int, default=None)
+def serve_up(yaml_path, service_name, lb_port):
+    """Bring up a service from a task YAML with a service: section."""
+    from skypilot_tpu.serve import core as serve_core
+    task = Task.from_yaml(yaml_path)
+    info = serve_core.up(task, service_name, lb_port=lb_port)
+    click.echo(f"Service {service_name!r} starting; endpoint "
+               f"{info['endpoint']}")
+
+
+@serve.command(name="status")
+@click.argument("service_name", required=False)
+def serve_status(service_name):
+    """Show services and their replicas."""
+    from skypilot_tpu.serve import core as serve_core
+    for s in serve_core.status(service_name):
+        click.echo(f"{s['name']}: {s['status'].value} "
+                   f"(endpoint http://127.0.0.1:{s['lb_port']})")
+        for r in s["replicas"]:
+            click.echo(f"  replica {r['replica_id']}: "
+                       f"{r['status'].value} {r['url'] or ''}")
+
+
+@serve.command(name="down")
+@click.argument("service_name")
+@click.option("--purge", is_flag=True, default=False)
+def serve_down(service_name, purge):
+    """Tear down a service (replicas, LB, controller)."""
+    from skypilot_tpu.serve import core as serve_core
+    serve_core.down(service_name, purge=purge)
+    click.echo(f"Service {service_name!r} torn down.")
+
+
 @cli.command(name="cost-report")
 def cost_report():
     """Show accumulated cost of terminated clusters."""

@@ -1,0 +1,71 @@
+"""Autoscalers: fixed-count and request-rate with hysteresis.
+
+Reference parity: sky/serve/autoscalers.py (Autoscaler:115,
+_AutoscalerWithHysteresis:348, RequestRateAutoscaler:431).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Optional
+
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+@dataclasses.dataclass
+class ScalingDecision:
+    target: int
+
+
+class Autoscaler:
+    def __init__(self, spec: SkyServiceSpec):
+        self.spec = spec
+
+    @classmethod
+    def from_spec(cls, spec: SkyServiceSpec) -> "Autoscaler":
+        if spec.target_qps_per_replica is not None:
+            return RequestRateAutoscaler(spec)
+        return FixedAutoscaler(spec)
+
+    def decide(self, current_qps: float, num_ready: int,
+               num_total: int) -> ScalingDecision:
+        raise NotImplementedError
+
+
+class FixedAutoscaler(Autoscaler):
+    def decide(self, current_qps, num_ready, num_total) -> ScalingDecision:
+        return ScalingDecision(self.spec.target_num_replicas)
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """target = ceil(qps / target_qps_per_replica), with upscale/downscale
+    delays so transient spikes don't thrash replicas."""
+
+    def __init__(self, spec: SkyServiceSpec):
+        super().__init__(spec)
+        self._proposal_since: Optional[float] = None
+        self._proposal: Optional[int] = None
+
+    def decide(self, current_qps, num_ready, num_total) -> ScalingDecision:
+        raw = math.ceil(current_qps / self.spec.target_qps_per_replica) \
+            if self.spec.target_qps_per_replica else self.spec.min_replicas
+        desired = max(self.spec.min_replicas,
+                      min(raw, self.spec.max_replicas))
+        now = time.time()
+        if desired == num_total:
+            self._proposal = None
+            self._proposal_since = None
+            return ScalingDecision(num_total)
+        if desired != self._proposal:
+            self._proposal = desired
+            self._proposal_since = now
+            return ScalingDecision(num_total)
+        delay = (self.spec.upscale_delay_seconds if desired > num_total
+                 else self.spec.downscale_delay_seconds)
+        if now - self._proposal_since >= delay:
+            self._proposal = None
+            self._proposal_since = None
+            return ScalingDecision(desired)
+        return ScalingDecision(num_total)

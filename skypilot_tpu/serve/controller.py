@@ -1,0 +1,90 @@
+"""Serve controller process: autoscaler loop + replica management + the
+load-balancer child process.
+
+Reference parity: sky/serve/service.py (_start_service forks controller
++ LB) and sky/serve/controller.py (SkyServeController:36,
+_run_autoscaler:64). Teardown handshake is DB-status based (the
+reference uses signal files, service.py:38).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+from skypilot_tpu.serve import autoscalers, replica_managers, serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.utils import paths
+
+POLL_SECONDS = float(os.environ.get("SKYTPU_SERVE_POLL", "2"))
+
+
+def run(service_name: str) -> int:
+    rec = serve_state.get_service(service_name)
+    if rec is None:
+        print(f"no service {service_name}", file=sys.stderr)
+        return 1
+    spec = SkyServiceSpec(**rec["spec"])
+    manager = replica_managers.ReplicaManager(service_name, spec,
+                                              rec["task_config"])
+    autoscaler = autoscalers.Autoscaler.from_spec(spec)
+
+    # Start the LB as a child; it dies with us.
+    lb_log = os.path.join(paths.logs_dir(),
+                          f"serve-lb-{service_name}.log")
+    with open(lb_log, "ab") as f:
+        lb = subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.serve.load_balancer",
+             "--service", service_name, "--port", str(rec["lb_port"])],
+            stdout=f, stderr=subprocess.STDOUT,
+            env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
+
+    serve_state.set_service_status(service_name, ServiceStatus.REPLICA_INIT)
+    manager.scale_to(spec.target_num_replicas)
+    try:
+        while True:
+            time.sleep(POLL_SECONDS)
+            rec = serve_state.get_service(service_name)
+            if rec is None or rec["status"] == ServiceStatus.SHUTTING_DOWN:
+                break
+            manager.probe_all()
+            replicas = serve_state.list_replicas(service_name)
+            ready = [r for r in replicas
+                     if r["status"] == ReplicaStatus.READY]
+            alive = [r for r in replicas
+                     if r["status"] not in (ReplicaStatus.FAILED,
+                                            ReplicaStatus.SHUTDOWN,
+                                            ReplicaStatus.PREEMPTED)]
+            status = (ServiceStatus.READY if ready
+                      else ServiceStatus.REPLICA_INIT)
+            if not alive and replicas:
+                status = ServiceStatus.FAILED
+            serve_state.set_service_status(service_name, status)
+            if status == ServiceStatus.FAILED:
+                break
+            decision = autoscaler.decide(serve_state.qps(service_name),
+                                         len(ready), len(alive))
+            manager.scale_to(decision.target)
+    finally:
+        lb.terminate()
+        manager.terminate_all()
+        final = serve_state.get_service(service_name)
+        if final is not None and final["status"] != ServiceStatus.FAILED:
+            serve_state.set_service_status(service_name,
+                                           ServiceStatus.SHUTDOWN)
+    return 0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--service", required=True)
+    args = ap.parse_args()
+    sys.exit(run(args.service))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""SkyServe client ops: up/down/status.
+
+Reference parity: sky/serve/server/core.py.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import paths
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def up(task: Task, service_name: str,
+       lb_port: Optional[int] = None) -> Dict[str, Any]:
+    if task.service is None:
+        raise exceptions.ServeError(
+            "task has no `service:` section; add one to serve it")
+    if serve_state.get_service(service_name) is not None:
+        raise exceptions.ServeError(
+            f"service {service_name!r} already exists")
+    lb_port = lb_port or _free_port()
+    spec_dict = {k: v for k, v in vars(task.service).items()}
+    serve_state.add_service(service_name, spec_dict, task.to_yaml_config(),
+                            lb_port)
+    log = os.path.join(paths.logs_dir(),
+                       f"serve-controller-{service_name}.log")
+    with open(log, "ab") as f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.serve.controller",
+             "--service", service_name],
+            stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
+            env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
+    serve_state.set_controller_pid(service_name, proc.pid)
+    return {"name": service_name, "endpoint": f"http://127.0.0.1:{lb_port}",
+            "lb_port": lb_port}
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    rec = serve_state.get_service(service_name)
+    if rec is None:
+        if purge:
+            return
+        raise exceptions.ServeError(f"no service {service_name!r}")
+    serve_state.set_service_status(service_name, ServiceStatus.SHUTTING_DOWN)
+    # Controller notices and tears everything down; wait briefly, then
+    # reap the record.
+    deadline = time.time() + 120
+    pid = rec["controller_pid"]
+    while time.time() < deadline:
+        cur = serve_state.get_service(service_name)
+        if cur is None or cur["status"] in (ServiceStatus.SHUTDOWN,
+                                            ServiceStatus.FAILED):
+            break
+        if pid is not None:
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                break  # controller is gone
+        time.sleep(0.3)
+    serve_state.remove_service(service_name)
+
+
+def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    services = ([serve_state.get_service(service_name)]
+                if service_name else serve_state.list_services())
+    out = []
+    for s in services:
+        if s is None:
+            continue
+        out.append(dict(s, replicas=serve_state.list_replicas(s["name"])))
+    return out
+
+
+def wait_ready(service_name: str, timeout: float = 120) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rec = serve_state.get_service(service_name)
+        if rec and rec["status"] == ServiceStatus.READY:
+            return
+        if rec and rec["status"].is_terminal():
+            raise exceptions.ServeError(
+                f"service entered {rec['status'].value}")
+        time.sleep(0.3)
+    raise TimeoutError(f"service {service_name} not READY in {timeout}s")

@@ -1,0 +1,149 @@
+"""Load balancer: stdlib threading HTTP reverse proxy.
+
+Reference parity: sky/serve/load_balancer.py (SkyServeLoadBalancer:22 —
+proxy + retries across replicas + QPS reporting) and
+load_balancing_policies.py (RoundRobinPolicy:89, LeastLoadPolicy:115).
+Replica set comes from the serve DB (probed by the controller); QPS is
+recorded there for the autoscaler.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import itertools
+import socketserver
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from skypilot_tpu.serve import serve_state
+
+_HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "host",
+                "proxy-authenticate", "proxy-authorization", "te",
+                "trailers", "upgrade"}
+
+
+class Policy:
+    def select(self, urls: List[str]) -> Optional[str]:
+        raise NotImplementedError
+
+    def done(self, url: str) -> None:
+        pass
+
+
+class RoundRobinPolicy(Policy):
+    def __init__(self):
+        self._counter = itertools.count()
+
+    def select(self, urls):
+        if not urls:
+            return None
+        return urls[next(self._counter) % len(urls)]
+
+
+class LeastLoadPolicy(Policy):
+    """Pick the replica with the fewest in-flight requests; break ties
+    round-robin so sequential (zero-concurrency) traffic still spreads."""
+
+    def __init__(self):
+        self._load: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+
+    def select(self, urls):
+        if not urls:
+            return None
+        with self._lock:
+            lowest = min(self._load.get(u, 0) for u in urls)
+            tied = [u for u in urls if self._load.get(u, 0) == lowest]
+            url = tied[next(self._rr) % len(tied)]
+            self._load[url] = self._load.get(url, 0) + 1
+        return url
+
+    def done(self, url):
+        with self._lock:
+            self._load[url] = max(0, self._load.get(url, 1) - 1)
+
+
+POLICIES = {"round_robin": RoundRobinPolicy, "least_load": LeastLoadPolicy}
+
+
+def make_handler(service: str, policy: Policy, max_retries: int = 3):
+    class ProxyHandler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _proxy(self):
+            serve_state.record_request(service)
+            body = None
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                body = self.rfile.read(length)
+            urls = serve_state.ready_urls(service)
+            tried = []
+            for _ in range(min(max_retries, max(len(urls), 1))):
+                url = policy.select([u for u in urls if u not in tried])
+                if url is None:
+                    break
+                tried.append(url)
+                try:
+                    self._forward(url, body)
+                    policy.done(url)
+                    return
+                except Exception:  # noqa: BLE001 — try next replica
+                    policy.done(url)
+            self.send_response(503)
+            msg = b"no ready replicas"
+            self.send_header("Content-Length", str(len(msg)))
+            self.end_headers()
+            self.wfile.write(msg)
+
+        def _forward(self, base_url: str, body: Optional[bytes]):
+            url = base_url + self.path
+            headers = {k: v for k, v in self.headers.items()
+                       if k.lower() not in _HOP_HEADERS}
+            req = urllib.request.Request(url, data=body, headers=headers,
+                                         method=self.command)
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                payload = resp.read()
+                self.send_response(resp.status)
+                for k, v in resp.headers.items():
+                    if k.lower() not in _HOP_HEADERS | {"content-length"}:
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _proxy
+
+        def log_message(self, *args):
+            pass
+
+    return ProxyHandler
+
+
+class _ThreadingServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def serve(service: str, port: int, policy_name: str = "least_load"):
+    policy = POLICIES[policy_name]()
+    httpd = _ThreadingServer(("0.0.0.0", port),
+                             make_handler(service, policy))
+    httpd.serve_forever()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--service", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--policy", default="least_load",
+                    choices=sorted(POLICIES))
+    args = ap.parse_args()
+    serve(args.service, args.port, args.policy)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+"""Serve state DB (sqlite): services, replicas, request stats.
+
+Reference parity: sky/serve/serve_state.py. The request-stat table
+doubles as the LB -> controller sync channel (the reference uses an HTTP
+endpoint, serve/controller.py:103; a shared DB removes a failure mode on
+the co-located controller VM and stays testable).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = "CONTROLLER_INIT"
+    REPLICA_INIT = "REPLICA_INIT"
+    READY = "READY"
+    SHUTTING_DOWN = "SHUTTING_DOWN"
+    FAILED = "FAILED"
+    SHUTDOWN = "SHUTDOWN"
+
+    def is_terminal(self) -> bool:
+        return self in (ServiceStatus.FAILED, ServiceStatus.SHUTDOWN)
+
+
+class ReplicaStatus(enum.Enum):
+    PROVISIONING = "PROVISIONING"
+    STARTING = "STARTING"
+    READY = "READY"
+    NOT_READY = "NOT_READY"
+    FAILED = "FAILED"
+    PREEMPTED = "PREEMPTED"
+    SHUTTING_DOWN = "SHUTTING_DOWN"
+    SHUTDOWN = "SHUTDOWN"
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS services (
+    name TEXT PRIMARY KEY,
+    spec TEXT,
+    task_config TEXT,
+    status TEXT,
+    controller_pid INTEGER,
+    lb_port INTEGER,
+    created_at REAL
+);
+CREATE TABLE IF NOT EXISTS replicas (
+    service TEXT,
+    replica_id INTEGER,
+    cluster_name TEXT,
+    status TEXT,
+    url TEXT,
+    launched_at REAL,
+    PRIMARY KEY (service, replica_id)
+);
+CREATE TABLE IF NOT EXISTS lb_requests (
+    service TEXT,
+    ts REAL
+);
+"""
+
+
+def _db_path() -> str:
+    return os.path.join(paths.home(), "serve.db")
+
+
+@contextlib.contextmanager
+def _db():
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.executescript(_SCHEMA)
+    try:
+        yield conn
+        conn.commit()
+    finally:
+        conn.close()
+
+
+# -- services ---------------------------------------------------------------
+
+def add_service(name: str, spec: Dict[str, Any], task_config: Dict[str, Any],
+                lb_port: int) -> None:
+    with _db() as c:
+        c.execute(
+            "INSERT INTO services (name, spec, task_config, status, lb_port,"
+            " created_at) VALUES (?,?,?,?,?,?)",
+            (name, json.dumps(spec), json.dumps(task_config),
+             ServiceStatus.CONTROLLER_INIT.value, lb_port, time.time()))
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    with _db() as c:
+        c.execute("UPDATE services SET status=? WHERE name=?",
+                  (status.value, name))
+
+
+def set_controller_pid(name: str, pid: int) -> None:
+    with _db() as c:
+        c.execute("UPDATE services SET controller_pid=? WHERE name=?",
+                  (pid, name))
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    with _db() as c:
+        row = c.execute(
+            "SELECT name, spec, task_config, status, controller_pid, lb_port,"
+            " created_at FROM services WHERE name=?", (name,)).fetchone()
+    if row is None:
+        return None
+    return {"name": row[0], "spec": json.loads(row[1]),
+            "task_config": json.loads(row[2]),
+            "status": ServiceStatus(row[3]), "controller_pid": row[4],
+            "lb_port": row[5], "created_at": row[6]}
+
+
+def list_services() -> List[Dict[str, Any]]:
+    with _db() as c:
+        names = [r[0] for r in c.execute("SELECT name FROM services")]
+    return [s for n in names if (s := get_service(n)) is not None]
+
+
+def remove_service(name: str) -> None:
+    with _db() as c:
+        c.execute("DELETE FROM services WHERE name=?", (name,))
+        c.execute("DELETE FROM replicas WHERE service=?", (name,))
+        c.execute("DELETE FROM lb_requests WHERE service=?", (name,))
+
+
+# -- replicas ---------------------------------------------------------------
+
+def upsert_replica(service: str, replica_id: int, cluster_name: str,
+                   status: ReplicaStatus, url: Optional[str]) -> None:
+    with _db() as c:
+        c.execute(
+            "INSERT INTO replicas (service, replica_id, cluster_name,"
+            " status, url, launched_at) VALUES (?,?,?,?,?,?)"
+            " ON CONFLICT(service, replica_id) DO UPDATE SET"
+            " cluster_name=excluded.cluster_name, status=excluded.status,"
+            " url=excluded.url",
+            (service, replica_id, cluster_name, status.value, url,
+             time.time()))
+
+
+def set_replica_status(service: str, replica_id: int,
+                       status: ReplicaStatus) -> None:
+    with _db() as c:
+        c.execute("UPDATE replicas SET status=? WHERE service=? AND"
+                  " replica_id=?", (status.value, service, replica_id))
+
+
+def remove_replica(service: str, replica_id: int) -> None:
+    with _db() as c:
+        c.execute("DELETE FROM replicas WHERE service=? AND replica_id=?",
+                  (service, replica_id))
+
+
+def list_replicas(service: str) -> List[Dict[str, Any]]:
+    with _db() as c:
+        rows = c.execute(
+            "SELECT replica_id, cluster_name, status, url, launched_at"
+            " FROM replicas WHERE service=? ORDER BY replica_id",
+            (service,)).fetchall()
+    return [{"replica_id": r[0], "cluster_name": r[1],
+             "status": ReplicaStatus(r[2]), "url": r[3],
+             "launched_at": r[4]} for r in rows]
+
+
+def ready_urls(service: str) -> List[str]:
+    return [r["url"] for r in list_replicas(service)
+            if r["status"] == ReplicaStatus.READY and r["url"]]
+
+
+# -- request stats (LB -> autoscaler channel) -------------------------------
+
+def record_request(service: str) -> None:
+    with _db() as c:
+        c.execute("INSERT INTO lb_requests (service, ts) VALUES (?,?)",
+                  (service, time.time()))
+
+
+def qps(service: str, window_seconds: float = 30.0) -> float:
+    cutoff = time.time() - window_seconds
+    with _db() as c:
+        n = c.execute("SELECT COUNT(*) FROM lb_requests WHERE service=?"
+                      " AND ts>?", (service, cutoff)).fetchone()[0]
+        c.execute("DELETE FROM lb_requests WHERE service=? AND ts<=?",
+                  (service, cutoff))
+    return n / window_seconds

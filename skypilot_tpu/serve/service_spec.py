@@ -1,0 +1,102 @@
+"""Service spec: the `service:` section of a task YAML.
+
+Reference parity: sky/serve/service_spec.py (SkyServiceSpec —
+readiness probe, replica counts, target qps, autoscaler knobs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass
+class SkyServiceSpec:
+    readiness_path: str = "/"
+    initial_delay_seconds: float = 60.0
+    readiness_timeout_seconds: float = 5.0
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None
+    target_num_replicas: Optional[int] = None
+    target_qps_per_replica: Optional[float] = None
+    replica_port: int = 8080
+    upscale_delay_seconds: float = 30.0
+    downscale_delay_seconds: float = 60.0
+    post_data: Optional[str] = None
+
+    def __post_init__(self):
+        if self.max_replicas is None:
+            self.max_replicas = max(self.min_replicas,
+                                    self.target_num_replicas or
+                                    self.min_replicas)
+        if self.target_num_replicas is None:
+            self.target_num_replicas = self.min_replicas
+        if not (self.min_replicas <= self.target_num_replicas
+                <= self.max_replicas):
+            raise exceptions.ServeError(
+                f"need min <= target <= max replicas, got "
+                f"{self.min_replicas}/{self.target_num_replicas}/"
+                f"{self.max_replicas}")
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> "SkyServiceSpec":
+        config = dict(config or {})
+        readiness = config.pop("readiness_probe", "/")
+        kwargs: Dict[str, Any] = {}
+        if isinstance(readiness, str):
+            kwargs["readiness_path"] = readiness
+        else:
+            kwargs["readiness_path"] = readiness.get("path", "/")
+            if "initial_delay_seconds" in readiness:
+                kwargs["initial_delay_seconds"] = float(
+                    readiness["initial_delay_seconds"])
+            if "post_data" in readiness:
+                kwargs["post_data"] = readiness["post_data"]
+        replicas = config.pop("replicas", None)
+        policy = config.pop("replica_policy", None) or {}
+        if replicas is not None and policy:
+            raise exceptions.ServeError(
+                "specify either `replicas` or `replica_policy`, not both")
+        if replicas is not None:
+            kwargs["min_replicas"] = kwargs["target_num_replicas"] = \
+                int(replicas)
+            kwargs["max_replicas"] = int(replicas)
+        for src, dst in (("min_replicas", "min_replicas"),
+                         ("max_replicas", "max_replicas"),
+                         ("target_qps_per_replica", "target_qps_per_replica"),
+                         ("upscale_delay_seconds", "upscale_delay_seconds"),
+                         ("downscale_delay_seconds",
+                          "downscale_delay_seconds")):
+            if src in policy:
+                kwargs[dst] = policy[src]
+        if "port" in config:
+            kwargs["replica_port"] = int(config.pop("port"))
+        if config:
+            raise exceptions.ServeError(
+                f"unknown service fields: {sorted(config)}")
+        return cls(**kwargs)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "readiness_probe": {
+                "path": self.readiness_path,
+                "initial_delay_seconds": self.initial_delay_seconds,
+            },
+            "port": self.replica_port,
+        }
+        if self.post_data:
+            out["readiness_probe"]["post_data"] = self.post_data
+        if self.min_replicas == self.max_replicas and \
+                self.target_qps_per_replica is None:
+            out["replicas"] = self.min_replicas
+        else:
+            out["replica_policy"] = {
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "target_qps_per_replica": self.target_qps_per_replica,
+                "upscale_delay_seconds": self.upscale_delay_seconds,
+                "downscale_delay_seconds": self.downscale_delay_seconds,
+            }
+        return out
