@@ -1,0 +1,196 @@
+"""Client SDK over the API server: submit -> request id -> poll/stream.
+
+Reference parity: sky/client/sdk.py (launch() posts /launch and returns
+a request id; get()/stream_and_get() poll; api_start/api_stop/api_info
+manage a local server). The CLI and Python API can run either direct
+(library calls, default) or through a server via these functions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import paths
+
+DEFAULT_URL = "http://127.0.0.1:46580"
+
+
+def _url() -> str:
+    return os.environ.get("SKYTPU_API_SERVER_URL", DEFAULT_URL)
+
+
+def _post(path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+    req = urllib.request.Request(
+        _url() + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _get_json(path: str) -> Any:
+    with urllib.request.urlopen(_url() + path, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+# -- async request API ------------------------------------------------------
+
+def get(request_id: str, timeout: float = 600) -> Any:
+    """Block until the request finishes; return its result or raise."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rec = _get_json(f"/api/get?request_id={request_id}")
+        if rec["status"] in ("SUCCEEDED",):
+            return rec["result"]
+        if rec["status"] in ("FAILED", "CANCELLED"):
+            raise exceptions.SkyTpuError(
+                rec.get("error") or f"request {rec['status']}")
+        time.sleep(0.2)
+    raise TimeoutError(f"request {request_id} not finished in {timeout}s")
+
+
+def stream_and_get(request_id: str, timeout: float = 600,
+                   out=None) -> Any:
+    out = out or sys.stdout
+    offset = 0
+    deadline = time.time() + timeout
+    while True:
+        content = _stream(request_id)
+        if len(content) > offset:
+            out.write(content[offset:])
+            out.flush()
+            offset = len(content)
+        rec = _get_json(f"/api/get?request_id={request_id}")
+        if rec["status"] == "SUCCEEDED":
+            return rec["result"]
+        if rec["status"] in ("FAILED", "CANCELLED"):
+            raise exceptions.SkyTpuError(
+                rec.get("error") or f"request {rec['status']}")
+        if time.time() > deadline:
+            raise TimeoutError(f"request {request_id} timed out")
+        time.sleep(0.2)
+
+
+def _stream(request_id: str) -> str:
+    with urllib.request.urlopen(
+            _url() + f"/api/stream?request_id={request_id}",
+            timeout=30) as resp:
+        return resp.read().decode(errors="replace")
+
+
+def api_cancel(request_id: str) -> None:
+    _post("/api/cancel", {"request_id": request_id})
+
+
+def api_status() -> List[Dict[str, Any]]:
+    return _get_json("/api/status")
+
+
+# -- operations (all return request ids) ------------------------------------
+
+def launch(task: Task, cluster_name: Optional[str] = None,
+           retry_until_up: bool = False,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False) -> str:
+    return _post("/launch", {
+        "task": task.to_yaml_config(), "cluster_name": cluster_name,
+        "retry_until_up": retry_until_up,
+        "idle_minutes_to_autostop": idle_minutes_to_autostop,
+        "down": down})["request_id"]
+
+
+def exec(task: Task, cluster_name: str) -> str:  # noqa: A001
+    return _post("/exec", {"task": task.to_yaml_config(),
+                           "cluster_name": cluster_name})["request_id"]
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> str:
+    return _post("/status", {"cluster_names": cluster_names,
+                             "refresh": refresh})["request_id"]
+
+
+def queue(cluster_name: str) -> str:
+    return _post("/queue", {"cluster_name": cluster_name})["request_id"]
+
+
+def stop(cluster_name: str) -> str:
+    return _post("/stop", {"cluster_name": cluster_name})["request_id"]
+
+
+def start(cluster_name: str) -> str:
+    return _post("/start", {"cluster_name": cluster_name})["request_id"]
+
+
+def down(cluster_name: str) -> str:
+    return _post("/down", {"cluster_name": cluster_name})["request_id"]
+
+
+def cancel(cluster_name: str, job_id: int) -> str:
+    return _post("/cancel", {"cluster_name": cluster_name,
+                             "job_id": job_id})["request_id"]
+
+
+def jobs_launch(task: Task, name: Optional[str] = None) -> str:
+    return _post("/jobs/launch", {"task": task.to_yaml_config(),
+                                  "name": name})["request_id"]
+
+
+def jobs_queue() -> str:
+    return _post("/jobs/queue", {})["request_id"]
+
+
+def serve_up(task: Task, service_name: str,
+             lb_port: Optional[int] = None) -> str:
+    return _post("/serve/up", {"task": task.to_yaml_config(),
+                               "service_name": service_name,
+                               "lb_port": lb_port})["request_id"]
+
+
+def serve_down(service_name: str) -> str:
+    return _post("/serve/down",
+                 {"service_name": service_name})["request_id"]
+
+
+# -- local server lifecycle --------------------------------------------------
+
+def api_info() -> Optional[Dict[str, Any]]:
+    try:
+        return _get_json("/api/health")
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def api_start(port: Optional[int] = None, wait: float = 15) -> Dict[str, Any]:
+    """Start a local API server daemon if none is running. The port
+    defaults to the one in SKYTPU_API_SERVER_URL (or 46580), and the
+    readiness poll targets that same port."""
+    if port is None:
+        port = urllib.parse.urlparse(_url()).port or 46580
+    os.environ["SKYTPU_API_SERVER_URL"] = f"http://127.0.0.1:{port}"
+    info = api_info()
+    if info is not None:
+        return info
+    log = os.path.join(paths.logs_dir(), "api_server.log")
+    with open(log, "ab") as f:
+        subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.server.server",
+             "--port", str(port)],
+            stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
+            env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
+    deadline = time.time() + wait
+    while time.time() < deadline:
+        info = api_info()
+        if info is not None:
+            return info
+        time.sleep(0.2)
+    raise exceptions.SkyTpuError("API server failed to start; see " + log)

@@ -1,0 +1,133 @@
+"""Async-request DB for the API server.
+
+Reference parity: sky/server/requests/requests.py (sqlite request
+records NEW->RUNNING->SUCCEEDED/FAILED/CANCELLED, per-request logs).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import sqlite3
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+
+class RequestStatus(enum.Enum):
+    NEW = "NEW"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS requests (
+    request_id TEXT PRIMARY KEY,
+    name TEXT,
+    status TEXT,
+    payload TEXT,
+    result TEXT,
+    error TEXT,
+    pid INTEGER,
+    created_at REAL,
+    finished_at REAL
+);
+"""
+
+
+@contextlib.contextmanager
+def _db():
+    conn = sqlite3.connect(paths.requests_db(), timeout=10)
+    conn.executescript(_SCHEMA)
+    try:
+        yield conn
+        conn.commit()
+    finally:
+        conn.close()
+
+
+def create(name: str, payload: Dict[str, Any]) -> str:
+    request_id = uuid.uuid4().hex[:16]
+    with _db() as c:
+        c.execute(
+            "INSERT INTO requests (request_id, name, status, payload,"
+            " created_at) VALUES (?,?,?,?,?)",
+            (request_id, name, RequestStatus.NEW.value,
+             json.dumps(payload), time.time()))
+    return request_id
+
+
+def next_new() -> Optional[Dict[str, Any]]:
+    """Claim the oldest NEW request (atomic via status flip)."""
+    with _db() as c:
+        row = c.execute(
+            "SELECT request_id FROM requests WHERE status=?"
+            " ORDER BY created_at LIMIT 1",
+            (RequestStatus.NEW.value,)).fetchone()
+        if row is None:
+            return None
+        n = c.execute(
+            "UPDATE requests SET status=? WHERE request_id=? AND status=?",
+            (RequestStatus.RUNNING.value, row[0],
+             RequestStatus.NEW.value)).rowcount
+        if n == 0:
+            return None
+    return get(row[0])
+
+
+def set_pid(request_id: str, pid: int) -> None:
+    with _db() as c:
+        c.execute("UPDATE requests SET pid=? WHERE request_id=?",
+                  (pid, request_id))
+
+
+def finish(request_id: str, status: RequestStatus,
+           result: Any = None, error: Optional[str] = None) -> None:
+    with _db() as c:
+        c.execute(
+            "UPDATE requests SET status=?, result=?, error=?, finished_at=?"
+            " WHERE request_id=?",
+            (status.value, json.dumps(result), error, time.time(),
+             request_id))
+
+
+def get(request_id: str) -> Optional[Dict[str, Any]]:
+    with _db() as c:
+        row = c.execute(
+            "SELECT request_id, name, status, payload, result, error, pid,"
+            " created_at, finished_at FROM requests WHERE request_id=?",
+            (request_id,)).fetchone()
+    if row is None:
+        return None
+    return {
+        "request_id": row[0], "name": row[1],
+        "status": RequestStatus(row[2]),
+        "payload": json.loads(row[3] or "{}"),
+        "result": json.loads(row[4]) if row[4] else None,
+        "error": row[5], "pid": row[6],
+        "created_at": row[7], "finished_at": row[8],
+    }
+
+
+def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    with _db() as c:
+        rows = c.execute(
+            "SELECT request_id FROM requests ORDER BY created_at DESC"
+            " LIMIT ?", (limit,)).fetchall()
+    return [r for rid, in rows if (r := get(rid)) is not None]
+
+
+def log_path(request_id: str) -> str:
+    d = os.path.join(paths.home(), "request_logs")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"{request_id}.log")

@@ -1,0 +1,204 @@
+"""The API server: REST over stdlib HTTP with an async request executor.
+
+Reference parity: sky/server/server.py (FastAPI app :145; every mutating
+endpoint creates a request record and returns its id) + the executor
+model of sky/server/requests/executor.py (few long-request workers, many
+short ones — here: a bounded dispatcher that runs each request as its
+own worker subprocess, so a crashing request never takes the server
+down).
+
+Endpoints:
+  POST /launch /exec /status /queue /stop /start /down /autostop /cancel
+       /cost_report /jobs/launch /jobs/queue /jobs/cancel
+       /serve/up /serve/status /serve/down     -> {"request_id": ...}
+  GET  /api/get?request_id=X                   -> request record (result)
+  GET  /api/stream?request_id=X                -> request log (text)
+  GET  /api/status                             -> recent requests
+  POST /api/cancel                             -> cancel a request
+  GET  /api/health                             -> {"status": "healthy"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from socketserver import ThreadingMixIn
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.requests_db import RequestStatus
+from skypilot_tpu.utils import paths
+
+MAX_CONCURRENT_REQUESTS = int(os.environ.get("SKYTPU_API_WORKERS", "8"))
+
+_ENDPOINTS = {
+    "/launch": "launch", "/exec": "exec", "/status": "status",
+    "/queue": "queue", "/stop": "stop", "/start": "start", "/down": "down",
+    "/autostop": "autostop", "/cancel": "cancel",
+    "/cost_report": "cost_report",
+    "/jobs/launch": "jobs.launch", "/jobs/queue": "jobs.queue",
+    "/jobs/cancel": "jobs.cancel",
+    "/serve/up": "serve.up", "/serve/status": "serve.status",
+    "/serve/down": "serve.down",
+}
+
+
+class Executor(threading.Thread):
+    """Dispatches NEW requests to worker subprocesses, bounded."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self._reap()
+            if len(self._procs) < MAX_CONCURRENT_REQUESTS:
+                rec = requests_db.next_new()
+                if rec is not None:
+                    self._spawn(rec)
+                    continue
+            time.sleep(0.05)
+
+    def _spawn(self, rec: Dict[str, Any]) -> None:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.server.worker",
+             "--request-id", rec["request_id"]],
+            env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
+        requests_db.set_pid(rec["request_id"], proc.pid)
+        self._procs[rec["request_id"]] = proc
+
+    def _reap(self) -> None:
+        for rid, proc in list(self._procs.items()):
+            if proc.poll() is not None:
+                del self._procs[rid]
+                # Worker died before recording a result? Mark failed.
+                rec = requests_db.get(rid)
+                if rec and not rec["status"].is_terminal():
+                    requests_db.finish(
+                        rid, RequestStatus.FAILED,
+                        error=f"worker exited with {proc.returncode} "
+                              f"without recording a result")
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def make_handler():
+    class ApiHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # -- helpers -------------------------------------------------------
+        def _json(self, code: int, obj: Any) -> None:
+            body = json.dumps(obj, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> Dict[str, Any]:
+            length = int(self.headers.get("Content-Length") or 0)
+            if not length:
+                return {}
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        # -- routes --------------------------------------------------------
+        def do_POST(self):
+            path = urllib.parse.urlparse(self.path).path
+            if path == "/api/cancel":
+                body = self._body()
+                rid = body.get("request_id")
+                rec = requests_db.get(rid) if rid else None
+                if rec is None:
+                    return self._json(404, {"error": "unknown request"})
+                if not rec["status"].is_terminal():
+                    if rec["pid"]:
+                        try:
+                            os.kill(rec["pid"], signal.SIGTERM)
+                        except OSError:
+                            pass
+                    requests_db.finish(rid, RequestStatus.CANCELLED)
+                return self._json(200, {"ok": True})
+            name = _ENDPOINTS.get(path)
+            if name is None:
+                return self._json(404, {"error": f"no endpoint {path}"})
+            rid = requests_db.create(name, self._body())
+            return self._json(200, {"request_id": rid})
+
+        def do_GET(self):
+            parsed = urllib.parse.urlparse(self.path)
+            qs = urllib.parse.parse_qs(parsed.query)
+            if parsed.path == "/api/health":
+                return self._json(200, {"status": "healthy",
+                                        "version": _version()})
+            if parsed.path == "/api/status":
+                return self._json(200, [
+                    {**r, "status": r["status"].value}
+                    for r in requests_db.list_requests()])
+            if parsed.path == "/api/get":
+                rid = (qs.get("request_id") or [None])[0]
+                rec = requests_db.get(rid) if rid else None
+                if rec is None:
+                    return self._json(404, {"error": "unknown request"})
+                return self._json(200, {**rec, "status": rec["status"].value})
+            if parsed.path == "/api/stream":
+                rid = (qs.get("request_id") or [None])[0]
+                log = requests_db.log_path(rid) if rid else None
+                content = ""
+                if log and os.path.exists(log):
+                    content = open(log).read()
+                body = content.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            return self._json(404, {"error": f"no endpoint {parsed.path}"})
+
+        def log_message(self, *args):
+            pass
+
+    return ApiHandler
+
+
+def _version() -> str:
+    import skypilot_tpu
+    return skypilot_tpu.__version__
+
+
+class _Server(ThreadingMixIn, HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def serve(host: str = "127.0.0.1", port: int = 46580) -> None:
+    executor = Executor()
+    executor.start()
+    httpd = _Server((host, port), make_handler())
+    try:
+        httpd.serve_forever()
+    finally:
+        executor.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=46580)
+    args = ap.parse_args()
+    serve(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

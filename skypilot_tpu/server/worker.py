@@ -1,0 +1,111 @@
+"""Request worker: executes one API request in its own process.
+
+Reference parity: sky/server/requests/executor.py
+(_request_execution_wrapper :222 — forked process per request, output
+redirected to the per-request log).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import sys
+import traceback
+from typing import Any, Dict
+
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.requests_db import RequestStatus
+
+
+def _execute(name: str, payload: Dict[str, Any]) -> Any:
+    import skypilot_tpu as sky
+    from skypilot_tpu.task import Task
+
+    if name == "launch":
+        task = Task.from_yaml_config(payload["task"])
+        job_id, handle = sky.launch(
+            task, cluster_name=payload.get("cluster_name"),
+            retry_until_up=payload.get("retry_until_up", False),
+            idle_minutes_to_autostop=payload.get("idle_minutes_to_autostop"),
+            down=payload.get("down", False))
+        return {"job_id": job_id,
+                "cluster_name": handle.cluster_name if handle else None}
+    if name == "exec":
+        task = Task.from_yaml_config(payload["task"])
+        job_id, handle = sky.exec(task, cluster_name=payload["cluster_name"])
+        return {"job_id": job_id, "cluster_name": handle.cluster_name}
+    if name == "status":
+        records = sky.status(payload.get("cluster_names"),
+                             refresh=payload.get("refresh", False))
+        return [{**r, "status": r["status"].value} for r in records]
+    if name == "queue":
+        jobs = sky.queue(payload["cluster_name"])
+        return [{**j, "status": j["status"].value} for j in jobs]
+    if name in ("stop", "start", "down"):
+        getattr(sky, name)(payload["cluster_name"])
+        return {"ok": True}
+    if name == "autostop":
+        sky.autostop(payload["cluster_name"], payload["idle_minutes"],
+                     payload.get("down", False))
+        return {"ok": True}
+    if name == "cancel":
+        sky.cancel(payload["cluster_name"], payload["job_id"])
+        return {"ok": True}
+    if name == "cost_report":
+        return sky.cost_report()
+    if name == "jobs.launch":
+        from skypilot_tpu.jobs import core as jobs_core
+        task = Task.from_yaml_config(payload["task"])
+        return {"job_id": jobs_core.launch(task, name=payload.get("name"))}
+    if name == "jobs.queue":
+        from skypilot_tpu.jobs import core as jobs_core
+        return [{**r, "status": r["status"].value}
+                for r in jobs_core.queue()]
+    if name == "jobs.cancel":
+        from skypilot_tpu.jobs import core as jobs_core
+        jobs_core.cancel(payload["job_id"])
+        return {"ok": True}
+    if name == "serve.up":
+        from skypilot_tpu.serve import core as serve_core
+        task = Task.from_yaml_config(payload["task"])
+        return serve_core.up(task, payload["service_name"],
+                             lb_port=payload.get("lb_port"))
+    if name == "serve.status":
+        from skypilot_tpu.serve import core as serve_core
+        out = []
+        for s in serve_core.status(payload.get("service_name")):
+            out.append({**s, "status": s["status"].value,
+                        "replicas": [{**r, "status": r["status"].value}
+                                     for r in s["replicas"]]})
+        return out
+    if name == "serve.down":
+        from skypilot_tpu.serve import core as serve_core
+        serve_core.down(payload["service_name"],
+                        purge=payload.get("purge", False))
+        return {"ok": True}
+    raise ValueError(f"unknown request name {name!r}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--request-id", required=True)
+    args = ap.parse_args()
+    rec = requests_db.get(args.request_id)
+    if rec is None:
+        sys.exit(1)
+    log = requests_db.log_path(args.request_id)
+    with open(log, "a", buffering=1) as f, \
+            contextlib.redirect_stdout(f), contextlib.redirect_stderr(f):
+        try:
+            result = _execute(rec["name"], rec["payload"])
+            requests_db.finish(args.request_id, RequestStatus.SUCCEEDED,
+                               result=result)
+        except Exception as e:  # noqa: BLE001 — report to the client
+            traceback.print_exc()
+            requests_db.finish(args.request_id, RequestStatus.FAILED,
+                               error=f"{type(e).__name__}: {e}")
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
