@@ -87,17 +87,21 @@ def main() -> None:
     step = trainer.make_train_step(cfg, tc, mesh)
     batch = trainer.synthetic_batch(cfg, args.batch, seq)
     state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
-    log(f"compile+first step: {time.time()-t0:.1f}s loss={float(metrics['loss']):.3f}")
+    # NOTE: on the axon TPU relay, jax.block_until_ready does NOT
+    # synchronize; a host fetch (float()) is the only reliable sync.
+    # The timed loop is chained through donated state, so fetching the
+    # final loss waits on every step.
+    first_loss = float(metrics["loss"])
+    log(f"compile+first step: {time.time()-t0:.1f}s loss={first_loss:.3f}")
 
     for _ in range(args.warmup - 1):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
     t0 = time.time()
     for _ in range(args.steps):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])  # host fetch = real sync
     dt = (time.time() - t0) / args.steps
 
     tokens_per_step = args.batch * seq

@@ -51,7 +51,12 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                   causal: bool = True, impl: str = "auto") -> jax.Array:
-    """Grouped-query attention. q: [B, S, Hq, D]; k/v: [B, S, Hkv, D]."""
+    """Grouped-query attention. q: [B, S, Hq, D]; k/v: [B, S, Hkv, D].
+
+    impl: "auto" | "flash" | "xla" (env override: SKYTPU_ATTN_IMPL).
+    """
+    import os
+    impl = os.environ.get("SKYTPU_ATTN_IMPL", impl)
     n_rep = q.shape[2] // k.shape[2]
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)

@@ -1,0 +1,315 @@
+"""Flash attention (FlashAttention-2) as Pallas TPU kernels.
+
+Design (per the pallas TPU playbook):
+* Grid (batch, heads, q-blocks); the KV sweep is a ``fori_loop`` inside
+  the kernel with the online-softmax running max/sum carried in
+  registers; accumulation in float32 scratch, output cast to the input
+  dtype (bf16 on TPU -> MXU-native matmuls).
+* Causal masking prunes whole KV blocks: q-block i only sweeps KV
+  blocks 0..i, and only the diagonal block pays the element mask.
+* Backward is the standard FA-2 split: a dKV kernel (grid over KV
+  blocks, sweeping q-blocks >= diagonal) and a dQ kernel (grid over
+  q-blocks, sweeping KV blocks <= diagonal), both recomputing P from
+  the saved logsumexp instead of materializing S.
+
+Used by ops.attention.gqa_attention on TPU for long sequences; the
+einsum path remains the fallback (and the numerics oracle in tests,
+which run this kernel with ``interpret=True`` on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+LANES = 128  # lane-replicated rowwise stats (Mosaic tiling)
+NEG_INF = -1e30
+
+
+def _blocks(s: int, b: int) -> int:
+    return (s + b - 1) // b
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                causal: bool, block_k: int, seq_len: int):
+    qi = pl.program_id(2)
+    block_q = q_ref.shape[0]
+    q = q_ref[...].astype(jnp.float32) * scale  # [Bq, D]
+
+    num_kv = _blocks(seq_len, block_k)
+    if causal:
+        # KV blocks strictly after this q block's end contribute nothing.
+        num_kv_live = lax.div(qi * block_q + block_q - 1, block_k) + 1
+    else:
+        num_kv_live = num_kv
+
+    def body(ki, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[pl.ds(ki * block_k, block_k), :]
+        v = v_ref[pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [Bq, Bk]
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    d = q_ref.shape[1]
+    init = (jnp.zeros((block_q, d), jnp.float32),
+            jnp.full((block_q, 1), NEG_INF, jnp.float32),
+            jnp.zeros((block_q, 1), jnp.float32))
+    acc, m, l = lax.fori_loop(0, num_kv_live, body, init)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    # Lane-replicated (Bq, 128) layout: Mosaic cannot tile 1-lane blocks.
+    lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape)
+
+
+def _fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
+         interpret: bool):
+    """q,k,v: [B, H, S, D] -> (o [B,H,S,D], lse [B,H,S,1] f32)."""
+    b, h, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    grid = (b, h, _blocks(s, block_q))
+    qspec = pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0))
+    kvspec = pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0))
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+        _fwd_kernel(q_ref.at[0, 0], k_ref.at[0, 0], v_ref.at[0, 0],
+                    o_ref.at[0, 0], lse_ref.at[0, 0],
+                    scale=scale, causal=causal, block_k=block_k,
+                    seq_len=s)
+
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=[qspec,
+                   pl.BlockSpec((1, 1, block_q, LANES),
+                                lambda bi, hi, qi: (bi, hi, qi, 0))],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((b, h, s, LANES), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, causal: bool,
+                    block_q: int, seq_len: int):
+    ki = pl.program_id(2)
+    block_k = k_ref.shape[0]
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    num_q = _blocks(seq_len, block_q)
+    # Causal: q blocks before this KV block's start see nothing of it.
+    q_start = lax.div(ki * block_k, block_q) if causal else 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(qi * block_q, block_q), :]
+        do = do_ref[pl.ds(qi * block_q, block_q), :]
+        lse = jnp.max(lse_ref[pl.ds(qi * block_q, block_q), :], axis=1,
+                      keepdims=True)
+        delta = jnp.max(delta_ref[pl.ds(qi * block_q, block_q), :], axis=1,
+                        keepdims=True)
+        q = q.astype(jnp.float32) * scale
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, k.shape[0]), 0)
+            k_pos = ki * k.shape[0] + lax.broadcasted_iota(
+                jnp.int32, (block_q, k.shape[0]), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [Bq, Bk]
+        do_f = do.astype(jnp.float32)
+        dv_new = dv + jax.lax.dot_general(
+            p, do_f, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # P^T dO
+        dp = jax.lax.dot_general(do_f, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)  # [Bq, Bk]
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # dS^T q (already scaled)
+        return dk_new, dv_new
+
+    d = k.shape[1]
+    init = (jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32))
+    dk, dv = lax.fori_loop(q_start, num_q, body, init)
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale: float, causal: bool, block_k: int,
+                   seq_len: int):
+    qi = pl.program_id(2)
+    block_q = q_ref.shape[0]
+    q = q_ref[...].astype(jnp.float32) * scale
+    do = do_ref[...].astype(jnp.float32)
+    lse = jnp.max(lse_ref[...], axis=1, keepdims=True)
+    delta = jnp.max(delta_ref[...], axis=1, keepdims=True)
+    num_kv = _blocks(seq_len, block_k)
+    num_kv_live = (lax.div(qi * block_q + block_q - 1, block_k) + 1
+                   if causal else num_kv)
+
+    def body(ki, dq):
+        k = k_ref[pl.ds(ki * block_k, block_k), :]
+        v = v_ref[pl.ds(ki * block_k, block_k), :]
+        k = k.astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, num_kv_live, body,
+                       jnp.zeros((block_q, q.shape[1]), jnp.float32))
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, o, lse = residuals
+    b, h, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    # delta = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it well.
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B,H,S,1]
+    delta = jnp.broadcast_to(delta, (b, h, s, LANES))
+
+    full = lambda bi, hi, i: (bi, hi, 0, 0)
+    kv_blocked = pl.BlockSpec((1, 1, block_k, d),
+                              lambda bi, hi, ki: (bi, hi, ki, 0))
+    q_blocked = pl.BlockSpec((1, 1, block_q, d),
+                             lambda bi, hi, qi: (bi, hi, qi, 0))
+    seq_full_d = pl.BlockSpec((1, 1, s, d), full)
+    seq_full_1 = pl.BlockSpec((1, 1, s, LANES), full)
+
+    dkv_kernel = functools.partial(
+        _pack_dkv, scale=scale, causal=causal, block_q=block_q, seq_len=s)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, _blocks(s, block_k)),
+        in_specs=[seq_full_d, kv_blocked, kv_blocked, seq_full_d,
+                  seq_full_1, seq_full_1],
+        out_specs=[kv_blocked, kv_blocked],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dq_kernel = functools.partial(
+        _pack_dq, scale=scale, causal=causal, block_k=block_k, seq_len=s)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, _blocks(s, block_q)),
+        in_specs=[q_blocked, seq_full_d, seq_full_d, q_blocked,
+                  pl.BlockSpec((1, 1, block_q, LANES),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+                  pl.BlockSpec((1, 1, block_q, LANES),
+                               lambda bi, hi, qi: (bi, hi, qi, 0))],
+        out_specs=q_blocked,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+def _pack_dkv(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+              dv_ref, **kw):
+    _bwd_dkv_kernel(q_ref.at[0, 0], k_ref.at[0, 0], v_ref.at[0, 0],
+                    do_ref.at[0, 0], lse_ref.at[0, 0], delta_ref.at[0, 0],
+                    dk_ref.at[0, 0], dv_ref.at[0, 0], **kw)
+
+
+def _pack_dq(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+             **kw):
+    _bwd_dq_kernel(q_ref.at[0, 0], k_ref.at[0, 0], v_ref.at[0, 0],
+                   do_ref.at[0, 0], lse_ref.at[0, 0], delta_ref.at[0, 0],
+                   dq_ref.at[0, 0], **kw)
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                interpret=interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal=causal, block_q=block_q,
+                  block_k=block_k, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    return _bwd(causal, block_q, block_k, interpret, residuals, g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False):
+    """q, k, v: [B, S, H, D] (same layout as ops.attention) -> [B, S, H, D].
+
+    K/V must already be GQA-expanded to H heads (ops.attention does it).
+    """
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} must be divisible by block sizes "
+                         f"({block_q}, {block_k})")
+    # [B,S,H,D] -> [B,H,S,D] for the kernels.
+    qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+    o = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    return o.swapaxes(1, 2)
