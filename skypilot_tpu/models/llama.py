@@ -176,15 +176,39 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _attention(q, k, v, cfg: LlamaConfig, positions=None):
-    """Grouped-query causal attention; dispatches to ops.attention."""
+def _attention(q, k, v, cfg: LlamaConfig, mesh=None, rules=None):
+    """Grouped-query causal attention; dispatches to ops.attention.
+
+    With a mesh whose sequence mesh-axis (per the activation rule table,
+    default ``seq -> sp``) is > 1, the sequence dimension is
+    context-parallel: ring attention over that ring, circulating the
+    unrepeated KV heads (see parallel.ring_attention). Otherwise local
+    flash/XLA attention. Mesh-axis names come from the rules table, never
+    hardcoded here.
+    """
     from skypilot_tpu.ops import attention as attn_ops
+    if mesh is not None:
+        from skypilot_tpu.parallel import ring_attention as ra
+        from skypilot_tpu.parallel import sharding as sh
+        rules = rules if rules is not None else sh.ACT_RULES
+        seq_axis = rules.get("seq")
+        if (isinstance(seq_axis, str)
+                and mesh.shape.get(seq_axis, 1) > 1
+                and q.shape[1] % mesh.shape[seq_axis] == 0):
+            # (seq not divisible by the ring size falls through to local
+            # attention — same degrade-to-replicated convention as spec_for.)
+            heads_axis = rules.get("heads")
+            return ra.ring_attention(
+                q, k, v, mesh, causal=True, axis=seq_axis,
+                batch_axes=rules.get("batch"),
+                heads_axis=heads_axis if isinstance(heads_axis, str) else None)
     return attn_ops.gqa_attention(q, k, v, causal=True)
 
 
 def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
                   cos: jax.Array, sin: jax.Array,
-                  constrain=lambda x, axes: x) -> jax.Array:
+                  constrain=lambda x, axes: x, mesh=None,
+                  rules=None) -> jax.Array:
     """One pre-norm decoder block. x: [B, S, D]."""
     B, S, D = x.shape
     h = rms_norm(x, layer["ln1"], cfg.norm_eps)
@@ -195,7 +219,7 @@ def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
     k = apply_rope(k, cos, sin)
     q = constrain(q, ("batch", "seq", "heads", "head_dim"))
     k = constrain(k, ("batch", "seq", "kv_heads", "head_dim"))
-    o = _attention(q, k, v, cfg)
+    o = _attention(q, k, v, cfg, mesh, rules)
     o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
     x = x + constrain(o, ("batch", "seq", "embed"))
 
@@ -212,11 +236,13 @@ def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
 # ---------------------------------------------------------------------------
 
 def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
-            constrain=None) -> jax.Array:
+            constrain=None, mesh=None, rules=None) -> jax.Array:
     """Token ids [B, S] -> logits [B, S, vocab] (float32).
 
     ``constrain`` is an optional fn(x, logical_axes) -> x applying
     ``with_sharding_constraint``; identity when running unsharded.
+    ``mesh`` (+ optional activation ``rules``) enables the
+    context-parallel attention path when the seq mesh-axis is > 1.
     """
     if constrain is None:
         constrain = lambda x, axes: x
@@ -228,7 +254,7 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
     cos, sin = rope_frequencies(cfg, positions)
 
     def body(carry, layer):
-        y = decoder_layer(cfg, carry, layer, cos, sin, constrain)
+        y = decoder_layer(cfg, carry, layer, cos, sin, constrain, mesh, rules)
         return y, None
 
     if cfg.remat:
@@ -243,11 +269,12 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
 
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: LlamaConfig,
-            constrain=None) -> tuple[jax.Array, Dict[str, jax.Array]]:
+            constrain=None, mesh=None,
+            rules=None) -> tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross-entropy. batch: {"tokens": [B, S] int32,
     optionally "mask": [B, S] (1 = predict this position's *next* token)}."""
     tokens = batch["tokens"]
-    logits = forward(params, tokens, cfg, constrain)
+    logits = forward(params, tokens, cfg, constrain, mesh, rules)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logps = jax.nn.log_softmax(logits, axis=-1)

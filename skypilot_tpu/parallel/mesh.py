@@ -4,8 +4,10 @@ The canonical mesh axes, outermost to innermost:
 
   ``dp``   pure data parallel (gradients all-reduced; params replicated)
   ``fsdp`` fully-sharded data parallel (params/opt-state sharded on embed dim)
-  ``tp``   tensor parallel (heads / mlp / vocab dims sharded)
   ``sp``   sequence/context parallel (ring attention; defaults to 1)
+  ``tp``   tensor parallel (heads / mlp / vocab dims sharded) — innermost:
+           per-layer all-reduces ride the fastest ICI wires; the sp ring's
+           neighbor ppermutes sit just outside
 
 Axis *order matters* on TPU: innermost axes map to the densest ICI links,
 so tensor-parallel collectives (per-layer all-reduces) ride the fastest
@@ -29,7 +31,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("dp", "fsdp", "tp", "sp")
+MESH_AXES = ("dp", "fsdp", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,8 +62,8 @@ def make_mesh(shape: Optional[MeshShape | Dict[str, int]] = None,
         raise ValueError(
             f"mesh shape {shape.as_dict()} needs {shape.size} devices, "
             f"got {n}")
-    dev_array = np.asarray(devices).reshape(shape.dp, shape.fsdp, shape.tp,
-                                            shape.sp)
+    dev_array = np.asarray(devices).reshape(shape.dp, shape.fsdp, shape.sp,
+                                            shape.tp)
     return Mesh(dev_array, MESH_AXES)
 
 

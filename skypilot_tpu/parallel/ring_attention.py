@@ -1,0 +1,286 @@
+"""Context parallelism: ring attention + Ulysses all-to-all attention.
+
+Long-sequence attention sharded over the ``sp`` mesh axis. Two schemes,
+both expressed as shard_map'ed collectives so XLA schedules the ICI
+traffic (and can overlap `ppermute` with the block matmuls):
+
+* **Ring attention** (`ring_attention`): K/V blocks circulate around the
+  ``sp`` ring via `lax.ppermute` while each device keeps its query shard;
+  softmax is accumulated online (flash-style running max/sum), so no
+  device ever materializes more than one remote K/V block. A custom VJP
+  runs a second ring in the backward pass with dK/dV accumulators riding
+  along with their K/V blocks — memory stays O(seq/sp) per device in both
+  passes. GQA is native: the *unrepeated* K/V heads circulate (grouped
+  einsums inside the ring body), so ICI volume and resident KV bytes are
+  n_kv_heads-sized, not n_heads-sized.
+
+* **Ulysses attention** (`ulysses_attention`): `lax.all_to_all` reshards
+  [seq/sp, heads] -> [seq, heads/sp], runs ordinary (flash) attention on
+  full sequences for a head subset, and reshards back. Cheaper in
+  collective volume when heads >= sp; requires heads % sp == 0.
+
+Reference parity: the reference has NO sequence/context parallelism
+anywhere (SURVEY.md §2.11 — long-context is delegated to workload
+engines like vLLM/DeepSpeed). Here it is first-class, per the TPU-native
+mandate: sequence parallelism shapes the core mesh design (the ``sp``
+axis in parallel.mesh) rather than being an external recipe concern.
+
+Causal note: blocks entirely in the masked future still do the matmul
+and are zeroed (uniform work per ring step keeps the collective schedule
+static). A zigzag layout that load-balances causal work is a known
+follow-up optimization; correctness and memory scaling come first.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_perm(axis_size: int):
+    return [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+
+def _block_mask(my_idx, kv_idx, s_q: int, s_k: int):
+    """Causal mask between global query/key positions of two ring blocks."""
+    q_pos = my_idx * s_q + jnp.arange(s_q)
+    k_pos = kv_idx * s_k + jnp.arange(s_k)
+    return q_pos[:, None] >= k_pos[None, :]
+
+
+def _group(q, n_kv: int):
+    """[B, S, Hq, D] -> [B, S, Hkv, G, D] with G = Hq // Hkv."""
+    B, S, Hq, D = q.shape
+    return q.reshape(B, S, n_kv, Hq // n_kv, D)
+
+
+# ---------------------------------------------------------------------------
+# Forward ring
+# ---------------------------------------------------------------------------
+
+def _ring_fwd(axis_name: str, axis_size: int, causal: bool, q, k, v):
+    """Local q [B,S,Hq,D]; k/v [B,S,Hkv,D], Hq % Hkv == 0.
+
+    Returns (o [B,S,Hq,D], lse [B,Hkv,G,S]). Grouped (GQA) einsums: the
+    circulating K/V stay at Hkv heads.
+    """
+    scale = q.shape[-1] ** -0.5
+    my_idx = lax.axis_index(axis_name)
+    B, S, Hq, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    perm = _ring_perm(axis_size)
+    q5 = _group(q, Hkv)  # [B, S, Hkv, G, D]
+
+    o0 = jnp.zeros((B, S, Hkv, G, D), jnp.float32)
+    m0 = jnp.full((B, Hkv, G, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, S), jnp.float32)
+
+    def step(carry, i):
+        o, m, l, k, v = carry
+        kv_idx = (my_idx - i) % axis_size
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = _block_mask(my_idx, kv_idx, S, Sk)
+            s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        l = l * alpha + p.sum(axis=-1)
+        pv = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v,
+                        preferred_element_type=jnp.float32)
+        o = o * alpha.transpose(0, 3, 1, 2)[..., None] + pv
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return (o, m_new, l, k, v), None
+
+    (o, m, l, k, v), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(axis_size))
+    # axis_size permutes = identity: k/v are home again (used by the bwd).
+    l_safe = jnp.maximum(l, 1e-30)
+    o = (o / l_safe.transpose(0, 3, 1, 2)[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l_safe)
+    return o.reshape(B, S, Hq, D), lse
+
+
+# ---------------------------------------------------------------------------
+# Backward ring: dK/dV accumulators travel with their K/V blocks.
+# ---------------------------------------------------------------------------
+
+def _ring_bwd(axis_name: str, axis_size: int, causal: bool, res, do):
+    q, k, v, o, lse = res
+    scale = q.shape[-1] ** -0.5
+    my_idx = lax.axis_index(axis_name)
+    B, S, Hq, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    perm = _ring_perm(axis_size)
+    q5 = _group(q, Hkv)
+    do5 = _group(do, Hkv)
+
+    # delta_i = sum_d do_i * o_i  (rowwise), [B, Hkv, G, S]
+    delta = jnp.einsum("bqhgd,bqhgd->bhgq", do5.astype(jnp.float32),
+                       _group(o, Hkv).astype(jnp.float32))
+
+    dq0 = jnp.zeros(q5.shape, jnp.float32)
+    dk0 = jnp.zeros_like(k, jnp.float32)
+    dv0 = jnp.zeros_like(v, jnp.float32)
+
+    def step(carry, i):
+        dq, k, v, dk, dv = carry
+        kv_idx = (my_idx - i) % axis_size
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k,
+                       preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[..., None])
+        if causal:
+            mask = _block_mask(my_idx, kv_idx, S, Sk)
+            p = jnp.where(mask, p, 0.0)
+        dv = dv + jnp.einsum("bhgqk,bqhgd->bkhd", p.astype(do.dtype), do5,
+                             preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqhgd,bkhd->bhgqk", do5, v,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        ds_c = ds.astype(q.dtype)
+        dq = dq + jnp.einsum("bhgqk,bkhd->bqhgd", ds_c, k,
+                             preferred_element_type=jnp.float32)
+        dk = dk + jnp.einsum("bhgqk,bqhgd->bkhd", ds_c, q5,
+                             preferred_element_type=jnp.float32)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return (dq, k, v, dk, dv), None
+
+    (dq, k, v, dk, dv), _ = lax.scan(step, (dq0, k, v, dk0, dv0),
+                                     jnp.arange(axis_size))
+    return (dq.reshape(B, S, Hq, D).astype(q.dtype),
+            dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ring_attn(axis_name: str, axis_size: int, causal: bool, q, k, v):
+    o, _ = _ring_fwd(axis_name, axis_size, causal, q, k, v)
+    return o
+
+
+def _ring_attn_fwd(axis_name, axis_size, causal, q, k, v):
+    o, lse = _ring_fwd(axis_name, axis_size, causal, q, k, v)
+    return o, (q, k, v, o, lse)
+
+
+_ring_attn.defvjp(_ring_attn_fwd, _ring_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) local body
+# ---------------------------------------------------------------------------
+
+def _ulysses_local(axis_name: str, axis_size: int, causal: bool, q, k, v):
+    """[B, S/n, H, D] local -> attention over full seq on H/n heads."""
+    from skypilot_tpu.ops import attention as attn_ops
+    # seq-sharded -> head-sharded: split heads (axis 2), concat seq (axis 1)
+    q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    o = attn_ops.gqa_attention(q, k, v, causal=causal)
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Public API (global arrays, jit-compatible: shard_map inside jit)
+# ---------------------------------------------------------------------------
+
+def _batch_spec(batch_axes, mesh: Mesh, b: int):
+    """Largest prefix of batch_axes whose product divides b (else None)."""
+    if isinstance(batch_axes, str):
+        batch_axes = (batch_axes,)
+    keep = []
+    prod = 1
+    for a in batch_axes or ():
+        prod *= mesh.shape[a]
+        if b % prod != 0:
+            break
+        keep.append(a)
+    return tuple(keep) if keep else None
+
+
+def _qkv_specs(mesh: Mesh, axis: str, batch_axes, heads_axis, q, k):
+    """Shard specs with the same divisibility fallback as sharding.spec_for:
+    a dim the mapped axis does not divide is replicated, not an error.
+
+    Heads sharding is all-or-nothing across q AND kv: sharding q heads
+    while replicating kv heads would re-pair grouped (GQA) heads with the
+    wrong kv head inside each shard.
+    """
+    bspec = _batch_spec(batch_axes, mesh, q.shape[0])
+    hspec = heads_axis
+    if (heads_axis is None
+            or q.shape[2] % mesh.shape[heads_axis] != 0
+            or k.shape[2] % mesh.shape[heads_axis] != 0):
+        hspec = None
+    q_spec = P(bspec, axis, hspec, None)
+    kv_spec = P(bspec, axis, hspec, None)
+    return q_spec, kv_spec
+
+
+def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
+                   axis: str = "sp", batch_axes=("dp", "fsdp"),
+                   heads_axis: Optional[str] = "tp"):
+    """Ring attention over `axis`. q [B,S,Hq,D]; k/v [B,S,Hkv,D] (GQA ok:
+    Hq % Hkv == 0; unrepeated K/V heads circulate the ring)."""
+    n = mesh.shape[axis]
+    if q.shape[1] % n != 0:
+        raise ValueError(f"seq {q.shape[1]} not divisible by {axis}={n}")
+    if q.shape[2] % k.shape[2] != 0:
+        raise ValueError(f"q heads {q.shape[2]} not a multiple of kv heads "
+                         f"{k.shape[2]}")
+    q_spec, kv_spec = _qkv_specs(mesh, axis, batch_axes, heads_axis, q, k)
+    fn = jax.shard_map(
+        functools.partial(_ring_attn, axis, n, causal),
+        mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
+                      axis: str = "sp", batch_axes=("dp", "fsdp"),
+                      heads_axis: Optional[str] = "tp"):
+    """All-to-all (Ulysses) sequence parallelism over `axis`.
+
+    Requires per-shard head counts (q and kv) divisible by the sp size:
+    the all_to_all converts the seq shard into a head shard.
+    """
+    n = mesh.shape[axis]
+    if q.shape[1] % n != 0:
+        raise ValueError(f"seq {q.shape[1]} not divisible by {axis}={n}")
+    q_spec, kv_spec = _qkv_specs(mesh, axis, batch_axes, heads_axis, q, k)
+    tp = mesh.shape[heads_axis] if q_spec[2] is not None else 1
+    for name, arr in (("q", q), ("kv", k)):
+        local_heads = arr.shape[2] // (tp if arr.shape[2] % tp == 0 else 1)
+        if local_heads % n != 0:
+            raise ValueError(
+                f"{name} heads/shard = {local_heads} not divisible by "
+                f"{axis}={n}; use ring_attention instead")
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis, n, causal),
+        mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def context_parallel_attention(q, k, v, mesh: Mesh, causal: bool = True,
+                               impl: str = "ring", **kw):
+    """Dispatch: impl in {"ring", "ulysses"}."""
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, mesh, causal=causal, **kw)
+    return ring_attention(q, k, v, mesh, causal=causal, **kw)

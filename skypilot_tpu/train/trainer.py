@@ -119,7 +119,8 @@ def make_train_step(cfg: llama.LlamaConfig, tc: TrainConfig,
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
         def lossf(params):
-            return llama.loss_fn(params, batch, cfg, constrain)
+            return llama.loss_fn(params, batch, cfg, constrain, mesh,
+                                 act_rules)
 
         (loss, metrics), grads = jax.value_and_grad(lossf, has_aux=True)(
             state["params"])
