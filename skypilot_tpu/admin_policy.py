@@ -1,0 +1,103 @@
+"""Admin policy plugin: org-level request mutation/validation.
+
+Reference parity: sky/admin_policy.py (UserRequest / MutatedUserRequest /
+AdminPolicy ABC) + sky/utils/admin_policy_utils.py (application at
+execution.py:180). The policy class is named by the ``admin_policy``
+key in config.yaml (``module.path.ClassName``), imported lazily, and
+invoked with every launch/exec request before optimization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass
+class RequestOptions:
+    """Context about the request, for the policy to inspect."""
+    cluster_name: Optional[str] = None
+    idle_minutes_to_autostop: Optional[int] = None
+    down: bool = False
+    dryrun: bool = False
+
+
+@dataclasses.dataclass
+class UserRequest:
+    """What the policy sees: the task plus effective global config."""
+    task: Any  # skypilot_tpu.task.Task
+    skypilot_config: Dict[str, Any]
+    request_options: Optional[RequestOptions] = None
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: Any
+    skypilot_config: Dict[str, Any]
+
+
+class AdminPolicy:
+    """Subclass and implement validate_and_mutate; reject by raising."""
+
+    @classmethod
+    def validate_and_mutate(cls, user_request: UserRequest
+                            ) -> MutatedUserRequest:
+        raise NotImplementedError
+
+
+class PolicyError(exceptions.SkyTpuError):
+    """Raised when the admin policy rejects a request."""
+
+
+def _load_policy() -> Optional[type]:
+    spec = config_lib.get_nested(("admin_policy",))
+    if not spec:
+        return None
+    module_path, _, class_name = spec.rpartition(".")
+    if not module_path:
+        raise PolicyError(f"admin_policy '{spec}' is not a module.Class path")
+    try:
+        module = importlib.import_module(module_path)
+        policy_cls = getattr(module, class_name)
+    except (ImportError, AttributeError) as e:
+        raise PolicyError(f"cannot import admin_policy '{spec}': {e}") from e
+    if not issubclass(policy_cls, AdminPolicy):
+        raise PolicyError(
+            f"admin_policy '{spec}' must subclass "
+            f"skypilot_tpu.admin_policy.AdminPolicy")
+    return policy_cls
+
+
+def apply(task, request_options: Optional[RequestOptions] = None):
+    """Run the configured policy over the task.
+
+    Returns ``(task, mutated_config_or_None)``. A non-None config is the
+    policy's replacement for the effective global config and must be
+    applied for the rest of the request (config_lib.replace_config).
+    """
+    policy_cls = _load_policy()
+    if policy_cls is None:
+        return task, None
+    original_config = config_lib.to_dict()
+    request = UserRequest(task=task,
+                          skypilot_config=config_lib.to_dict(),
+                          request_options=request_options)
+    try:
+        mutated = policy_cls.validate_and_mutate(request)
+    except PolicyError:
+        raise
+    except exceptions.SkyTpuError:
+        raise
+    except Exception as e:  # noqa: BLE001 — policy bugs surface as rejection
+        raise PolicyError(f"admin policy rejected request: {e}") from e
+    if not isinstance(mutated, MutatedUserRequest):
+        raise PolicyError(
+            f"admin policy must return MutatedUserRequest, got "
+            f"{type(mutated).__name__}")
+    mutated_config = (mutated.skypilot_config
+                      if mutated.skypilot_config != original_config else None)
+    return mutated.task, mutated_config

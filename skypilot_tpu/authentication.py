@@ -1,0 +1,92 @@
+"""SSH keypair management + per-cloud public-key registration.
+
+Reference parity: sky/authentication.py (keypair generation at
+``~/.ssh/sky-key``, per-cloud pubkey upload). Here: an ed25519 keypair
+at ``~/.ssh/skypilot_tpu`` generated once with ssh-keygen (every image
+ships it), and GCP registration via project OS Login metadata — the
+TPU-VM path uses the project-wide ``ssh-keys`` metadata entry exactly
+as ``gcloud compute tpus tpu-vm ssh`` does.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import subprocess
+from typing import Tuple
+
+_KEY_PATH = "~/.ssh/skypilot_tpu"
+
+
+def key_paths() -> Tuple[str, str]:
+    priv = os.path.expanduser(
+        os.environ.get("SKYPILOT_TPU_SSH_KEY", _KEY_PATH))
+    return priv, priv + ".pub"
+
+
+@functools.lru_cache(maxsize=None)
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Return (private, public) key paths, generating once if absent."""
+    priv, pub = key_paths()
+    if not os.path.exists(priv):
+        os.makedirs(os.path.dirname(priv), mode=0o700, exist_ok=True)
+        subprocess.run(
+            ["ssh-keygen", "-t", "ed25519", "-N", "", "-q", "-f", priv,
+             "-C", "skypilot-tpu"],
+            check=True, capture_output=True)
+        os.chmod(priv, 0o600)
+    if not os.path.exists(pub):
+        out = subprocess.run(["ssh-keygen", "-y", "-f", priv],
+                             check=True, capture_output=True, text=True)
+        with open(pub, "w") as f:
+            f.write(out.stdout)
+    return priv, pub
+
+
+def public_key_openssh() -> str:
+    _, pub = get_or_generate_keys()
+    with open(pub) as f:
+        return f.read().strip()
+
+
+def _gcp_http(method: str, url: str, body=None) -> dict:
+    import json
+    import urllib.request
+
+    from skypilot_tpu.provision import gcp_auth
+
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method,
+        headers={"Authorization": f"Bearer {gcp_auth.get_access_token()}",
+                 "Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def setup_gcp_authentication(project: str, ssh_user: str = "skypilot") -> str:
+    """Ensure our pubkey is in the project-wide ssh-keys metadata.
+
+    Returns the ssh user name. Idempotent: a key already present is
+    left alone. Same mechanism as ``gcloud compute tpus tpu-vm ssh``
+    (TPU-VMs honor project-wide compute metadata ssh-keys).
+    """
+    key = public_key_openssh()
+    entry = f"{ssh_user}:{key}"
+
+    base = f"https://compute.googleapis.com/compute/v1/projects/{project}"
+    meta = _gcp_http("GET", base).get("commonInstanceMetadata", {})
+    items = meta.get("items", [])
+    ssh_item = next((i for i in items if i["key"] == "ssh-keys"), None)
+    existing = ssh_item["value"] if ssh_item else ""
+    if entry in existing:
+        return ssh_user
+    new_value = (existing + "\n" + entry).strip()
+    if ssh_item:
+        ssh_item["value"] = new_value
+    else:
+        items.append({"key": "ssh-keys", "value": new_value})
+    body = {"fingerprint": meta.get("fingerprint"), "items": items}
+    _gcp_http("POST", f"{base}/setCommonInstanceMetadata", body)
+    return ssh_user

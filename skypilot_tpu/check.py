@@ -1,0 +1,95 @@
+"""Cloud credential checking + enabled-cloud cache.
+
+Reference parity: sky/check.py (check:23 validates credentials per
+cloud; get_cached_enabled_clouds_or_refresh:172 caches the enabled
+list). Providers here are the provision modules; each may export
+``check_credentials() -> (bool, str)``. The enabled set is cached in
+``$SKYPILOT_TPU_HOME/enabled_clouds.json`` and consulted by the
+optimizer via get_cached_enabled_clouds_or_refresh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import paths
+
+# Known providers, in display order. 'local' is the in-process fake
+# cloud used by tests and demos; it is always credentialed.
+CLOUDS = ("gcp", "kubernetes", "local")
+
+
+def _cache_path() -> str:
+    return os.path.join(paths.home(), "enabled_clouds.json")
+
+
+def _check_one(cloud: str) -> Tuple[bool, str]:
+    if cloud == "local":
+        return True, "local fake cloud (always enabled)"
+    if cloud == "gcp":
+        from skypilot_tpu.provision import gcp_auth
+        return gcp_auth.check_credentials()
+    if cloud == "kubernetes":
+        try:
+            from skypilot_tpu.provision import kubernetes as k8s
+            return k8s.check_credentials()
+        except ImportError:
+            return False, "kubernetes provider not available"
+    return False, f"unknown cloud {cloud!r}"
+
+
+def check(quiet: bool = False,
+          clouds: Optional[List[str]] = None) -> List[str]:
+    """Validate credentials per cloud; merge into + return the enabled list.
+
+    A subset check (``clouds=['gcp']``) only updates the checked clouds'
+    entries in the cache — previously enabled clouds stay enabled
+    (reference behavior: sky/check.py merges subset results).
+    """
+    to_check = list(clouds) if clouds else list(CLOUDS)
+    prior: List[str] = []
+    if clouds and os.path.exists(_cache_path()):
+        try:
+            with open(_cache_path()) as f:
+                prior = json.load(f)["enabled"]
+        except (json.JSONDecodeError, KeyError):
+            prior = []
+    enabled = [c for c in prior if c not in to_check]
+    reasons: Dict[str, str] = {}
+    for cloud in to_check:
+        ok, reason = _check_one(cloud)
+        reasons[cloud] = reason
+        if ok:
+            enabled.append(cloud)
+    enabled = sorted(enabled, key=lambda c: (CLOUDS + (c,)).index(c))
+    if not quiet:
+        for cloud in to_check:
+            mark = "enabled" if cloud in enabled else "disabled"
+            print(f"  {cloud}: {mark} — {reasons[cloud]}")
+    with open(_cache_path(), "w") as f:
+        json.dump({"enabled": enabled}, f)
+    if not enabled:
+        raise exceptions.NoCloudAccessError(
+            "no cloud is enabled; run `skytpu check` after configuring "
+            "credentials (gcloud auth application-default login)")
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access: bool = False) -> List[str]:
+    path = _cache_path()
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                return json.load(f)["enabled"]
+        except (json.JSONDecodeError, KeyError):
+            pass
+    try:
+        return check(quiet=True)
+    except exceptions.NoCloudAccessError:
+        if raise_if_no_cloud_access:
+            raise
+        return []

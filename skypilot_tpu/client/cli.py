@@ -13,6 +13,7 @@ import sys
 from typing import Optional
 
 import click
+import yaml
 
 import skypilot_tpu as sky
 from skypilot_tpu import exceptions
@@ -222,12 +223,57 @@ def show_gpus(name_filter):
 
 
 @cli.command()
-def check():
-    """Check cloud access (local always; gcp if credentials present)."""
-    from skypilot_tpu.provision import gcp_auth
-    click.echo("  local: enabled")
-    ok, why = gcp_auth.check_credentials()
-    click.echo(f"  gcp: {'enabled' if ok else f'disabled ({why})'}")
+@click.argument("clouds", nargs=-1)
+def check(clouds):
+    """Check cloud credentials and cache the enabled-cloud list."""
+    from skypilot_tpu import check as check_lib
+    try:
+        check_lib.check(clouds=list(clouds) or None)
+    except exceptions.NoCloudAccessError as e:
+        click.echo(f"Error: {e}", err=True)
+        sys.exit(1)
+
+
+@cli.group()
+def config():
+    """Inspect or edit the layered global config."""
+
+
+@config.command(name="get")
+@click.argument("key")
+def config_get(key):
+    """Print a config value; KEY is dot-separated (e.g. gcp.project)."""
+    from skypilot_tpu import config as config_lib
+    val = config_lib.get_nested(tuple(key.split(".")))
+    if val is None:
+        click.echo("(unset)")
+    elif isinstance(val, (dict, list)):
+        click.echo(yaml.safe_dump(val, sort_keys=False).strip())
+    else:
+        click.echo(val)
+
+
+@config.command(name="set")
+@click.argument("key")
+@click.argument("value")
+def config_set(key, value):
+    """Set a config value in config.yaml (value parsed as YAML)."""
+    from skypilot_tpu import config as config_lib
+    try:
+        config_lib.set_nested(tuple(key.split(".")), yaml.safe_load(value))
+    except (ValueError, yaml.YAMLError) as e:
+        click.echo(f"Error: {e}", err=True)
+        sys.exit(1)
+    click.echo(f"{key} = {value} -> {config_lib.config_path()}")
+
+
+@config.command(name="list")
+def config_list():
+    """Dump the effective config."""
+    from skypilot_tpu import config as config_lib
+    cfg = config_lib.to_dict()
+    click.echo(yaml.safe_dump(cfg, sort_keys=False).strip()
+               if cfg else "(empty)")
 
 
 @cli.group()

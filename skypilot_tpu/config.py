@@ -1,0 +1,166 @@
+"""Layered global configuration.
+
+Reference parity: sky/skypilot_config.py (docs at :1-50 — `get_nested`
+over a nested dict loaded from ``~/.sky/config.yaml``, plus per-task
+overrides merged via ``experimental.config_overrides``). Same model
+here: a YAML file at ``$SKYPILOT_TPU_HOME/config.yaml`` (overridable
+with ``SKYPILOT_TPU_CONFIG``), read once and cached; tasks may carry an
+``config_overrides`` dict that is deep-merged on top for the duration
+of one request (``override_config`` context manager).
+
+Example config.yaml:
+
+    gcp:
+      project: my-proj
+      specific_reservations: [res-1]
+    provisioner:
+      ssh_timeout: 300
+    admin_policy: mypkg.policy.MyPolicy
+"""
+
+from __future__ import annotations
+
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu.utils import paths
+
+_lock = threading.Lock()
+_loaded: Optional[Dict[str, Any]] = None
+_loaded_path: Optional[str] = None
+_overrides = threading.local()
+
+
+def config_path() -> str:
+    return os.environ.get("SKYPILOT_TPU_CONFIG",
+                          os.path.join(paths.home(), "config.yaml"))
+
+
+def _load() -> Dict[str, Any]:
+    global _loaded, _loaded_path
+    path = config_path()
+    with _lock:
+        if _loaded is not None and _loaded_path == path:
+            return _loaded
+        cfg: Dict[str, Any] = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                loaded = yaml.safe_load(f)
+            if loaded is not None and not isinstance(loaded, dict):
+                raise ValueError(
+                    f"config file {path} must parse to a dict, got "
+                    f"{type(loaded).__name__}")
+            cfg = loaded or {}
+        _loaded, _loaded_path = cfg, path
+        return cfg
+
+
+def reload() -> None:
+    """Drop the cache (used by tests and after `config set`)."""
+    global _loaded, _loaded_path
+    with _lock:
+        _loaded = None
+        _loaded_path = None
+
+
+def loaded_config_path() -> Optional[str]:
+    path = config_path()
+    return path if os.path.exists(path) else None
+
+
+def deep_merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
+    """Return base with `over` recursively merged on top (new dict)."""
+    out = copy.deepcopy(base)
+    for key, val in over.items():
+        if (key in out and isinstance(out[key], dict)
+                and isinstance(val, dict)):
+            out[key] = deep_merge(out[key], val)
+        else:
+            out[key] = copy.deepcopy(val)
+    return out
+
+
+def _effective() -> Dict[str, Any]:
+    replacement = getattr(_overrides, "replacement", None)
+    cfg = replacement if replacement is not None else _load()
+    over = getattr(_overrides, "stack", None)
+    if over:
+        for layer in over:
+            cfg = deep_merge(cfg, layer)
+    return cfg
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_effective())
+
+
+def get_nested(keys: Iterable[str], default: Any = None) -> Any:
+    """config.get_nested(('gcp', 'project'), None)"""
+    cur: Any = _effective()
+    for key in keys:
+        if not isinstance(cur, dict) or key not in cur:
+            return default
+        cur = cur[key]
+    # Containers are copied: the no-override path returns views into the
+    # process-wide cache, and a mutating caller must not corrupt it.
+    return copy.deepcopy(cur) if isinstance(cur, (dict, list)) else cur
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> None:
+    """Persist a value into the config file (used by `config set`)."""
+    path = config_path()
+    cfg: Dict[str, Any] = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+    cur = cfg
+    for key in keys[:-1]:
+        cur = cur.setdefault(key, {})
+        if not isinstance(cur, dict):
+            raise ValueError(f"config key {'.'.join(keys)} conflicts with "
+                             f"a non-dict value")
+    cur[keys[-1]] = value
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(cfg, f, sort_keys=False)
+    reload()
+
+
+@contextlib.contextmanager
+def replace_config(new_config: Optional[Dict[str, Any]]):
+    """Replace the effective base config for the enclosed request —
+    used to honor an admin policy's mutated skypilot_config."""
+    if new_config is None:
+        yield
+        return
+    prev = getattr(_overrides, "replacement", None)
+    _overrides.replacement = copy.deepcopy(new_config)
+    try:
+        yield
+    finally:
+        _overrides.replacement = prev
+
+
+@contextlib.contextmanager
+def override_config(overrides: Optional[Dict[str, Any]]):
+    """Apply per-task `config_overrides` for the enclosed request.
+
+    Reference parity: task `experimental.config_overrides` merged in
+    sky/skypilot_config.py / resources.py:487.
+    """
+    if not overrides:
+        yield
+        return
+    stack = getattr(_overrides, "stack", None)
+    if stack is None:
+        stack = _overrides.stack = []
+    stack.append(overrides)
+    try:
+        yield
+    finally:
+        stack.pop()

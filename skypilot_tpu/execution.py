@@ -41,6 +41,27 @@ def launch(task: Task,
            dryrun: bool = False) -> Tuple[Optional[int], Optional[ClusterHandle]]:
     """Provision (or reuse) a cluster and run the task on it."""
     cluster_name = cluster_name or _generate_cluster_name()
+
+    # Org-level request mutation/validation hook (reference:
+    # execution.py:180 admin_policy_utils.apply).
+    from skypilot_tpu import admin_policy, config as config_lib
+    task, mutated_config = admin_policy.apply(
+        task, admin_policy.RequestOptions(
+            cluster_name=cluster_name,
+            idle_minutes_to_autostop=idle_minutes_to_autostop,
+            down=down, dryrun=dryrun))
+
+    with config_lib.replace_config(mutated_config), \
+            config_lib.override_config(getattr(task, "config_overrides",
+                                               None)):
+        return _launch_with_config(
+            task, cluster_name, retry_until_up, idle_minutes_to_autostop,
+            down, detach_run, dryrun)
+
+
+def _launch_with_config(task, cluster_name, retry_until_up,
+                        idle_minutes_to_autostop, down, detach_run,
+                        dryrun) -> Tuple[Optional[int], Optional[ClusterHandle]]:
     backend = TpuVmBackend()
 
     if dryrun:
@@ -77,6 +98,17 @@ def exec(task: Task,  # noqa: A001 — mirrors the public API name
          cluster_name: str,
          detach_run: bool = True) -> Tuple[int, ClusterHandle]:
     """Run a task on an existing cluster, skipping provisioning."""
+    from skypilot_tpu import admin_policy, config as config_lib
+    task, mutated_config = admin_policy.apply(
+        task, admin_policy.RequestOptions(cluster_name=cluster_name))
+    with config_lib.replace_config(mutated_config), \
+            config_lib.override_config(getattr(task, "config_overrides",
+                                               None)):
+        return _exec_with_config(task, cluster_name, detach_run)
+
+
+def _exec_with_config(task: Task, cluster_name: str,
+                      detach_run: bool) -> Tuple[int, ClusterHandle]:
     rec = state.get_cluster(cluster_name)
     if rec is None:
         raise exceptions.ClusterNotUpError(

@@ -48,6 +48,9 @@ class Task:
         self.resources: List[Resources] = [Resources()]
         self.service: Optional[Any] = None  # serve.SkyServiceSpec
         self.estimated_runtime_seconds: Optional[float] = None
+        # Per-task global-config overrides (reference:
+        # experimental.config_overrides, sky/skypilot_config.py).
+        self.config_overrides: Optional[Dict[str, Any]] = None
 
     # -- builder API -------------------------------------------------------
     def set_resources(self, resources: Union[Resources, List[Resources]]):
@@ -71,8 +74,12 @@ class Task:
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> "Task":
         config = dict(config or {})
+        from skypilot_tpu.utils import schemas
+        schemas.validate_task_config(config)
         resources_cfg = config.pop("resources", None)
         service_cfg = config.pop("service", None)
+        config_overrides = config.pop("config_overrides", None)
+        storage_mounts = config.pop("storage_mounts", None)
         task = cls(
             name=config.pop("name", None),
             setup=config.pop("setup", None),
@@ -82,7 +89,9 @@ class Task:
             workdir=config.pop("workdir", None),
             num_nodes=int(config.pop("num_nodes", 1) or 1),
             file_mounts=config.pop("file_mounts", None),
+            storage_mounts=storage_mounts,
         )
+        task.config_overrides = config_overrides
         if config:
             raise exceptions.InvalidTaskError(
                 f"unknown task fields: {sorted(config)}")
@@ -127,8 +136,15 @@ class Task:
             out["run"] = self.run
         if self.file_mounts:
             out["file_mounts"] = dict(self.file_mounts)
+        if self.storage_mounts:
+            out["storage_mounts"] = {
+                dst: (s.to_yaml_config() if hasattr(s, "to_yaml_config")
+                      else s)
+                for dst, s in self.storage_mounts.items()}
         if self.service is not None:
             out["service"] = self.service.to_yaml_config()
+        if self.config_overrides:
+            out["config_overrides"] = dict(self.config_overrides)
         return out
 
     def to_yaml(self, path: str) -> None:

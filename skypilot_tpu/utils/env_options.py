@@ -1,0 +1,23 @@
+"""Boolean env-var flags.
+
+Reference parity: sky/utils/env_options.py (SKYPILOT_DEBUG,
+SKYPILOT_DISABLE_USAGE_COLLECTION, SKYPILOT_MINIMIZE_LOGGING).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+
+
+class Options(enum.Enum):
+    IS_DEVELOPER = "SKYPILOT_TPU_DEV"
+    SHOW_DEBUG_INFO = "SKYPILOT_TPU_DEBUG"
+    DISABLE_USAGE_COLLECTION = "SKYPILOT_TPU_DISABLE_USAGE_COLLECTION"
+    MINIMIZE_LOGGING = "SKYPILOT_TPU_MINIMIZE_LOGGING"
+
+    def get(self) -> bool:
+        return os.environ.get(self.value, "0").lower() in ("1", "true", "yes")
+
+    def __bool__(self) -> bool:
+        return self.get()

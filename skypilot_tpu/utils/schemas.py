@@ -1,0 +1,152 @@
+"""JSON schemas for task YAML / config validation.
+
+Reference parity: sky/utils/schemas.py (985 LoC of jsonschema dicts —
+the task-YAML spec lives there and is enforced at Task.from_yaml_config
+time). Scope here is the TPU-native surface: task, resources, service,
+and global config.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jsonschema
+
+from skypilot_tpu import exceptions
+
+_RESOURCES_SCHEMA: Dict[str, Any] = {
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "cloud": {"type": ["string", "null"]},
+        "region": {"type": ["string", "null"]},
+        "zone": {"type": ["string", "null"]},
+        "accelerators": {"type": ["string", "object", "null"]},
+        "runtime_version": {"type": ["string", "null"]},
+        "job_recovery": {"type": ["string", "object", "null"]},
+        "cpus": {"type": ["string", "number", "null"]},
+        "memory": {"type": ["string", "number", "null"]},
+        "instance_type": {"type": ["string", "null"]},
+        "use_spot": {"type": "boolean"},
+        "disk_size": {"type": ["integer", "null"]},
+        "ports": {"type": ["array", "integer", "string", "null"],
+                  "items": {"type": ["integer", "string"]}},
+        "labels": {"type": ["object", "null"]},
+        "image_id": {"type": ["string", "null"]},
+        "any_of": {"type": "array"},
+    },
+}
+
+_SERVICE_SCHEMA: Dict[str, Any] = {
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "readiness_probe": {
+            "anyOf": [
+                {"type": "string"},
+                {
+                    "type": "object",
+                    "additionalProperties": False,
+                    "properties": {
+                        "path": {"type": "string"},
+                        "initial_delay_seconds": {"type": "number"},
+                        "timeout_seconds": {"type": "number"},
+                        "post_data": {"type": ["object", "string"]},
+                    },
+                },
+            ],
+        },
+        "replica_policy": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "min_replicas": {"type": "integer"},
+                "max_replicas": {"type": ["integer", "null"]},
+                "target_qps_per_replica": {"type": ["number", "null"]},
+                "upscale_delay_seconds": {"type": "number"},
+                "downscale_delay_seconds": {"type": "number"},
+            },
+        },
+        "replicas": {"type": "integer"},
+        "load_balancing_policy": {"type": "string"},
+    },
+}
+
+TASK_SCHEMA: Dict[str, Any] = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "name": {"type": ["string", "null"]},
+        "workdir": {"type": ["string", "null"]},
+        "num_nodes": {"type": ["integer", "null"], "minimum": 1},
+        "setup": {"type": ["string", "null"]},
+        "run": {"type": ["string", "null"]},
+        "envs": {
+            "type": ["object", "null"],
+            "patternProperties": {
+                "^[A-Za-z_][A-Za-z0-9_]*$": {
+                    "type": ["string", "number", "boolean", "null"]},
+            },
+            "additionalProperties": False,
+        },
+        "file_mounts": {"type": ["object", "null"]},
+        "storage_mounts": {"type": ["object", "null"]},
+        "resources": {
+            "anyOf": [
+                _RESOURCES_SCHEMA,
+                {"type": "array", "items": _RESOURCES_SCHEMA},
+                {"type": "null"},
+            ],
+        },
+        "service": _SERVICE_SCHEMA,
+        "config_overrides": {"type": "object"},
+    },
+}
+
+CONFIG_SCHEMA: Dict[str, Any] = {
+    "$schema": "http://json-schema.org/draft-07/schema#",
+    "type": "object",
+    "additionalProperties": True,
+    "properties": {
+        "admin_policy": {"type": "string"},
+        "gcp": {
+            "type": "object",
+            "properties": {
+                "project": {"type": "string"},
+                "specific_reservations": {"type": "array",
+                                          "items": {"type": "string"}},
+            },
+        },
+        "provisioner": {
+            "type": "object",
+            "properties": {
+                "ssh_timeout": {"type": "number"},
+            },
+        },
+        "jobs": {"type": "object"},
+        "serve": {"type": "object"},
+        "usage": {
+            "type": "object",
+            "properties": {"disabled": {"type": "boolean"}},
+        },
+    },
+}
+
+
+def _validate(config: Dict[str, Any], schema: Dict[str, Any],
+              what: str) -> None:
+    try:
+        jsonschema.validate(instance=config, schema=schema)
+    except jsonschema.ValidationError as e:
+        path = ".".join(str(p) for p in e.absolute_path) or "<root>"
+        raise exceptions.InvalidTaskError(
+            f"invalid {what}: {path}: {e.message}") from None
+
+
+def validate_task_config(config: Dict[str, Any]) -> None:
+    _validate(config, TASK_SCHEMA, "task YAML")
+
+
+def validate_global_config(config: Dict[str, Any]) -> None:
+    _validate(config, CONFIG_SCHEMA, "config.yaml")

@@ -1,0 +1,142 @@
+"""Config layering, schema validation, admin policy, cloud check.
+
+Reference parity for test strategy: the reference's offline config and
+admin-policy tests (tests/test_config.py, SURVEY.md §4) — everything
+runs with SKYPILOT_TPU_HOME pointed at a tmp dir.
+"""
+
+import os
+
+import pytest
+
+from skypilot_tpu import admin_policy, check as check_lib
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import schemas
+
+
+@pytest.fixture(autouse=True)
+def tmp_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    monkeypatch.delenv("SKYPILOT_TPU_CONFIG", raising=False)
+    config_lib.reload()
+    yield
+    config_lib.reload()
+
+
+def test_config_roundtrip_and_nesting():
+    assert config_lib.get_nested(("gcp", "project")) is None
+    config_lib.set_nested(("gcp", "project"), "proj-1")
+    config_lib.set_nested(("provisioner", "ssh_timeout"), 120)
+    assert config_lib.get_nested(("gcp", "project")) == "proj-1"
+    assert config_lib.get_nested(("provisioner", "ssh_timeout")) == 120
+    assert config_lib.get_nested(("gcp", "missing"), "dflt") == "dflt"
+    cfg = config_lib.to_dict()
+    schemas.validate_global_config(cfg)
+
+
+def test_config_override_context():
+    config_lib.set_nested(("gcp", "project"), "base")
+    with config_lib.override_config({"gcp": {"project": "task-level"}}):
+        assert config_lib.get_nested(("gcp", "project")) == "task-level"
+    assert config_lib.get_nested(("gcp", "project")) == "base"
+
+
+def test_task_schema_rejects_bad_yaml():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml_config({"num_nodes": "not-an-int"})
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml_config({"unknown_field": 1})
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml_config({"resources": {"bogus": True}})
+
+
+def test_task_config_overrides_parsed():
+    task = Task.from_yaml_config({
+        "run": "echo hi",
+        "config_overrides": {"gcp": {"project": "override-me"}},
+    })
+    assert task.config_overrides == {"gcp": {"project": "override-me"}}
+
+
+class _RenamePolicy(admin_policy.AdminPolicy):
+    @classmethod
+    def validate_and_mutate(cls, user_request):
+        user_request.task.name = "policy-renamed"
+        return admin_policy.MutatedUserRequest(
+            task=user_request.task,
+            skypilot_config=user_request.skypilot_config)
+
+
+class _RejectPolicy(admin_policy.AdminPolicy):
+    @classmethod
+    def validate_and_mutate(cls, user_request):
+        raise admin_policy.PolicyError("spot only!")
+
+
+class _ConfigMutatingPolicy(admin_policy.AdminPolicy):
+    @classmethod
+    def validate_and_mutate(cls, user_request):
+        cfg = dict(user_request.skypilot_config)
+        cfg.setdefault("gcp", {})["project"] = "policy-project"
+        return admin_policy.MutatedUserRequest(
+            task=user_request.task, skypilot_config=cfg)
+
+
+def test_admin_policy_mutates_task():
+    config_lib.set_nested(
+        ("admin_policy",),
+        f"{__name__}._RenamePolicy")
+    task = Task(name="orig", run="echo hi")
+    out, mutated_cfg = admin_policy.apply(task)
+    assert out.name == "policy-renamed"
+    assert mutated_cfg is None  # config untouched by this policy
+
+
+def test_admin_policy_mutated_config_returned():
+    config_lib.set_nested(
+        ("admin_policy",), f"{__name__}._ConfigMutatingPolicy")
+    _, mutated_cfg = admin_policy.apply(Task(run="echo hi"))
+    assert mutated_cfg["gcp"]["project"] == "policy-project"
+    with config_lib.replace_config(mutated_cfg):
+        assert config_lib.get_nested(("gcp", "project")) == "policy-project"
+    assert config_lib.get_nested(("gcp", "project")) is None
+
+
+def test_admin_policy_rejects():
+    config_lib.set_nested(("admin_policy",), f"{__name__}._RejectPolicy")
+    with pytest.raises(admin_policy.PolicyError, match="spot only"):
+        admin_policy.apply(Task(run="echo hi"))
+
+
+def test_admin_policy_absent_is_noop():
+    task = Task(run="echo hi")
+    out, cfg = admin_policy.apply(task)
+    assert out is task and cfg is None
+
+
+def test_get_nested_returns_copies():
+    config_lib.set_nested(("gcp", "project"), "base")
+    view = config_lib.get_nested(("gcp",))
+    view["project"] = "mutated-by-caller"
+    assert config_lib.get_nested(("gcp", "project")) == "base"
+
+
+def test_check_caches_enabled_clouds():
+    enabled = check_lib.check(quiet=True, clouds=["local"])
+    assert enabled == ["local"]
+    cached = check_lib.get_cached_enabled_clouds_or_refresh()
+    assert cached == ["local"]
+    assert os.path.exists(os.path.join(
+        os.environ["SKYPILOT_TPU_HOME"], "enabled_clouds.json"))
+
+
+def test_check_subset_merges_cache(monkeypatch):
+    check_lib.check(quiet=True, clouds=["local"])
+    # A failing subset check must not clobber previously enabled clouds.
+    monkeypatch.setattr(check_lib, "_check_one",
+                        lambda c: (False, "forced failure"))
+    enabled = check_lib.check(quiet=True, clouds=["gcp"])
+    assert enabled == ["local"]
+    assert "local" in check_lib.get_cached_enabled_clouds_or_refresh()
