@@ -68,6 +68,7 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
             },
         },
         "replicas": {"type": "integer"},
+        "port": {"type": "integer"},
         "load_balancing_policy": {"type": "string"},
     },
 }

@@ -36,8 +36,8 @@ def test_jobs_run_fifo_one_at_a_time():
     j2, _ = sky.exec(_local_task(f"date +%s.%N > {marker}.start2"),
                      cluster_name="fifo")
     backend = TpuVmBackend()
-    assert backend.wait_job(handle, j1, 30) == JobStatus.SUCCEEDED
-    assert backend.wait_job(handle, j2, 30) == JobStatus.SUCCEEDED
+    assert backend.wait_job(handle, j1, 120) == JobStatus.SUCCEEDED
+    assert backend.wait_job(handle, j2, 120) == JobStatus.SUCCEEDED
     from skypilot_tpu.provision import local as lp
     ws = lp.get_cluster_info("fifo", "local").hosts[0].workspace
     end1 = float(open(os.path.join(ws, f"{marker}.end1")).read())
@@ -63,7 +63,7 @@ def test_cancel_pending_job():
 def test_autostop_daemon_stops_idle_cluster():
     j, handle = sky.launch(_local_task("echo done"), cluster_name="auto1",
                            idle_minutes_to_autostop=0)
-    TpuVmBackend().wait_job(handle, j, 30)
+    TpuVmBackend().wait_job(handle, j, 120)
     deadline = time.time() + 10
     while time.time() < deadline:
         rec = state.get_cluster("auto1")
@@ -76,7 +76,7 @@ def test_autostop_daemon_stops_idle_cluster():
 def test_autodown_daemon_removes_cluster():
     j, handle = sky.launch(_local_task("echo done"), cluster_name="auto2",
                            idle_minutes_to_autostop=0, down=True)
-    TpuVmBackend().wait_job(handle, j, 30)
+    TpuVmBackend().wait_job(handle, j, 120)
     deadline = time.time() + 10
     while time.time() < deadline:
         if state.get_cluster("auto2") is None:
@@ -89,7 +89,7 @@ def test_cost_report_whole_cluster_price():
     t = Task(name="multi", run="echo x", num_nodes=4)
     t.set_resources(Resources(cloud="local"))
     j, handle = sky.launch(t, cluster_name="cost4")
-    TpuVmBackend().wait_job(handle, j, 30)
+    TpuVmBackend().wait_job(handle, j, 120)
     # Fake a known price then tear down.
     rec = state.get_cluster("cost4")
     state.set_cluster("cost4", rec["handle"], state.ClusterStatus.UP,
@@ -104,6 +104,6 @@ def test_cost_report_whole_cluster_price():
 
 def test_tail_logs_unknown_job_raises():
     j, handle = sky.launch(_local_task("echo x"), cluster_name="logx")
-    TpuVmBackend().wait_job(handle, j, 30)
+    TpuVmBackend().wait_job(handle, j, 120)
     with pytest.raises(exceptions.JobNotFoundError):
         sky.tail_logs("logx", 999, follow=True)
