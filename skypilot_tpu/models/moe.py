@@ -1,0 +1,283 @@
+"""Sparse Mixture-of-Experts decoder (Mixtral-family proportions), pure JAX.
+
+TPU-first design notes
+----------------------
+* Routing is *capacity-based dispatch via one-hot einsums* (the
+  Switch/flaxformer formulation): every shape is static, so the whole
+  layer compiles to dense MXU einsums plus an ep-axis all-to-all that XLA
+  derives from the shardings — no gather/scatter, no dynamic shapes, no
+  host round trips. Tokens over capacity are dropped (standard capacity
+  -factor semantics); the combine tensor simply carries zero weight.
+* Expert weights carry a leading ``expert`` logical axis mapped to the
+  ``ep`` mesh axis (parallel.sharding.DEFAULT_RULES), composing freely
+  with fsdp (embed dim) and tp (mlp dim) on the *same* weights.
+* The router runs in float32 (softmax over tiny E-dim — numerics matter,
+  FLOPs don't), everything else in bfloat16.
+* Per-layer weights are stacked on a leading ``layer`` axis and the body
+  runs under one ``lax.scan``, like models.llama; the scan carry threads
+  the accumulated aux load-balancing loss.
+
+Attention / norms / rope are reused from models.llama — an MoE block is
+a Llama block with the dense FFN swapped for the expert FFN.
+
+Reference parity: the reference ships MoE serving only as external
+recipes (reference: llm/mixtral/README.md, llm/deepseek-r1/ — vLLM/SGLang
+expert parallelism inside the engine). In-tree MoE + ep mesh axis is the
+TPU-native equivalent of that capability (SURVEY.md §2.11).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.models import llama
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(llama.LlamaConfig):
+    """Llama-style decoder where every FFN is a sparse top-k MoE."""
+
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    def num_params(self) -> int:
+        d, ff, v = self.d_model, self.d_ff, self.vocab_size
+        hd = self.head_dim
+        attn = (d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd
+                + self.n_heads * hd * d)
+        moe = self.n_experts * 3 * d * ff + d * self.n_experts
+        norms = 2 * d
+        per_layer = attn + moe + norms
+        emb = v * d if self.tie_embeddings else 2 * v * d
+        return self.n_layers * per_layer + emb + d
+
+    def num_active_params(self) -> int:
+        """Params touched per token (for MFU accounting of sparse FLOPs)."""
+        d, ff = self.d_model, self.d_ff
+        dense = super().num_params() - self.n_layers * 3 * d * ff
+        return dense + self.n_layers * (self.top_k * 3 * d * ff
+                                        + d * self.n_experts)
+
+
+CONFIGS: Dict[str, MoEConfig] = {
+    # Mixtral-8x7B proportions (32 layers, 8 experts, top-2).
+    "mixtral-8x7b": MoEConfig(vocab_size=32_000, d_model=4096, n_layers=32,
+                              n_heads=32, n_kv_heads=8, d_ff=14_336,
+                              n_experts=8, top_k=2),
+    # ~8x the FFN of llama3-400m; fits CPU test meshes and a single v5e.
+    "moe-small": MoEConfig(vocab_size=32_768, d_model=1024, n_layers=8,
+                           n_heads=8, n_kv_heads=4, d_ff=4096,
+                           n_experts=8, top_k=2, max_seq_len=4096),
+    "moe-tiny": MoEConfig(vocab_size=512, d_model=128, n_layers=2,
+                          n_heads=4, n_kv_heads=2, d_ff=256,
+                          n_experts=4, top_k=2, max_seq_len=256),
+}
+
+
+# ---------------------------------------------------------------------------
+# Parameter init + logical sharding axes
+# ---------------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> Params:
+    """Initialize parameters; per-layer tensors stacked on axis 0."""
+    d, ff, v, L, E = (cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers,
+                      cfg.n_experts)
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    k = iter(jax.random.split(rng, 16))
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, cfg.param_dtype)
+                * (fan_in ** -0.5))
+
+    params: Params = {
+        "embed": jax.random.normal(next(k), (v, d), cfg.param_dtype) * 0.02,
+        "blocks": {
+            "ln1": jnp.ones((L, d), cfg.param_dtype),
+            "ln2": jnp.ones((L, d), cfg.param_dtype),
+            "wq": norm_init(next(k), (L, d, nh, hd), d),
+            "wk": norm_init(next(k), (L, d, nkv, hd), d),
+            "wv": norm_init(next(k), (L, d, nkv, hd), d),
+            "wo": norm_init(next(k), (L, nh, hd, d), nh * hd),
+            "w_router": norm_init(next(k), (L, d, E), d),
+            "we_gate": norm_init(next(k), (L, E, d, ff), d),
+            "we_up": norm_init(next(k), (L, E, d, ff), d),
+            "we_down": norm_init(next(k), (L, E, ff, d), ff),
+        },
+        "final_norm": jnp.ones((d,), cfg.param_dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm_init(next(k), (d, v), d)
+    return params
+
+
+def param_logical_axes(cfg: MoEConfig) -> Params:
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "ln1": ("layer", "embed"),
+            "ln2": ("layer", "embed"),
+            "wq": ("layer", "embed", "heads", "head_dim"),
+            "wk": ("layer", "embed", "kv_heads", "head_dim"),
+            "wv": ("layer", "embed", "kv_heads", "head_dim"),
+            "wo": ("layer", "heads", "head_dim", "embed"),
+            "w_router": ("layer", "embed", "expert"),
+            "we_gate": ("layer", "expert", "embed", "mlp"),
+            "we_up": ("layer", "expert", "embed", "mlp"),
+            "we_down": ("layer", "expert", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# The expert FFN
+# ---------------------------------------------------------------------------
+
+def expert_capacity(cfg: MoEConfig, seq_len: int) -> int:
+    """Per-expert per-row token capacity (static)."""
+    cap = math.ceil(cfg.capacity_factor * cfg.top_k * seq_len / cfg.n_experts)
+    return max(int(cap), 1)
+
+
+def moe_ffn(cfg: MoEConfig, h: jax.Array, layer: Params,
+            constrain=lambda x, axes: x) -> Tuple[jax.Array, jax.Array]:
+    """Top-k capacity-dispatched expert FFN.
+
+    h: [B, S, D] (post-norm). Returns (out [B, S, D], aux loss scalar).
+    """
+    B, S, D = h.shape
+    E, K = cfg.n_experts, cfg.top_k
+    C = expert_capacity(cfg, S)
+
+    # --- Router (float32) -------------------------------------------------
+    logits = jnp.einsum("bsd,de->bse", h.astype(jnp.float32),
+                        layer["w_router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                     # [B,S,E]
+    gate_vals, gate_idx = lax.top_k(probs, K)                   # [B,S,K]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # --- Aux load-balancing loss (Switch-style, over first choices) -------
+    # f_e: fraction of tokens whose top-1 choice is e; p_e: mean router
+    # prob for e. Balanced routing minimizes E * sum(f_e * p_e) at 1.0.
+    top1 = jax.nn.one_hot(gate_idx[..., 0], E, dtype=jnp.float32)
+    f = jnp.mean(top1, axis=(0, 1))
+    p = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(f * p)
+
+    # --- Capacity assignment ---------------------------------------------
+    # Priority order: token position first, then choice rank — flatten
+    # (S, K) to S*K so a cumulative sum assigns each (token, choice) its
+    # position inside the chosen expert's buffer; >= C drops.
+    expert_mask = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [B,S,K,E]
+    flat = expert_mask.reshape(B, S * K, E)
+    pos = (jnp.cumsum(flat, axis=1) - flat).reshape(B, S, K, E)  # 0-based
+    keep = expert_mask * (pos < C)                               # [B,S,K,E]
+    gates = gate_vals[..., None] * keep                          # [B,S,K,E]
+
+    pos_oh = jax.nn.one_hot(pos, C, dtype=cfg.dtype)             # [B,S,K,E,C]
+    keepc = keep[..., None].astype(cfg.dtype) * pos_oh
+    dispatch = keepc.sum(axis=2)                                 # [B,S,E,C]
+    combine = (gates[..., None].astype(cfg.dtype) * pos_oh).sum(axis=2)
+
+    # --- Expert compute (ep-sharded einsums) ------------------------------
+    xe = jnp.einsum("bsd,bsec->becd", h, dispatch)               # [B,E,C,D]
+    xe = constrain(xe, ("batch", "expert", "capacity", "embed"))
+    g = jnp.einsum("becd,edf->becf", xe, layer["we_gate"].astype(cfg.dtype))
+    u = jnp.einsum("becd,edf->becf", xe, layer["we_up"].astype(cfg.dtype))
+    y = jnp.einsum("becf,efd->becd", jax.nn.silu(g) * u,
+                   layer["we_down"].astype(cfg.dtype))
+    y = constrain(y, ("batch", "expert", "capacity", "embed"))
+    out = jnp.einsum("becd,bsec->bsd", y, combine)               # [B,S,D]
+    return out, aux.astype(jnp.float32)
+
+
+def decoder_layer(cfg: MoEConfig, x: jax.Array, layer: Params,
+                  cos: jax.Array, sin: jax.Array,
+                  constrain=lambda x, axes: x, mesh=None,
+                  rules=None) -> Tuple[jax.Array, jax.Array]:
+    """One pre-norm MoE decoder block. Returns (x, aux)."""
+    h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    q = llama.apply_rope(q, cos, sin)
+    k = llama.apply_rope(k, cos, sin)
+    q = constrain(q, ("batch", "seq", "heads", "head_dim"))
+    k = constrain(k, ("batch", "seq", "kv_heads", "head_dim"))
+    o = llama._attention(q, k, v, cfg, mesh, rules)
+    o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
+    x = x + constrain(o, ("batch", "seq", "embed"))
+
+    h = llama.rms_norm(x, layer["ln2"], cfg.norm_eps)
+    m, aux = moe_ffn(cfg, h, layer, constrain)
+    return x + constrain(m, ("batch", "seq", "embed")), aux
+
+
+# ---------------------------------------------------------------------------
+# Forward / loss
+# ---------------------------------------------------------------------------
+
+def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
+            constrain=None, mesh=None,
+            rules=None) -> Tuple[jax.Array, jax.Array]:
+    """[B, S] ids -> (logits [B, S, vocab] fp32, mean aux loss scalar)."""
+    if constrain is None:
+        constrain = lambda x, axes: x
+
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = constrain(x, ("batch", "seq", "embed"))
+    positions = jnp.arange(S)
+    cos, sin = llama.rope_frequencies(cfg, positions)
+
+    def body(carry, layer):
+        x, aux_sum = carry
+        y, aux = decoder_layer(cfg, x, layer, cos, sin, constrain, mesh,
+                               rules)
+        return (y, aux_sum + aux), None
+
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable)
+
+    (x, aux_sum), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                               params["blocks"])
+    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
+    logits = constrain(logits, ("batch", "seq", "vocab"))
+    return logits.astype(jnp.float32), aux_sum / cfg.n_layers
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: MoEConfig,
+            constrain=None, mesh=None,
+            rules=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy + weighted aux load-balancing loss."""
+    tokens = batch["tokens"]
+    logits, aux = forward(params, tokens, cfg, constrain, mesh, rules)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logps = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    mask = jnp.ones_like(ll) if mask is None else mask[:, :-1].astype(ll.dtype)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    xent = -(ll * mask).sum() / denom
+    loss = xent + cfg.aux_loss_weight * aux
+    acc = ((jnp.argmax(logits, -1) == targets) * mask).sum() / denom
+    return loss, {"loss": loss, "xent": xent, "aux_loss": aux,
+                  "accuracy": acc, "tokens": denom}

@@ -2,8 +2,10 @@
 
 The canonical mesh axes, outermost to innermost:
 
+  ``pp``   pipeline parallel (decoder stages; p2p activation permutes)
   ``dp``   pure data parallel (gradients all-reduced; params replicated)
   ``fsdp`` fully-sharded data parallel (params/opt-state sharded on embed dim)
+  ``ep``   expert parallel (MoE expert dim sharded; token all-to-alls)
   ``sp``   sequence/context parallel (ring attention; defaults to 1)
   ``tp``   tensor parallel (heads / mlp / vocab dims sharded) — innermost:
            per-layer all-reduces ride the fastest ICI wires; the sp ring's
@@ -31,22 +33,26 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("dp", "fsdp", "sp", "tp")
+MESH_AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshShape:
+    pp: int = 1
     dp: int = 1
     fsdp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return (self.pp * self.dp * self.fsdp * self.ep * self.tp
+                * self.sp)
 
     def as_dict(self) -> Dict[str, int]:
-        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+        return {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
+                "ep": self.ep, "tp": self.tp, "sp": self.sp}
 
 
 def make_mesh(shape: Optional[MeshShape | Dict[str, int]] = None,
@@ -62,16 +68,17 @@ def make_mesh(shape: Optional[MeshShape | Dict[str, int]] = None,
         raise ValueError(
             f"mesh shape {shape.as_dict()} needs {shape.size} devices, "
             f"got {n}")
-    dev_array = np.asarray(devices).reshape(shape.dp, shape.fsdp, shape.sp,
-                                            shape.tp)
+    dev_array = np.asarray(devices).reshape(shape.pp, shape.dp, shape.fsdp,
+                                            shape.ep, shape.sp, shape.tp)
     return Mesh(dev_array, MESH_AXES)
 
 
 def default_shape_for(n_devices: int, tp: int = 1, sp: int = 1,
-                      dp: int = 1) -> MeshShape:
-    """FSDP-dominant factorization: everything not tp/sp/dp goes to fsdp."""
-    rest = n_devices // (tp * sp * dp)
-    if rest * tp * sp * dp != n_devices:
+                      dp: int = 1, ep: int = 1, pp: int = 1) -> MeshShape:
+    """FSDP-dominant factorization: the remainder goes to fsdp."""
+    denom = tp * sp * dp * ep * pp
+    rest = n_devices // denom
+    if rest * denom != n_devices:
         raise ValueError(f"{n_devices} devices not divisible by "
-                         f"tp={tp} sp={sp} dp={dp}")
-    return MeshShape(dp=dp, fsdp=rest, tp=tp, sp=sp)
+                         f"tp={tp} sp={sp} dp={dp} ep={ep} pp={pp}")
+    return MeshShape(pp=pp, dp=dp, fsdp=rest, ep=ep, tp=tp, sp=sp)

@@ -1,0 +1,189 @@
+"""Pipeline parallelism: GPipe-schedule microbatching over the ``pp`` axis.
+
+TPU-first design notes
+----------------------
+* No per-stage processes, no send/recv: pipelining is expressed as a
+  *dense, sharded program*. Layer weights are stacked on a leading
+  ``stage`` dim sharded over the ``pp`` mesh axis; a ring buffer of
+  per-stage activations carries one microbatch per stage; each tick
+  (a) shifts the buffer one stage forward — a concatenate on a
+  pp-sharded dim that XLA lowers to neighbor collective-permutes over
+  ICI — and (b) applies every stage's layers to its slot in parallel
+  (``vmap`` over the stage dim). After ``n_micro + n_stages - 1`` ticks
+  all microbatches have flowed through all stages.
+* Classic GPipe bubble: stages idle ``(n_stages-1)/(n_micro+n_stages-1)``
+  of the time; raise ``n_microbatches`` to amortize.
+* Backward is plain autodiff through the tick ``lax.scan`` — XLA
+  reverses the permutes, yielding the mirrored backward schedule. Each
+  tick is rematerialized (``jax.checkpoint``) so live activation memory
+  is one microbatch per stage, not the whole schedule.
+* Composes with the other axes: batch dims still shard over (dp, fsdp),
+  per-layer weights over fsdp/tp within each stage.
+
+This module is a *model adapter*: it exposes the same
+``init_params / param_logical_axes / loss_fn`` surface the trainer
+expects, wrapping ``models.llama`` with stage-stacked parameters.
+
+Reference parity: the reference has no pipeline parallelism anywhere —
+it delegates to DeepSpeed/SGLang inside workload recipes (reference:
+examples/deepspeed-multinode/sky.yaml; SURVEY.md §2.11). Here it is a
+first-class mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.models import llama
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig(llama.LlamaConfig):
+    """Llama config with a pipelined decoder stack."""
+
+    n_stages: int = 2
+    n_microbatches: int = 4
+
+    def __post_init__(self):
+        if self.n_layers % self.n_stages:
+            raise ValueError(f"n_layers={self.n_layers} not divisible by "
+                             f"n_stages={self.n_stages}")
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.n_layers // self.n_stages
+
+
+CONFIGS: Dict[str, PipelineConfig] = {
+    "pp-tiny": PipelineConfig(vocab_size=512, d_model=128, n_layers=4,
+                              n_heads=4, n_kv_heads=2, d_ff=256,
+                              max_seq_len=256, n_stages=2,
+                              n_microbatches=4),
+}
+
+
+# ---------------------------------------------------------------------------
+# Stage-stacked parameters
+# ---------------------------------------------------------------------------
+
+def _to_stages(blocks: Params, n_stages: int) -> Params:
+    """[L, ...] stacked per-layer weights -> [n_stages, L/stages, ...]."""
+    return jax.tree.map(
+        lambda x: x.reshape(n_stages, x.shape[0] // n_stages, *x.shape[1:]),
+        blocks)
+
+
+def init_params(rng: jax.Array, cfg: PipelineConfig) -> Params:
+    params = llama.init_params(rng, cfg)
+    params["blocks"] = _to_stages(params["blocks"], cfg.n_stages)
+    return params
+
+
+def param_logical_axes(cfg: PipelineConfig) -> Params:
+    axes = llama.param_logical_axes(cfg)
+    axes["blocks"] = jax.tree.map(
+        lambda t: ("stage",) + t,
+        axes["blocks"], is_leaf=lambda x: isinstance(x, tuple))
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# The pipelined forward
+# ---------------------------------------------------------------------------
+
+def _stage_apply(cfg: PipelineConfig, stage_blocks: Params, x: jax.Array,
+                 cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Run one stage's layers_per_stage decoder layers. x: [b, S, D]."""
+
+    def body(carry, layer):
+        return llama.decoder_layer(cfg, carry, layer, cos, sin), None
+
+    x, _ = lax.scan(body, x, stage_blocks)
+    return x
+
+
+def forward(params: Params, tokens: jax.Array, cfg: PipelineConfig,
+            constrain=None, mesh=None, rules=None) -> jax.Array:
+    """[B, S] ids -> logits [B, S, vocab] via the pipelined stack.
+
+    B must be divisible by n_microbatches. ``constrain/mesh/rules`` follow
+    the models.llama signature; activation constraints are applied to the
+    whole stage buffer (stage dim -> pp), per-layer internals are left to
+    XLA's sharding propagation from the weight shardings.
+    """
+    if constrain is None:
+        constrain = lambda x, axes: x
+    S_stages, M = cfg.n_stages, cfg.n_microbatches
+    B, S = tokens.shape
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    b = B // M
+
+    x = params["embed"].astype(cfg.dtype)[tokens]        # [B, S, D]
+    micro = x.reshape(M, b, S, x.shape[-1])
+    micro = constrain(micro, ("micro", "batch", "seq", "embed"))
+    positions = jnp.arange(S)
+    cos, sin = llama.rope_frequencies(cfg, positions)
+
+    apply_all = jax.vmap(
+        lambda blocks, xs: _stage_apply(cfg, blocks, xs, cos, sin))
+
+    def tick(carry, t):
+        buf, out = carry
+        # Feed microbatch t into stage 0 (past the end: recycle the last
+        # one; its output lands outside the valid output range).
+        inp = lax.dynamic_index_in_dim(micro, jnp.minimum(t, M - 1), axis=0,
+                                       keepdims=False)
+        # Shift one stage forward: on a pp-sharded dim this is a neighbor
+        # collective-permute, the pipeline's only communication.
+        buf = jnp.concatenate([inp[None], buf[:-1]], axis=0)
+        buf = constrain(buf, ("stage", "batch", "seq", "embed"))
+        buf = apply_all(params["blocks"], buf)
+        buf = constrain(buf, ("stage", "batch", "seq", "embed"))
+        # Stage (n_stages-1) just finished microbatch t-(n_stages-1).
+        # Earlier ticks write warm-up garbage to slot 0; the true
+        # microbatch-0 output overwrites it at t = n_stages-1 (ticks only
+        # move forward, so every slot's final write is the real one).
+        idx = jnp.maximum(t - (S_stages - 1), 0)
+        out = lax.dynamic_update_index_in_dim(out, buf[-1], idx, axis=0)
+        return (buf, out), None
+
+    D = x.shape[-1]
+    buf0 = jnp.zeros((S_stages, b, S, D), cfg.dtype)
+    out0 = jnp.zeros((M, b, S, D), cfg.dtype)
+    total_ticks = M + S_stages - 1
+    tick_fn = jax.checkpoint(
+        tick, policy=jax.checkpoint_policies.nothing_saveable)
+    (_, out), _ = lax.scan(tick_fn, (buf0, out0), jnp.arange(total_ticks))
+
+    x = out.reshape(B, S, D)
+    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
+    logits = constrain(logits, ("batch", "seq", "vocab"))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            cfg: PipelineConfig, constrain=None, mesh=None,
+            rules=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy through the pipelined forward."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens, cfg, constrain, mesh, rules)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logps = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    mask = jnp.ones_like(ll) if mask is None else mask[:, :-1].astype(ll.dtype)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = -(ll * mask).sum() / denom
+    acc = ((jnp.argmax(logits, -1) == targets) * mask).sum() / denom
+    return loss, {"loss": loss, "accuracy": acc, "tokens": denom}

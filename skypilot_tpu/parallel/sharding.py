@@ -30,6 +30,15 @@ DEFAULT_RULES: Rules = {
     "mlp": "tp",
     "head_dim": None,
     "layer": None,
+    # MoE: the expert dim shards over ep; XLA turns the dispatch/combine
+    # einsums into all-to-alls over the ep axis. Capacity stays local.
+    "expert": "ep",
+    "capacity": None,
+    # Pipeline: the stage dim of stage-stacked weights / activation
+    # buffers shards over pp; the tick shift compiles to collective
+    # permutes between neighbor stages.
+    "stage": "pp",
+    "micro": None,
 }
 
 # Activation-side overrides: activations' "embed" stays unsharded (it is

@@ -65,13 +65,13 @@ def _train_state(params, opt_state, step) -> TrainState:
 
 
 def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
-                    rules: sh.Rules = sh.DEFAULT_RULES):
+                    rules: sh.Rules = sh.DEFAULT_RULES, model=llama):
     """Shardings for the full train state (opt state mirrors params)."""
     tc = TrainConfig()
     opt = make_optimizer(tc)
-    p_shapes = jax.eval_shape(lambda: llama.init_params(jax.random.key(0), cfg))
+    p_shapes = jax.eval_shape(lambda: model.init_params(jax.random.key(0), cfg))
     opt_shapes = jax.eval_shape(opt.init, p_shapes)
-    p_sh = sh.logical_to_sharding(llama.param_logical_axes(cfg), mesh, rules,
+    p_sh = sh.logical_to_sharding(model.param_logical_axes(cfg), mesh, rules,
                                   shapes=p_shapes)
 
     def opt_leaf_sharding(leaf):
@@ -94,32 +94,57 @@ def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
 
 def create_train_state(cfg: llama.LlamaConfig, tc: TrainConfig,
                        mesh: Optional[Mesh], seed: int = 0,
-                       rules: sh.Rules = sh.DEFAULT_RULES) -> TrainState:
+                       rules: sh.Rules = sh.DEFAULT_RULES,
+                       model=llama) -> TrainState:
     opt = make_optimizer(tc)
 
     def init_fn(rng):
-        params = llama.init_params(rng, cfg)
+        params = model.init_params(rng, cfg)
         return _train_state(params, opt.init(params),
                             jnp.zeros((), jnp.int32))
 
     rng = jax.random.key(seed)
     if mesh is None:
         return jax.jit(init_fn)(rng)
-    shardings = state_shardings(cfg, mesh, rules)
+    shardings = state_shardings(cfg, mesh, rules, model)
     return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def create_abstract_state(cfg: llama.LlamaConfig, tc: TrainConfig,
+                          mesh: Optional[Mesh],
+                          rules: sh.Rules = sh.DEFAULT_RULES,
+                          model=llama) -> TrainState:
+    """ShapeDtypeStruct pytree (with shardings) of the train state —
+    the restore target for ``train.checkpoints`` without materializing
+    anything."""
+    opt = make_optimizer(tc)
+
+    def init_fn(rng):
+        params = model.init_params(rng, cfg)
+        return _train_state(params, opt.init(params),
+                            jnp.zeros((), jnp.int32))
+
+    shapes = jax.eval_shape(init_fn, jax.random.key(0))
+    if mesh is None:
+        return shapes
+    shardings = state_shardings(cfg, mesh, rules, model)
+    return jax.tree.map(
+        lambda s, shd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=shd),
+        shapes, shardings)
 
 
 def make_train_step(cfg: llama.LlamaConfig, tc: TrainConfig,
                     mesh: Optional[Mesh],
                     rules: sh.Rules = sh.DEFAULT_RULES,
-                    act_rules: sh.Rules = sh.ACT_RULES) -> Callable:
+                    act_rules: sh.Rules = sh.ACT_RULES,
+                    model=llama) -> Callable:
     """Returns jitted step(state, batch) -> (state, metrics)."""
     opt = make_optimizer(tc)
     constrain = sh.make_constrain(mesh, act_rules)
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
         def lossf(params):
-            return llama.loss_fn(params, batch, cfg, constrain, mesh,
+            return model.loss_fn(params, batch, cfg, constrain, mesh,
                                  act_rules)
 
         (loss, metrics), grads = jax.value_and_grad(lossf, has_aux=True)(
@@ -134,7 +159,7 @@ def make_train_step(cfg: llama.LlamaConfig, tc: TrainConfig,
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,))
-    shardings = state_shardings(cfg, mesh, rules)
+    shardings = state_shardings(cfg, mesh, rules, model)
     batch_spec = NamedSharding(mesh, P(("dp", "fsdp")))
     return jax.jit(
         step,

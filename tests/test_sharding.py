@@ -13,14 +13,14 @@ from skypilot_tpu.train import trainer
 
 def test_make_mesh_shapes():
     m = mesh_lib.make_mesh(mesh_lib.MeshShape(dp=2, fsdp=2, tp=2))
-    assert dict(m.shape) == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+    assert dict(m.shape) == {"pp": 1, "dp": 2, "fsdp": 2, "ep": 1, "tp": 2, "sp": 1}
     with pytest.raises(ValueError):
         mesh_lib.make_mesh(mesh_lib.MeshShape(dp=3, fsdp=2, tp=2))
 
 
 def test_default_shape_factorization():
     s = mesh_lib.default_shape_for(8, tp=2)
-    assert s.as_dict() == {"dp": 1, "fsdp": 4, "tp": 2, "sp": 1}
+    assert s.as_dict() == {"pp": 1, "dp": 1, "fsdp": 4, "ep": 1, "tp": 2, "sp": 1}
 
 
 def test_param_shardings_resolve(mesh8, tiny_cfg):
