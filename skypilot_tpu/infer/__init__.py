@@ -1,0 +1,1 @@
+"""TPU-native inference: KV-cache decode + continuous-batching engine."""

@@ -1,0 +1,96 @@
+"""Serving benchmark: TTFT + token throughput on the local accelerator.
+
+Prints ONE JSON line, same contract as the repo-root bench.py:
+  {"metric": "serve_median_ttft", "value": ..., "unit": "ms",
+   "vs_baseline": ...}
+
+vs_baseline compares against the reference's JetStream anchor on TPU
+(reference: examples/tpu/v6e/README.md — median TTFT 1829.33 ms,
+2147.98 output tok/s for Llama-2-7B on v6e; BASELINE.md). Ratio > 1
+means faster than baseline (baseline_ttft / our_ttft).
+
+Usage: python -m skypilot_tpu.infer.bench_serve [--config llama3-400m]
+       [--requests 16] [--slots 8] [--prompt-len 96] [--new-tokens 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+REF_TTFT_MS = 1829.33
+REF_TOK_S = 2147.98
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=96)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.infer import engine as eng
+    from skypilot_tpu.models import llama
+
+    on_cpu = jax.default_backend() == "cpu"
+    if args.config is None:
+        args.config = "llama3-tiny" if on_cpu else "llama3-400m"
+    cfg = llama.CONFIGS[args.config]
+    log(f"serve bench: {args.config} on {jax.devices()[0].device_kind}")
+
+    params = llama.init_params(jax.random.key(0), cfg)
+    max_len = args.prompt_len + args.new_tokens + 8
+    e = eng.InferenceEngine(params, cfg, n_slots=args.slots,
+                            max_len=max_len,
+                            prompt_buckets=(args.prompt_len,))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            args.prompt_len).tolist()
+               for _ in range(args.requests)]
+
+    # Warmup: compile prefill + decode.
+    e.generate([prompts[0]], max_new_tokens=2)
+    e.finished.clear()
+
+    t0 = time.time()
+    for p in prompts:
+        e.add_request(p, max_new_tokens=args.new_tokens)
+    done = e.run_to_completion()
+    # Force a host sync so the wall clock is honest (axon relay:
+    # block_until_ready does not synchronize; a host fetch does).
+    float(e.cache["length"][0])
+    wall = time.time() - t0
+
+    ttfts = sorted((r.first_token_s - r.submit_s) * 1e3 for r in done)
+    med_ttft = ttfts[len(ttfts) // 2]
+    total_tokens = sum(len(r.tokens) for r in done)
+    tok_s = total_tokens / wall
+    req_s = len(done) / wall
+
+    log(f"requests={len(done)} wall={wall:.2f}s median_ttft={med_ttft:.1f}ms "
+        f"tok/s={tok_s:.1f} req/s={req_s:.2f}")
+    print(json.dumps({
+        "metric": "serve_median_ttft",
+        "value": round(med_ttft, 2),
+        "unit": "ms",
+        "vs_baseline": round(REF_TTFT_MS / max(med_ttft, 1e-9), 3),
+        "output_tok_per_s": round(tok_s, 2),
+        "req_per_s": round(req_s, 3),
+        "config": args.config,
+    }))
+
+
+if __name__ == "__main__":
+    main()

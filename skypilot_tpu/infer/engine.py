@@ -1,0 +1,194 @@
+"""Continuous-batching inference engine (JetStream-equivalent).
+
+Slot-based serving: a fixed pool of decode slots advances one token per
+``step()`` for every active request, while new requests prefill into free
+slots between steps. All device programs are compiled once per prompt
+bucket — admission/eviction is host-side bookkeeping only; no shape ever
+changes on device.
+
+TTFT = one bucketed prefill (+ queue wait); steady-state throughput =
+slots x decode rate. The orchestration mirrors JetStream's
+prefill-insert-generate loop, which is what the reference benchmarks on
+TPU (reference: examples/tpu/v6e/README.md §Serve — 11.42 req/s,
+1829 ms median TTFT on v6e; BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.infer import kvcache, sampling
+from skypilot_tpu.models import llama
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    submit_s: float = 0.0
+    first_token_s: Optional[float] = None
+    done: bool = False
+    eos_id: Optional[int] = None
+
+
+def _bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds max bucket {buckets[-1]}")
+
+
+class InferenceEngine:
+    """Single-model continuous-batching engine.
+
+    Parameters live wherever the caller put them (replicated or
+    TP-sharded under a mesh); the engine only compiles and schedules.
+    """
+
+    def __init__(self, params: llama.Params, cfg: llama.LlamaConfig,
+                 n_slots: int = 8, max_len: int = 1024,
+                 prompt_buckets: Tuple[int, ...] = (128, 512, 1024),
+                 sampling_params: sampling.SamplingParams = sampling.SamplingParams(),
+                 eos_id: Optional[int] = None, seed: int = 0):
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.buckets = tuple(b for b in prompt_buckets if b <= max_len)
+        self.sampling_params = sampling_params
+        self.eos_id = eos_id
+        self.cache = kvcache.init_cache(cfg, n_slots, max_len)
+        self.rng = jax.random.key(seed)
+
+        self.free_slots = list(range(n_slots))
+        self.slot_req: Dict[int, Request] = {}
+        self.waiting: List[Request] = []
+        self.finished: List[Request] = []
+        self._next_rid = 0
+
+        sp = self.sampling_params
+
+        @functools.partial(jax.jit, static_argnames=("bucket",))
+        def _prefill(params, tokens, true_len, rng, *, bucket):
+            del bucket
+            prefix, logits = kvcache.prefill(params, tokens, true_len, cfg)
+            tok = sampling.sample(logits, rng, sp)
+            return prefix, tok
+
+        # Donate the cache: the engine reassigns self.cache from the
+        # output every call, so XLA can update the [L, slots, max_len,
+        # G, hd] buffers in place instead of copying them per token.
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _insert(cache, prefix, slot, true_len, first_token):
+            return kvcache.insert(cache, prefix, slot, true_len, first_token)
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _decode(params, cache, rng, active):
+            cache, logits = kvcache.decode_step(params, cache, cfg)
+            toks = sampling.sample(logits, rng, sp)
+            cache = kvcache.commit_tokens(cache, toks, active)
+            return cache, toks
+
+        self._prefill_fn = _prefill
+        self._insert_fn = _insert
+        self._decode_fn = _decode
+
+    # -- admission ---------------------------------------------------------
+
+    def add_request(self, prompt: List[int],
+                    max_new_tokens: int = 128) -> int:
+        req = Request(rid=self._next_rid, prompt=list(prompt),
+                      max_new_tokens=max_new_tokens, submit_s=time.time(),
+                      eos_id=self.eos_id)
+        self._next_rid += 1
+        self.waiting.append(req)
+        return req.rid
+
+    def _admit(self) -> None:
+        while self.waiting and self.free_slots:
+            req = self.waiting.pop(0)
+            slot = self.free_slots.pop(0)
+            bucket = _bucket(len(req.prompt), self.buckets)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:len(req.prompt)] = req.prompt
+            self.rng, sub = jax.random.split(self.rng)
+            prefix, tok = self._prefill_fn(
+                self.params, jnp.asarray(padded),
+                jnp.asarray(len(req.prompt), jnp.int32), sub, bucket=bucket)
+            self.cache = self._insert_fn(
+                self.cache, prefix, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(len(req.prompt), jnp.int32), tok)
+            first = int(tok)
+            req.slot = slot
+            req.tokens.append(first)
+            req.first_token_s = time.time()
+            self.slot_req[slot] = req
+            if self._req_finished(req, first):
+                self._retire(req)
+
+    # -- stepping ----------------------------------------------------------
+
+    def _req_finished(self, req: Request, tok: int) -> bool:
+        if req.eos_id is not None and tok == req.eos_id:
+            return True
+        if len(req.tokens) >= req.max_new_tokens:
+            return True
+        return len(req.prompt) + len(req.tokens) >= self.max_len
+
+    def _retire(self, req: Request) -> None:
+        req.done = True
+        self.finished.append(req)
+        if req.slot is not None:
+            self.slot_req.pop(req.slot, None)
+            self.free_slots.append(req.slot)
+            self.cache["length"] = self.cache["length"].at[req.slot].set(0)
+            req.slot = None
+
+    def step(self) -> Dict[int, int]:
+        """Admit waiting requests, decode one token per active slot.
+
+        Returns {rid: token} emitted this step.
+        """
+        self._admit()
+        if not self.slot_req:
+            return {}
+        active = np.zeros((self.n_slots,), bool)
+        for s in self.slot_req:
+            active[s] = True
+        self.rng, sub = jax.random.split(self.rng)
+        self.cache, toks = self._decode_fn(self.params, self.cache, sub,
+                                           jnp.asarray(active))
+        toks = np.asarray(toks)
+        out: Dict[int, int] = {}
+        for slot, req in list(self.slot_req.items()):
+            tok = int(toks[slot])
+            req.tokens.append(tok)
+            out[req.rid] = tok
+            if self._req_finished(req, tok):
+                self._retire(req)
+        return out
+
+    def run_to_completion(self) -> List[Request]:
+        """Drain all waiting + active requests; returns finished list."""
+        while self.waiting or self.slot_req:
+            self.step()
+        return self.finished
+
+    # -- convenience -------------------------------------------------------
+
+    def generate(self, prompts: List[List[int]],
+                 max_new_tokens: int = 128) -> List[List[int]]:
+        ids = [self.add_request(p, max_new_tokens) for p in prompts]
+        self.run_to_completion()
+        by_rid = {r.rid: r for r in self.finished}
+        return [by_rid[i].tokens for i in ids]

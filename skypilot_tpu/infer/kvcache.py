@@ -1,0 +1,204 @@
+"""KV-cache prefill / decode steps for the Llama family.
+
+TPU-first design notes
+----------------------
+* Everything is **static-shape**: the decode cache is a pre-allocated
+  ``[L, slots, max_len, kv_heads, head_dim]`` buffer; per-slot lengths
+  mask attention instead of resizing anything. One compiled prefill per
+  prompt bucket, one compiled decode step, reused for the whole serving
+  lifetime — no retracing, ever.
+* Prefill is the plain causal forward (right-padded to a bucket length)
+  that additionally emits each layer's post-rope K/V rows; padding rows
+  never poison the cache because causal attention keeps positions
+  < true_len independent of them, and decode masks rows >= length.
+* Decode processes *all slots together*: [slots, 1] tokens through the
+  stacked-layer ``lax.scan``, one scatter per layer to append K/V. This
+  is the JetStream-style generate step — MXU-batched across requests.
+* Sharding composes with serving TP: cache kv-head dim maps to ``tp``,
+  slot dim to (``dp``, ``fsdp``) via the standard rule table.
+
+Reference parity: the reference serves LLMs only through external
+engines (reference: llm/vllm/serve.yaml, examples/tpu/v6e/README.md
+JetStream section). This module is the in-tree TPU-native engine core.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.models import llama
+
+Cache = Dict[str, jax.Array]
+
+
+def init_cache(cfg: llama.LlamaConfig, n_slots: int,
+               max_len: int) -> Cache:
+    """Pre-allocated decode state for ``n_slots`` concurrent requests."""
+    L, G, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    return {
+        "k": jnp.zeros((L, n_slots, max_len, G, hd), cfg.dtype),
+        "v": jnp.zeros((L, n_slots, max_len, G, hd), cfg.dtype),
+        # Tokens generated + prompt rows present, per slot (0 = free).
+        "length": jnp.zeros((n_slots,), jnp.int32),
+        "last_token": jnp.zeros((n_slots,), jnp.int32),
+    }
+
+
+def cache_logical_axes() -> Dict[str, Tuple]:
+    return {
+        "k": ("layer", "batch", "seq_cache", "kv_heads", "head_dim"),
+        "v": ("layer", "batch", "seq_cache", "kv_heads", "head_dim"),
+        "length": ("batch",),
+        "last_token": ("batch",),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+# ---------------------------------------------------------------------------
+
+def prefill(params: llama.Params, tokens: jax.Array, true_len: jax.Array,
+            cfg: llama.LlamaConfig,
+            constrain=None) -> Tuple[Cache, jax.Array]:
+    """Causal forward over a right-padded prompt.
+
+    tokens: [S_bucket] int32 (single request), true_len: scalar int32.
+    Returns ({"k","v"}: [L, S_bucket, G, hd] post-rope rows, logits at
+    the last real position [vocab] fp32).
+    """
+    if constrain is None:
+        constrain = lambda x, axes: x
+    tokens = tokens[None]                                     # [1, S]
+    S = tokens.shape[1]
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.arange(S)
+    cos, sin = llama.rope_frequencies(cfg, positions)
+
+    def body(carry, layer):
+        x = carry
+        h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+        from skypilot_tpu.ops import attention as attn_ops
+        o = attn_ops.gqa_attention(q, k, v, causal=True)
+        o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
+        x = x + o
+        h = llama.rms_norm(x, layer["ln2"], cfg.norm_eps)
+        g = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype))
+        u = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+        m = jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u,
+                       layer["w_down"].astype(cfg.dtype))
+        return x + m, (k[0], v[0])
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = x[0, true_len - 1]                                  # [D]
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = (last @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return {"k": ks, "v": vs}, logits
+
+
+def insert(cache: Cache, prefix: Cache, slot: jax.Array,
+           true_len: jax.Array, first_token: jax.Array) -> Cache:
+    """Install a prefilled prompt into a decode slot.
+
+    prefix k/v: [L, S_bucket, G, hd]; rows >= true_len are padding but
+    harmless — decode masks by ``length``.
+    """
+    k = lax.dynamic_update_slice(
+        cache["k"], prefix["k"][:, None], (0, slot, 0, 0, 0))
+    v = lax.dynamic_update_slice(
+        cache["v"], prefix["v"][:, None], (0, slot, 0, 0, 0))
+    return {
+        "k": k,
+        "v": v,
+        "length": cache["length"].at[slot].set(true_len),
+        "last_token": cache["last_token"].at[slot].set(first_token),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Decode
+# ---------------------------------------------------------------------------
+
+def decode_step(params: llama.Params, cache: Cache,
+                cfg: llama.LlamaConfig,
+                constrain=None) -> Tuple[Cache, jax.Array]:
+    """One token for every slot. Returns (cache', logits [slots, vocab])."""
+    if constrain is None:
+        constrain = lambda x, axes: x
+    B = cache["length"].shape[0]
+    M = cache["k"].shape[2]
+    G, hd = cfg.n_kv_heads, cfg.head_dim
+    rep = cfg.n_heads // G
+
+    tokens = cache["last_token"][:, None]                     # [B, 1]
+    # ``length`` counts rows already in the cache (prompt + committed
+    # tokens); the pending token's K/V row is written at index length.
+    pos = cache["length"]                                     # [B]
+    x = params["embed"].astype(cfg.dtype)[tokens]             # [B, 1, D]
+    cos, sin = llama.rope_frequencies(cfg, pos[:, None])      # [B,1,hd/2]
+
+    # Rows <= length are valid (the just-written current row included).
+    valid = (jnp.arange(M)[None, :] <= cache["length"][:, None])  # [B, M]
+    neg = jnp.asarray(-1e30, jnp.float32)
+    scale = hd ** -0.5
+    batch_ix = jnp.arange(B)
+
+    def body(carry, layer_kv):
+        x = carry
+        layer, ck, cv = layer_kv                              # ck [B,M,G,hd]
+        h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+        ck = ck.at[batch_ix, pos].set(k[:, 0])
+        cv = cv.at[batch_ix, pos].set(v[:, 0])
+        qh = q[:, 0].reshape(B, G, rep, hd)
+        s = jnp.einsum("bgrk,bmgk->bgrm", qh.astype(jnp.float32),
+                       ck.astype(jnp.float32)) * scale
+        s = jnp.where(valid[:, None, None, :], s, neg)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bgrm,bmgk->bgrk", w, cv.astype(jnp.float32))
+        o = o.reshape(B, 1, cfg.n_heads, hd).astype(cfg.dtype)
+        o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
+        x = x + o
+        h = llama.rms_norm(x, layer["ln2"], cfg.norm_eps)
+        g = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype))
+        u = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+        m = jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u,
+                       layer["w_down"].astype(cfg.dtype))
+        return x + m, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x,
+                        head.astype(cfg.dtype))[:, 0].astype(jnp.float32)
+    return {
+        "k": new_k,
+        "v": new_v,
+        "length": cache["length"],
+        "last_token": cache["last_token"],
+    }, logits
+
+
+def commit_tokens(cache: Cache, tokens: jax.Array,
+                  active: jax.Array) -> Cache:
+    """Append sampled tokens on active slots: bump lengths, set last."""
+    return {
+        "k": cache["k"],
+        "v": cache["v"],
+        "length": cache["length"] + active.astype(jnp.int32),
+        "last_token": jnp.where(active, tokens, cache["last_token"]),
+    }

@@ -1,0 +1,28 @@
+"""Token sampling: greedy / temperature / top-k, batched and jittable."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0      # 0 => greedy
+    top_k: Optional[int] = None   # None => full vocab
+
+
+def sample(logits: jax.Array, rng: jax.Array,
+           params: SamplingParams) -> jax.Array:
+    """logits: [..., vocab] fp32 -> token ids [...]."""
+    if params.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / params.temperature
+    if params.top_k is not None and params.top_k > 0:
+        top_vals, _ = jax.lax.top_k(logits, params.top_k)
+        cutoff = top_vals[..., -1:]
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

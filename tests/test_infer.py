@@ -1,0 +1,97 @@
+"""Inference engine: KV-cache decode parity + continuous batching."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import engine as eng
+from skypilot_tpu.infer import kvcache, sampling
+from skypilot_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.CONFIGS["llama3-tiny"]
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(jax.random.key(0), cfg)
+
+
+def greedy_reference(params, cfg, prompt, n_new):
+    """Greedy decode via repeated full forwards (the slow oracle)."""
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = llama.forward(params, jnp.asarray([toks], jnp.int32), cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def test_incremental_decode_matches_full_forward(cfg, params):
+    prompt = [3, 17, 42, 7, 99]
+    n_new = 8
+    want = greedy_reference(params, cfg, prompt, n_new)
+
+    e = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                            prompt_buckets=(16, 64))
+    got = e.generate([prompt], max_new_tokens=n_new)[0]
+    assert got == want
+
+
+def test_continuous_batching_isolation(cfg, params):
+    """Staggered concurrent requests decode exactly like solo runs."""
+    p1, p2 = [5, 9, 31], [44, 2, 8, 19, 3, 27]
+    want1 = greedy_reference(params, cfg, p1, 6)
+    want2 = greedy_reference(params, cfg, p2, 6)
+
+    e = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                            prompt_buckets=(16,))
+    r1 = e.add_request(p1, max_new_tokens=6)
+    e.step()   # r1 decodes alone for two steps
+    e.step()
+    r2 = e.add_request(p2, max_new_tokens=6)
+    e.run_to_completion()
+    by_rid = {r.rid: r.tokens for r in e.finished}
+    assert by_rid[r1] == want1
+    assert by_rid[r2] == want2
+
+
+def test_slots_recycled(cfg, params):
+    e = eng.InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                            prompt_buckets=(16,))
+    outs = e.generate([[1, 2, 3], [4, 5, 6], [7, 8]], max_new_tokens=3)
+    assert len(outs) == 3
+    assert all(len(o) == 3 for o in outs)
+    assert len(e.free_slots) == 1
+
+
+def test_ttft_recorded(cfg, params):
+    e = eng.InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                            prompt_buckets=(16,))
+    e.add_request([1, 2, 3, 4], max_new_tokens=2)
+    e.run_to_completion()
+    req = e.finished[0]
+    assert req.first_token_s is not None
+    assert req.first_token_s >= req.submit_s
+
+
+def test_eos_stops_decode(cfg, params):
+    # Find the greedy first token, then declare it EOS: request must
+    # retire after a single token.
+    prompt = [3, 17, 42]
+    first = greedy_reference(params, cfg, prompt, 1)[0]
+    e = eng.InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                            prompt_buckets=(16,), eos_id=first)
+    out = e.generate([prompt], max_new_tokens=10)[0]
+    assert out == [first]
+
+
+def test_sampling_temperature_valid(cfg, params):
+    sp = sampling.SamplingParams(temperature=0.8, top_k=10)
+    e = eng.InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                            prompt_buckets=(16,), sampling_params=sp)
+    out = e.generate([[1, 2, 3]], max_new_tokens=5)[0]
+    assert len(out) == 5
+    assert all(0 <= t < cfg.vocab_size for t in out)
