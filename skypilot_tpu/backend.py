@@ -207,11 +207,14 @@ class TpuVmBackend:
     def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
         info = provision.get_cluster_info(handle.provider,
                                           handle.cluster_name, handle.zone)
+        from skypilot_tpu.data import storage_utils
+        excludes = ([".git"] + storage_utils.read_ignore_patterns(
+            os.path.expanduser(workdir)))
         for runner, host in zip(provision.get_command_runners(info),
                                 info.hosts):
             dst = (os.path.join(host.workspace, "sky_workdir")
                    if host.workspace else "~/sky_workdir")
-            runner.rsync(workdir, dst, up=True)
+            runner.rsync(workdir, dst, up=True, excludes=excludes)
 
     def sync_file_mounts(self, handle: ClusterHandle,
                          file_mounts: Dict[str, str]) -> None:
@@ -229,6 +232,44 @@ class TpuVmBackend:
                 tgt = (os.path.join(host.workspace, dst.lstrip("/~"))
                        if host.workspace else dst)
                 runner.rsync(os.path.expanduser(src), tgt, up=True)
+
+    def sync_storage_mounts(self, handle: ClusterHandle,
+                            storage_mounts: Dict[str, Any]) -> None:
+        """Create/upload buckets, then mount (gcsfuse) or copy down on
+        every host. Values may be data.storage.Storage objects or their
+        YAML dicts."""
+        if not storage_mounts:
+            return
+        from skypilot_tpu.data import storage as storage_lib
+        info = provision.get_cluster_info(handle.provider,
+                                          handle.cluster_name, handle.zone)
+        runners = provision.get_command_runners(info)
+        ephemeral = list(handle.get("ephemeral_storage", []))
+        for dst, spec in storage_mounts.items():
+            store = (spec if isinstance(spec, storage_lib.Storage)
+                     else storage_lib.Storage.from_yaml_config(spec))
+            store.sync_up()
+            if not store.persistent:
+                cfg = store.to_yaml_config()
+                if cfg not in ephemeral:
+                    ephemeral.append(cfg)
+            for runner, host in zip(runners, info.hosts):
+                tgt = (os.path.join(host.workspace, dst.lstrip("/~"))
+                       if host.workspace else dst)
+                for cmd in store.attach_commands(tgt):
+                    rc, out, err = runner.run(cmd)
+                    if rc != 0:
+                        raise exceptions.StorageError(
+                            f"storage mount {dst} failed on host "
+                            f"{runner.host_id}: {out}{err}")
+        if ephemeral:
+            # Persist on the handle so teardown can delete the buckets.
+            handle["ephemeral_storage"] = ephemeral
+            rec = state.get_cluster(handle.cluster_name)
+            if rec is not None:
+                state.set_cluster(handle.cluster_name, dict(handle),
+                                  rec["status"],
+                                  rec.get("price_per_hour", 0.0))
 
     # -- execution ---------------------------------------------------------
     def execute(self, handle: ClusterHandle, task: Task,
@@ -376,6 +417,14 @@ class TpuVmBackend:
     def teardown(self, handle: ClusterHandle) -> None:
         provision.terminate_instances(handle.provider, handle.cluster_name,
                                       handle.zone)
+        # Ephemeral (persistent: false) buckets die with the cluster.
+        for cfg in handle.get("ephemeral_storage", []):
+            from skypilot_tpu.data import storage as storage_lib
+            try:
+                storage_lib.Storage.from_yaml_config(cfg).delete()
+            except Exception as e:  # noqa: BLE001 — teardown must proceed
+                print(f"WARNING: deleting ephemeral storage {cfg} "
+                      f"failed: {e}", file=sys.stderr)
         state.remove_cluster(handle.cluster_name)
         # Clear the client-side cluster dir (job queue, logs, scripts) so
         # a future cluster reusing the name starts clean.

@@ -1,0 +1,69 @@
+"""CloudStorage adapters: scheme -> fetch/sync command builders.
+
+Used by the backend to materialize ``gs://`` / ``https://`` file-mount
+sources on cluster hosts. Pure command construction (offline-testable);
+execution goes through CommandRunners.
+
+Reference parity: sky/cloud_stores.py (CloudStorage adapters for
+file_mounts from s3://, gs://, https://).
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import Dict
+
+
+class CloudStorage:
+    """Command builders for one URL scheme."""
+
+    def is_directory(self, url: str) -> bool:
+        raise NotImplementedError
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        raise NotImplementedError
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        raise NotImplementedError
+
+
+class GcsCloudStorage(CloudStorage):
+    """gs:// via the gcloud storage CLI (preinstalled on TPU-VMs)."""
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p {dst} && "
+                f"gcloud storage rsync -r {shlex.quote(source)} {dst}")
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p $(dirname {dst}) && "
+                f"gcloud storage cp {shlex.quote(source)} {dst}")
+
+
+class HttpCloudStorage(CloudStorage):
+    """https:// single-file fetch via curl."""
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p $(dirname {dst}) && "
+                f"curl -fsSL {shlex.quote(source)} -o {dst}")
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        raise ValueError(f"https source {source} must be a single file")
+
+
+_REGISTRY: Dict[str, CloudStorage] = {
+    "gs": GcsCloudStorage(),
+    "https": HttpCloudStorage(),
+    "http": HttpCloudStorage(),
+}
+
+
+def get_storage_from_path(url: str) -> CloudStorage:
+    scheme = url.split("://", 1)[0]
+    if scheme not in _REGISTRY:
+        raise ValueError(
+            f"unsupported storage scheme {scheme!r} in {url!r}; "
+            f"supported: {sorted(_REGISTRY)}")
+    return _REGISTRY[scheme]

@@ -1,0 +1,59 @@
+"""FUSE mount command generation (gcsfuse-first).
+
+Pure command-string construction — execution happens on cluster hosts
+via CommandRunners, so everything here is offline-testable.
+
+Reference parity: sky/data/mounting_utils.py (goofys/gcsfuse/blobfuse2
+command builders, :26-45). GCS is the TPU-native first-class store;
+other protocols raise until their stores land.
+"""
+
+from __future__ import annotations
+
+import shlex
+
+GCSFUSE_VERSION = "2.4.0"
+
+# Installed on TPU-VM images already in most cases; this is the fallback.
+GCSFUSE_INSTALL_CMD = (
+    "which gcsfuse >/dev/null 2>&1 || ("
+    "curl -fsSL https://github.com/GoogleCloudPlatform/gcsfuse/releases/"
+    f"download/v{GCSFUSE_VERSION}/gcsfuse_{GCSFUSE_VERSION}_amd64.deb "
+    "-o /tmp/gcsfuse.deb && sudo dpkg -i /tmp/gcsfuse.deb)")
+
+
+def get_mount_cmd(bucket: str, mount_path: str,
+                  readonly: bool = False,
+                  only_dir: str | None = None) -> str:
+    """gcsfuse mount command for ``gs://bucket`` at ``mount_path``.
+
+    ``only_dir`` restricts the mount to a prefix within the bucket
+    (gs://bucket/sub -> pass only_dir='sub')."""
+    bucket = bucket.removeprefix("gs://").split("/", 1)[0]
+    opts = [
+        "--implicit-dirs",
+        # Checkpoint-oriented tuning: large sequential writes (Orbax
+        # shard streams) want big write buffers and no type caching.
+        "--stat-cache-ttl 10s",
+        "--type-cache-ttl 10s",
+        "--rename-dir-limit 10000",
+    ]
+    if only_dir:
+        opts.append(f"--only-dir {shlex.quote(only_dir)}")
+    if readonly:
+        opts.append("-o ro")
+    return (f"mkdir -p {shlex.quote(mount_path)} && "
+            f"gcsfuse {' '.join(opts)} {shlex.quote(bucket)} "
+            f"{shlex.quote(mount_path)}")
+
+
+def get_umount_cmd(mount_path: str) -> str:
+    return (f"fusermount -u {shlex.quote(mount_path)} 2>/dev/null || "
+            f"sudo umount -l {shlex.quote(mount_path)} 2>/dev/null || true")
+
+
+def get_mount_with_install_cmd(bucket: str, mount_path: str,
+                               readonly: bool = False,
+                               only_dir: str | None = None) -> str:
+    return (f"({GCSFUSE_INSTALL_CMD}) && "
+            f"{get_mount_cmd(bucket, mount_path, readonly, only_dir)}")

@@ -1,16 +1,257 @@
-"""Bucket storage (GCS-first). COPY/MOUNT lifecycle lands with the data
-layer milestone; this module currently carries the backend-facing hook.
+"""Bucket storage lifecycle (GCS-first): create, sync-up, mount, delete.
 
-Reference parity target: sky/data/storage.py (Storage:468, GcsStore:1786)
-+ mounting_utils (gcsfuse).
+Design: command construction is pure (offline-testable); execution is
+injected — locally a subprocess runner, on-cluster the provisioner's
+CommandRunners. MOUNT mode = gcsfuse (mounting_utils); COPY mode =
+``gcloud storage rsync`` onto the host disk.
+
+Reference parity: sky/data/storage.py (Storage:468 w/ StorageMode
+MOUNT|COPY :237, AbstractStore:242, GcsStore:1786 — bucket lifecycle,
+sync via gsutil rsync, persistent vs ephemeral) and
+sky/data/data_utils.py (bucket URL parsing).
 """
 
 from __future__ import annotations
 
-from skypilot_tpu import exceptions
+import enum
+import shlex
+import subprocess
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import mounting_utils, storage_utils
+
+RunFn = Callable[[str], Tuple[int, str]]
+
+
+def _local_run(cmd: str) -> Tuple[int, str]:
+    proc = subprocess.run(["bash", "-c", cmd], capture_output=True,
+                          text=True)
+    return proc.returncode, (proc.stdout or "") + (proc.stderr or "")
+
+
+class StorageMode(enum.Enum):
+    MOUNT = "MOUNT"
+    COPY = "COPY"
+
+
+def split_bucket_url(url: str) -> Tuple[str, str]:
+    """'gs://bucket/sub/path' -> ('bucket', 'sub/path')."""
+    if "://" not in url:
+        raise ValueError(f"not a bucket URL: {url!r}")
+    rest = url.split("://", 1)[1]
+    bucket, _, sub = rest.partition("/")
+    if not bucket:
+        raise ValueError(f"no bucket name in {url!r}")
+    return bucket, sub
+
+
+class AbstractStore:
+    """One bucket (optionally a prefix within it) on one provider."""
+
+    SCHEME = ""
+
+    def __init__(self, name: str, run: RunFn = _local_run,
+                 subpath: str = ""):
+        self.name = name
+        self.subpath = subpath.strip("/")
+        self._run = run
+
+    @property
+    def url(self) -> str:
+        base = f"{self.SCHEME}://{self.name}"
+        return f"{base}/{self.subpath}" if self.subpath else base
+
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    def create(self, region: Optional[str] = None) -> None:
+        raise NotImplementedError
+
+    def upload(self, source: str) -> None:
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    def mount_command(self, mount_path: str) -> str:
+        raise NotImplementedError
+
+    def copy_down_command(self, destination: str) -> str:
+        raise NotImplementedError
+
+
+class GcsStore(AbstractStore):
+    """GCS bucket via the gcloud storage CLI."""
+
+    SCHEME = "gs"
+
+    def exists(self) -> bool:
+        rc, _ = self._run(
+            f"gcloud storage buckets describe gs://{self.name} "
+            f"--format='value(name)'")
+        return rc == 0
+
+    def create(self, region: Optional[str] = None) -> None:
+        loc = f" --location={shlex.quote(region)}" if region else ""
+        rc, out = self._run(
+            f"gcloud storage buckets create gs://{self.name}{loc} "
+            f"--uniform-bucket-level-access")
+        if rc != 0 and "already" not in out.lower():
+            raise exceptions.StorageError(
+                f"creating gs://{self.name} failed: {out.strip()}")
+
+    def upload(self, source: str) -> None:
+        excl = storage_utils.gsutil_exclude_regex(source)
+        xflag = f" -x {shlex.quote(excl)}" if excl else ""
+        rc, out = self._run(
+            f"gcloud storage rsync -r{xflag} {shlex.quote(source)} "
+            f"gs://{self.name}")
+        if rc != 0:
+            raise exceptions.StorageError(
+                f"upload {source} -> gs://{self.name} failed: {out.strip()}")
+
+    def delete(self) -> None:
+        rc, out = self._run(f"gcloud storage rm -r gs://{self.name}")
+        if rc != 0 and "not found" not in out.lower():
+            raise exceptions.StorageError(
+                f"deleting gs://{self.name} failed: {out.strip()}")
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.get_mount_with_install_cmd(
+            self.name, mount_path, only_dir=self.subpath or None)
+
+    def copy_down_command(self, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p {dst} && "
+                f"gcloud storage rsync -r {self.url} {dst}")
+
+
+_STORE_TYPES: Dict[str, type] = {"gs": GcsStore}
+
+
+class Storage:
+    """A named storage object: optional local source + bucket store(s).
+
+    YAML form (under ``storage_mounts``)::
+
+        /outputs:
+          name: my-train-outputs
+          store: gs
+          mode: MOUNT
+          persistent: true
+        /data:
+          source: gs://my-dataset     # pre-existing bucket
+          mode: COPY
+    """
+
+    def __init__(self, name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 mode: StorageMode = StorageMode.MOUNT,
+                 store: str = "gs", persistent: bool = True,
+                 run: RunFn = _local_run):
+        if name is None and source is None:
+            raise exceptions.StorageError(
+                "storage needs a `name` (new bucket) or `source` "
+                "(existing bucket or local path)")
+        self.mode = mode
+        self.persistent = persistent
+        self.source = source
+        self._run = run
+        if source and "://" in source:
+            bucket, sub = split_bucket_url(source)
+            scheme = source.split("://", 1)[0]
+            if scheme not in _STORE_TYPES:
+                raise exceptions.StorageError(
+                    f"unsupported store scheme {scheme!r}")
+            self.name = name or bucket
+            self.store: AbstractStore = _STORE_TYPES[scheme](bucket, run,
+                                                             subpath=sub)
+            self._external = True
+        else:
+            if name is None:
+                raise exceptions.StorageError(
+                    f"local source {source!r} needs a bucket `name`")
+            self.name = name
+            self.store = _STORE_TYPES[store](name, run)
+            self._external = False
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         run: RunFn = _local_run) -> "Storage":
+        config = dict(config or {})
+        mode = StorageMode(config.pop("mode", "MOUNT").upper())
+        obj = cls(name=config.pop("name", None),
+                  source=config.pop("source", None), mode=mode,
+                  store=config.pop("store", "gs"),
+                  persistent=config.pop("persistent", True), run=run)
+        if config:
+            raise exceptions.StorageError(
+                f"unknown storage fields: {sorted(config)}")
+        return obj
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"mode": self.mode.value,
+                               "persistent": self.persistent}
+        if self._external:
+            out["source"] = self.source
+        else:
+            out["name"] = self.name
+            if self.source:
+                out["source"] = self.source
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def sync_up(self, region: Optional[str] = None) -> None:
+        """Ensure the bucket exists; upload the local source if any."""
+        if self._external:
+            return
+        if not self.store.exists():
+            self.store.create(region)
+        if self.source:
+            self.store.upload(self.source)
+
+    def attach_commands(self, mount_path: str) -> List[str]:
+        """Commands to run on every cluster host to make this storage
+        visible at ``mount_path``."""
+        if self.mode == StorageMode.MOUNT:
+            return [self.store.mount_command(mount_path)]
+        return [self.store.copy_down_command(mount_path)]
+
+    def delete(self) -> None:
+        if self.persistent or self._external:
+            return
+        self.store.delete()
+
+
+# ---------------------------------------------------------------------------
+# Backend hook: bucket-URL file mounts (COPY semantics, like the
+# reference's file_mounts with a bucket source).
+# ---------------------------------------------------------------------------
 
 def mount_or_copy(handle, dst: str, src: str) -> None:
-    raise exceptions.StorageError(
-        f"bucket file mounts ({src} -> {dst}) require the storage layer; "
-        f"not yet available in this build")
+    from skypilot_tpu import provision
+    from skypilot_tpu.data import cloud_stores
+    info = provision.get_cluster_info(handle.provider, handle.cluster_name,
+                                      handle.zone)
+    store = cloud_stores.get_storage_from_path(src)
+    scheme = src.split("://", 1)[0]
+    if scheme in ("http", "https"):
+        # An http(s) source is always a single file.
+        cmd = store.make_sync_file_command(src, dst)
+    else:
+        # Bucket *subpaths* with a dotted basename look like files;
+        # bucket roots (dotted or not) are directories. The rsync
+        # command degrades to an empty copy for a missing prefix.
+        _, sub = split_bucket_url(src)
+        is_file = ("." in sub.rsplit("/", 1)[-1]
+                   and not src.endswith("/")) if sub else False
+        cmd = (store.make_sync_file_command(src, dst) if is_file
+               else store.make_sync_dir_command(src, dst))
+    for runner in provision.get_command_runners(info):
+        rc, out, err = runner.run(cmd)
+        if rc != 0:
+            raise exceptions.StorageError(
+                f"materializing {src} -> {dst} failed on host "
+                f"{runner.host_id}: {out}{err}")

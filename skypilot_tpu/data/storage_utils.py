@@ -1,0 +1,66 @@
+"""Sync exclusion lists: .skyignore / .gitignore handling.
+
+Reference parity: sky/utils/... storage_utils (`.skyignore`/gitignore
+exclusion lists for workdir + file-mount uploads).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+SKY_IGNORE_FILE = ".skyignore"
+GIT_IGNORE_FILE = ".gitignore"
+
+
+def read_ignore_patterns(src_dir: str) -> List[str]:
+    """Patterns from .skyignore (preferred) else .gitignore, rsync-style.
+
+    Comments and blank lines dropped; leading ``/`` anchors are kept
+    (rsync interprets them relative to the transfer root, same as git).
+    """
+    for fname in (SKY_IGNORE_FILE, GIT_IGNORE_FILE):
+        path = os.path.join(src_dir, fname)
+        if os.path.isfile(path):
+            patterns = []
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    if line.startswith("!"):
+                        # Negation is unsupported: the preceding exclude
+                        # still applies, so the re-included file would be
+                        # skipped. Warn so the user knows to adjust.
+                        print(f"WARNING: {path}: negation pattern "
+                              f"{line!r} is not supported and will be "
+                              f"ignored; matching files stay excluded "
+                              f"by earlier patterns.", file=sys.stderr)
+                        continue
+                    patterns.append(line)
+            return patterns
+    return []
+
+
+def rsync_exclude_args(src_dir: str) -> List[str]:
+    """['--exclude', pat, ...] for every ignore pattern + VCS dirs."""
+    args = ["--exclude", ".git"]
+    for pat in read_ignore_patterns(src_dir):
+        args += ["--exclude", pat]
+    return args
+
+
+def gsutil_exclude_regex(src_dir: str) -> str:
+    """A single regex for ``gcloud storage rsync -x`` built from the
+    ignore patterns (glob -> regex, anchored like rsync's)."""
+    import re as _re
+    parts = [r"\.git(/.*)?$"]
+    for pat in read_ignore_patterns(src_dir):
+        anchored = pat.startswith("/")
+        pat = pat.strip("/")
+        rx = _re.escape(pat).replace(r"\*\*", ".*").replace(r"\*", "[^/]*")
+        rx = rx.replace(r"\?", "[^/]")
+        prefix = "^" if anchored else "(^|.*/)"
+        parts.append(f"{prefix}{rx}(/.*)?$")
+    return "|".join(parts)

@@ -77,6 +77,8 @@ def _launch_with_config(task, cluster_name, retry_until_up,
         backend.sync_workdir(handle, task.workdir)
     if task.file_mounts:
         backend.sync_file_mounts(handle, task.file_mounts)
+    if task.storage_mounts:
+        backend.sync_storage_mounts(handle, task.storage_mounts)
 
     if idle_minutes_to_autostop is not None:
         state.set_autostop(cluster_name, idle_minutes_to_autostop, down)

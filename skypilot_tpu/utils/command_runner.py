@@ -36,7 +36,10 @@ class CommandRunner:
         whole tree can be killed for gang-cancel)."""
         raise NotImplementedError
 
-    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+    def rsync(self, src: str, dst: str, up: bool = True,
+              excludes: Optional[List[str]] = None) -> None:
+        """Copy src -> dst. ``excludes``: rsync-style patterns to skip
+        (ignored by fallback copy paths)."""
         raise NotImplementedError
 
     def kill(self, pid: int) -> None:
@@ -108,16 +111,19 @@ class LocalRunner(CommandRunner):
             except OSError:
                 pass
 
-    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+    def rsync(self, src: str, dst: str, up: bool = True,
+              excludes: Optional[List[str]] = None) -> None:
         src = os.path.expanduser(src)
         dst = os.path.expanduser(dst)
         os.makedirs(dst if os.path.isdir(src) else os.path.dirname(dst),
                     exist_ok=True)
         # rsync if available, else cp (keeps the zero-dep property).
         # Both paths copy a directory's *contents* into dst (src/. form).
+        excl = " ".join(f"--exclude {shlex.quote(e)}"
+                        for e in (excludes or []))
         if os.path.isdir(src):
             copy = (f"command -v rsync >/dev/null && "
-                    f"rsync -a {shlex.quote(src.rstrip('/') + '/')} "
+                    f"rsync -a {excl} {shlex.quote(src.rstrip('/') + '/')} "
                     f"{shlex.quote(dst)} || "
                     f"cp -r {shlex.quote(os.path.join(src, '.'))} "
                     f"{shlex.quote(dst)}")
@@ -201,12 +207,14 @@ class SSHRunner(CommandRunner):
         self.run(f"kill -TERM -- -{pid} 2>/dev/null || "
                  f"kill -TERM {pid} 2>/dev/null || true")
 
-    def rsync(self, src: str, dst: str, up: bool = True) -> None:
+    def rsync(self, src: str, dst: str, up: bool = True,
+              excludes: Optional[List[str]] = None) -> None:
         ssh_cmd = " ".join(self._ssh_base()[:-1])
         remote = f"{self.user}@{self.ip}"
         pair = ([src, f"{remote}:{dst}"] if up else [f"{remote}:{src}", dst])
+        excl = [a for e in (excludes or []) for a in ("--exclude", e)]
         proc = subprocess.run(
-            ["rsync", "-az", "-e", ssh_cmd, "--mkpath", *pair],
+            ["rsync", "-az", *excl, "-e", ssh_cmd, "--mkpath", *pair],
             capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(f"rsync failed: {proc.stderr}")

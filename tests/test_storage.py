@@ -1,0 +1,145 @@
+"""Storage subsystem: stores, mounting commands, ignore lists — offline.
+
+Cloud CLI calls are captured by a fake runner; nothing talks to GCS.
+"""
+
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import (cloud_stores, mounting_utils, storage,
+                               storage_utils)
+
+
+class FakeRun:
+    """Records commands; scripted return codes."""
+
+    def __init__(self, rc=0, out="", fail_on=None):
+        self.cmds = []
+        self.rc = rc
+        self.out = out
+        self.fail_on = fail_on or ()
+
+    def __call__(self, cmd):
+        self.cmds.append(cmd)
+        if any(s in cmd for s in self.fail_on):
+            return 1, "boom"
+        return self.rc, self.out
+
+
+def test_split_bucket_url():
+    assert storage.split_bucket_url("gs://b/sub/p") == ("b", "sub/p")
+    assert storage.split_bucket_url("gs://b") == ("b", "")
+    with pytest.raises(ValueError):
+        storage.split_bucket_url("/local/path")
+
+
+def test_gcs_store_lifecycle_commands():
+    run = FakeRun()
+    st = storage.GcsStore("mybucket", run=run)
+    st.create(region="us-central2")
+    st.delete()
+    assert any("buckets create gs://mybucket" in c and "us-central2" in c
+               for c in run.cmds)
+    assert any("rm -r gs://mybucket" in c for c in run.cmds)
+
+
+def test_storage_sync_up_creates_and_uploads(tmp_path):
+    rec = FakeRun()
+
+    def scripted(cmd):
+        rec.cmds.append(cmd)
+        if "buckets describe" in cmd:
+            return 1, ""      # bucket does not exist yet
+        return 0, ""
+
+    st = storage.Storage(name="out-bkt", source=str(tmp_path),
+                         mode=storage.StorageMode.MOUNT, run=scripted)
+    st.sync_up(region="us-central2")
+    assert any("buckets create" in c for c in rec.cmds)
+    assert any("rsync" in c for c in rec.cmds)
+
+
+def test_external_bucket_not_created_or_deleted():
+    run = FakeRun()
+    st = storage.Storage(source="gs://public-data/imagenet",
+                         mode=storage.StorageMode.COPY, run=run)
+    st.sync_up()
+    st.delete()
+    assert run.cmds == []  # external: no lifecycle ops
+    cmds = st.attach_commands("/data")
+    # Subpath is honored: only the imagenet prefix is copied.
+    assert "gcloud storage rsync -r gs://public-data/imagenet /data" in cmds[0]
+
+
+def test_subpath_mount_uses_only_dir():
+    st = storage.Storage(source="gs://bkt/checkpoints/run1",
+                         mode=storage.StorageMode.MOUNT, run=FakeRun())
+    (cmd,) = st.attach_commands("/ckpt")
+    assert "--only-dir checkpoints/run1" in cmd
+    assert " bkt " in cmd
+
+
+def test_ephemeral_delete():
+    run = FakeRun()
+    st = storage.Storage(name="scratch", persistent=False, run=run)
+    st.delete()
+    assert any("rm -r gs://scratch" in c for c in run.cmds)
+    # Persistent and external storages never delete.
+    run2 = FakeRun()
+    storage.Storage(name="keep", persistent=True, run=run2).delete()
+    storage.Storage(source="gs://ext/b", persistent=False,
+                    run=run2).delete()
+    assert run2.cmds == []
+
+
+def test_mount_mode_uses_gcsfuse():
+    st = storage.Storage(name="ckpts", run=FakeRun())
+    (cmd,) = st.attach_commands("/outputs")
+    assert "gcsfuse" in cmd
+    assert "/outputs" in cmd
+
+
+def test_storage_yaml_roundtrip():
+    cfg = {"name": "bkt", "mode": "COPY", "persistent": False}
+    st = storage.Storage.from_yaml_config(cfg, run=FakeRun())
+    assert st.mode == storage.StorageMode.COPY
+    assert not st.persistent
+    out = st.to_yaml_config()
+    assert out["mode"] == "COPY" and out["name"] == "bkt"
+    with pytest.raises(exceptions.StorageError):
+        storage.Storage.from_yaml_config({"name": "x", "bogus": 1})
+
+
+def test_mount_command_quoting():
+    cmd = mounting_utils.get_mount_cmd("gs://bkt/sub", "/mnt/path")
+    assert "gcsfuse" in cmd and " bkt " in cmd and "/mnt/path" in cmd
+    assert "sub" not in cmd.split("gcsfuse")[1]  # bucket only, no subpath
+
+
+def test_skyignore_patterns(tmp_path):
+    (tmp_path / ".skyignore").write_text(
+        "# comment\n\n*.ckpt\n/secrets\n!keep.ckpt\n")
+    pats = storage_utils.read_ignore_patterns(str(tmp_path))
+    assert pats == ["*.ckpt", "/secrets"]  # comments/blank/negation dropped
+    args = storage_utils.rsync_exclude_args(str(tmp_path))
+    assert args[:2] == ["--exclude", ".git"]
+    assert "*.ckpt" in args
+
+
+def test_gitignore_fallback(tmp_path):
+    (tmp_path / ".gitignore").write_text("node_modules\n")
+    assert storage_utils.read_ignore_patterns(str(tmp_path)) == [
+        "node_modules"]
+
+
+def test_cloud_stores_registry():
+    gs = cloud_stores.get_storage_from_path("gs://b/x")
+    assert "gcloud storage rsync" in gs.make_sync_dir_command("gs://b/x",
+                                                              "/d")
+    http = cloud_stores.get_storage_from_path("https://host/f.bin")
+    assert "curl" in http.make_sync_file_command("https://host/f.bin",
+                                                 "/tmp/f.bin")
+    with pytest.raises(ValueError):
+        cloud_stores.get_storage_from_path("ftp://x/y")
