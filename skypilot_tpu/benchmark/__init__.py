@@ -1,0 +1,1 @@
+"""`bench`: launch a task on N candidate resources, compare cost/time."""

@@ -1,0 +1,109 @@
+"""Benchmark state: sqlite records of benchmark runs and their results.
+
+Reference parity: sky/benchmark/benchmark_state.py (sqlite-backed
+benchmark + benchmark_results tables powering `sky bench ls/show`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+
+def _db():
+    """Context manager: connection that commits AND closes on exit."""
+    path = os.path.join(paths.home(), "benchmark.db")
+    conn = sqlite3.connect(path, timeout=30)
+    conn.execute("""CREATE TABLE IF NOT EXISTS benchmarks (
+        name TEXT PRIMARY KEY,
+        task_yaml TEXT,
+        launched_at INTEGER,
+        status TEXT DEFAULT 'RUNNING')""")
+    conn.execute("""CREATE TABLE IF NOT EXISTS benchmark_results (
+        benchmark TEXT,
+        cluster TEXT,
+        resources TEXT,
+        price_per_hour REAL,
+        duration_s REAL,
+        metrics TEXT,
+        status TEXT DEFAULT 'RUNNING',
+        PRIMARY KEY (benchmark, cluster))""")
+    conn.commit()
+
+    @contextlib.contextmanager
+    def _ctx():
+        try:
+            with conn:     # transaction: commit/rollback
+                yield conn
+        finally:
+            conn.close()
+
+    return _ctx()
+
+
+def add_benchmark(name: str, task_yaml: str) -> None:
+    with _db() as c:
+        c.execute(
+            "INSERT OR REPLACE INTO benchmarks (name, task_yaml,"
+            " launched_at, status) VALUES (?,?,?,'RUNNING')",
+            (name, task_yaml, int(time.time())))
+
+
+def add_result(benchmark: str, cluster: str, resources: str,
+               price_per_hour: float) -> None:
+    with _db() as c:
+        c.execute(
+            "INSERT OR REPLACE INTO benchmark_results (benchmark, cluster,"
+            " resources, price_per_hour, duration_s, metrics, status)"
+            " VALUES (?,?,?,?,0,'{}','RUNNING')",
+            (benchmark, cluster, resources, price_per_hour))
+
+
+def finish_result(benchmark: str, cluster: str, duration_s: float,
+                  metrics: Optional[Dict[str, Any]] = None,
+                  status: str = "FINISHED") -> None:
+    with _db() as c:
+        c.execute(
+            "UPDATE benchmark_results SET duration_s=?, metrics=?, status=?"
+            " WHERE benchmark=? AND cluster=?",
+            (duration_s, json.dumps(metrics or {}), status, benchmark,
+             cluster))
+
+
+def set_benchmark_status(name: str, status: str) -> None:
+    with _db() as c:
+        c.execute("UPDATE benchmarks SET status=? WHERE name=?",
+                  (status, name))
+
+
+def list_benchmarks() -> List[Dict[str, Any]]:
+    with _db() as c:
+        rows = c.execute("SELECT name, task_yaml, launched_at, status"
+                         " FROM benchmarks").fetchall()
+    return [{"name": n, "task_yaml": t, "launched_at": la, "status": s}
+            for n, t, la, s in rows]
+
+
+def get_results(benchmark: str) -> List[Dict[str, Any]]:
+    with _db() as c:
+        rows = c.execute(
+            "SELECT cluster, resources, price_per_hour, duration_s,"
+            " metrics, status FROM benchmark_results WHERE benchmark=?",
+            (benchmark,)).fetchall()
+    return [{"cluster": cl, "resources": r, "price_per_hour": p,
+             "duration_s": d, "metrics": json.loads(m or "{}"),
+             "status": s}
+            for cl, r, p, d, m, s in rows]
+
+
+def delete_benchmark(name: str) -> None:
+    with _db() as c:
+        c.execute("DELETE FROM benchmarks WHERE name=?", (name,))
+        c.execute("DELETE FROM benchmark_results WHERE benchmark=?",
+                  (name,))

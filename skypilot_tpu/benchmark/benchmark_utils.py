@@ -1,0 +1,108 @@
+"""Benchmark orchestration: launch one task on N resource candidates in
+parallel, wait, record cost/time, summarize.
+
+Reference parity: sky/benchmark/benchmark_utils.py (launch N resource
+variants in parallel clusters named sky-bench-..., collect + summarize
+for `sky bench show`; SURVEY.md §2.10).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import copy
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import execution, optimizer
+from skypilot_tpu.benchmark import benchmark_state
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+CLUSTER_PREFIX = "tpu-bench-"
+
+
+def _candidate_tasks(task: Task,
+                     candidates: List[Dict[str, Any]]) -> List[Task]:
+    out = []
+    for cand in candidates:
+        t = copy.deepcopy(task)
+        base = t.resources[0].to_yaml_config() if t.resources else {}
+        base.update(cand)
+        t.set_resources(Resources.from_yaml_config(base))
+        out.append(t)
+    return out
+
+
+def launch_benchmark(benchmark: str, task: Task,
+                     candidates: List[Dict[str, Any]],
+                     wait: bool = True,
+                     teardown: bool = True) -> List[Dict[str, Any]]:
+    """Run ``task`` once per candidate resource dict; record results.
+
+    Each candidate launches a cluster ``tpu-bench-<benchmark>-<i>``;
+    cost/time come from the optimizer price and the measured job wall
+    time. Returns the result rows (status RUNNING when wait=False).
+    """
+    benchmark_state.add_benchmark(benchmark,
+                                  str(task.to_yaml_config()))
+    tasks = _candidate_tasks(task, candidates)
+
+    def _one(i: int, t: Task) -> Dict[str, Any]:
+        cluster = f"{CLUSTER_PREFIX}{benchmark}-{i}"
+        start = time.time()
+        status = "FINISHED"
+        price = 0.0
+        error = None
+        try:
+            launchable = optimizer.optimize_task(t)
+            price = (launchable.price or 0.0) * max(t.num_nodes, 1)
+            benchmark_state.add_result(benchmark, cluster, str(launchable),
+                                       price)
+            job_id, handle = execution.launch(t, cluster_name=cluster,
+                                              detach_run=True)
+            if not wait:
+                # Leave RUNNING (and the cluster up): duration/teardown
+                # are meaningless until the job actually finishes.
+                return {"cluster": cluster, "duration_s": 0.0,
+                        "price_per_hour": price, "status": "RUNNING"}
+            if job_id is not None:
+                from skypilot_tpu.backend import TpuVmBackend
+                from skypilot_tpu.runtime.job_queue import JobStatus
+                final = TpuVmBackend().wait_job(handle, job_id,
+                                                timeout=float("inf"))
+                if final is not JobStatus.SUCCEEDED:
+                    status = "FAILED"
+                    error = f"job ended {final.value}"
+        except Exception as e:  # noqa: BLE001 — other candidates continue
+            status = "FAILED"
+            error = f"{type(e).__name__}: {e}"
+            benchmark_state.add_result(benchmark, cluster, "-", price)
+        duration = time.time() - start
+        metrics = {"error": error} if error else {}
+        benchmark_state.finish_result(benchmark, cluster, duration,
+                                      metrics=metrics, status=status)
+        if teardown:
+            try:
+                from skypilot_tpu import core
+                core.down(cluster)
+            except Exception:  # noqa: BLE001
+                pass
+        return {"cluster": cluster, "duration_s": duration,
+                "price_per_hour": price, "status": status,
+                "error": error}
+
+    with cf.ThreadPoolExecutor(max_workers=max(len(tasks), 1)) as pool:
+        results = list(pool.map(lambda it: _one(*it), enumerate(tasks)))
+    any_running = any(r["status"] == "RUNNING" for r in results)
+    benchmark_state.set_benchmark_status(
+        benchmark, "RUNNING" if any_running else "FINISHED")
+    return results
+
+
+def summarize(benchmark: str) -> List[Dict[str, Any]]:
+    """Result rows + derived $ cost, cheapest-first."""
+    rows = benchmark_state.get_results(benchmark)
+    for r in rows:
+        r["cost"] = round(r["price_per_hour"] * r["duration_s"] / 3600.0, 6)
+    return sorted(rows, key=lambda r: (r["status"] != "FINISHED",
+                                       r["cost"]))

@@ -400,6 +400,74 @@ def cost_report():
                               f"{r['cost']:.2f}"))
 
 
+@cli.group()
+def bench():
+    """Benchmark a task across candidate resources (cost/time)."""
+
+
+@bench.command(name="launch")
+@click.argument("yaml_path")
+@click.option("--benchmark", "-b", required=True, help="Benchmark name.")
+@click.option("-g", "--gpus", "--accelerators", "accelerators",
+              multiple=True,
+              help="Candidate accelerators; repeat for variants "
+                   "(e.g. -g tpu-v5e-8 -g tpu-v6e-8).")
+@click.option("--no-wait", is_flag=True, default=False)
+@click.option("--keep-clusters", is_flag=True, default=False)
+def bench_launch(yaml_path, benchmark, accelerators, no_wait,
+                 keep_clusters):
+    """Launch the task once per candidate resource set."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    task = _load_task(yaml_path, None, None, None, None, False, None)
+    candidates = ([{"accelerators": a} for a in accelerators]
+                  or [{}])
+    results = benchmark_utils.launch_benchmark(
+        benchmark, task, candidates, wait=not no_wait,
+        teardown=not keep_clusters)
+    for r in results:
+        extra = f" — {r['error']}" if r.get("error") else ""
+        click.echo(f"{r['cluster']}: {r['status']} "
+                   f"({r['duration_s']:.0f}s @ ${r['price_per_hour']}/hr)"
+                   f"{extra}")
+
+
+@bench.command(name="ls")
+def bench_ls():
+    """List benchmarks."""
+    from skypilot_tpu.benchmark import benchmark_state
+    fmt = "{:<24}{:<12}"
+    click.echo(fmt.format("BENCHMARK", "STATUS"))
+    for b in benchmark_state.list_benchmarks():
+        click.echo(fmt.format(b["name"], b["status"]))
+
+
+@bench.command(name="show")
+@click.argument("benchmark")
+def bench_show(benchmark):
+    """Per-candidate cost/time comparison, cheapest first."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    rows = benchmark_utils.summarize(benchmark)
+    if not rows:
+        click.echo(f"No results for benchmark {benchmark!r}.")
+        return
+    fmt = "{:<34}{:<34}{:>10}{:>12}{:>10}"
+    click.echo(fmt.format("CLUSTER", "RESOURCES", "DUR(S)", "COST($)",
+                          "STATUS"))
+    for r in rows:
+        click.echo(fmt.format(r["cluster"], r["resources"][:32],
+                              f"{r['duration_s']:.0f}",
+                              f"{r['cost']:.4f}", r["status"]))
+
+
+@bench.command(name="delete")
+@click.argument("benchmark")
+def bench_delete(benchmark):
+    """Delete a benchmark's records."""
+    from skypilot_tpu.benchmark import benchmark_state
+    benchmark_state.delete_benchmark(benchmark)
+    click.echo(f"Deleted benchmark {benchmark!r}.")
+
+
 def main():
     try:
         cli()

@@ -16,6 +16,8 @@ from typing import Optional, Tuple
 from skypilot_tpu import exceptions, state
 from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
 from skypilot_tpu.task import Task
+from skypilot_tpu.usage import usage_lib
+from skypilot_tpu.utils import timeline
 
 
 class Stage(enum.Enum):
@@ -32,6 +34,8 @@ def _generate_cluster_name() -> str:
     return f"sky-{uuid.uuid4().hex[:6]}"
 
 
+@timeline.event
+@usage_lib.entrypoint
 def launch(task: Task,
            cluster_name: Optional[str] = None,
            retry_until_up: bool = False,
@@ -96,6 +100,8 @@ def _launch_with_config(task, cluster_name, retry_until_up,
     return job_id, handle
 
 
+@timeline.event
+@usage_lib.entrypoint
 def exec(task: Task,  # noqa: A001 — mirrors the public API name
          cluster_name: str,
          detach_run: bool = True) -> Tuple[int, ClusterHandle]:

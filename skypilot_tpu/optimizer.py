@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils import timeline
 from skypilot_tpu.task import Task
 
 # (cloud, region|None, zone|None) triples; None = block whole scope.
@@ -60,6 +61,7 @@ def _egress_cost(a: Resources, b: Resources, gigabytes: float = 0.0) -> float:
     return gigabytes * _EGRESS_PER_GB
 
 
+@timeline.event
 def optimize(dag: dag_lib.Dag,
              minimize: OptimizeTarget = OptimizeTarget.COST,
              blocked_resources: Optional[BlockedSet] = None,

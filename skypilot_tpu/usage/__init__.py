@@ -1,0 +1,1 @@
+"""Usage telemetry (schema'd, opt-out, local-sink by default)."""

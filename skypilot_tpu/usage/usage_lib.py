@@ -1,0 +1,121 @@
+"""Usage telemetry: schema'd per-run messages with an opt-out.
+
+Default sink is a local JSONL file (``~/.skypilot_tpu/usage/``). An
+HTTP endpoint can be configured (``SKYTPU_USAGE_ENDPOINT``); with no
+endpoint nothing leaves the machine — the schema/collection machinery
+is what the framework standardizes, not any phone-home default.
+
+Opt-out: ``SKYTPU_DISABLE_USAGE_COLLECTION=1``.
+
+Reference parity: sky/usage/usage_lib.py (MessageToReport:49 schema'd
+heartbeat + per-run usage to a Loki endpoint :341,
+SKYPILOT_DISABLE_USAGE_COLLECTION; SURVEY.md §5 Telemetry).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.utils import paths
+
+DISABLE_ENV = "SKYTPU_DISABLE_USAGE_COLLECTION"
+ENDPOINT_ENV = "SKYTPU_USAGE_ENDPOINT"
+
+_run_id: Optional[str] = None
+
+
+def disabled() -> bool:
+    return os.environ.get(DISABLE_ENV, "0") == "1"
+
+
+def run_id() -> str:
+    global _run_id
+    if _run_id is None:
+        _run_id = uuid.uuid4().hex[:12]
+    return _run_id
+
+
+class MessageToReport:
+    """One schema'd usage record, filled over the life of an entrypoint."""
+
+    SCHEMA_VERSION = 1
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.start_s = time.time()
+        self.fields: Dict[str, Any] = {}
+        self.exception: Optional[str] = None
+
+    def set(self, key: str, value: Any) -> None:
+        self.fields[key] = value
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "schema_version": self.SCHEMA_VERSION,
+            "kind": self.kind,
+            "run_id": run_id(),
+            "start_s": round(self.start_s, 3),
+            "duration_s": round(time.time() - self.start_s, 3),
+            "exception": self.exception,
+            **self.fields,
+        }
+
+
+def _sink(record: Dict[str, Any]) -> None:
+    endpoint = os.environ.get(ENDPOINT_ENV)
+    if endpoint:
+        try:
+            import urllib.request
+            req = urllib.request.Request(
+                endpoint, data=json.dumps(record).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=2)
+            return
+        except Exception:  # noqa: BLE001 — telemetry must never break ops
+            pass
+    usage_dir = os.path.join(paths.home(), "usage")
+    os.makedirs(usage_dir, exist_ok=True)
+    with open(os.path.join(usage_dir, "usage.jsonl"), "a",
+              encoding="utf-8") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def report(message: MessageToReport) -> None:
+    if disabled():
+        return
+    try:
+        _sink(message.to_dict())
+    except Exception:  # noqa: BLE001
+        pass
+
+
+@contextlib.contextmanager
+def entrypoint_context(kind: str, **fields: Any):
+    """Collect timing + outcome for one API entrypoint."""
+    msg = MessageToReport(kind)
+    for k, v in fields.items():
+        msg.set(k, v)
+    try:
+        yield msg
+    except BaseException as e:
+        msg.exception = type(e).__name__
+        raise
+    finally:
+        report(msg)
+
+
+def entrypoint(fn):
+    """Decorator form of ``entrypoint_context``."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with entrypoint_context(f"{fn.__module__}.{fn.__qualname__}"):
+            return fn(*args, **kwargs)
+
+    return wrapper

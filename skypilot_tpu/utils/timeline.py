@@ -1,0 +1,132 @@
+"""Chrome trace-event tracing: ``@timeline.event`` + FileLockEvent.
+
+Events are buffered in-process and flushed as Chrome trace-format JSON
+(chrome://tracing / Perfetto loadable) to the path in
+``SKYTPU_TIMELINE_FILE_PATH`` at process exit. Zero overhead when the
+env var is unset.
+
+Reference parity: sky/utils/timeline.py (Event/FileLockEvent, @event
+decorator, SKYPILOT_TIMELINE_FILE_PATH; SURVEY.md §5 Tracing).
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_VAR = "SKYTPU_TIMELINE_FILE_PATH"
+
+_events: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_registered = False
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(ENV_VAR))
+
+
+def _save() -> None:
+    path = os.environ.get(ENV_VAR)
+    if not path or not _events:
+        return
+    with _lock:
+        payload = {"traceEvents": list(_events),
+                   "displayTimeUnit": "ms"}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+
+
+def _ensure_atexit() -> None:
+    global _registered
+    if not _registered:
+        atexit.register(_save)
+        _registered = True
+
+
+class Event:
+    """Context manager emitting a complete ('X') trace event."""
+
+    def __init__(self, name: str, message: Optional[str] = None):
+        self._name = name
+        self._message = message
+        self._begin_us = 0.0
+
+    def begin(self) -> None:
+        self._begin_us = time.time() * 1e6
+
+    def end(self) -> None:
+        if not enabled():
+            return
+        _ensure_atexit()
+        evt = {
+            "name": self._name,
+            "ph": "X",
+            "ts": self._begin_us,
+            "dur": time.time() * 1e6 - self._begin_us,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 100_000,
+        }
+        if self._message:
+            evt["args"] = {"message": self._message}
+        with _lock:
+            _events.append(evt)
+
+    def __enter__(self) -> "Event":
+        self.begin()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+
+
+def event(fn: Optional[Callable] = None, name: Optional[str] = None):
+    """Decorator tracing every call of ``fn`` (no-op when disabled)."""
+    if fn is None:
+        return functools.partial(event, name=name)
+
+    evt_name = name or f"{fn.__module__}.{fn.__qualname__}"
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not enabled():
+            return fn(*args, **kwargs)
+        with Event(evt_name):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+class FileLockEvent:
+    """A filelock wrapped so acquisition waits show up on the trace."""
+
+    def __init__(self, lockfile: str, timeout: float = -1):
+        import filelock
+        self._lockfile = lockfile
+        os.makedirs(os.path.dirname(os.path.abspath(lockfile)),
+                    exist_ok=True)
+        self._lock = filelock.FileLock(lockfile, timeout=timeout)
+
+    def acquire(self):
+        with Event(f"filelock.acquire:{self._lockfile}"):
+            return self._lock.acquire()
+
+    def release(self):
+        return self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def save_now() -> None:
+    """Flush buffered events immediately (tests / long daemons)."""
+    _save()

@@ -1,0 +1,129 @@
+"""Timeline tracing, usage telemetry, callbacks, benchmark subsystem."""
+
+import json
+import os
+import time
+
+import pytest
+from click.testing import CliRunner
+
+import skypilot_tpu.callbacks as sky_callback
+from skypilot_tpu.usage import usage_lib
+from skypilot_tpu.utils import timeline
+
+
+def test_timeline_disabled_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv(timeline.ENV_VAR, raising=False)
+
+    @timeline.event
+    def f():
+        return 42
+
+    assert f() == 42
+    assert not timeline._events
+
+
+def test_timeline_records_and_saves(tmp_path, monkeypatch):
+    out = tmp_path / "trace.json"
+    monkeypatch.setenv(timeline.ENV_VAR, str(out))
+    timeline._events.clear()
+
+    @timeline.event(name="my-op")
+    def f():
+        time.sleep(0.01)
+        return 1
+
+    f()
+    with timeline.Event("manual", message="hello"):
+        pass
+    timeline.save_now()
+    data = json.loads(out.read_text())
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "my-op" in names and "manual" in names
+    evt = next(e for e in data["traceEvents"] if e["name"] == "my-op")
+    assert evt["ph"] == "X" and evt["dur"] >= 10_000  # >= 10ms in us
+
+
+def test_filelock_event(tmp_path, monkeypatch):
+    monkeypatch.setenv(timeline.ENV_VAR, str(tmp_path / "t.json"))
+    with timeline.FileLockEvent(str(tmp_path / "x.lock")):
+        pass
+    assert any("filelock.acquire" in e["name"] for e in timeline._events)
+
+
+def test_usage_sink_local(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    monkeypatch.delenv(usage_lib.DISABLE_ENV, raising=False)
+    monkeypatch.delenv(usage_lib.ENDPOINT_ENV, raising=False)
+    with usage_lib.entrypoint_context("launch", cloud="gcp") as msg:
+        msg.set("num_nodes", 4)
+    rec = json.loads((tmp_path / "usage" / "usage.jsonl")
+                     .read_text().strip().splitlines()[-1])
+    assert rec["kind"] == "launch"
+    assert rec["num_nodes"] == 4 and rec["cloud"] == "gcp"
+    assert rec["exception"] is None and rec["schema_version"] == 1
+
+
+def test_usage_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    monkeypatch.setenv(usage_lib.DISABLE_ENV, "1")
+    with usage_lib.entrypoint_context("launch"):
+        pass
+    assert not (tmp_path / "usage").exists()
+
+
+def test_usage_records_exception(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    monkeypatch.delenv(usage_lib.DISABLE_ENV, raising=False)
+    with pytest.raises(ValueError):
+        with usage_lib.entrypoint_context("down"):
+            raise ValueError("x")
+    rec = json.loads((tmp_path / "usage" / "usage.jsonl")
+                     .read_text().strip().splitlines()[-1])
+    assert rec["exception"] == "ValueError"
+
+
+def test_callbacks_summary(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYTPU_CALLBACK_LOG_DIR", str(tmp_path))
+    sky_callback.init(total_steps=10, warmup_steps=1)
+    for _ in range(3):
+        with sky_callback.step():
+            time.sleep(0.005)
+    s = sky_callback.summary()
+    assert s["steps"] == 3
+    assert s["avg_step_s"] >= 0.004     # warmup step excluded
+    assert s["eta_s"] is not None
+    sky_callback.write_summary()
+    on_disk = json.loads((tmp_path / sky_callback.SUMMARY_FILE).read_text())
+    assert on_disk["steps"] == 3
+
+
+def test_benchmark_state_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    from skypilot_tpu.benchmark import benchmark_state as bs
+    bs.add_benchmark("b1", "{}")
+    bs.add_result("b1", "c0", "local:tpu-v5e-8", 1.2)
+    bs.finish_result("b1", "c0", 600.0, metrics={"steps": 5})
+    bs.set_benchmark_status("b1", "FINISHED")
+    assert bs.list_benchmarks()[0]["status"] == "FINISHED"
+    (row,) = bs.get_results("b1")
+    assert row["duration_s"] == 600.0 and row["metrics"]["steps"] == 5
+    bs.delete_benchmark("b1")
+    assert bs.get_results("b1") == []
+
+
+def test_benchmark_launch_local(tmp_path, monkeypatch):
+    """End-to-end bench over the local fake cloud, two candidates."""
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    from skypilot_tpu.benchmark import benchmark_utils
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    task = Task(run="echo bench-ok", name="b")
+    task.set_resources(Resources.from_yaml_config(
+        {"cloud": "local", "accelerators": "tpu-v5e-8"}))
+    results = benchmark_utils.launch_benchmark(
+        "bench-e2e", task, [{}, {"accelerators": "tpu-v5e-8"}])
+    assert all(r["status"] == "FINISHED" for r in results)
+    rows = benchmark_utils.summarize("bench-e2e")
+    assert len(rows) == 2
+    assert all(r["cost"] >= 0 for r in rows)
