@@ -84,5 +84,13 @@ class ManagedJobError(SkyTpuError):
     pass
 
 
+class ProvisionError(ResourcesUnavailableError):
+    """Provider-level instance CRUD failure (drives failover)."""
+
+
+class NotSupportedError(SkyTpuError):
+    """The provider cannot perform the requested operation."""
+
+
 class InvalidTaskError(SkyTpuError):
     pass

@@ -1,0 +1,286 @@
+"""Kubernetes (GKE-first) provisioning: TPU podslice pods via kubectl.
+
+Implements the uniform provider function set (provision/__init__.py) on
+top of ``kubectl`` — no python k8s SDK dependency. One pod per host;
+TPU hosts get GKE podslice nodeSelectors
+(``cloud.google.com/gke-tpu-accelerator`` + ``gke-tpu-topology``) and a
+``google.com/tpu`` chip request, which is how GKE gang-places the pods
+of one slice (all-or-nothing by node-pool shape).
+
+``kubectl`` binary is overridable via ``SKYTPU_KUBECTL`` (tests inject
+a recording fake, mirroring the reference's offline strategy).
+
+Reference parity: sky/provision/kubernetes/ (pod-based provisioning,
+TPU-on-GKE labels; SURVEY.md §2.3) and the GKE TPU podslice smoke test
+(reference: tests/smoke_tests/test_cluster_job.py:593-601).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+import time
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
+                                           ProvisionConfig, ProvisionRecord)
+from skypilot_tpu.utils.command_runner import CommandRunner
+
+LABEL = "skypilot-tpu/cluster"
+NODE_LABEL = "skypilot-tpu/node"
+WORKER_LABEL = "skypilot-tpu/worker"
+DEFAULT_IMAGE = "python:3.11-slim"
+
+# TPU accelerator ("tpu-v5e-16") -> GKE podslice accelerator label.
+_GKE_TPU_ACCEL = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+# (version, total chips) -> topology label. 8 chips/host for v5e/v6e
+# single-host; pods of multi-host slices each see 4 chips (2x2 per host).
+_TOPOLOGY = {
+    ("v5e", 1): "1x1", ("v5e", 4): "2x2", ("v5e", 8): "2x4",
+    ("v5e", 16): "4x4", ("v5e", 32): "4x8", ("v5e", 64): "8x8",
+    ("v5e", 128): "8x16", ("v5e", 256): "16x16",
+    ("v6e", 1): "1x1", ("v6e", 4): "2x2", ("v6e", 8): "2x4",
+    ("v6e", 16): "4x4", ("v6e", 32): "4x8", ("v6e", 64): "8x8",
+    ("v6e", 128): "8x16", ("v6e", 256): "16x16",
+    ("v5p", 8): "2x2x1", ("v5p", 16): "2x2x2", ("v5p", 32): "2x2x4",
+    ("v4", 8): "2x2x1", ("v4", 16): "2x2x2", ("v4", 32): "2x2x4",
+}
+
+
+def _kubectl() -> str:
+    return os.environ.get("SKYTPU_KUBECTL", "kubectl")
+
+
+def _run(args: List[str], stdin: Optional[str] = None) -> Tuple[int, str]:
+    proc = subprocess.run([_kubectl(), *args], input=stdin,
+                          capture_output=True, text=True)
+    return proc.returncode, (proc.stdout or "") + (proc.stderr or "")
+
+
+def parse_tpu_accelerator(accelerator: str) -> Tuple[str, int]:
+    """'tpu-v5e-16' -> ('v5e', 16)."""
+    parts = accelerator.split("-")
+    if len(parts) != 3 or parts[0] != "tpu":
+        raise exceptions.ProvisionError(
+            f"unrecognized TPU accelerator {accelerator!r}")
+    return parts[1], int(parts[2])
+
+
+def pod_manifest(config: ProvisionConfig, node_id: int,
+                 worker_id: int) -> Dict:
+    """One host's pod spec (dict -> YAML/JSON for kubectl apply)."""
+    name = f"{config.cluster_name}-{node_id}-{worker_id}"
+    spec: Dict = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "labels": {
+                LABEL: config.cluster_name,
+                NODE_LABEL: str(node_id),
+                WORKER_LABEL: str(worker_id),
+                **config.labels,
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [{
+                "name": "task",
+                "image": config.image_id or DEFAULT_IMAGE,
+                "command": ["/bin/sh", "-c",
+                            "sleep infinity"],
+                "resources": {"requests": {}, "limits": {}},
+            }],
+        },
+    }
+    if config.accelerator and config.accelerator.startswith("tpu-"):
+        version, chips = parse_tpu_accelerator(config.accelerator)
+        accel_label = _GKE_TPU_ACCEL.get(version)
+        if accel_label is None:
+            raise exceptions.ProvisionError(
+                f"no GKE podslice mapping for TPU version {version!r}")
+        topology = _TOPOLOGY.get((version, chips))
+        if topology is None:
+            raise exceptions.ProvisionError(
+                f"no GKE topology mapping for {config.accelerator!r}")
+        chips_per_host = max(chips // max(config.hosts_per_node, 1), 1)
+        spec["spec"]["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": accel_label,
+            "cloud.google.com/gke-tpu-topology": topology,
+        }
+        res = spec["spec"]["containers"][0]["resources"]
+        res["requests"]["google.com/tpu"] = str(chips_per_host)
+        res["limits"]["google.com/tpu"] = str(chips_per_host)
+        if config.use_spot:
+            spec["spec"]["tolerations"] = [{
+                "key": "cloud.google.com/gke-spot",
+                "operator": "Equal", "value": "true",
+                "effect": "NoSchedule"}]
+            spec["spec"]["nodeSelector"][
+                "cloud.google.com/gke-spot"] = "true"
+    return spec
+
+
+# ---------------------------------------------------------------------------
+# Provider function set
+# ---------------------------------------------------------------------------
+
+def run_instances(config: ProvisionConfig) -> ProvisionRecord:
+    created = []
+    for node_id in range(config.num_nodes):
+        for worker_id in range(config.hosts_per_node):
+            manifest = pod_manifest(config, node_id, worker_id)
+            rc, out = _run(["apply", "-f", "-"],
+                           stdin=json.dumps(manifest))
+            if rc != 0:
+                raise exceptions.ProvisionError(
+                    f"kubectl apply failed for "
+                    f"{manifest['metadata']['name']}: {out.strip()}")
+            created.append(manifest["metadata"]["name"])
+    return ProvisionRecord(provider="kubernetes",
+                           cluster_name=config.cluster_name,
+                           zone=config.zone,
+                           created_instance_ids=created)
+
+
+def stop_instances(cluster_name: str, zone: str) -> None:
+    raise exceptions.NotSupportedError(
+        "kubernetes pods cannot be stopped; use down (terminate) instead")
+
+
+def terminate_instances(cluster_name: str, zone: str) -> None:
+    rc, out = _run(["delete", "pods", "-l", f"{LABEL}={cluster_name}",
+                    "--ignore-not-found", "--wait=false"])
+    if rc != 0:
+        raise exceptions.ProvisionError(
+            f"kubectl delete failed: {out.strip()}")
+
+
+def _get_pods(cluster_name: str) -> List[Dict]:
+    rc, out = _run(["get", "pods", "-l", f"{LABEL}={cluster_name}",
+                    "-o", "json"])
+    if rc != 0:
+        raise exceptions.ProvisionError(
+            f"kubectl get pods failed: {out.strip()}")
+    # kubectl may append warnings after the JSON on stderr; find the
+    # JSON object in the combined stream.
+    start = out.find("{")
+    return json.loads(out[start:])["items"] if start >= 0 else []
+
+
+def query_instances(cluster_name: str, zone: str) -> str:
+    pods = _get_pods(cluster_name)
+    if not pods:
+        return "NOT_FOUND"
+    phases = [p.get("status", {}).get("phase", "Unknown") for p in pods]
+    if all(ph == "Running" for ph in phases):
+        return "UP"
+    return "PARTIAL"
+
+
+def wait_instances(cluster_name: str, zone: str,
+                   timeout: float = 600) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if query_instances(cluster_name, zone) == "UP":
+            return
+        time.sleep(2)
+    raise exceptions.ProvisionError(
+        f"pods of {cluster_name} not Running within {timeout}s")
+
+
+def get_cluster_info(cluster_name: str, zone: str) -> ClusterInfo:
+    pods = _get_pods(cluster_name)
+    hosts = []
+    for pod in sorted(pods, key=lambda p: (
+            int(p["metadata"]["labels"].get(NODE_LABEL, 0)),
+            int(p["metadata"]["labels"].get(WORKER_LABEL, 0)))):
+        labels = pod["metadata"]["labels"]
+        hosts.append(HostInfo(
+            host_id=len(hosts),
+            node_id=int(labels.get(NODE_LABEL, 0)),
+            worker_id=int(labels.get(WORKER_LABEL, 0)),
+            internal_ip=pod.get("status", {}).get("podIP", ""),
+            external_ip=None,
+            workspace=None,
+        ))
+    info = ClusterInfo(cluster_name=cluster_name, provider="kubernetes",
+                       zone=zone, hosts=hosts)
+    info.metadata["pod_names"] = [p["metadata"]["name"] for p in pods]
+    return info
+
+
+def get_command_runners(info: ClusterInfo) -> List[CommandRunner]:
+    names = info.metadata.get("pod_names") or [
+        f"{info.cluster_name}-{h.node_id}-{h.worker_id}"
+        for h in info.hosts]
+    return [KubernetesRunner(pod, host_id=h.host_id, ip=h.internal_ip)
+            for pod, h in zip(sorted(names), info.hosts)]
+
+
+class KubernetesRunner(CommandRunner):
+    """kubectl exec / cp against one pod."""
+
+    def __init__(self, pod_name: str, host_id: int = 0, ip: str = ""):
+        super().__init__(host_id, ip)
+        self.pod_name = pod_name
+
+    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None):
+        env_prefix = "".join(
+            f"export {k}={shlex.quote(str(v))}; "
+            for k, v in (env or {}).items())
+        cd = f"cd {shlex.quote(cwd)}; " if cwd else ""
+        full = f"{env_prefix}{cd}{cmd}"
+        proc = subprocess.run(
+            [_kubectl(), "exec", self.pod_name, "--", "/bin/sh", "-c",
+             full],
+            capture_output=True, text=True, timeout=timeout)
+        if log_path:
+            os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            with open(log_path, "ab") as f:
+                f.write((proc.stdout or "").encode())
+                f.write((proc.stderr or "").encode())
+            return proc.returncode, "", ""
+        return proc.returncode, proc.stdout, proc.stderr
+
+    def run_detached(self, cmd, env=None, cwd=None, log_path="/dev/null"):
+        env_prefix = "".join(
+            f"export {k}={shlex.quote(str(v))}; "
+            for k, v in (env or {}).items())
+        cd = f"cd {shlex.quote(cwd)}; " if cwd else ""
+        wrapped = (f"nohup sh -c {shlex.quote(env_prefix + cd + cmd)} "
+                   f">> {shlex.quote(log_path)} 2>&1 & echo $!")
+        rc, out, err = self.run(wrapped)
+        if rc != 0:
+            raise exceptions.ProvisionError(
+                f"detached exec failed on {self.pod_name}: {err}")
+        return int(out.strip().splitlines()[-1])
+
+    def rsync(self, src, dst, up=True, excludes=None):
+        if up:
+            pair = [src, f"{self.pod_name}:{dst}"]
+            self.run(f"mkdir -p {shlex.quote(dst if src.endswith('/') else os.path.dirname(dst) or '.')}")
+        else:
+            pair = [f"{self.pod_name}:{src}", dst]
+        rc = subprocess.run([_kubectl(), "cp", *pair],
+                            capture_output=True).returncode
+        if rc != 0:
+            raise RuntimeError(
+                f"kubectl cp {pair[0]} -> {pair[1]} failed")
+
+    def read_file(self, path: str) -> Optional[str]:
+        rc, out, _ = self.run(f"cat {shlex.quote(path)}")
+        return out if rc == 0 else None
+
+    def kill(self, pid: int) -> None:
+        self.run(f"kill -TERM -- -{pid} 2>/dev/null || "
+                 f"kill -TERM {pid} 2>/dev/null || true")

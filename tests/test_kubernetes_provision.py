@@ -1,0 +1,128 @@
+"""Kubernetes provisioning offline: a recording fake kubectl shim.
+
+Mirrors the reference's strategy of testing provisioning logic without a
+cluster (reference: tests/unit_tests/kubernetes/).
+"""
+
+import json
+import os
+import stat
+import textwrap
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import kubernetes as k8s
+from skypilot_tpu.provision.common import ProvisionConfig
+
+
+@pytest.fixture()
+def fake_kubectl(tmp_path, monkeypatch):
+    """A shim that records argv+stdin and replays scripted pod JSON."""
+    record = tmp_path / "calls.jsonl"
+    pods_file = tmp_path / "pods.json"
+    pods_file.write_text(json.dumps({"items": []}))
+    shim = tmp_path / "kubectl"
+    shim.write_text(textwrap.dedent(f"""\
+        #!/usr/bin/env python3
+        import json, sys
+        stdin = sys.stdin.read() if not sys.stdin.isatty() else ""
+        with open({str(record)!r}, "a") as f:
+            f.write(json.dumps({{"argv": sys.argv[1:], "stdin": stdin}})
+                    + "\\n")
+        if sys.argv[1:3] == ["get", "pods"]:
+            print(open({str(pods_file)!r}).read())
+        """))
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("SKYTPU_KUBECTL", str(shim))
+
+    class Ctl:
+        def calls(self):
+            if not record.exists():
+                return []
+            return [json.loads(l) for l in record.read_text().splitlines()]
+
+        def set_pods(self, items):
+            pods_file.write_text(json.dumps({"items": items}))
+
+    return Ctl()
+
+
+def _cfg(**kw):
+    defaults = dict(cluster_name="kt", num_nodes=1, hosts_per_node=4,
+                    zone="us-central2-b", region="us-central2",
+                    accelerator="tpu-v5e-16", accelerator_count=16)
+    defaults.update(kw)
+    return ProvisionConfig(**defaults)
+
+
+def _pod_item(name, node, worker, phase="Running", ip="10.0.0.1"):
+    return {"metadata": {"name": name,
+                         "labels": {k8s.LABEL: "kt",
+                                    k8s.NODE_LABEL: str(node),
+                                    k8s.WORKER_LABEL: str(worker)}},
+            "status": {"phase": phase, "podIP": ip}}
+
+
+def test_pod_manifest_tpu_selectors():
+    spec = k8s.pod_manifest(_cfg(), node_id=0, worker_id=2)
+    sel = spec["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+        "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+    # 16 chips over 4 hosts -> 4 chips per pod.
+    res = spec["spec"]["containers"][0]["resources"]
+    assert res["limits"]["google.com/tpu"] == "4"
+    assert spec["metadata"]["labels"][k8s.WORKER_LABEL] == "2"
+
+
+def test_pod_manifest_spot_tolerations():
+    spec = k8s.pod_manifest(_cfg(use_spot=True), 0, 0)
+    assert spec["spec"]["nodeSelector"]["cloud.google.com/gke-spot"] == \
+        "true"
+    assert any(t["key"] == "cloud.google.com/gke-spot"
+               for t in spec["spec"]["tolerations"])
+
+
+def test_pod_manifest_unknown_topology():
+    with pytest.raises(exceptions.ProvisionError):
+        k8s.pod_manifest(_cfg(accelerator="tpu-v5e-12"), 0, 0)
+
+
+def test_run_instances_applies_all_pods(fake_kubectl):
+    rec = k8s.run_instances(_cfg())
+    assert len(rec.created_instance_ids) == 4
+    applies = [c for c in fake_kubectl.calls() if c["argv"][0] == "apply"]
+    assert len(applies) == 4
+    manifest = json.loads(applies[0]["stdin"])
+    assert manifest["metadata"]["name"] == "kt-0-0"
+
+
+def test_query_and_wait(fake_kubectl):
+    assert k8s.query_instances("kt", "z") == "NOT_FOUND"
+    fake_kubectl.set_pods([_pod_item("kt-0-0", 0, 0, "Pending")])
+    assert k8s.query_instances("kt", "z") == "PARTIAL"
+    fake_kubectl.set_pods([_pod_item("kt-0-0", 0, 0, "Running")])
+    assert k8s.query_instances("kt", "z") == "UP"
+    k8s.wait_instances("kt", "z", timeout=5)
+
+
+def test_get_cluster_info_orders_hosts(fake_kubectl):
+    fake_kubectl.set_pods([
+        _pod_item("kt-0-1", 0, 1, ip="10.0.0.2"),
+        _pod_item("kt-0-0", 0, 0, ip="10.0.0.1"),
+    ])
+    info = k8s.get_cluster_info("kt", "z")
+    assert [h.worker_id for h in info.hosts] == [0, 1]
+    assert info.hosts[0].internal_ip == "10.0.0.1"
+    runners = k8s.get_command_runners(info)
+    assert [r.pod_name for r in runners] == ["kt-0-0", "kt-0-1"]
+
+
+def test_terminate_and_stop(fake_kubectl):
+    k8s.terminate_instances("kt", "z")
+    deletes = [c for c in fake_kubectl.calls()
+               if c["argv"][0] == "delete"]
+    assert deletes and f"{k8s.LABEL}=kt" in deletes[0]["argv"]
+    with pytest.raises(exceptions.NotSupportedError):
+        k8s.stop_instances("kt", "z")
