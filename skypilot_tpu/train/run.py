@@ -1,0 +1,150 @@
+"""Training recipe entry point: ``python -m skypilot_tpu.train.run``.
+
+The in-tree replacement for the reference's external workload recipes
+(reference: examples/tpu/v6e/train-llama3-8b.yaml runs a PyTorch/XLA
+HF Trainer; llm/llama-3_1-finetuning/ runs torchtune). One process per
+TPU host; multi-host slices initialize jax.distributed from the env
+contract injected by the runtime (SKYTPU_COORDINATOR, SKYTPU_NUM_HOSTS,
+SKYTPU_HOST_ID — runtime/driver.py).
+
+Examples::
+
+    # single host, FSDP over all local chips:
+    python -m skypilot_tpu.train.run --config llama3-400m --steps 100
+
+    # 4-host v5p-16, fsdp x tp, checkpoints to a bucket mount:
+    python -m skypilot_tpu.train.run --config llama3-8b --tp 4 \
+        --steps 1000 --ckpt-dir /outputs/ckpts --ckpt-every 100
+
+    # MoE with expert parallelism:
+    python -m skypilot_tpu.train.run --model moe --config moe-small --ep 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama",
+                    choices=("llama", "moe", "pipeline"))
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=0,
+                    help="global batch (0 = 4 x data-parallel degree)")
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--ep", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--ckpt-every", type=int, default=100)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--log-every", type=int, default=10)
+    args = ap.parse_args()
+
+    # Multi-host: join the slice-wide jax.distributed rendezvous using
+    # the runtime's env contract (runtime/constants.py) before touching
+    # devices.
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coord and int(os.environ.get("SKYTPU_NUM_HOSTS", "1")) > 1:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["SKYTPU_NUM_HOSTS"]),
+            process_id=int(os.environ.get("SKYTPU_HOST_ID", "0")))
+
+    import jax
+
+    import skypilot_tpu.callbacks as sky_callback
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import trainer
+
+    if args.model == "llama":
+        from skypilot_tpu.models import llama as model
+        default_cfg = "llama3-400m"
+    elif args.model == "moe":
+        from skypilot_tpu.models import moe as model
+        default_cfg = "moe-small"
+    else:
+        from skypilot_tpu.parallel import pipeline as model
+        default_cfg = "pp-tiny"
+    cfg = model.CONFIGS[args.config or default_cfg]
+    args.seq = min(args.seq, cfg.max_seq_len)
+
+    n = jax.device_count()
+    shape = mesh_lib.default_shape_for(n, tp=args.tp, sp=args.sp,
+                                       dp=args.dp, ep=args.ep, pp=args.pp)
+    mesh = mesh_lib.make_mesh(shape)
+    log(f"mesh: {shape.as_dict()} over {n} devices "
+        f"({jax.devices()[0].device_kind})")
+
+    data_degree = shape.dp * shape.fsdp
+    batch = args.batch or 4 * data_degree
+    if batch % data_degree:
+        batch = data_degree * max(1, batch // data_degree)
+    micro = getattr(cfg, "n_microbatches", None)
+    if micro and batch % micro:
+        batch = micro * max(1, batch // micro)
+
+    tc = trainer.TrainConfig(learning_rate=args.lr,
+                             warmup_steps=max(1, min(100, args.steps // 10)),
+                             total_steps=args.steps)
+    step_fn = trainer.make_train_step(cfg, tc, mesh, model=model)
+
+    mgr = None
+    start_step = 0
+    state = None
+    if args.ckpt_dir:
+        from skypilot_tpu.train import checkpoints
+        mgr = checkpoints.CheckpointManager(args.ckpt_dir)
+        if args.resume and mgr.latest_step() is not None:
+            target = trainer.create_abstract_state(cfg, tc, mesh,
+                                                   model=model)
+            state = mgr.restore(target)
+            start_step = int(mgr.latest_step())
+            log(f"resumed from step {start_step}")
+    if state is None:
+        state = trainer.create_train_state(cfg, tc, mesh, model=model)
+
+    batch_data = trainer.synthetic_batch(cfg, batch, args.seq)
+    sky_callback.init(total_steps=args.steps)
+    t0 = time.time()
+    for step in range(start_step, args.steps):
+        with sky_callback.step():
+            state, metrics = step_fn(state, batch_data)
+        if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
+            loss = float(metrics["loss"])
+            log(f"step {step + 1}/{args.steps} loss={loss:.4f}")
+        if mgr and (step + 1) % args.ckpt_every == 0:
+            mgr.save(step + 1, state)
+    loss = float(metrics["loss"])  # host fetch = real sync
+    wall = time.time() - t0
+    if mgr:
+        mgr.save(args.steps, state, force=True)
+        mgr.wait()
+        mgr.close()
+    tokens_per_s = batch * args.seq * (args.steps - start_step) / wall
+    print(json.dumps({
+        "final_loss": round(loss, 4),
+        "steps": args.steps - start_step,
+        "wall_s": round(wall, 2),
+        "tokens_per_sec": round(tokens_per_s, 1),
+        "tokens_per_sec_per_chip": round(tokens_per_s / n, 1),
+        "mesh": shape.as_dict(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

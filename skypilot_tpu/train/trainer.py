@@ -45,7 +45,10 @@ class TrainConfig:
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=tc.learning_rate,
-        warmup_steps=tc.warmup_steps, decay_steps=max(tc.total_steps, 1),
+        warmup_steps=tc.warmup_steps,
+        # optax requires decay_steps > warmup_steps (the cosine segment
+        # length is the difference).
+        decay_steps=max(tc.total_steps, tc.warmup_steps + 1, 1),
         end_value=tc.learning_rate * 0.1)
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
