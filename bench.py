@@ -55,6 +55,9 @@ def main() -> None:
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--remat-policy", default="dots",
+                    choices=("none", "dots"))
+    ap.add_argument("--xent-chunk", type=int, default=None)
     args = ap.parse_args()
 
     import jax
@@ -73,11 +76,17 @@ def main() -> None:
     if args.config is None:
         args.config = "llama3-tiny" if on_cpu else "llama3-400m"
     if args.batch is None:
-        args.batch = 2 if on_cpu else 4 * max(n_chips, 1)
+        # batch 6/chip + "dots" remat is the measured sweet spot on a
+        # 16G v5e (MFU 0.574 vs 0.520 at batch 4 + full remat).
+        args.batch = 2 if on_cpu else 6 * max(n_chips, 1)
     if on_cpu and args.seq > 256:
         args.seq = 128
 
     cfg = llama.CONFIGS[args.config]
+    import dataclasses
+    cfg = dataclasses.replace(cfg, remat_policy=args.remat_policy)
+    if args.xent_chunk is not None:
+        cfg = dataclasses.replace(cfg, xent_chunk=args.xent_chunk)
     seq = min(args.seq, cfg.max_seq_len)
     mesh = mesh_lib.make_mesh() if n_chips > 1 else None
 

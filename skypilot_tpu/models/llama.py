@@ -52,6 +52,16 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16       # activation / compute dtype
     param_dtype: Any = jnp.float32  # storage dtype for parameters
     remat: bool = True              # rematerialize each layer in the bwd pass
+    # "none" recomputes everything (min HBM); "dots" saves matmul
+    # outputs with no batch dims (MXU results kept, elementwise
+    # recomputed — the usual best FLOPs/HBM trade on TPU).
+    remat_policy: str = "none"
+    # Sequence positions per cross-entropy chunk (0 = single pass).
+    # Chunking never materializes the full [B, S, vocab] fp32 logits:
+    # each chunk's logits are recomputed in the backward (remat), so
+    # peak HBM drops by ~B*S*vocab*4 bytes at the cost of one extra
+    # lm_head matmul in the backward.
+    xent_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -148,6 +158,17 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
 # Building blocks
 # ---------------------------------------------------------------------------
 
+def remat_policy(cfg: LlamaConfig):
+    """Resolve cfg.remat_policy to a jax checkpoint policy."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy != "none":
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r}; "
+            f"expected 'none' or 'dots'")
+    return jax.checkpoint_policies.nothing_saveable
+
+
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     dtype = x.dtype
     x = x.astype(jnp.float32)
@@ -235,15 +256,9 @@ def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
 # Forward pass
 # ---------------------------------------------------------------------------
 
-def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
-            constrain=None, mesh=None, rules=None) -> jax.Array:
-    """Token ids [B, S] -> logits [B, S, vocab] (float32).
-
-    ``constrain`` is an optional fn(x, logical_axes) -> x applying
-    ``with_sharding_constraint``; identity when running unsharded.
-    ``mesh`` (+ optional activation ``rules``) enables the
-    context-parallel attention path when the seq mesh-axis is > 1.
-    """
+def forward_hidden(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+                   constrain=None, mesh=None, rules=None) -> jax.Array:
+    """Token ids [B, S] -> final-norm hidden states [B, S, D] (cfg.dtype)."""
     if constrain is None:
         constrain = lambda x, axes: x
 
@@ -258,10 +273,24 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
         return y, None
 
     if cfg.remat:
-        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+        body = jax.checkpoint(body, policy=remat_policy(cfg))
 
     x, _ = lax.scan(body, x, params["blocks"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+            constrain=None, mesh=None, rules=None) -> jax.Array:
+    """Token ids [B, S] -> logits [B, S, vocab] (float32).
+
+    ``constrain`` is an optional fn(x, logical_axes) -> x applying
+    ``with_sharding_constraint``; identity when running unsharded.
+    ``mesh`` (+ optional activation ``rules``) enables the
+    context-parallel attention path when the seq mesh-axis is > 1.
+    """
+    if constrain is None:
+        constrain = lambda x, axes: x
+    x = forward_hidden(params, tokens, cfg, constrain, mesh, rules)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
     logits = constrain(logits, ("batch", "seq", "vocab"))
@@ -272,16 +301,78 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: LlamaConfig,
             constrain=None, mesh=None,
             rules=None) -> tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross-entropy. batch: {"tokens": [B, S] int32,
-    optionally "mask": [B, S] (1 = predict this position's *next* token)}."""
+    optionally "mask": [B, S] (1 = predict this position's *next* token)}.
+
+    With ``cfg.xent_chunk`` > 0 the head matmul + softmax run chunked
+    over the sequence under remat, so the [B, S, vocab] logits tensor
+    never exists in HBM.
+    """
+    if constrain is None:
+        constrain = lambda x, axes: x
     tokens = batch["tokens"]
-    logits = forward(params, tokens, cfg, constrain, mesh, rules)
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logps = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("mask")
-    mask = jnp.ones_like(ll) if mask is None else mask[:, :-1].astype(ll.dtype)
-    denom = jnp.maximum(mask.sum(), 1.0)
-    loss = -(ll * mask).sum() / denom
-    acc = ((jnp.argmax(logits, -1) == targets) * mask).sum() / denom
+    h = forward_hidden(params, tokens, cfg, constrain, mesh, rules)
+    loss, acc, denom = xent_metrics(params, h, tokens, batch.get("mask"),
+                                    cfg, constrain)
     return loss, {"loss": loss, "accuracy": acc, "tokens": denom}
+
+
+def xent_metrics(params: Params, h: jax.Array, tokens: jax.Array,
+                 mask: Optional[jax.Array], cfg: LlamaConfig,
+                 constrain=lambda x, axes: x):
+    """Shared LM-head + next-token cross-entropy epilogue.
+
+    h: final-norm hidden states [B, S, D]. Returns (loss, acc, denom).
+    Honors ``cfg.xent_chunk`` (see LlamaConfig) — used by the llama,
+    moe, and pipeline loss functions alike.
+    """
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    head = head.astype(cfg.dtype)
+    if not cfg.xent_chunk:
+        logits = jnp.einsum("bsd,dv->bsv", h, head)
+        logits = constrain(logits, ("batch", "seq", "vocab"))
+        logits = logits.astype(jnp.float32)[:, :-1]
+        targets = tokens[:, 1:]
+        logps = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
+        m = (jnp.ones_like(ll) if mask is None
+             else mask[:, :-1].astype(ll.dtype))
+        denom = jnp.maximum(m.sum(), 1.0)
+        loss = -(ll * m).sum() / denom
+        acc = ((jnp.argmax(logits, -1) == targets) * m).sum() / denom
+        return loss, acc, denom
+
+    B, S = tokens.shape
+    # Positions 0..S-2 predict targets 1..S-1. Pad to a chunk multiple
+    # with masked-out positions.
+    h = h[:, :-1]
+    targets = tokens[:, 1:]
+    m = (jnp.ones((B, S - 1), jnp.float32) if mask is None
+         else mask[:, :-1].astype(jnp.float32))
+    c = cfg.xent_chunk
+    pad = (-(S - 1)) % c
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        m = jnp.pad(m, ((0, 0), (0, pad)))
+    n_chunks = h.shape[1] // c
+    h = h.reshape(B, n_chunks, c, -1).swapaxes(0, 1)       # [N,B,c,D]
+    targets = targets.reshape(B, n_chunks, c).swapaxes(0, 1)
+    m = m.reshape(B, n_chunks, c).swapaxes(0, 1)
+
+    def chunk_body(carry, xs):
+        ll_sum, correct = carry
+        hc, tc, mc = xs
+        logits = jnp.einsum("bcd,dv->bcv", hc, head).astype(jnp.float32)
+        logps = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logps, tc[..., None], axis=-1)[..., 0]
+        ll_sum += (ll * mc).sum()
+        correct += ((jnp.argmax(logits, -1) == tc) * mc).sum()
+        return (ll_sum, correct), None
+
+    body = jax.checkpoint(chunk_body,
+                          policy=jax.checkpoint_policies.nothing_saveable)
+    (ll_sum, correct), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (h, targets, m))
+    denom = jnp.maximum(m.sum(), 1.0)
+    return -ll_sum / denom, correct / denom, denom

@@ -231,10 +231,10 @@ def decoder_layer(cfg: MoEConfig, x: jax.Array, layer: Params,
 # Forward / loss
 # ---------------------------------------------------------------------------
 
-def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
-            constrain=None, mesh=None,
-            rules=None) -> Tuple[jax.Array, jax.Array]:
-    """[B, S] ids -> (logits [B, S, vocab] fp32, mean aux loss scalar)."""
+def forward_hidden(params: Params, tokens: jax.Array, cfg: MoEConfig,
+                   constrain=None, mesh=None,
+                   rules=None) -> Tuple[jax.Array, jax.Array]:
+    """[B, S] ids -> (final-norm hidden [B, S, D], mean aux loss)."""
     if constrain is None:
         constrain = lambda x, axes: x
 
@@ -251,33 +251,40 @@ def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
         return (y, aux_sum + aux), None
 
     if cfg.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable)
+        body = jax.checkpoint(body, policy=llama.remat_policy(cfg))
 
     (x, aux_sum), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
                                params["blocks"])
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return x, aux_sum / cfg.n_layers
+
+
+def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
+            constrain=None, mesh=None,
+            rules=None) -> Tuple[jax.Array, jax.Array]:
+    """[B, S] ids -> (logits [B, S, vocab] fp32, mean aux loss scalar)."""
+    if constrain is None:
+        constrain = lambda x, axes: x
+    x, aux = forward_hidden(params, tokens, cfg, constrain, mesh, rules)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
     logits = constrain(logits, ("batch", "seq", "vocab"))
-    return logits.astype(jnp.float32), aux_sum / cfg.n_layers
+    return logits.astype(jnp.float32), aux
 
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: MoEConfig,
             constrain=None, mesh=None,
             rules=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Next-token cross-entropy + weighted aux load-balancing loss."""
+    """Next-token cross-entropy + weighted aux load-balancing loss.
+
+    Honors ``cfg.xent_chunk`` via the shared llama.xent_metrics epilogue.
+    """
+    if constrain is None:
+        constrain = lambda x, axes: x
     tokens = batch["tokens"]
-    logits, aux = forward(params, tokens, cfg, constrain, mesh, rules)
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logps = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("mask")
-    mask = jnp.ones_like(ll) if mask is None else mask[:, :-1].astype(ll.dtype)
-    denom = jnp.maximum(mask.sum(), 1.0)
-    xent = -(ll * mask).sum() / denom
+    h, aux = forward_hidden(params, tokens, cfg, constrain, mesh, rules)
+    xent, acc, denom = llama.xent_metrics(params, h, tokens,
+                                          batch.get("mask"), cfg, constrain)
     loss = xent + cfg.aux_loss_weight * aux
-    acc = ((jnp.argmax(logits, -1) == targets) * mask).sum() / denom
     return loss, {"loss": loss, "xent": xent, "aux_loss": aux,
                   "accuracy": acc, "tokens": denom}

@@ -109,9 +109,9 @@ def _stage_apply(cfg: PipelineConfig, stage_blocks: Params, x: jax.Array,
     return x
 
 
-def forward(params: Params, tokens: jax.Array, cfg: PipelineConfig,
-            constrain=None, mesh=None, rules=None) -> jax.Array:
-    """[B, S] ids -> logits [B, S, vocab] via the pipelined stack.
+def forward_hidden(params: Params, tokens: jax.Array, cfg: PipelineConfig,
+                   constrain=None, mesh=None, rules=None) -> jax.Array:
+    """[B, S] ids -> final-norm hidden [B, S, D] via the pipelined stack.
 
     B must be divisible by n_microbatches. ``constrain/mesh/rules`` follow
     the models.llama signature; activation constraints are applied to the
@@ -159,12 +159,19 @@ def forward(params: Params, tokens: jax.Array, cfg: PipelineConfig,
     buf0 = jnp.zeros((S_stages, b, S, D), cfg.dtype)
     out0 = jnp.zeros((M, b, S, D), cfg.dtype)
     total_ticks = M + S_stages - 1
-    tick_fn = jax.checkpoint(
-        tick, policy=jax.checkpoint_policies.nothing_saveable)
+    tick_fn = jax.checkpoint(tick, policy=llama.remat_policy(cfg))
     (_, out), _ = lax.scan(tick_fn, (buf0, out0), jnp.arange(total_ticks))
 
     x = out.reshape(B, S, D)
-    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(params: Params, tokens: jax.Array, cfg: PipelineConfig,
+            constrain=None, mesh=None, rules=None) -> jax.Array:
+    """[B, S] ids -> logits [B, S, vocab] fp32 (pipelined stack)."""
+    if constrain is None:
+        constrain = lambda x, axes: x
+    x = forward_hidden(params, tokens, cfg, constrain, mesh, rules)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
     logits = constrain(logits, ("batch", "seq", "vocab"))
@@ -174,16 +181,15 @@ def forward(params: Params, tokens: jax.Array, cfg: PipelineConfig,
 def loss_fn(params: Params, batch: Dict[str, jax.Array],
             cfg: PipelineConfig, constrain=None, mesh=None,
             rules=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Next-token cross-entropy through the pipelined forward."""
+    """Next-token cross-entropy through the pipelined forward.
+
+    Honors ``cfg.xent_chunk`` via the shared llama.xent_metrics epilogue.
+    """
+    if constrain is None:
+        constrain = lambda x, axes: x
     tokens = batch["tokens"]
-    logits = forward(params, tokens, cfg, constrain, mesh, rules)
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logps = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("mask")
-    mask = jnp.ones_like(ll) if mask is None else mask[:, :-1].astype(ll.dtype)
-    denom = jnp.maximum(mask.sum(), 1.0)
-    loss = -(ll * mask).sum() / denom
-    acc = ((jnp.argmax(logits, -1) == targets) * mask).sum() / denom
+    h = forward_hidden(params, tokens, cfg, constrain, mesh, rules)
+    loss, acc, denom = llama.xent_metrics(params, h, tokens,
+                                          batch.get("mask"), cfg,
+                                          constrain)
     return loss, {"loss": loss, "accuracy": acc, "tokens": denom}

@@ -134,6 +134,17 @@ def pod_manifest(config: ProvisionConfig, node_id: int,
 # Provider function set
 # ---------------------------------------------------------------------------
 
+def check_credentials() -> Tuple[bool, str]:
+    """kubectl reachable and pointed at a context?"""
+    try:
+        rc, out = _run(["config", "current-context"])
+    except FileNotFoundError:
+        return False, "kubectl not installed"
+    if rc != 0:
+        return False, f"no kubectl context: {out.strip()[:200]}"
+    return True, f"context {out.strip()}"
+
+
 def run_instances(config: ProvisionConfig) -> ProvisionRecord:
     created = []
     for node_id in range(config.num_nodes):
