@@ -362,7 +362,9 @@ def xent_metrics(params: Params, h: jax.Array, tokens: jax.Array,
     def chunk_body(carry, xs):
         ll_sum, correct = carry
         hc, tc, mc = xs
-        logits = jnp.einsum("bcd,dv->bcv", hc, head).astype(jnp.float32)
+        logits = jnp.einsum("bcd,dv->bcv", hc, head)
+        logits = constrain(logits, ("batch", "seq", "vocab"))
+        logits = logits.astype(jnp.float32)
         logps = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logps, tc[..., None], axis=-1)[..., 0]
         ll_sum += (ll * mc).sum()

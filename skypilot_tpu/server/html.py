@@ -1,0 +1,85 @@
+"""Dashboard HTML (stdlib-served, zero deps).
+
+Reference parity: sky/jobs/dashboard/ (Flask+HTML jobs table) and
+sky/server/html/log.html (browser log viewer) — one page here covering
+clusters, managed jobs, and API requests, auto-refreshing from the
+JSON endpoints.
+"""
+
+DASHBOARD_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>skypilot-tpu dashboard</title>
+<style>
+  body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
+         margin: 2rem; color: #1a1a2e; }
+  h1 { font-size: 1.3rem; }
+  h2 { font-size: 1.05rem; margin-top: 1.8rem; }
+  table { border-collapse: collapse; width: 100%; font-size: 0.88rem; }
+  th, td { text-align: left; padding: 6px 12px;
+           border-bottom: 1px solid #e5e5ef; }
+  th { color: #555; font-weight: 600; }
+  .ok { color: #0a7d33; } .bad { color: #b3261e; } .dim { color: #888; }
+  #updated { color: #888; font-size: 0.8rem; }
+</style>
+</head>
+<body>
+<h1>skypilot-tpu <span id="updated"></span></h1>
+<h2>Clusters</h2>
+<table id="clusters"><thead><tr>
+  <th>Name</th><th>Status</th><th>Resources</th><th>Autostop</th>
+</tr></thead><tbody></tbody></table>
+<h2>Managed jobs</h2>
+<table id="jobs"><thead><tr>
+  <th>ID</th><th>Name</th><th>Status</th><th>Recoveries</th><th>Cluster</th>
+</tr></thead><tbody></tbody></table>
+<h2>API requests</h2>
+<table id="requests"><thead><tr>
+  <th>ID</th><th>Op</th><th>Status</th>
+</tr></thead><tbody></tbody></table>
+<script>
+function cls(s) {
+  if (["UP","SUCCEEDED","RUNNING"].includes(s)) return "ok";
+  if (s.startsWith("FAILED") || s === "CANCELLED") return "bad";
+  return "dim";
+}
+function fill(id, rows, cols) {
+  const tb = document.querySelector(`#${id} tbody`);
+  tb.innerHTML = "";
+  for (const r of rows) {
+    const tr = document.createElement("tr");
+    for (const c of cols) {
+      const td = document.createElement("td");
+      const v = r[c] ?? "-";
+      td.textContent = v;
+      if (c === "status") td.className = cls(String(v));
+      tr.appendChild(td);
+    }
+    tb.appendChild(tr);
+  }
+}
+async function refresh() {
+  try {
+    const [cs, js, rs] = await Promise.all([
+      fetch("/api/clusters").then(r => r.json()),
+      fetch("/api/jobs").then(r => r.json()),
+      fetch("/api/status").then(r => r.json()),
+    ]);
+    fill("clusters", cs, ["name", "status", "resources", "autostop"]);
+    fill("jobs", js, ["job_id", "name", "status", "recovery_count",
+                      "cluster_name"]);
+    fill("requests", rs.slice(-30).reverse(),
+         ["request_id", "name", "status"]);
+    document.getElementById("updated").textContent =
+      "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    document.getElementById("updated").textContent = "refresh failed";
+  }
+}
+refresh();
+setInterval(refresh, 5000);
+</script>
+</body>
+</html>
+"""

@@ -141,6 +141,35 @@ def make_handler():
             if parsed.path == "/api/health":
                 return self._json(200, {"status": "healthy",
                                         "version": _version()})
+            if parsed.path == "/api/clusters":
+                from skypilot_tpu import state as gstate
+                rows = []
+                for r in gstate.list_clusters():
+                    h = r.get("handle") or {}
+                    res = h.get("resources") or {}
+                    rows.append({
+                        "name": r["name"],
+                        "status": r["status"].value,
+                        "resources": f"{h.get('provider', '?')}:"
+                                     f"{res.get('accelerators', '?')}",
+                        "autostop": r.get("autostop_minutes"),
+                    })
+                return self._json(200, rows)
+            if parsed.path == "/api/jobs":
+                from skypilot_tpu.jobs import state as jobs_state
+                return self._json(200, [
+                    {**j, "status": j["status"].value}
+                    for j in jobs_state.list_jobs()])
+            if parsed.path in ("/dashboard", "/"):
+                from skypilot_tpu.server import html as html_mod
+                body = html_mod.DASHBOARD_HTML.encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if parsed.path == "/api/status":
                 return self._json(200, [
                     {**r, "status": r["status"].value}

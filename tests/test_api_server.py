@@ -92,3 +92,18 @@ def test_api_cancel(api_server):
     sdk.api_cancel(rid)
     with pytest.raises(exceptions.SkyTpuError):
         sdk.get(rid, timeout=30)
+
+
+def test_dashboard_and_json_endpoints(api_server):
+    import json
+    import urllib.request
+
+    html = urllib.request.urlopen(f"{api_server}/dashboard").read().decode()
+    assert "skypilot-tpu" in html and "Clusters" in html
+
+    clusters = json.loads(
+        urllib.request.urlopen(f"{api_server}/api/clusters").read())
+    assert isinstance(clusters, list)
+    jobs = json.loads(
+        urllib.request.urlopen(f"{api_server}/api/jobs").read())
+    assert isinstance(jobs, list)

@@ -143,3 +143,48 @@ def test_cloud_stores_registry():
                                                  "/tmp/f.bin")
     with pytest.raises(ValueError):
         cloud_stores.get_storage_from_path("ftp://x/y")
+
+
+def test_data_transfer_commands():
+    from skypilot_tpu.data import data_transfer as dt
+
+    rec = []
+
+    def run(cmd):
+        rec.append(cmd)
+        return 0, ""
+
+    dt.transfer("s3://src-bkt", "gs://dst-bkt", run=run)
+    assert "transfer jobs create" in rec[0] and "s3://src-bkt" in rec[0]
+    dt.transfer("gs://a", "gs://b", run=run)
+    assert "rsync -r gs://a gs://b" in rec[1]
+    dt.transfer("/tmp/x", "gs://b", run=run)
+    assert "rsync -r /tmp/x gs://b" in rec[2]
+    dt.transfer("gs://b/sub", "/tmp/y", run=run)
+    assert "gs://b/sub /tmp/y" in rec[3]
+    with pytest.raises(exceptions.StorageError):
+        dt.transfer("/tmp/a", "/tmp/b", run=run)
+
+    def fail(cmd):
+        return 1, "denied"
+
+    with pytest.raises(exceptions.StorageError):
+        dt.transfer("gs://a", "gs://b", run=fail)
+
+
+def test_data_transfer_rejects_gs_to_s3_and_copies_files(tmp_path):
+    from skypilot_tpu.data import data_transfer as dt
+
+    rec = []
+
+    def run(cmd):
+        rec.append(cmd)
+        return 0, ""
+
+    with pytest.raises(exceptions.StorageError):
+        dt.transfer("gs://bkt", "s3://dst", run=run)
+
+    f = tmp_path / "model.bin"
+    f.write_text("x")
+    dt.transfer(str(f), "gs://bkt/ckpt/model.bin", run=run)
+    assert rec and rec[-1].startswith("gcloud storage cp ")
