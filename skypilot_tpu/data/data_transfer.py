@@ -27,10 +27,14 @@ def _local_run(cmd: str) -> Tuple[int, str]:
 
 
 def s3_to_gcs_command(s3_bucket: str, gcs_bucket: str) -> str:
-    """One-shot Storage Transfer Service job S3 -> GCS."""
+    """Storage Transfer Service job S3 -> GCS, blocking until the copy
+    completes (create is async; monitor waits on it)."""
+    import uuid
+    job = f"skytpu-transfer-{uuid.uuid4().hex[:10]}"
     return ("gcloud transfer jobs create "
             f"s3://{shlex.quote(s3_bucket)} gs://{shlex.quote(gcs_bucket)} "
-            "--source-auth-method=AWS_SIGNATURE_V4")
+            f"--name={job} --source-auth-method=AWS_SIGNATURE_V4 && "
+            f"gcloud transfer jobs monitor {job}")
 
 
 def gcs_to_gcs_command(src_bucket: str, dst_bucket: str) -> str:
@@ -59,7 +63,12 @@ def transfer(src: str, dst: str, run: RunFn = _local_run) -> None:
     Supported pairs: s3->gs, gs->gs, local->gs, gs->local. Single
     local files use ``cp``; directories use ``rsync -r``.
     """
+    import os
     s, d = _scheme(src), _scheme(dst)
+    if s == "local":
+        src = os.path.expanduser(src)
+    if d == "local":
+        dst = os.path.expanduser(dst)
     if (s, d) == ("s3", "gs"):
         cmd = s3_to_gcs_command(src.removeprefix("s3://"),
                                 dst.removeprefix("gs://"))
@@ -67,8 +76,7 @@ def transfer(src: str, dst: str, run: RunFn = _local_run) -> None:
         cmd = gcs_to_gcs_command(src.removeprefix("gs://"),
                                  dst.removeprefix("gs://"))
     elif (s, d) == ("local", "gs"):
-        import os
-        if os.path.isfile(os.path.expanduser(src)):
+        if os.path.isfile(src):
             cmd = (f"gcloud storage cp {shlex.quote(src)} "
                    f"{shlex.quote(dst)}")
         else:

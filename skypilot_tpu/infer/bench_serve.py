@@ -33,9 +33,10 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None)
     ap.add_argument("--requests", type=int, default=16)
-    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--slots", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=96)
     ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--max-burst", type=int, default=32)
     args = ap.parse_args()
 
     import jax
@@ -60,14 +61,15 @@ def main() -> None:
                             args.prompt_len).tolist()
                for _ in range(args.requests)]
 
-    # Warmup: compile prefill + decode.
-    e.generate([prompts[0]], max_new_tokens=2)
+    # Warmup: compile the full-wave admission program and the burst
+    # decode programs actually used by the measured run.
+    e.generate([prompts[0]] * args.slots, max_new_tokens=args.new_tokens)
     e.finished.clear()
 
     t0 = time.time()
     for p in prompts:
         e.add_request(p, max_new_tokens=args.new_tokens)
-    done = e.run_to_completion()
+    done = e.run_to_completion(max_burst=args.max_burst)
     # Force a host sync so the wall clock is honest (axon relay:
     # block_until_ready does not synchronize; a host fetch does).
     float(e.cache["length"][0])

@@ -67,7 +67,10 @@ class InferenceEngine:
         self.buckets = tuple(b for b in prompt_buckets if b <= max_len)
         self.sampling_params = sampling_params
         self.eos_id = eos_id
-        self.cache = kvcache.init_cache(cfg, n_slots, max_len)
+        # One hidden spare slot (index n_slots): batched admission pads
+        # its wave with dummy prefills targeting the spare, so one
+        # compiled program serves every wave size.
+        self.cache = kvcache.init_cache(cfg, n_slots + 1, max_len)
         self.rng = jax.random.key(seed)
 
         self.free_slots = list(range(n_slots))
@@ -78,19 +81,30 @@ class InferenceEngine:
 
         sp = self.sampling_params
 
-        @functools.partial(jax.jit, static_argnames=("bucket",))
-        def _prefill(params, tokens, true_len, rng, *, bucket):
-            del bucket
-            prefix, logits = kvcache.prefill(params, tokens, true_len, cfg)
-            tok = sampling.sample(logits, rng, sp)
-            return prefix, tok
+        # The cache is donated everywhere: the engine reassigns
+        # self.cache from the output every call, so XLA updates the
+        # [L, slots, max_len, G, hd] buffers in place, never copying.
 
-        # Donate the cache: the engine reassigns self.cache from the
-        # output every call, so XLA can update the [L, slots, max_len,
-        # G, hd] buffers in place instead of copying them per token.
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def _insert(cache, prefix, slot, true_len, first_token):
-            return kvcache.insert(cache, prefix, slot, true_len, first_token)
+        # Batched admission: prefill + insert a whole wave in ONE device
+        # program (scan over requests). Dummy rows target the spare slot.
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("bucket",))
+        def _admit_wave(params, cache, tokens_b, true_lens, slots, rng,
+                        *, bucket):
+            del bucket
+            from jax import lax as _lax
+
+            def body(c, xs):
+                toks, tl, slot, key = xs
+                prefix, logits = kvcache.prefill(params, toks, tl, cfg)
+                tok = sampling.sample(logits, key, sp)
+                c = kvcache.insert(c, prefix, slot, tl, tok)
+                return c, tok
+
+            keys = jax.random.split(rng, tokens_b.shape[0])
+            cache, first = _lax.scan(
+                body, cache, (tokens_b, true_lens, slots, keys))
+            return cache, first
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _decode(params, cache, rng, active):
@@ -99,14 +113,33 @@ class InferenceEngine:
             cache = kvcache.commit_tokens(cache, toks, active)
             return cache, toks
 
-        self._prefill_fn = _prefill
-        self._insert_fn = _insert
+        # Burst decode: k steps in one device program -> one host round
+        # trip per k tokens. Crucial when dispatch latency rivals the
+        # per-token compute (small models, remote/relayed TPUs).
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("k",))
+        def _decode_burst(params, cache, rng, active, *, k):
+            from jax import lax as _lax
+
+            def body(c, key):
+                c, logits = kvcache.decode_step(params, c, cfg)
+                toks = sampling.sample(logits, key, sp)
+                c = kvcache.commit_tokens(c, toks, active)
+                return c, toks
+
+            cache, toks = _lax.scan(body, cache,
+                                    jax.random.split(rng, k))
+            return cache, toks                     # [k, slots]
+
+        self._admit_wave_fn = _admit_wave
         self._decode_fn = _decode
+        self._decode_burst_fn = _decode_burst
 
     # -- admission ---------------------------------------------------------
 
     def add_request(self, prompt: List[int],
                     max_new_tokens: int = 128) -> int:
+        _bucket(len(prompt), self.buckets)   # validate length up front
         req = Request(rid=self._next_rid, prompt=list(prompt),
                       max_new_tokens=max_new_tokens, submit_s=time.time(),
                       eos_id=self.eos_id)
@@ -115,25 +148,42 @@ class InferenceEngine:
         return req.rid
 
     def _admit(self) -> None:
+        if not (self.waiting and self.free_slots):
+            return
+        # One wave: as many waiting requests as there are free slots,
+        # padded up to the next power-of-two row count (dummy rows ->
+        # spare slot), so each (bucket, rows) pair compiles once and a
+        # single-request admission doesn't pay n_slots prefills.
+        wave: List[Request] = []
+        slots: List[int] = []
         while self.waiting and self.free_slots:
-            req = self.waiting.pop(0)
-            slot = self.free_slots.pop(0)
-            bucket = _bucket(len(req.prompt), self.buckets)
-            padded = np.zeros((bucket,), np.int32)
-            padded[:len(req.prompt)] = req.prompt
-            self.rng, sub = jax.random.split(self.rng)
-            prefix, tok = self._prefill_fn(
-                self.params, jnp.asarray(padded),
-                jnp.asarray(len(req.prompt), jnp.int32), sub, bucket=bucket)
-            self.cache = self._insert_fn(
-                self.cache, prefix, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(len(req.prompt), jnp.int32), tok)
-            first = int(tok)
+            wave.append(self.waiting.pop(0))
+            slots.append(self.free_slots.pop(0))
+        bucket = max(_bucket(len(r.prompt), self.buckets) for r in wave)
+        n = 1 << (len(wave) - 1).bit_length() if len(wave) > 1 else 1
+        tokens_b = np.zeros((n, bucket), np.int32)
+        true_lens = np.ones((n,), np.int32)
+        slot_ids = np.full((n,), self.n_slots, np.int32)  # spare
+        for i, (req, slot) in enumerate(zip(wave, slots)):
+            tokens_b[i, :len(req.prompt)] = req.prompt
+            true_lens[i] = len(req.prompt)
+            slot_ids[i] = slot
+        self.rng, sub = jax.random.split(self.rng)
+        self.cache, first = self._admit_wave_fn(
+            self.params, self.cache, jnp.asarray(tokens_b),
+            jnp.asarray(true_lens), jnp.asarray(slot_ids), sub,
+            bucket=bucket)
+        first = np.asarray(first)
+        now = time.time()
+        # Spare-slot bookkeeping must not linger.
+        self.cache["length"] = self.cache["length"].at[self.n_slots].set(0)
+        for i, (req, slot) in enumerate(zip(wave, slots)):
+            tok = int(first[i])
             req.slot = slot
-            req.tokens.append(first)
-            req.first_token_s = time.time()
+            req.tokens.append(tok)
+            req.first_token_s = now
             self.slot_req[slot] = req
-            if self._req_finished(req, first):
+            if self._req_finished(req, tok):
                 self._retire(req)
 
     # -- stepping ----------------------------------------------------------
@@ -160,9 +210,54 @@ class InferenceEngine:
         Returns {rid: token} emitted this step.
         """
         self._admit()
+        return self.step_decode_once()
+
+    def step_burst(self, max_burst: int = 8) -> Dict[int, List[int]]:
+        """Admit, then decode up to ``max_burst`` tokens per slot in one
+        device call. Tokens past a request's EOS/limit are discarded
+        host-side (their cache rows die with the slot). Returns
+        {rid: [tokens...]} emitted this call."""
+        self._admit()
         if not self.slot_req:
             return {}
-        active = np.zeros((self.n_slots,), bool)
+        # Cap the burst so no active slot's cache can overflow, then
+        # round down to a power of two: each distinct k compiles its own
+        # program, so the k-space must stay tiny. (Tokens a request
+        # doesn't need are discarded host-side — cheaper than a
+        # recompile.)
+        k = max_burst
+        for req in self.slot_req.values():
+            rows = len(req.prompt) + len(req.tokens)
+            k = min(k, self.max_len - rows)
+        k = max(k, 1)
+        k = 1 << (k.bit_length() - 1)
+        if k == 1:
+            return {r: [t] for r, t in self.step_decode_once().items()}
+        active = np.zeros((self.n_slots + 1,), bool)
+        for s in self.slot_req:
+            active[s] = True
+        self.rng, sub = jax.random.split(self.rng)
+        self.cache, toks = self._decode_burst_fn(
+            self.params, self.cache, sub, jnp.asarray(active), k=k)
+        toks = np.asarray(toks)                    # [k, slots]
+        out: Dict[int, List[int]] = {}
+        for slot, req in list(self.slot_req.items()):
+            emitted = []
+            for i in range(k):
+                tok = int(toks[i, slot])
+                emitted.append(tok)
+                req.tokens.append(tok)
+                if self._req_finished(req, tok):
+                    self._retire(req)
+                    break
+            out[req.rid] = emitted
+        return out
+
+    def step_decode_once(self) -> Dict[int, int]:
+        """One single-token decode for all active slots (no admission)."""
+        if not self.slot_req:
+            return {}
+        active = np.zeros((self.n_slots + 1,), bool)
         for s in self.slot_req:
             active[s] = True
         self.rng, sub = jax.random.split(self.rng)
@@ -178,10 +273,10 @@ class InferenceEngine:
                 self._retire(req)
         return out
 
-    def run_to_completion(self) -> List[Request]:
+    def run_to_completion(self, max_burst: int = 8) -> List[Request]:
         """Drain all waiting + active requests; returns finished list."""
         while self.waiting or self.slot_req:
-            self.step()
+            self.step_burst(max_burst)
         return self.finished
 
     # -- convenience -------------------------------------------------------

@@ -95,3 +95,14 @@ def test_sampling_temperature_valid(cfg, params):
     out = e.generate([[1, 2, 3]], max_new_tokens=5)[0]
     assert len(out) == 5
     assert all(0 <= t < cfg.vocab_size for t in out)
+
+
+def test_oversized_prompt_rejected_at_submit(cfg, params):
+    e = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                            prompt_buckets=(16,))
+    with pytest.raises(ValueError):
+        e.add_request(list(range(17)), max_new_tokens=2)
+    # Engine is untouched: a valid request still goes through.
+    out = e.generate([[1, 2, 3]], max_new_tokens=2)[0]
+    assert len(out) == 2
+    assert len(e.free_slots) == 2
