@@ -62,8 +62,10 @@ def main() -> None:
                for _ in range(args.requests)]
 
     # Warmup: compile the full-wave admission program and the burst
-    # decode programs actually used by the measured run.
-    e.generate([prompts[0]] * args.slots, max_new_tokens=args.new_tokens)
+    # decode programs at the measured run's own burst size.
+    for p in [prompts[0]] * args.slots:
+        e.add_request(p, max_new_tokens=args.new_tokens)
+    e.run_to_completion(max_burst=args.max_burst)
     e.finished.clear()
 
     t0 = time.time()

@@ -148,18 +148,28 @@ class InferenceEngine:
         return req.rid
 
     def _admit(self) -> None:
-        if not (self.waiting and self.free_slots):
-            return
-        # One wave: as many waiting requests as there are free slots,
-        # padded up to the next power-of-two row count (dummy rows ->
-        # spare slot), so each (bucket, rows) pair compiles once and a
-        # single-request admission doesn't pay n_slots prefills.
-        wave: List[Request] = []
-        slots: List[int] = []
+        # Waves are grouped by prompt bucket (prefill is O(S^2): one
+        # long prompt must not drag every co-admitted short prompt up
+        # to its bucket), then padded to the next power-of-two row
+        # count (dummy rows -> spare slot) so each (bucket, rows) pair
+        # compiles exactly once.
         while self.waiting and self.free_slots:
-            wave.append(self.waiting.pop(0))
-            slots.append(self.free_slots.pop(0))
-        bucket = max(_bucket(len(r.prompt), self.buckets) for r in wave)
+            bucket = _bucket(len(self.waiting[0].prompt), self.buckets)
+            wave: List[Request] = []
+            slots: List[int] = []
+            rest: List[Request] = []
+            while self.waiting and self.free_slots:
+                req = self.waiting.pop(0)
+                if _bucket(len(req.prompt), self.buckets) == bucket:
+                    wave.append(req)
+                    slots.append(self.free_slots.pop(0))
+                else:
+                    rest.append(req)
+            self.waiting = rest + self.waiting
+            self._admit_wave(wave, slots, bucket)
+
+    def _admit_wave(self, wave: List["Request"], slots: List[int],
+                    bucket: int) -> None:
         n = 1 << (len(wave) - 1).bit_length() if len(wave) > 1 else 1
         tokens_b = np.zeros((n, bucket), np.int32)
         true_lens = np.ones((n,), np.int32)

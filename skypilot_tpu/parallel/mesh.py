@@ -82,3 +82,64 @@ def default_shape_for(n_devices: int, tp: int = 1, sp: int = 1,
         raise ValueError(f"{n_devices} devices not divisible by "
                          f"tp={tp} sp={sp} dp={dp} ep={ep} pp={pp}")
     return MeshShape(pp=pp, dp=dp, fsdp=rest, ep=ep, tp=tp, sp=sp)
+
+
+def make_multislice_mesh(shape: Optional[MeshShape | Dict[str, int]] = None,
+                         devices: Optional[Sequence[jax.Device]] = None,
+                         n_slices: Optional[int] = None) -> Mesh:
+    """Mesh spanning multiple TPU slices (multislice / DCN).
+
+    The ``dp`` axis indexes slices — gradient all-reduces ride DCN
+    between slices while every other axis (fsdp/ep/sp/tp + any
+    within-slice pp) stays on ICI inside a slice. This is the
+    scaling-book multislice recipe: data-parallel across slices,
+    model-parallel within.
+
+    Devices are grouped by ``slice_index`` (libtpu sets it on real
+    multislice); on single-slice/CPU backends pass ``n_slices`` to
+    split the device list into equal virtual slices for testing.
+
+    Reference parity: none — the reference has no multislice support at
+    all (SURVEY.md §2.3: "No queued-resources / multi-slice API —
+    north-star gap to build").
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    groups: Dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0) or 0,
+                          []).append(d)
+    if len(groups) == 1 and n_slices and n_slices > 1:
+        per = len(devices) // n_slices
+        if per * n_slices != len(devices):
+            raise ValueError(f"{len(devices)} devices not divisible "
+                             f"into {n_slices} slices")
+        groups = {i: devices[i * per:(i + 1) * per]
+                  for i in range(n_slices)}
+    slices = [groups[k] for k in sorted(groups)]
+    ns = len(slices)
+    per_slice = len(slices[0])
+    if any(len(s) != per_slice for s in slices):
+        raise ValueError("slices are not equally sized")
+
+    if shape is None:
+        shape = MeshShape(dp=ns, fsdp=per_slice)
+    elif isinstance(shape, dict):
+        shape = MeshShape(**{k: v for k, v in shape.items()
+                             if k in MESH_AXES})
+    if shape.dp != ns:
+        raise ValueError(
+            f"multislice mesh needs dp == n_slices ({ns}), got "
+            f"dp={shape.dp}")
+    within = shape.pp * shape.fsdp * shape.ep * shape.sp * shape.tp
+    if within != per_slice:
+        raise ValueError(
+            f"within-slice axes need {within} devices but each slice "
+            f"has {per_slice}")
+    # dev_array[pp, dp, fsdp, ep, sp, tp]: dp indexes the slice; all
+    # other dims range over that slice's own devices.
+    per_arrays = [
+        np.asarray(s).reshape(shape.pp, shape.fsdp, shape.ep, shape.sp,
+                              shape.tp)
+        for s in slices]
+    dev_array = np.stack(per_arrays, axis=1)
+    return Mesh(dev_array, MESH_AXES)

@@ -107,3 +107,30 @@ def test_dashboard_and_json_endpoints(api_server):
     jobs = json.loads(
         urllib.request.urlopen(f"{api_server}/api/jobs").read())
     assert isinstance(jobs, list)
+
+
+def test_server_concurrent_load(api_server):
+    """Load test: concurrent status requests + a burst of submissions
+    (reference analogue: tests/load_tests/test_load_on_server.py)."""
+    import concurrent.futures as cf
+    import json
+    import urllib.request
+
+    def get_status(_):
+        with urllib.request.urlopen(f"{api_server}/api/status",
+                                    timeout=30) as r:
+            return r.status
+
+    def submit(_):
+        body = json.dumps({"cluster_name": "nonexistent-xyz"}).encode()
+        req = urllib.request.Request(
+            f"{api_server}/status", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())["request_id"]
+
+    with cf.ThreadPoolExecutor(max_workers=16) as pool:
+        codes = list(pool.map(get_status, range(40)))
+        rids = list(pool.map(submit, range(10)))
+    assert all(c == 200 for c in codes)
+    assert len(set(rids)) == 10

@@ -106,3 +106,18 @@ def test_oversized_prompt_rejected_at_submit(cfg, params):
     out = e.generate([[1, 2, 3]], max_new_tokens=2)[0]
     assert len(out) == 2
     assert len(e.free_slots) == 2
+
+
+def test_mixed_bucket_admission(cfg, params):
+    """Prompts from different buckets admit in separate waves but all
+    decode correctly together."""
+    e = eng.InferenceEngine(params, cfg, n_slots=4, max_len=96,
+                            prompt_buckets=(8, 32))
+    short1, short2 = [1, 2, 3], [9, 8]
+    long1 = list(range(1, 21))
+    want_s1 = greedy_reference(params, cfg, short1, 4)
+    want_l1 = greedy_reference(params, cfg, long1, 4)
+    outs = e.generate([short1, long1, short2], max_new_tokens=4)
+    assert outs[0] == want_s1
+    assert outs[1] == want_l1
+    assert len(outs[2]) == 4

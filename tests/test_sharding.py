@@ -59,3 +59,29 @@ def test_sharded_matches_unsharded(mesh8, tiny_cfg):
 
     np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]),
                                rtol=2e-2)
+
+
+def test_multislice_mesh_virtual_slices(tiny_cfg):
+    """2 virtual slices x 4 devices: dp spans slices, train step runs."""
+    mesh = mesh_lib.make_multislice_mesh(
+        mesh_lib.MeshShape(dp=2, fsdp=2, tp=2), n_slices=2)
+    assert dict(mesh.shape)["dp"] == 2
+    # Slice 0's devices occupy dp index 0 exactly.
+    devs = jax.devices()
+    assert set(mesh.devices[:, 0].flat) == set(devs[:4])
+    assert set(mesh.devices[:, 1].flat) == set(devs[4:])
+
+    tc = trainer.TrainConfig(warmup_steps=1, total_steps=4)
+    state = trainer.create_train_state(tiny_cfg, tc, mesh)
+    step = trainer.make_train_step(tiny_cfg, tc, mesh)
+    _, metrics = step(state, trainer.synthetic_batch(tiny_cfg, 8, 32))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_multislice_mesh_validation():
+    with pytest.raises(ValueError):
+        mesh_lib.make_multislice_mesh(
+            mesh_lib.MeshShape(dp=3, fsdp=2), n_slices=2)
+    with pytest.raises(ValueError):
+        mesh_lib.make_multislice_mesh(
+            mesh_lib.MeshShape(dp=2, fsdp=3), n_slices=2)
