@@ -249,6 +249,7 @@ class TpuVmBackend:
             store = (spec if isinstance(spec, storage_lib.Storage)
                      else storage_lib.Storage.from_yaml_config(spec))
             store.sync_up()
+            state.add_storage(store.name, store.to_yaml_config())
             if not store.persistent:
                 cfg = store.to_yaml_config()
                 if cfg not in ephemeral:
@@ -421,7 +422,9 @@ class TpuVmBackend:
         for cfg in handle.get("ephemeral_storage", []):
             from skypilot_tpu.data import storage as storage_lib
             try:
-                storage_lib.Storage.from_yaml_config(cfg).delete()
+                store = storage_lib.Storage.from_yaml_config(cfg)
+                store.delete()
+                state.remove_storage(store.name)
             except Exception as e:  # noqa: BLE001 — teardown must proceed
                 print(f"WARNING: deleting ephemeral storage {cfg} "
                       f"failed: {e}", file=sys.stderr)

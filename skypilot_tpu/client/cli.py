@@ -401,6 +401,55 @@ def cost_report():
 
 
 @cli.group()
+def storage():
+    """Bucket storage objects created via storage_mounts."""
+
+
+@storage.command(name="ls")
+def storage_ls():
+    """List tracked storage objects."""
+    from skypilot_tpu import state as state_mod
+    fmt = "{:<28}{:<10}{:<12}{:<12}"
+    click.echo(fmt.format("NAME", "MODE", "PERSISTENT", "STORE"))
+    for s in state_mod.list_storage():
+        h = s["handle"]
+        src = h.get("source") or ""
+        scheme = src.split("://")[0] if "://" in src else "gs"
+        click.echo(fmt.format(
+            s["name"], h.get("mode", "-"),
+            str(h.get("persistent", True)), scheme))
+
+
+@storage.command(name="delete")
+@click.argument("names", nargs=-1, required=True)
+def storage_delete(names):
+    """Delete storage object(s): removes the bucket and the record."""
+    from skypilot_tpu import state as state_mod
+    from skypilot_tpu.data import storage as storage_lib
+    for name in names:
+        rec = state_mod.get_storage(name)
+        if rec is None:
+            click.echo(f"Storage {name!r} not found.", err=True)
+            continue
+        h = dict(rec["handle"])
+        h["persistent"] = False       # explicit delete overrides
+        src = h.get("source") or ""
+        if "://" in src:
+            # External bucket: we didn't create it, we don't delete it.
+            state_mod.remove_storage(name)
+            click.echo(f"Removed record for {name!r} (external bucket "
+                       f"{src} left intact).")
+            continue
+        try:
+            storage_lib.Storage.from_yaml_config(h).delete()
+        except Exception as e:  # noqa: BLE001
+            click.echo(f"Warning: deleting bucket for {name!r} failed: "
+                       f"{e}", err=True)
+        state_mod.remove_storage(name)
+        click.echo(f"Deleted storage {name!r}.")
+
+
+@cli.group()
 def bench():
     """Benchmark a task across candidate resources (cost/time)."""
 

@@ -149,7 +149,8 @@ class Storage:
                  source: Optional[str] = None,
                  mode: StorageMode = StorageMode.MOUNT,
                  store: str = "gs", persistent: bool = True,
-                 run: RunFn = _local_run):
+                 run: Optional[RunFn] = None):
+        run = run if run is not None else _local_run
         if name is None and source is None:
             raise exceptions.StorageError(
                 "storage needs a `name` (new bucket) or `source` "
@@ -178,7 +179,7 @@ class Storage:
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any],
-                         run: RunFn = _local_run) -> "Storage":
+                         run: Optional[RunFn] = None) -> "Storage":
         config = dict(config or {})
         mode = StorageMode(config.pop("mode", "MOUNT").upper())
         obj = cls(name=config.pop("name", None),

@@ -1,0 +1,194 @@
+"""HTTP model server: continuous-batching engine behind a stdlib server.
+
+This is what a SkyServe replica runs (see llm/serve-llama.yaml): the
+load balancer probes ``/health`` and proxies ``/generate``; the engine
+thread batches concurrent requests into shared decode bursts.
+
+Endpoints:
+  GET  /health              -> 200 {"status": "ok"} once warm
+  POST /generate            {"tokens": [...], "max_new_tokens": N}
+                            -> {"tokens": [...], "ttft_ms": ..., ...}
+
+Reference parity: the reference's serving recipes wrap external engines
+(reference: llm/vllm/serve.yaml, JetStream in examples/tpu/v6e) — this
+is the in-tree TPU-native equivalent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from socketserver import ThreadingMixIn
+from typing import Dict, Optional
+
+
+class _Pending:
+    def __init__(self):
+        self.event = threading.Event()
+        self.result: Optional[Dict] = None
+
+
+class ModelServer:
+    """Engine + request queue + batching loop."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._pending: Dict[int, _Pending] = {}
+        self._ready = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, tokens, max_new_tokens: int) -> Dict:
+        p = _Pending()
+        t0 = time.time()
+        with self._lock:
+            rid = self.engine.add_request(list(tokens), max_new_tokens)
+            self._pending[rid] = p
+        p.event.wait()
+        out = dict(p.result or {})
+        out["total_ms"] = round((time.time() - t0) * 1e3, 2)
+        return out
+
+    def _loop(self) -> None:
+        # Warm the compile path before /health flips: the load balancer
+        # must not route traffic into a cold XLA compile.
+        try:
+            self.engine.generate([[1]], max_new_tokens=2)
+            self.engine.finished.clear()
+        except Exception as e:  # noqa: BLE001
+            print(f"model server warmup failed: {e}", file=sys.stderr)
+        self._ready.set()
+        while not self._stop.is_set():
+            try:
+                busy = self._step()
+            except Exception as e:  # noqa: BLE001 — fail the in-flight
+                # requests loudly; never let the serving thread die
+                # while /health reports ok.
+                with self._lock:
+                    for p in self._pending.values():
+                        p.result = {"error": f"engine failure: {e}"}
+                        p.event.set()
+                    self._pending.clear()
+                busy = False
+            if not busy:
+                time.sleep(0.002)
+
+    def _step(self) -> bool:
+        with self._lock:
+            busy = bool(self.engine.waiting or self.engine.slot_req)
+            if not busy:
+                return False
+            self.engine.step_burst(max_burst=8)
+            for req in self.engine.finished:
+                p = self._pending.pop(req.rid, None)
+                if p is None:
+                    continue
+                ttft = ((req.first_token_s - req.submit_s) * 1e3
+                        if req.first_token_s is not None else None)
+                p.result = {
+                    "tokens": req.tokens,
+                    "ttft_ms": (round(ttft, 2)
+                                if ttft is not None else None),
+                }
+                p.event.set()
+            self.engine.finished.clear()
+        return True
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class _Threading(ThreadingMixIn, HTTPServer):
+    daemon_threads = True
+
+
+def make_handler(model: ModelServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _json(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                if model._ready.is_set():
+                    return self._json(200, {"status": "ok"})
+                return self._json(503, {"status": "warming"})
+            return self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._json(404, {"error": "not found"})
+            length = int(self.headers.get("Content-Length") or 0)
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+                tokens = [int(t) for t in body["tokens"]]
+                max_new = int(body.get("max_new_tokens", 64))
+            except (ValueError, TypeError, KeyError) as e:
+                return self._json(400, {"error": f"bad request: {e}"})
+            try:
+                out = model.submit(tokens, max_new)
+            except ValueError as e:      # oversized prompt etc.
+                return self._json(400, {"error": str(e)})
+            if "error" in out:
+                return self._json(500, out)
+            return self._json(200, out)
+
+        def log_message(self, *a):
+            pass
+
+    return Handler
+
+
+def serve(engine, host: str = "0.0.0.0", port: int = 8080):
+    model = ModelServer(engine)
+    httpd = _Threading((host, port), make_handler(model))
+    return model, httpd
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--max-len", type=int, default=1024)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    args = ap.parse_args()
+
+    import jax
+
+    from skypilot_tpu.infer import engine as eng, sampling
+    from skypilot_tpu.models import llama
+
+    on_cpu = jax.default_backend() == "cpu"
+    cfg = llama.CONFIGS[args.config or
+                        ("llama3-tiny" if on_cpu else "llama3-400m")]
+    params = llama.init_params(jax.random.key(0), cfg)
+    engine = eng.InferenceEngine(
+        params, cfg, n_slots=args.slots, max_len=args.max_len,
+        prompt_buckets=(128, min(512, args.max_len),
+                        args.max_len),
+        sampling_params=sampling.SamplingParams(
+            temperature=args.temperature))
+    model, httpd = serve(engine, port=args.port)
+    print(f"serving on :{args.port}", file=sys.stderr, flush=True)
+    try:
+        httpd.serve_forever()
+    finally:
+        model.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -136,3 +136,35 @@ def _row_to_record(row) -> Dict[str, Any]:
         "autostop_down": bool(ad),
         "price_per_hour": price,
     }
+
+
+# -- storage registry (reference: global_user_state storage table) ------
+
+def add_storage(name: str, handle: Dict[str, Any]) -> None:
+    with _db() as c:
+        c.execute(
+            "INSERT OR REPLACE INTO storage (name, handle, created_at)"
+            " VALUES (?,?,?)", (name, json.dumps(handle), int(time.time())))
+
+
+def list_storage() -> List[Dict[str, Any]]:
+    with _db() as c:
+        rows = c.execute(
+            "SELECT name, handle, created_at FROM storage").fetchall()
+    return [{"name": n, "handle": json.loads(h), "created_at": ca}
+            for n, h, ca in rows]
+
+
+def get_storage(name: str) -> Optional[Dict[str, Any]]:
+    with _db() as c:
+        row = c.execute("SELECT name, handle, created_at FROM storage"
+                        " WHERE name=?", (name,)).fetchone()
+    if row is None:
+        return None
+    return {"name": row[0], "handle": json.loads(row[1]),
+            "created_at": row[2]}
+
+
+def remove_storage(name: str) -> None:
+    with _db() as c:
+        c.execute("DELETE FROM storage WHERE name=?", (name,))

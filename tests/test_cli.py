@@ -77,3 +77,24 @@ def test_check(runner):
 def test_unknown_cluster_errors(runner):
     res = runner.invoke(cli_mod.cli, ["queue", "nope"])
     assert res.exit_code != 0
+
+
+def test_storage_ls_and_delete(runner, monkeypatch):
+    from skypilot_tpu import state
+    from skypilot_tpu.data import storage as storage_lib
+
+    state.add_storage("ckpts", {"name": "ckpts", "mode": "MOUNT",
+                                "persistent": True})
+    res = runner.invoke(cli_mod.cli, ["storage", "ls"])
+    assert res.exit_code == 0 and "ckpts" in res.output
+
+    deleted = []
+    monkeypatch.setattr(storage_lib, "_local_run",
+                        lambda cmd: (deleted.append(cmd) or (0, "")))
+    res = runner.invoke(cli_mod.cli, ["storage", "delete", "ckpts"])
+    assert res.exit_code == 0, res.output
+    assert any("rm -r gs://ckpts" in c for c in deleted)
+    assert state.get_storage("ckpts") is None
+
+    res = runner.invoke(cli_mod.cli, ["storage", "delete", "missing"])
+    assert "not found" in res.output

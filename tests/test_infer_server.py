@@ -1,0 +1,87 @@
+"""HTTP model server: health, generate, concurrency, bad input."""
+
+import json
+import socket
+import threading
+import urllib.request
+
+import jax
+import pytest
+
+from skypilot_tpu.infer import engine as eng
+from skypilot_tpu.infer import server as srv
+from skypilot_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def model_server():
+    cfg = llama.CONFIGS["llama3-tiny"]
+    params = llama.init_params(jax.random.key(0), cfg)
+    engine = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                                 prompt_buckets=(16,))
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    model, httpd = srv.serve(engine, host="127.0.0.1", port=port)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    assert model._ready.wait(timeout=300)  # warmup compile done
+    yield f"http://127.0.0.1:{port}", params, cfg
+    model.shutdown()
+    httpd.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_health(model_server):
+    url, _, _ = model_server
+    with urllib.request.urlopen(f"{url}/health", timeout=30) as r:
+        assert json.loads(r.read())["status"] == "ok"
+
+
+def test_generate_greedy_matches_engine(model_server):
+    url, params, cfg = model_server
+    prompt = [3, 17, 42]
+    solo = eng.InferenceEngine(params, cfg, n_slots=1, max_len=64,
+                               prompt_buckets=(16,))
+    want = solo.generate([prompt], max_new_tokens=5)[0]
+    code, out = _post(f"{url}/generate",
+                      {"tokens": prompt, "max_new_tokens": 5})
+    assert code == 200
+    assert out["tokens"] == want
+    assert out["ttft_ms"] is not None and out["total_ms"] > 0
+
+
+def test_concurrent_generates(model_server):
+    url, _, _ = model_server
+    results = {}
+
+    def one(i):
+        code, out = _post(f"{url}/generate",
+                          {"tokens": [i + 1, i + 2], "max_new_tokens": 4})
+        results[i] = (code, len(out.get("tokens", [])))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert all(results[i] == (200, 4) for i in range(4))
+
+
+def test_bad_requests(model_server):
+    url, _, _ = model_server
+    code, out = _post(f"{url}/generate", {"max_new_tokens": 4})
+    assert code == 400
+    code, out = _post(f"{url}/generate",
+                      {"tokens": list(range(99)), "max_new_tokens": 2})
+    assert code == 400  # prompt exceeds the largest bucket

@@ -1,0 +1,71 @@
+"""Optimizer correctness fuzzing: DP plan vs brute-force enumeration.
+
+Reference parity: tests/test_optimizer_random_dag.py (random DAGs,
+ILP/DP cost compared against brute force). Chains only here — the
+executable surface (see optimizer.optimize).
+"""
+
+import itertools
+import random
+
+import pytest
+
+from skypilot_tpu import dag as dag_lib, optimizer
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+def _chain(n_tasks, rng):
+    d = dag_lib.Dag()
+    tasks = []
+    accels = ["tpu-v5e-8", "tpu-v5e-16", "tpu-v4-8", "tpu-v5p-8", None]
+    prev = None
+    for i in range(n_tasks):
+        t = Task(name=f"t{i}", run="true")
+        cfg = {"accelerators": rng.choice(accels)}
+        if rng.random() < 0.3:
+            cfg["use_spot"] = True
+        t.set_resources(Resources.from_yaml_config(
+            {k: v for k, v in cfg.items() if v is not None}))
+        d.add(t)
+        if prev is not None:
+            d.add_edge(prev, t)
+        prev = t
+        tasks.append(t)
+    return d, tasks
+
+
+def _brute_force_cost(tasks, per_task):
+    best = None
+    for combo in itertools.product(*(per_task[t] for t in tasks)):
+        total = sum(c.cost for c in combo)
+        for a, b in zip(combo, combo[1:]):
+            total += optimizer._egress_cost(a.resources, b.resources)
+        if best is None or total < best:
+            best = total
+    return best
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_dp_matches_brute_force(seed):
+    rng = random.Random(seed)
+    d, tasks = _chain(rng.randint(1, 4), rng)
+    per_task = {t: optimizer._candidates_for(t, set()) for t in tasks}
+    # Keep brute force tractable.
+    per_task = {t: cands[:6] for t, cands in per_task.items()}
+
+    want = _brute_force_cost(tasks, per_task)
+
+    import unittest.mock as mock
+    with mock.patch.object(optimizer, "_candidates_for",
+                           side_effect=lambda t, b: per_task[t]):
+        plan = optimizer.optimize(d)
+    got = sum(
+        next(c.cost for c in per_task[t]
+             if c.resources is plan[t]) for t in tasks)
+    # DP must never be worse than brute force; equality unless egress
+    # terms made a non-greedy pick cheaper (DP includes them, the `got`
+    # sum here recomputes the same way).
+    for a, b in zip(tasks, tasks[1:]):
+        got += optimizer._egress_cost(plan[a], plan[b])
+    assert got == pytest.approx(want, rel=1e-9)
