@@ -1,0 +1,67 @@
+// Sequence packer: greedy first-fit packing of tokenized documents into
+// fixed [rows, cols] training batches with segment ids and restart
+// positions. C ABI, loaded from Python via ctypes
+// (skypilot_tpu/data/input_pipeline.py; pure-numpy fallback exists).
+//
+// The hot loop is trivial but runs per training batch on the host input
+// path; native keeps it off the Python interpreter the way the
+// reference leans on native code for its data path (reference:
+// third-party FUSE/Ray — SURVEY.md §0 "Performance-critical native
+// pieces are third-party").
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Pack documents into rows using greedy first-fit on remaining space.
+//
+//   tokens:    concatenated document tokens (int32)
+//   doc_lens:  per-document lengths (int64), n_docs entries
+//   out_tokens / out_segments / out_positions: [rows * cols] int32,
+//       pre-filled by caller with pad_id / 0 / 0.
+//   returns: number of documents placed (<= n_docs; the rest did not
+//       fit and should be carried into the next batch).
+int64_t pack_documents(const int32_t* tokens, const int64_t* doc_lens,
+                       int64_t n_docs, int32_t* out_tokens,
+                       int32_t* out_segments, int32_t* out_positions,
+                       int64_t rows, int64_t cols, int32_t pad_id) {
+  std::vector<int64_t> used(rows, 0);
+  std::vector<int32_t> next_segment(rows, 1);
+  int64_t offset = 0;
+  int64_t placed = 0;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    const int64_t len = doc_lens[d];
+    if (len > cols) {  // oversized docs must be pre-chunked by caller
+      offset += len;
+      ++placed;  // counted as consumed: dropping silently would stall
+      continue;
+    }
+    int64_t row = -1;
+    for (int64_t r = 0; r < rows; ++r) {
+      if (cols - used[r] >= len) {
+        row = r;
+        break;
+      }
+    }
+    if (row < 0) break;  // batch full: stop, carry the rest
+    int32_t* t = out_tokens + row * cols + used[row];
+    int32_t* s = out_segments + row * cols + used[row];
+    int32_t* p = out_positions + row * cols + used[row];
+    std::memcpy(t, tokens + offset, len * sizeof(int32_t));
+    const int32_t seg = next_segment[row]++;
+    for (int64_t i = 0; i < len; ++i) {
+      s[i] = seg;
+      p[i] = static_cast<int32_t>(i);
+    }
+    used[row] += len;
+    offset += len;
+    ++placed;
+  }
+  return placed;
+}
+
+}  // extern "C"

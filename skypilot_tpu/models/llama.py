@@ -197,7 +197,8 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _attention(q, k, v, cfg: LlamaConfig, mesh=None, rules=None):
+def _attention(q, k, v, cfg: LlamaConfig, mesh=None, rules=None,
+               segment_ids=None):
     """Grouped-query causal attention; dispatches to ops.attention.
 
     With a mesh whose sequence mesh-axis (per the activation rule table,
@@ -208,6 +209,21 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh=None, rules=None):
     hardcoded here.
     """
     from skypilot_tpu.ops import attention as attn_ops
+    if segment_ids is not None:
+        # Packed sequences: segment masking (XLA path; ring attention
+        # has no segment support — refuse loudly rather than silently
+        # materializing O(S^2) scores at context-parallel lengths).
+        if mesh is not None:
+            from skypilot_tpu.parallel import sharding as sh
+            r = rules if rules is not None else sh.ACT_RULES
+            seq_axis = r.get("seq")
+            if isinstance(seq_axis, str) and mesh.shape.get(seq_axis,
+                                                            1) > 1:
+                raise ValueError(
+                    "packed sequences (segment_ids) are not supported "
+                    "with sequence/context parallelism (sp > 1)")
+        return attn_ops.gqa_attention(q, k, v, causal=True,
+                                      segment_ids=segment_ids)
     if mesh is not None:
         from skypilot_tpu.parallel import ring_attention as ra
         from skypilot_tpu.parallel import sharding as sh
@@ -229,7 +245,7 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh=None, rules=None):
 def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
                   cos: jax.Array, sin: jax.Array,
                   constrain=lambda x, axes: x, mesh=None,
-                  rules=None) -> jax.Array:
+                  rules=None, segment_ids=None) -> jax.Array:
     """One pre-norm decoder block. x: [B, S, D]."""
     B, S, D = x.shape
     h = rms_norm(x, layer["ln1"], cfg.norm_eps)
@@ -240,7 +256,7 @@ def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
     k = apply_rope(k, cos, sin)
     q = constrain(q, ("batch", "seq", "heads", "head_dim"))
     k = constrain(k, ("batch", "seq", "kv_heads", "head_dim"))
-    o = _attention(q, k, v, cfg, mesh, rules)
+    o = _attention(q, k, v, cfg, mesh, rules, segment_ids)
     o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
     x = x + constrain(o, ("batch", "seq", "embed"))
 
@@ -257,19 +273,27 @@ def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
 # ---------------------------------------------------------------------------
 
 def forward_hidden(params: Params, tokens: jax.Array, cfg: LlamaConfig,
-                   constrain=None, mesh=None, rules=None) -> jax.Array:
-    """Token ids [B, S] -> final-norm hidden states [B, S, D] (cfg.dtype)."""
+                   constrain=None, mesh=None, rules=None,
+                   positions=None, segment_ids=None) -> jax.Array:
+    """Token ids [B, S] -> final-norm hidden states [B, S, D] (cfg.dtype).
+
+    ``positions`` [B, S] and ``segment_ids`` [B, S] enable packed
+    sequences (per-document rope restart + segment attention masking;
+    see data.input_pipeline).
+    """
     if constrain is None:
         constrain = lambda x, axes: x
 
     B, S = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = constrain(x, ("batch", "seq", "embed"))
-    positions = jnp.arange(S)
+    if positions is None:
+        positions = jnp.arange(S)
     cos, sin = rope_frequencies(cfg, positions)
 
     def body(carry, layer):
-        y = decoder_layer(cfg, carry, layer, cos, sin, constrain, mesh, rules)
+        y = decoder_layer(cfg, carry, layer, cos, sin, constrain, mesh,
+                          rules, segment_ids)
         return y, None
 
     if cfg.remat:
@@ -310,10 +334,28 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: LlamaConfig,
     if constrain is None:
         constrain = lambda x, axes: x
     tokens = batch["tokens"]
-    h = forward_hidden(params, tokens, cfg, constrain, mesh, rules)
-    loss, acc, denom = xent_metrics(params, h, tokens, batch.get("mask"),
-                                    cfg, constrain)
+    h = forward_hidden(params, tokens, cfg, constrain, mesh, rules,
+                       positions=batch.get("positions"),
+                       segment_ids=batch.get("segment_ids"))
+    loss, acc, denom = xent_metrics(params, h, tokens,
+                                    packed_loss_mask(batch), cfg,
+                                    constrain)
     return loss, {"loss": loss, "accuracy": acc, "tokens": denom}
+
+
+def packed_loss_mask(batch: Dict[str, jax.Array]):
+    """Loss mask honoring packed segments: only within-document
+    next-token transitions count (the last token of each segment has no
+    target). Returns batch["mask"] unchanged when not packed."""
+    mask = batch.get("mask")
+    seg = batch.get("segment_ids")
+    if seg is None:
+        return mask
+    same_next = (seg[:, :-1] == seg[:, 1:]) & (seg[:, :-1] > 0)
+    pad = jnp.zeros((seg.shape[0], 1), bool)
+    seg_mask = jnp.concatenate([same_next, pad], axis=1)
+    return (seg_mask if mask is None
+            else mask * seg_mask.astype(mask.dtype))
 
 
 def xent_metrics(params: Params, h: jax.Array, tokens: jax.Array,

@@ -208,7 +208,7 @@ def moe_ffn(cfg: MoEConfig, h: jax.Array, layer: Params,
 def decoder_layer(cfg: MoEConfig, x: jax.Array, layer: Params,
                   cos: jax.Array, sin: jax.Array,
                   constrain=lambda x, axes: x, mesh=None,
-                  rules=None) -> Tuple[jax.Array, jax.Array]:
+                  rules=None, segment_ids=None) -> Tuple[jax.Array, jax.Array]:
     """One pre-norm MoE decoder block. Returns (x, aux)."""
     h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
@@ -218,7 +218,7 @@ def decoder_layer(cfg: MoEConfig, x: jax.Array, layer: Params,
     k = llama.apply_rope(k, cos, sin)
     q = constrain(q, ("batch", "seq", "heads", "head_dim"))
     k = constrain(k, ("batch", "seq", "kv_heads", "head_dim"))
-    o = llama._attention(q, k, v, cfg, mesh, rules)
+    o = llama._attention(q, k, v, cfg, mesh, rules, segment_ids)
     o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
     x = x + constrain(o, ("batch", "seq", "embed"))
 
@@ -232,22 +232,28 @@ def decoder_layer(cfg: MoEConfig, x: jax.Array, layer: Params,
 # ---------------------------------------------------------------------------
 
 def forward_hidden(params: Params, tokens: jax.Array, cfg: MoEConfig,
-                   constrain=None, mesh=None,
-                   rules=None) -> Tuple[jax.Array, jax.Array]:
-    """[B, S] ids -> (final-norm hidden [B, S, D], mean aux loss)."""
+                   constrain=None, mesh=None, rules=None,
+                   positions=None,
+                   segment_ids=None) -> Tuple[jax.Array, jax.Array]:
+    """[B, S] ids -> (final-norm hidden [B, S, D], mean aux loss).
+
+    ``positions``/``segment_ids`` enable packed sequences, same as
+    models.llama.forward_hidden.
+    """
     if constrain is None:
         constrain = lambda x, axes: x
 
     B, S = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = constrain(x, ("batch", "seq", "embed"))
-    positions = jnp.arange(S)
+    if positions is None:
+        positions = jnp.arange(S)
     cos, sin = llama.rope_frequencies(cfg, positions)
 
     def body(carry, layer):
         x, aux_sum = carry
         y, aux = decoder_layer(cfg, x, layer, cos, sin, constrain, mesh,
-                               rules)
+                               rules, segment_ids)
         return (y, aux_sum + aux), None
 
     if cfg.remat:
@@ -282,9 +288,12 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: MoEConfig,
     if constrain is None:
         constrain = lambda x, axes: x
     tokens = batch["tokens"]
-    h, aux = forward_hidden(params, tokens, cfg, constrain, mesh, rules)
-    xent, acc, denom = llama.xent_metrics(params, h, tokens,
-                                          batch.get("mask"), cfg, constrain)
+    h, aux = forward_hidden(params, tokens, cfg, constrain, mesh, rules,
+                            positions=batch.get("positions"),
+                            segment_ids=batch.get("segment_ids"))
+    mask = llama.packed_loss_mask(batch)
+    xent, acc, denom = llama.xent_metrics(params, h, tokens, mask, cfg,
+                                          constrain)
     loss = xent + cfg.aux_loss_weight * aux
     return loss, {"loss": loss, "xent": xent, "aux_loss": aux,
                   "accuracy": acc, "tokens": denom}

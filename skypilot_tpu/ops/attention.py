@@ -36,8 +36,13 @@ def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
 
 
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                  causal: bool = True) -> jax.Array:
-    """Reference einsum attention. q: [B, S, H, D]; k/v: [B, S, H, D]."""
+                  causal: bool = True,
+                  segment_ids: jax.Array | None = None) -> jax.Array:
+    """Reference einsum attention. q: [B, S, H, D]; k/v: [B, S, H, D].
+
+    ``segment_ids`` [B, S] (0 = padding): packed-sequence masking —
+    a position only attends within its own segment.
+    """
     *_, d = q.shape
     scale = d ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
@@ -45,21 +50,31 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         s_q, s_k = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
         scores = jnp.where(mask, scores, -1e30)
+    if segment_ids is not None:
+        same = (segment_ids[:, None, :, None]
+                == segment_ids[:, None, None, :])   # [B, 1, Sq, Sk]
+        scores = jnp.where(same, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
 def gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                  causal: bool = True, impl: str = "auto") -> jax.Array:
+                  causal: bool = True, impl: str = "auto",
+                  segment_ids: jax.Array | None = None) -> jax.Array:
     """Grouped-query attention. q: [B, S, Hq, D]; k/v: [B, S, Hkv, D].
 
     impl: "auto" | "flash" | "xla" (env override: SKYTPU_ATTN_IMPL).
+    ``segment_ids`` (packed sequences) forces the XLA path — the flash
+    kernel has no segment masking yet.
     """
     import os
     impl = os.environ.get("SKYTPU_ATTN_IMPL", impl)
     n_rep = q.shape[2] // k.shape[2]
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
+    if segment_ids is not None:
+        return xla_attention(q, k, v, causal=causal,
+                             segment_ids=segment_ids)
     seq = q.shape[1]
     use_flash = (impl == "flash" or
                  (impl == "auto" and _on_tpu() and seq >= _FLASH_MIN_SEQ))

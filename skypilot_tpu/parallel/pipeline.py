@@ -187,6 +187,10 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     """
     if constrain is None:
         constrain = lambda x, axes: x
+    if batch.get("segment_ids") is not None:
+        raise ValueError(
+            "packed sequences (segment_ids) are not supported by the "
+            "pipeline adapter; use the llama or moe model")
     tokens = batch["tokens"]
     h = forward_hidden(params, tokens, cfg, constrain, mesh, rules)
     loss, acc, denom = llama.xent_metrics(params, h, tokens,

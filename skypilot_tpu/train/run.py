@@ -48,11 +48,19 @@ def main() -> None:
     ap.add_argument("--sp", type=int, default=1)
     ap.add_argument("--ep", type=int, default=1)
     ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--packed", action="store_true",
+                    help="packed-sequence input pipeline (segment-aware "
+                         "attention) over synthetic variable-length docs")
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--ckpt-every", type=int, default=100)
     ap.add_argument("--resume", action="store_true")
     ap.add_argument("--log-every", type=int, default=10)
     args = ap.parse_args()
+    if args.packed and args.model == "pipeline":
+        ap.error("--packed is not supported with --model pipeline")
+    if args.packed and args.sp > 1:
+        ap.error("--packed is not supported with --sp > 1 "
+                 "(ring attention has no segment masking)")
 
     # Multi-host: join the slice-wide jax.distributed rendezvous using
     # the runtime's env contract (runtime/constants.py) before touching
@@ -118,10 +126,32 @@ def main() -> None:
     if state is None:
         state = trainer.create_train_state(cfg, tc, mesh, model=model)
 
-    batch_data = trainer.synthetic_batch(cfg, batch, args.seq)
+    if args.packed:
+        import jax.numpy as jnp
+
+        from skypilot_tpu.data import input_pipeline as ip
+
+        def batch_stream():
+            seed = 0
+            while True:
+                docs = ip.synthetic_doc_stream(
+                    256, cfg.vocab_size, mean_len=args.seq // 3,
+                    seed=seed)
+                yield from ip.packed_batches(docs, batch, args.seq)
+                seed += 1
+
+        batches = ip.prefetch(
+            batch_stream(),
+            device_put=lambda b: {k: jnp.asarray(v)
+                                  for k, v in b.items()})
+    else:
+        batches = None
+        batch_data = trainer.synthetic_batch(cfg, batch, args.seq)
     sky_callback.init(total_steps=args.steps)
     t0 = time.time()
     for step in range(start_step, args.steps):
+        if batches is not None:
+            batch_data = next(batches)
         with sky_callback.step():
             state, metrics = step_fn(state, batch_data)
         if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
