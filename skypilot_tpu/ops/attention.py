@@ -64,25 +64,25 @@ def gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Grouped-query attention. q: [B, S, Hq, D]; k/v: [B, S, Hkv, D].
 
     impl: "auto" | "flash" | "xla" (env override: SKYTPU_ATTN_IMPL).
-    ``segment_ids`` (packed sequences) forces the XLA path — the flash
-    kernel has no segment masking yet.
+    ``segment_ids`` [B, S] (packed sequences) is supported on both
+    paths: the Pallas flash kernel masks segments in-block (lane-tiled
+    compare), the XLA fallback masks on the materialized scores.
     """
     import os
     impl = os.environ.get("SKYTPU_ATTN_IMPL", impl)
     n_rep = q.shape[2] // k.shape[2]
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
-    if segment_ids is not None:
-        return xla_attention(q, k, v, causal=causal,
-                             segment_ids=segment_ids)
     seq = q.shape[1]
     use_flash = (impl == "flash" or
                  (impl == "auto" and _on_tpu() and seq >= _FLASH_MIN_SEQ))
     if use_flash:
         try:
             from skypilot_tpu.ops import flash_attention as fa
-            return fa.flash_attention(q, k, v, causal=causal)
+            return fa.flash_attention(q, k, v, causal=causal,
+                                      segment_ids=segment_ids)
         except Exception:
             if impl == "flash":
                 raise
-    return xla_attention(q, k, v, causal=causal)
+    return xla_attention(q, k, v, causal=causal,
+                         segment_ids=segment_ids)

@@ -29,7 +29,8 @@ from jax.experimental import pallas as pl
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
-LANES = 128  # lane-replicated rowwise stats (Mosaic tiling)
+LANES = 128     # lane-replicated rowwise stats (Mosaic tiling)
+SUBLANES = 8    # kv-side segment-id layout: [B, SUBLANES, S]
 NEG_INF = -1e30
 
 
@@ -37,12 +38,26 @@ def _blocks(s: int, b: int) -> int:
     return (s + b - 1) // b
 
 
+def _segment_mask(qseg_tile, kseg_ref, ki, block_k):
+    """[Bq, Bk] same-segment mask.
+
+    qseg_tile: [Bq, LANES] lane-replicated q segment ids;
+    kseg_ref: [SUBLANES, S] ref with the seq dim in lanes (the official
+    TPU layout trick — equality broadcasts [Bq, Bk] == [1, Bk] without
+    any in-kernel transpose).
+    """
+    q_seg = jnp.tile(qseg_tile, (1, block_k // LANES))      # [Bq, Bk]
+    k_seg = kseg_ref[:1, pl.ds(ki * block_k, block_k)]      # [1, Bk]
+    return q_seg == k_seg
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
-                causal: bool, block_k: int, seq_len: int):
+                causal: bool, block_k: int, seq_len: int,
+                qseg_ref=None, kseg_ref=None):
     qi = pl.program_id(2)
     block_q = q_ref.shape[0]
     q = q_ref[...].astype(jnp.float32) * scale  # [Bq, D]
@@ -67,6 +82,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
             k_pos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if qseg_ref is not None:
+            s = jnp.where(_segment_mask(qseg_ref[...], kseg_ref, ki,
+                                        block_k), s, NEG_INF)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
@@ -88,33 +106,60 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape)
 
 
-def _fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
+def _seg_layouts(segments, s):
+    """[B, S] int32 -> (q-side [B, S, LANES], kv-side [B, SUBLANES, S])."""
+    qseg = jax.lax.broadcast_in_dim(
+        segments, (segments.shape[0], s, LANES), (0, 1))
+    kseg = jax.lax.broadcast_in_dim(
+        segments, (segments.shape[0], SUBLANES, s), (0, 2))
+    return qseg, kseg
+
+
+def _fwd(q, k, v, segments, *, causal: bool, block_q: int, block_k: int,
          interpret: bool):
-    """q,k,v: [B, H, S, D] -> (o [B,H,S,D], lse [B,H,S,1] f32)."""
+    """q,k,v: [B, H, S, D]; segments: [B, S] int32 or None
+    -> (o [B,H,S,D], lse [B,H,S,LANES] f32)."""
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     grid = (b, h, _blocks(s, block_q))
     qspec = pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi: (bi, hi, qi, 0))
     kvspec = pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0))
+    in_specs = [qspec, kvspec, kvspec]
+    args = [q, k, v]
+    if segments is not None:
+        qseg, kseg = _seg_layouts(segments, s)
+        in_specs += [
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bi, hi, qi: (bi, qi, 0)),
+            pl.BlockSpec((1, SUBLANES, s), lambda bi, hi, qi: (bi, 0, 0)),
+        ]
+        args += [qseg, kseg]
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+    def kernel(q_ref, k_ref, v_ref, *rest):
+        if segments is not None:
+            qseg_ref, kseg_ref, o_ref, lse_ref = rest
+            segrefs = dict(qseg_ref=qseg_ref.at[0],
+                           kseg_ref=kseg_ref.at[0])
+        else:
+            o_ref, lse_ref = rest
+            segrefs = {}
         _fwd_kernel(q_ref.at[0, 0], k_ref.at[0, 0], v_ref.at[0, 0],
                     o_ref.at[0, 0], lse_ref.at[0, 0],
                     scale=scale, causal=causal, block_k=block_k,
-                    seq_len=s)
+                    seq_len=s, **segrefs)
 
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[qspec, kvspec, kvspec],
+        in_specs=in_specs,
         out_specs=[qspec,
                    pl.BlockSpec((1, 1, block_q, LANES),
                                 lambda bi, hi, qi: (bi, hi, qi, 0))],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct((b, h, s, LANES), jnp.float32)],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
@@ -124,7 +169,8 @@ def _fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale: float, causal: bool,
-                    block_q: int, seq_len: int):
+                    block_q: int, seq_len: int,
+                    qseg_ref=None, kseg_ref=None):
     ki = pl.program_id(2)
     block_k = k_ref.shape[0]
     k = k_ref[...].astype(jnp.float32)
@@ -150,6 +196,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = ki * k.shape[0] + lax.broadcasted_iota(
                 jnp.int32, (block_q, k.shape[0]), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if qseg_ref is not None:
+            # kseg_ref here is the ki-blocked tile [SUBLANES, Bk]: the
+            # kv index inside _segment_mask must be 0.
+            qs = qseg_ref[pl.ds(qi * block_q, block_q), :]
+            s = jnp.where(_segment_mask(qs, kseg_ref, 0, block_k), s,
+                          NEG_INF)
         p = jnp.exp(s - lse)  # [Bq, Bk]
         do_f = do.astype(jnp.float32)
         dv_new = dv + jax.lax.dot_general(
@@ -173,7 +225,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, *, scale: float, causal: bool, block_k: int,
-                   seq_len: int):
+                   seq_len: int, qseg_ref=None, kseg_ref=None):
     qi = pl.program_id(2)
     block_q = q_ref.shape[0]
     q = q_ref[...].astype(jnp.float32) * scale
@@ -196,6 +248,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if qseg_ref is not None:
+            s = jnp.where(_segment_mask(qseg_ref[...], kseg_ref, ki,
+                                        block_k), s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
@@ -211,7 +266,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v, o, lse = residuals
+    q, k, v, segments, o, lse = residuals
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     # delta = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it well.
@@ -227,21 +282,38 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
     seq_full_d = pl.BlockSpec((1, 1, s, d), full)
     seq_full_1 = pl.BlockSpec((1, 1, s, LANES), full)
 
+    seg_args, dkv_seg_specs, dq_seg_specs = [], [], []
+    if segments is not None:
+        qseg, kseg = _seg_layouts(segments, s)
+        seg_args = [qseg, kseg]
+        dkv_seg_specs = [
+            pl.BlockSpec((1, s, LANES), lambda bi, hi, ki: (bi, 0, 0)),
+            pl.BlockSpec((1, SUBLANES, block_k),
+                         lambda bi, hi, ki: (bi, 0, ki)),
+        ]
+        dq_seg_specs = [
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bi, hi, qi: (bi, qi, 0)),
+            pl.BlockSpec((1, SUBLANES, s), lambda bi, hi, qi: (bi, 0, 0)),
+        ]
+
     dkv_kernel = functools.partial(
-        _pack_dkv, scale=scale, causal=causal, block_q=block_q, seq_len=s)
+        _pack_dkv, scale=scale, causal=causal, block_q=block_q, seq_len=s,
+        with_segments=segments is not None)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b, h, _blocks(s, block_k)),
         in_specs=[seq_full_d, kv_blocked, kv_blocked, seq_full_d,
-                  seq_full_1, seq_full_1],
+                  seq_full_1, seq_full_1, *dkv_seg_specs],
         out_specs=[kv_blocked, kv_blocked],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse, delta, *seg_args)
 
     dq_kernel = functools.partial(
-        _pack_dq, scale=scale, causal=causal, block_k=block_k, seq_len=s)
+        _pack_dq, scale=scale, causal=causal, block_k=block_k, seq_len=s,
+        with_segments=segments is not None)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, h, _blocks(s, block_q)),
@@ -249,23 +321,34 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
                   pl.BlockSpec((1, 1, block_q, LANES),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
                   pl.BlockSpec((1, 1, block_q, LANES),
-                               lambda bi, hi, qi: (bi, hi, qi, 0))],
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+                  *dq_seg_specs],
         out_specs=q_blocked,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
-    return dq, dk, dv
+    )(q, k, v, g, lse, delta, *seg_args)
+    return dq, dk, dv, None
 
 
-def _pack_dkv(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-              dv_ref, **kw):
+def _pack_dkv(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+              with_segments, **kw):
+    if with_segments:
+        qseg_ref, kseg_ref, dk_ref, dv_ref = rest
+        kw.update(qseg_ref=qseg_ref.at[0], kseg_ref=kseg_ref.at[0])
+    else:
+        dk_ref, dv_ref = rest
     _bwd_dkv_kernel(q_ref.at[0, 0], k_ref.at[0, 0], v_ref.at[0, 0],
                     do_ref.at[0, 0], lse_ref.at[0, 0], delta_ref.at[0, 0],
                     dk_ref.at[0, 0], dv_ref.at[0, 0], **kw)
 
 
-def _pack_dq(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-             **kw):
+def _pack_dq(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+             with_segments, **kw):
+    if with_segments:
+        qseg_ref, kseg_ref, dq_ref = rest
+        kw.update(qseg_ref=qseg_ref.at[0], kseg_ref=kseg_ref.at[0])
+    else:
+        (dq_ref,) = rest
     _bwd_dq_kernel(q_ref.at[0, 0], k_ref.at[0, 0], v_ref.at[0, 0],
                    do_ref.at[0, 0], lse_ref.at[0, 0], delta_ref.at[0, 0],
                    dq_ref.at[0, 0], **kw)
@@ -275,17 +358,17 @@ def _pack_dq(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 # Public API with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-                interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, segments, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, segments, causal=causal, block_q=block_q,
+                block_k=block_k, interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal=causal, block_q=block_q,
+def _flash_fwd(q, k, v, segments, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, segments, causal=causal, block_q=block_q,
                   block_k=block_k, interpret=interpret)
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, segments, o, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
@@ -296,12 +379,15 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True,
+                    segment_ids=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False):
     """q, k, v: [B, S, H, D] (same layout as ops.attention) -> [B, S, H, D].
 
     K/V must already be GQA-expanded to H heads (ops.attention does it).
+    ``segment_ids`` [B, S] int32 enables packed-sequence masking (needs
+    block_k to be a multiple of 128 for the lane-tiled compare).
     """
     b, s, h, d = q.shape
     block_q = min(block_q, s)
@@ -309,7 +395,14 @@ def flash_attention(q, k, v, causal: bool = True,
     if s % block_q or s % block_k:
         raise ValueError(f"seq len {s} must be divisible by block sizes "
                          f"({block_q}, {block_k})")
+    if segment_ids is not None:
+        if block_k % LANES:
+            raise ValueError(
+                f"segment masking needs block_k % {LANES} == 0, got "
+                f"{block_k}")
+        segment_ids = segment_ids.astype(jnp.int32)
     # [B,S,H,D] -> [B,H,S,D] for the kernels.
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-    o = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    o = _flash(qt, kt, vt, segment_ids, causal, block_q, block_k,
+               interpret)
     return o.swapaxes(1, 2)
