@@ -1,0 +1,228 @@
+"""Vision Transformer (ViT) classifier, pure functional JAX.
+
+The non-LLM model family: patchify -> encoder stack -> CLS head. Same
+TPU-first conventions as models.llama — stacked per-layer weights under
+one ``lax.scan``, logical sharding axes resolved by
+``parallel.sharding``, bf16 compute / fp32 params, per-layer remat.
+Patchify is a single conv-as-matmul (unfold + einsum) so the whole
+model is MXU matmuls.
+
+Plugs into train.trainer via the same init_params /
+param_logical_axes / loss_fn surface (batch: {"images": [B,H,W,C],
+"labels": [B]}).
+
+Reference parity: the reference ships vision training only as external
+workload recipes (reference: examples/resnet_distributed_torch.yaml,
+examples/torch_ddp_benchmark/ — torch DDP). In-tree equivalent per
+SURVEY.md §2.11.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.models import llama
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    channels: int = 3
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    remat_policy: str = "none"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        d, ff = self.d_model, self.d_ff
+        attn = 4 * d * d
+        mlp = 2 * d * ff + ff + d      # weights + b_up + b_down
+        per_layer = attn + mlp + 4 * d  # + ln scales/biases
+        patch = self.patch_size ** 2 * self.channels * d + d
+        return (self.n_layers * per_layer + patch
+                + (self.n_patches + 1) * d        # pos emb
+                + 3 * d                           # cls token + final ln
+                + d * self.num_classes + self.num_classes)
+
+
+CONFIGS: Dict[str, ViTConfig] = {
+    "vit-b16": ViTConfig(),
+    "vit-s16": ViTConfig(d_model=384, n_layers=12, n_heads=6, d_ff=1536),
+    "vit-tiny": ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                          d_model=64, n_layers=2, n_heads=4, d_ff=128),
+}
+
+
+def init_params(rng: jax.Array, cfg: ViTConfig) -> Params:
+    d, ff, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    p = cfg.patch_size ** 2 * cfg.channels
+    k = iter(jax.random.split(rng, 12))
+
+    def init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, cfg.param_dtype)
+                * (fan_in ** -0.5))
+
+    return {
+        "patch_embed": init(next(k), (p, d), p),
+        "patch_bias": jnp.zeros((d,), cfg.param_dtype),
+        "pos_embed": jax.random.normal(
+            next(k), (cfg.n_patches + 1, d), cfg.param_dtype) * 0.02,
+        "cls_token": jnp.zeros((d,), cfg.param_dtype),
+        "blocks": {
+            "ln1": jnp.ones((L, d), cfg.param_dtype),
+            "ln1_b": jnp.zeros((L, d), cfg.param_dtype),
+            "wqkv": init(next(k), (L, d, 3, cfg.n_heads, cfg.head_dim),
+                         d),
+            "wo": init(next(k), (L, cfg.n_heads, cfg.head_dim, d), d),
+            "ln2": jnp.ones((L, d), cfg.param_dtype),
+            "ln2_b": jnp.zeros((L, d), cfg.param_dtype),
+            "w_up": init(next(k), (L, d, ff), d),
+            "b_up": jnp.zeros((L, ff), cfg.param_dtype),
+            "w_down": init(next(k), (L, ff, d), ff),
+            "b_down": jnp.zeros((L, d), cfg.param_dtype),
+        },
+        "final_ln": jnp.ones((d,), cfg.param_dtype),
+        "final_ln_b": jnp.zeros((d,), cfg.param_dtype),
+        "head": init(next(k), (d, cfg.num_classes), d),
+        "head_b": jnp.zeros((cfg.num_classes,), cfg.param_dtype),
+    }
+
+
+def param_logical_axes(cfg: ViTConfig) -> Params:
+    return {
+        "patch_embed": ("patch", "embed"),
+        "patch_bias": ("embed",),
+        "pos_embed": ("seq_static", "embed"),
+        "cls_token": ("embed",),
+        "blocks": {
+            "ln1": ("layer", "embed"),
+            "ln1_b": ("layer", "embed"),
+            "wqkv": ("layer", "embed", None, "heads", "head_dim"),
+            "wo": ("layer", "heads", "head_dim", "embed"),
+            "ln2": ("layer", "embed"),
+            "ln2_b": ("layer", "embed"),
+            "w_up": ("layer", "embed", "mlp"),
+            "b_up": ("layer", "mlp"),
+            "w_down": ("layer", "mlp", "embed"),
+            "b_down": ("layer", "embed"),
+        },
+        "final_ln": ("embed",),
+        "final_ln_b": ("embed",),
+        "head": ("embed", "vocab"),
+        "head_b": ("vocab",),
+    }
+
+
+def _layer_norm(x, scale, bias, eps):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    out = (x - mu) * lax.rsqrt(var + eps)
+    return out.astype(dtype) * scale.astype(dtype) + bias.astype(dtype)
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] -> [B, n_patches, p*p*C] (unfold, no conv op)."""
+    B, H, W, C = images.shape
+    p = cfg.patch_size
+    x = images.reshape(B, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, (H // p) * (W // p), p * p * C)
+
+
+def encoder_layer(cfg: ViTConfig, x: jax.Array, layer: Params,
+                  constrain=lambda x, axes: x) -> jax.Array:
+    h = _layer_norm(x, layer["ln1"], layer["ln1_b"], cfg.norm_eps)
+    qkv = jnp.einsum("bsd,dthk->tbshk", h,
+                     layer["wqkv"].astype(cfg.dtype))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    q = constrain(q, ("batch", "seq", "heads", "head_dim"))
+    from skypilot_tpu.ops import attention as attn_ops
+    o = attn_ops.gqa_attention(q, k, v, causal=False)
+    o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
+    x = x + constrain(o, ("batch", "seq", "embed"))
+
+    h = _layer_norm(x, layer["ln2"], layer["ln2_b"], cfg.norm_eps)
+    u = (jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+         + layer["b_up"].astype(cfg.dtype))
+    m = (jnp.einsum("bsf,fd->bsd", jax.nn.gelu(u),
+                    layer["w_down"].astype(cfg.dtype))
+         + layer["b_down"].astype(cfg.dtype))
+    return x + constrain(m, ("batch", "seq", "embed"))
+
+
+def forward(params: Params, images: jax.Array, cfg: ViTConfig,
+            constrain=None, mesh=None, rules=None) -> jax.Array:
+    """[B, H, W, C] float images -> logits [B, num_classes] fp32."""
+    if constrain is None:
+        constrain = lambda x, axes: x
+    x = patchify(images.astype(cfg.dtype), cfg)
+    x = (jnp.einsum("bsp,pd->bsd", x,
+                    params["patch_embed"].astype(cfg.dtype))
+         + params["patch_bias"].astype(cfg.dtype))
+    cls = jnp.broadcast_to(params["cls_token"].astype(cfg.dtype),
+                           (x.shape[0], 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)
+    x = constrain(x, ("batch", "seq", "embed"))
+
+    def body(carry, layer):
+        return encoder_layer(cfg, carry, layer, constrain), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body, policy=llama.remat_policy(cfg))
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _layer_norm(x, params["final_ln"], params["final_ln_b"],
+                    cfg.norm_eps)
+    logits = (x[:, 0] @ params["head"].astype(cfg.dtype)
+              + params["head_b"].astype(cfg.dtype))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: ViTConfig,
+            constrain=None, mesh=None,
+            rules=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Softmax cross-entropy. batch: {"images", "labels"}."""
+    logits = forward(params, batch["images"], cfg, constrain, mesh, rules)
+    labels = batch["labels"]
+    logps = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logps, labels[:, None], axis=-1)[:, 0]
+    loss = -jnp.mean(ll)
+    acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+    return loss, {"loss": loss, "accuracy": acc,
+                  "tokens": jnp.asarray(labels.shape[0], jnp.float32)}
+
+
+def synthetic_batch(cfg: ViTConfig, batch_size: int,
+                    seed: int = 0) -> Dict[str, jax.Array]:
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    return {
+        "images": jax.random.normal(
+            k1, (batch_size, cfg.image_size, cfg.image_size,
+                 cfg.channels), jnp.float32),
+        "labels": jax.random.randint(k2, (batch_size,), 0,
+                                     cfg.num_classes, dtype=jnp.int32),
+    }

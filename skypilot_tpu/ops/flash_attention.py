@@ -330,13 +330,19 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
     return dq, dk, dv, None
 
 
+def _split_seg_refs(rest, with_segments, kw):
+    """Shared unpack for the optional trailing (qseg, kseg) refs: the
+    segment refs, when present, precede the output refs in ``rest``."""
+    if with_segments:
+        qseg_ref, kseg_ref, *outs = rest
+        kw = dict(kw, qseg_ref=qseg_ref.at[0], kseg_ref=kseg_ref.at[0])
+        return outs, kw
+    return list(rest), kw
+
+
 def _pack_dkv(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
               with_segments, **kw):
-    if with_segments:
-        qseg_ref, kseg_ref, dk_ref, dv_ref = rest
-        kw.update(qseg_ref=qseg_ref.at[0], kseg_ref=kseg_ref.at[0])
-    else:
-        dk_ref, dv_ref = rest
+    (dk_ref, dv_ref), kw = _split_seg_refs(rest, with_segments, kw)
     _bwd_dkv_kernel(q_ref.at[0, 0], k_ref.at[0, 0], v_ref.at[0, 0],
                     do_ref.at[0, 0], lse_ref.at[0, 0], delta_ref.at[0, 0],
                     dk_ref.at[0, 0], dv_ref.at[0, 0], **kw)
@@ -344,11 +350,7 @@ def _pack_dkv(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 def _pack_dq(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
              with_segments, **kw):
-    if with_segments:
-        qseg_ref, kseg_ref, dq_ref = rest
-        kw.update(qseg_ref=qseg_ref.at[0], kseg_ref=kseg_ref.at[0])
-    else:
-        (dq_ref,) = rest
+    (dq_ref,), kw = _split_seg_refs(rest, with_segments, kw)
     _bwd_dq_kernel(q_ref.at[0, 0], k_ref.at[0, 0], v_ref.at[0, 0],
                    do_ref.at[0, 0], lse_ref.at[0, 0], delta_ref.at[0, 0],
                    dq_ref.at[0, 0], **kw)
@@ -398,8 +400,10 @@ def flash_attention(q, k, v, causal: bool = True,
     if segment_ids is not None:
         if block_k % LANES:
             raise ValueError(
-                f"segment masking needs block_k % {LANES} == 0, got "
-                f"{block_k}")
+                f"segment masking needs the kv block to be a multiple "
+                f"of {LANES} lanes; effective block_k is {block_k} "
+                f"(seq len {s} — pad the sequence to a multiple of "
+                f"{LANES})")
         segment_ids = segment_ids.astype(jnp.int32)
     # [B,S,H,D] -> [B,H,S,D] for the kernels.
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
