@@ -401,6 +401,133 @@ def cost_report():
 
 
 @cli.group()
+def api():
+    """The local API server (async request execution + dashboard)."""
+
+
+def _api_url() -> str:
+    from skypilot_tpu.client import sdk as sdk_mod
+    return sdk_mod._url()
+
+
+def _api_pid_file() -> str:
+    from skypilot_tpu.utils import paths
+    return os.path.join(paths.home(), "api_server.pid")
+
+
+@api.command(name="start")
+@click.option("--port", type=int, default=None)
+def api_start(port):
+    """Start the API server (no-op if one is already running)."""
+    from skypilot_tpu.client import sdk as sdk_mod
+    info = sdk_mod.api_start(port)
+    click.echo(f"API server healthy at {_api_url()} "
+               f"(version {info.get('version', '?')}); dashboard at "
+               f"{_api_url()}/dashboard")
+
+
+@api.command(name="stop")
+def api_stop():
+    """Stop the background API server."""
+    import signal
+    pid = None
+    try:
+        with open(_api_pid_file()) as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        click.echo("No running API server found.", err=True)
+        return
+    try:
+        os.kill(pid, signal.SIGTERM)
+        click.echo(f"Stopped API server (pid {pid}).")
+    except ProcessLookupError:
+        click.echo("API server already gone; cleaned up stale record.",
+                   err=True)
+    finally:
+        try:
+            os.remove(_api_pid_file())
+        except OSError:
+            pass
+
+
+@api.command(name="info")
+def api_info():
+    """Health/version of the API server."""
+    import json
+    import urllib.request
+    try:
+        with urllib.request.urlopen(f"{_api_url()}/api/health",
+                                    timeout=5) as r:
+            info = json.loads(r.read())
+        click.echo(f"API server at {_api_url()}: {info['status']} "
+                   f"(version {info.get('version', '?')})")
+    except OSError:
+        click.echo(f"API server at {_api_url()} is not reachable.",
+                   err=True)
+        sys.exit(1)
+
+
+def _api_unreachable() -> None:
+    click.echo(f"API server at {_api_url()} is not reachable "
+               f"(try `api start`).", err=True)
+    sys.exit(1)
+
+
+@api.command(name="status")
+def api_status():
+    """List recent API requests."""
+    import json
+    import urllib.request
+    try:
+        with urllib.request.urlopen(f"{_api_url()}/api/status",
+                                    timeout=10) as r:
+            rows = json.loads(r.read())
+    except OSError:
+        return _api_unreachable()
+    fmt = "{:<14}{:<18}{:<12}"
+    click.echo(fmt.format("REQUEST", "OP", "STATUS"))
+    for row in rows[-30:]:
+        click.echo(fmt.format(row["request_id"][:12], row["name"],
+                              row["status"]))
+
+
+@api.command(name="cancel")
+@click.argument("request_id")
+def api_cancel(request_id):
+    """Cancel an in-flight API request."""
+    import json
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        f"{_api_url()}/api/cancel",
+        data=json.dumps({"request_id": request_id}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        click.echo(f"Error: {e.read().decode()[:200]}", err=True)
+        sys.exit(1)
+    except OSError:
+        return _api_unreachable()
+    click.echo(f"Cancelled request {request_id}.")
+
+
+@api.command(name="logs")
+@click.argument("request_id")
+def api_logs(request_id):
+    """Stream a request's log."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"{_api_url()}/api/stream?request_id={request_id}",
+                timeout=30) as r:
+            click.echo(r.read().decode())
+    except OSError:
+        return _api_unreachable()
+
+
+@cli.group()
 def storage():
     """Bucket storage objects created via storage_mounts."""
 

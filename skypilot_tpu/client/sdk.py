@@ -182,11 +182,13 @@ def api_start(port: Optional[int] = None, wait: float = 15) -> Dict[str, Any]:
         return info
     log = os.path.join(paths.logs_dir(), "api_server.log")
     with open(log, "ab") as f:
-        subprocess.Popen(
+        proc = subprocess.Popen(
             [sys.executable, "-m", "skypilot_tpu.server.server",
              "--port", str(port)],
             stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
             env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
+    with open(os.path.join(paths.home(), "api_server.pid"), "w") as f:
+        f.write(str(proc.pid))
     deadline = time.time() + wait
     while time.time() < deadline:
         info = api_info()

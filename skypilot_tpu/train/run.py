@@ -147,6 +147,13 @@ def main() -> None:
     else:
         batches = None
         batch_data = trainer.synthetic_batch(cfg, batch, args.seq)
+    if start_step >= args.steps:
+        log(f"checkpoint already at step {start_step}; nothing to train")
+        if mgr:
+            mgr.close()
+        print(json.dumps({"steps": 0, "resumed_step": start_step,
+                          "mesh": shape.as_dict()}))
+        return
     sky_callback.init(total_steps=args.steps)
     t0 = time.time()
     for step in range(start_step, args.steps):
@@ -162,7 +169,8 @@ def main() -> None:
     loss = float(metrics["loss"])  # host fetch = real sync
     wall = time.time() - t0
     if mgr:
-        mgr.save(args.steps, state, force=True)
+        if mgr.latest_step() != args.steps:
+            mgr.save(args.steps, state, force=True)
         mgr.wait()
         mgr.close()
     tokens_per_s = batch * args.seq * (args.steps - start_step) / wall

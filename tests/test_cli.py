@@ -98,3 +98,33 @@ def test_storage_ls_and_delete(runner, monkeypatch):
 
     res = runner.invoke(cli_mod.cli, ["storage", "delete", "missing"])
     assert "not found" in res.output
+
+
+def test_api_lifecycle(runner, tmp_path, monkeypatch):
+    """api start -> info -> status -> stop against a real subprocess."""
+    import socket
+    import time as time_mod
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    monkeypatch.setenv("SKYTPU_API_SERVER_URL", f"http://127.0.0.1:{port}")
+
+    res = runner.invoke(cli_mod.cli, ["api", "start", "--port", str(port)])
+    assert res.exit_code == 0, res.output
+    try:
+        deadline = time_mod.time() + 30
+        while time_mod.time() < deadline:
+            res = runner.invoke(cli_mod.cli, ["api", "info"])
+            if res.exit_code == 0:
+                break
+            time_mod.sleep(0.5)
+        assert res.exit_code == 0, res.output
+        assert "healthy" in res.output
+
+        res = runner.invoke(cli_mod.cli, ["api", "status"])
+        assert res.exit_code == 0, res.output
+        assert "REQUEST" in res.output
+    finally:
+        res = runner.invoke(cli_mod.cli, ["api", "stop"])
+    assert "Stopped" in res.output

@@ -73,3 +73,28 @@ def test_collectives_bench_smoke():
     assert out["all_reduce"]["algbw_gbps"] > 0
     assert out["all_gather"]["time_ms"] > 0
     assert out["ppermute"]["time_ms"] > 0
+
+
+def test_evaluate_cli_smoke(tmp_path):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    # Train 2 steps with a checkpoint, then evaluate from it.
+    proc = subprocess.run(
+        [sys.executable, "-m", "skypilot_tpu.train.run",
+         "--config", "llama3-tiny", "--steps", "2", "--seq", "64",
+         "--ckpt-dir", str(tmp_path / "ck"), "--ckpt-every", "2"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    proc = subprocess.run(
+        [sys.executable, "-m", "skypilot_tpu.train.evaluate",
+         "--config", "llama3-tiny", "--seq", "64", "--batches", "2",
+         "--batch", "2", "--ckpt-dir", str(tmp_path / "ck"), "--packed"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["batches"] == 2
+    assert out["perplexity"] > 1.0
+    assert "restored step 2" in proc.stderr
