@@ -368,7 +368,14 @@ def serve_up(yaml_path, service_name, lb_port):
 def serve_status(service_name):
     """Show services and their replicas."""
     from skypilot_tpu.serve import core as serve_core
-    for s in serve_core.status(service_name):
+    services = serve_core.status(service_name)
+    if not services:
+        if service_name:
+            click.echo(f"Service {service_name!r} not found.", err=True)
+            sys.exit(1)
+        click.echo("No services.")
+        return
+    for s in services:
         click.echo(f"{s['name']}: {s['status'].value} "
                    f"(endpoint http://127.0.0.1:{s['lb_port']})")
         for r in s["replicas"]:
