@@ -51,6 +51,9 @@ def main() -> None:
     ap.add_argument("--packed", action="store_true",
                     help="packed-sequence input pipeline (segment-aware "
                          "attention) over synthetic variable-length docs")
+    ap.add_argument("--lora", type=int, default=0, metavar="RANK",
+                    help="LoRA finetune: train rank-RANK adapters over "
+                         "frozen base weights (llama only)")
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--ckpt-every", type=int, default=100)
     ap.add_argument("--resume", action="store_true")
@@ -58,6 +61,10 @@ def main() -> None:
     args = ap.parse_args()
     if args.packed and args.model == "pipeline":
         ap.error("--packed is not supported with --model pipeline")
+    if args.lora and args.model != "llama":
+        ap.error("--lora currently supports --model llama only")
+    if args.lora < 0:
+        ap.error("--lora rank must be positive")
     if args.packed and args.sp > 1:
         ap.error("--packed is not supported with --sp > 1 "
                  "(ring attention has no segment masking)")
@@ -109,7 +116,6 @@ def main() -> None:
     tc = trainer.TrainConfig(learning_rate=args.lr,
                              warmup_steps=max(1, min(100, args.steps // 10)),
                              total_steps=args.steps)
-    step_fn = trainer.make_train_step(cfg, tc, mesh, model=model)
 
     mgr = None
     start_step = 0
@@ -117,14 +123,39 @@ def main() -> None:
     if args.ckpt_dir:
         from skypilot_tpu.train import checkpoints
         mgr = checkpoints.CheckpointManager(args.ckpt_dir)
-        if args.resume and mgr.latest_step() is not None:
+
+    if args.lora:
+        from skypilot_tpu.train import lora as lora_lib
+        lc = lora_lib.LoRAConfig(rank=args.lora)
+        base_sh = lora_lib.base_param_shardings(cfg, mesh, model)
+        base_params = jax.jit(
+            lambda r: model.init_params(r, cfg),
+            out_shardings=base_sh)(jax.random.key(1))
+        log(f"LoRA rank {args.lora}: "
+            f"{lora_lib.num_trainable_params(cfg, lc):,} trainable / "
+            f"{cfg.num_params():,} base params (frozen)")
+        if mgr and args.resume and mgr.latest_step() is not None:
+            state = mgr.restore(
+                lora_lib.abstract_lora_state(cfg, lc, tc, mesh))
+            start_step = int(mgr.latest_step())
+            log(f"resumed from step {start_step}")
+        else:
+            state = lora_lib.create_lora_state(cfg, lc, tc, mesh)
+        raw_step = lora_lib.make_lora_train_step(cfg, lc, tc, mesh,
+                                                 model=model,
+                                                 base_sh=base_sh)
+        step_fn = lambda s, b: raw_step(s, base_params, b)
+    else:
+        step_fn = trainer.make_train_step(cfg, tc, mesh, model=model)
+        if mgr and args.resume and mgr.latest_step() is not None:
             target = trainer.create_abstract_state(cfg, tc, mesh,
                                                    model=model)
             state = mgr.restore(target)
             start_step = int(mgr.latest_step())
             log(f"resumed from step {start_step}")
-    if state is None:
-        state = trainer.create_train_state(cfg, tc, mesh, model=model)
+        if state is None:
+            state = trainer.create_train_state(cfg, tc, mesh,
+                                               model=model)
 
     if args.packed:
         import jax.numpy as jnp

@@ -77,22 +77,31 @@ def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
     p_sh = sh.logical_to_sharding(model.param_logical_axes(cfg), mesh, rules,
                                   shapes=p_shapes)
 
+    opt_sh = opt_state_shardings(p_sh, p_shapes, opt_shapes, mesh)
+    return {"params": p_sh, "opt_state": opt_sh,
+            "step": NamedSharding(mesh, P())}
+
+
+def opt_state_shardings(param_sh, param_shapes, opt_shapes, mesh: Mesh):
+    """Shardings for an optax state given the params' shardings.
+
+    Adam moments have param shapes -> reuse the matching param sharding
+    by shape lookup; scalars (step counts) replicate. Shared by the
+    full trainer and the LoRA adapter trainer.
+    """
+
     def opt_leaf_sharding(leaf):
-        # Adam moments have param shapes -> reuse the matching param
-        # sharding by shape lookup; scalars (counts) replicate.
         if leaf.ndim == 0:
             return NamedSharding(mesh, P())
-        for ps, pl in zip(jax.tree.leaves(p_sh), jax.tree.leaves(p_shapes)):
+        for ps, pl in zip(jax.tree.leaves(param_sh),
+                          jax.tree.leaves(param_shapes)):
             if pl.shape == leaf.shape:
                 return ps
         return NamedSharding(mesh, P())
 
     # Walk opt_state structurally: moments subtree matches params treedef.
-    opt_sh = jax.tree.map(
-        opt_leaf_sharding, opt_shapes,
-        is_leaf=lambda x: hasattr(x, "shape"))
-    return {"params": p_sh, "opt_state": opt_sh,
-            "step": NamedSharding(mesh, P())}
+    return jax.tree.map(opt_leaf_sharding, opt_shapes,
+                        is_leaf=lambda x: hasattr(x, "shape"))
 
 
 def create_train_state(cfg: llama.LlamaConfig, tc: TrainConfig,

@@ -1,0 +1,86 @@
+"""LoRA finetuning: init identity, adapter-only training, sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.train import lora, trainer
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.CONFIGS["llama3-tiny"]
+
+
+@pytest.fixture(scope="module")
+def base(cfg):
+    return llama.init_params(jax.random.key(0), cfg)
+
+
+@pytest.fixture(scope="module")
+def lc():
+    return lora.LoRAConfig(rank=4, alpha=8.0)
+
+
+def test_identity_at_init(cfg, base, lc):
+    """B starts at zero: merged model == base model exactly."""
+    adapters = lora.init_lora_params(jax.random.key(1), cfg, lc)
+    merged = lora.merge(base, adapters, lc)
+    tokens = jnp.asarray([[3, 17, 42, 7]], jnp.int32)
+    ref = llama.forward(base, tokens, cfg)
+    got = llama.forward(merged, tokens, cfg)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_trainable_fraction_tiny(cfg, lc):
+    n_lora = lora.num_trainable_params(cfg, lc)
+    n_base = cfg.num_params()
+    assert 0 < n_lora < n_base * 0.2
+
+
+def test_adapters_learn_base_frozen(cfg, base, lc):
+    tc = trainer.TrainConfig(learning_rate=5e-3, warmup_steps=1,
+                             total_steps=20)
+    state = lora.create_lora_state(cfg, lc, tc, None)
+    step = lora.make_lora_train_step(cfg, lc, tc, None)
+    batch = trainer.synthetic_batch(cfg, 2, 32)
+    snapshot = jax.tree.map(lambda x: np.asarray(x).copy(), base)
+    first = None
+    for _ in range(8):
+        state, metrics = step(state, base, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+    # Adapters actually moved; the B factor is no longer all-zero.
+    b = state["params"]["wq"]["b"]
+    assert float(jnp.max(jnp.abs(b))) > 0
+    # The base is bitwise untouched (no donation, no updates).
+    jax.tree.map(
+        lambda a, s: np.testing.assert_array_equal(np.asarray(a), s),
+        base, snapshot)
+
+
+def test_sharded_lora_step(cfg, base, lc):
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(dp=2, fsdp=2, tp=2))
+    tc = trainer.TrainConfig(warmup_steps=1, total_steps=4)
+    state = lora.create_lora_state(cfg, lc, tc, mesh)
+    step = lora.make_lora_train_step(cfg, lc, tc, mesh)
+    import skypilot_tpu.parallel.sharding as sh
+    base_sh = sh.logical_to_sharding(
+        llama.param_logical_axes(cfg), mesh, sh.DEFAULT_RULES,
+        shapes=base)
+    base_s = jax.device_put(base, base_sh)
+    batch = trainer.synthetic_batch(cfg, 4, 32)
+    state, metrics = step(state, base_s, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert len(state["params"]["wq"]["a"].sharding.device_set) == 8
+
+
+def test_unknown_target_rejected(cfg):
+    with pytest.raises(ValueError):
+        lora.init_lora_params(
+            jax.random.key(0), cfg,
+            lora.LoRAConfig(targets=("w_nonexistent",)))
