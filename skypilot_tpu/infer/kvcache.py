@@ -35,6 +35,26 @@ from skypilot_tpu.models import llama
 Cache = Dict[str, jax.Array]
 
 
+def _ffn(cfg: llama.LlamaConfig, h: jax.Array, layer: Dict) -> jax.Array:
+    """Post-norm FFN: dense SwiGLU, or the sparse expert FFN when the
+    config is an MoE (aux loss is irrelevant at inference and dropped).
+    h: [B, S, D].
+
+    MoE + right-padded prefill is safe: capacity assignment is
+    position-ordered, so padding rows (after true_len) can never evict
+    a real token from an expert's buffer; decode steps see S=1 where
+    top-k choices always fit.
+    """
+    if hasattr(cfg, "n_experts"):
+        from skypilot_tpu.models import moe
+        out, _ = moe.moe_ffn(cfg, h, layer)
+        return out
+    g = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype))
+    u = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u,
+                      layer["w_down"].astype(cfg.dtype))
+
+
 def init_cache(cfg: llama.LlamaConfig, n_slots: int,
                max_len: int) -> Cache:
     """Pre-allocated decode state for ``n_slots`` concurrent requests."""
@@ -91,11 +111,7 @@ def prefill(params: llama.Params, tokens: jax.Array, true_len: jax.Array,
         o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
         x = x + o
         h = llama.rms_norm(x, layer["ln2"], cfg.norm_eps)
-        g = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype))
-        u = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
-        m = jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u,
-                       layer["w_down"].astype(cfg.dtype))
-        return x + m, (k[0], v[0])
+        return x + _ffn(cfg, h, layer), (k[0], v[0])
 
     x, (ks, vs) = lax.scan(body, x, params["blocks"])
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
@@ -173,11 +189,7 @@ def decode_step(params: llama.Params, cache: Cache,
         o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
         x = x + o
         h = llama.rms_norm(x, layer["ln2"], cfg.norm_eps)
-        g = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype))
-        u = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
-        m = jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u,
-                       layer["w_down"].astype(cfg.dtype))
-        return x + m, (ck, cv)
+        return x + _ffn(cfg, h, layer), (ck, cv)
 
     x, (new_k, new_v) = lax.scan(
         body, x, (params["blocks"], cache["k"], cache["v"]))

@@ -18,6 +18,7 @@ recipe, external). In-tree TPU-native equivalent.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -126,18 +127,25 @@ def lora_state_shardings(cfg: llama.LlamaConfig, lc: LoRAConfig,
             "step": NamedSharding(mesh, P())}
 
 
-def abstract_lora_state(cfg: llama.LlamaConfig, lc: LoRAConfig,
-                        tc: trainer.TrainConfig, mesh: Optional[Mesh]):
-    """ShapeDtypeStruct pytree (with shardings) — the checkpoint-restore
-    target, nothing materialized."""
-    opt = trainer.make_optimizer(tc)
+def _state_init_fn(cfg: llama.LlamaConfig, lc: LoRAConfig, opt):
+    """The single definition of the LoRA train-state tree (shared by
+    create/abstract so restore targets can never diverge)."""
 
     def init_fn(rng):
         adapters = init_lora_params(rng, cfg, lc)
         return {"params": adapters, "opt_state": opt.init(adapters),
                 "step": jnp.zeros((), jnp.int32)}
 
-    shapes = jax.eval_shape(init_fn, jax.random.key(0))
+    return init_fn
+
+
+def abstract_lora_state(cfg: llama.LlamaConfig, lc: LoRAConfig,
+                        tc: trainer.TrainConfig, mesh: Optional[Mesh]):
+    """ShapeDtypeStruct pytree (with shardings) — the checkpoint-restore
+    target, nothing materialized."""
+    opt = trainer.make_optimizer(tc)
+    shapes = jax.eval_shape(_state_init_fn(cfg, lc, opt),
+                            jax.random.key(0))
     if mesh is None:
         return shapes
     shardings = lora_state_shardings(cfg, lc, tc, mesh)
@@ -151,12 +159,7 @@ def create_lora_state(cfg: llama.LlamaConfig, lc: LoRAConfig,
                       tc: trainer.TrainConfig, mesh: Optional[Mesh],
                       seed: int = 0):
     opt = trainer.make_optimizer(tc)
-
-    def init_fn(rng):
-        adapters = init_lora_params(rng, cfg, lc)
-        return {"params": adapters, "opt_state": opt.init(adapters),
-                "step": jnp.zeros((), jnp.int32)}
-
+    init_fn = _state_init_fn(cfg, lc, opt)
     rng = jax.random.key(seed)
     if mesh is None:
         return jax.jit(init_fn)(rng)
@@ -214,5 +217,4 @@ def num_trainable_params(cfg: llama.LlamaConfig,
                          lc: LoRAConfig) -> int:
     shapes = jax.eval_shape(
         lambda: init_lora_params(jax.random.key(0), cfg, lc))
-    return sum(int(jnp.prod(jnp.asarray(s.shape)))
-               for s in jax.tree.leaves(shapes))
+    return sum(math.prod(s.shape) for s in jax.tree.leaves(shapes))
