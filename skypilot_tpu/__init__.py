@@ -3,22 +3,60 @@
 Public API surface mirrors the reference's (reference:
 sky/__init__.py:83-220) with the TPU-first additions (mesh/sharding,
 in-tree models and trainers).
+
+Exports resolve lazily (PEP 562) so that head-side runtime processes —
+which run under ``python -S`` with stdlib only — can import
+``skypilot_tpu.runtime.*`` without dragging in the orchestration stack,
+and so the CLI starts fast (the reference solves the same problem with
+sky/adaptors LazyImport shims).
 """
 
-from skypilot_tpu.dag import Dag
-from skypilot_tpu.execution import exec, launch  # noqa: A004
-from skypilot_tpu.core import (autostop, cancel, cost_report, down,
-                               job_status, queue, start, status, stop,
-                               tail_logs)
-from skypilot_tpu.resources import Resources
-from skypilot_tpu.task import Task
+import importlib
+import typing
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
-__all__ = [
-    "Dag", "Resources", "Task",
-    "launch", "exec",
-    "status", "start", "stop", "down", "autostop",
-    "queue", "cancel", "tail_logs", "job_status", "cost_report",
-    "__version__",
-]
+_EXPORTS = {
+    "Dag": ("skypilot_tpu.dag", "Dag"),
+    "Task": ("skypilot_tpu.task", "Task"),
+    "Resources": ("skypilot_tpu.resources", "Resources"),
+    "launch": ("skypilot_tpu.execution", "launch"),
+    "exec": ("skypilot_tpu.execution", "exec"),
+    "status": ("skypilot_tpu.core", "status"),
+    "start": ("skypilot_tpu.core", "start"),
+    "stop": ("skypilot_tpu.core", "stop"),
+    "down": ("skypilot_tpu.core", "down"),
+    "autostop": ("skypilot_tpu.core", "autostop"),
+    "queue": ("skypilot_tpu.core", "queue"),
+    "cancel": ("skypilot_tpu.core", "cancel"),
+    "tail_logs": ("skypilot_tpu.core", "tail_logs"),
+    "job_status": ("skypilot_tpu.core", "job_status"),
+    "cost_report": ("skypilot_tpu.core", "cost_report"),
+}
+
+__all__ = ["__version__"] + sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    value = getattr(importlib.import_module(module_name), attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
+
+if typing.TYPE_CHECKING:  # static-analysis visibility for the lazy names
+    from skypilot_tpu.core import (autostop, cancel, cost_report, down,
+                                   job_status, queue, start, status, stop,
+                                   tail_logs)
+    from skypilot_tpu.dag import Dag
+    from skypilot_tpu.execution import exec, launch  # noqa: A004
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task

@@ -4,8 +4,14 @@ failover-retry provisioner.
 Reference parity: sky/backends/cloud_vm_ray_backend.py — but the 5,100-LoC
 monolith decomposes here because two big reference subsystems vanish by
 design: (a) no Ray codegen (runtime/driver.py is a real program, not
-generated source), (b) no SSH-string-codegen RPC (job queue is accessed
-as a library locally / over the runner for remote clusters).
+generated source), (b) no SSH-string-codegen RPC (the cluster-side job
+queue/driver/skylet are reached through the typed JSON RPC in
+runtime/rpc.py, executed on the head via the command runner).
+
+All job state lives ON THE CLUSTER HEAD (reference: on-head sqlite at
+sky/skylet/job_lib.py:204-276): a launched cluster is autonomous —
+running jobs, log capture, and autostop survive the client, and any
+client that can reach the head can queue/cancel/tail.
 
 The failover engine (RetryingProvisioner) keeps the reference's proven
 shape (reference :1988 provision_with_retries): iterate candidates from
@@ -16,20 +22,23 @@ loop until up.
 
 from __future__ import annotations
 
-import json
 import os
 import shlex
-import subprocess
 import sys
 import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions, optimizer, provision, state
-from skypilot_tpu.provision.common import ProvisionConfig
+from skypilot_tpu.provision.common import ClusterInfo, ProvisionConfig
 from skypilot_tpu.resources import Resources
-from skypilot_tpu.runtime import constants, job_queue
+from skypilot_tpu.runtime import job_queue, topology
+from skypilot_tpu.runtime.rpc_client import ClusterRpc
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import paths
+
+# Head-side location of the intra-cluster SSH key (pushed by
+# instance_setup for ssh-reachable hosts).
+_HEAD_SSH_KEY = "~/.skypilot_tpu/ssh/sky-key"
 
 
 class ClusterHandle(dict):
@@ -134,46 +143,34 @@ class RetryingProvisioner:
         )
         provision.run_instances(handle.provider, config)
         provision.wait_instances(handle.provider, cluster_name, handle.zone)
-        if handle.provider != "local":
-            from skypilot_tpu.provision import instance_setup
-            info = provision.get_cluster_info(handle.provider, cluster_name,
-                                              handle.zone)
-            instance_setup.wait_for_ssh(info)
-            instance_setup.setup_runtime_on_cluster(info)
-        # Persist cluster.json so the (possibly remote) driver is
-        # self-sufficient.
-        cdir = paths.cluster_dir(cluster_name)
-        with open(os.path.join(cdir, "cluster.json"), "w") as f:
-            json.dump({"provider": handle.provider,
-                       "cluster_name": cluster_name,
-                       "zone": handle.zone,
-                       "num_nodes": task.num_nodes,
-                       "hosts_per_node": launchable.hosts_per_node}, f)
+        _setup_and_init_runtime(handle.provider, cluster_name, handle.zone)
         state.set_cluster(cluster_name, dict(handle), state.ClusterStatus.UP,
                           handle["price_per_hour"])
-        _spawn_skylet(cluster_name)
         return handle
 
 
-def _spawn_skylet(cluster_name: str) -> None:
-    """One autostop daemon per cluster (pidfile-deduplicated)."""
-    cdir = paths.cluster_dir(cluster_name)
-    pidfile = os.path.join(cdir, "skylet.pid")
-    if os.path.exists(pidfile):
-        try:
-            os.kill(int(open(pidfile).read().strip()), 0)
-            return  # still alive
-        except (OSError, ValueError):
-            pass
-    log = os.path.join(cdir, "skylet.log")
-    with open(log, "ab") as f:
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "skypilot_tpu.runtime.skylet",
-             "--cluster-name", cluster_name],
-            stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
-            env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
-    with open(pidfile, "w") as f:
-        f.write(str(proc.pid))
+def _setup_and_init_runtime(provider: str, cluster_name: str,
+                            zone: str) -> ClusterInfo:
+    """Post-provision: wait for hosts, push the framework + cluster key,
+    and write the head-side cluster.json through the RPC so the cluster
+    runtime (driver/skylet/job DB) is self-sufficient from here on."""
+    from skypilot_tpu.provision import instance_setup
+    info = provision.get_cluster_info(provider, cluster_name, zone)
+    instance_setup.wait_for_ssh(info)
+    instance_setup.setup_runtime_on_cluster(info)
+    uses_ssh = any(h.runner_kind == "ssh" for h in info.hosts)
+    meta = topology.from_cluster_info(
+        info,
+        provider_env=info.metadata.get("provider_env"),
+        ssh_key_path=_HEAD_SSH_KEY if uses_ssh else None,
+        launched_at=time.time())
+    _rpc_for_info(info, cluster_name).init_cluster(meta)
+    return info
+
+
+def _rpc_for_info(info: ClusterInfo, cluster_name: str) -> ClusterRpc:
+    head_runner = provision.get_command_runners(info)[0]
+    return ClusterRpc(head_runner, cluster_name)
 
 
 class TpuVmBackend:
@@ -273,10 +270,13 @@ class TpuVmBackend:
                                   rec.get("price_per_hour", 0.0))
 
     # -- execution ---------------------------------------------------------
+    def _rpc(self, handle: ClusterHandle) -> ClusterRpc:
+        info = provision.get_cluster_info(handle.provider,
+                                          handle.cluster_name, handle.zone)
+        return _rpc_for_info(info, handle.cluster_name)
+
     def execute(self, handle: ClusterHandle, task: Task,
                 detach_run: bool = True) -> int:
-        cdir = paths.cluster_dir(handle.cluster_name)
-        db = os.path.join(cdir, constants.JOB_DB)
         setup = f"{task.setup}\n" if task.setup else ""
         if task.run is None:
             run_cmd = "true"
@@ -290,107 +290,72 @@ class TpuVmBackend:
             f"export {k}={shlex.quote(str(v))}\n"
             for k, v in task.envs.items())
         script = f"{env_exports}{setup}{run_cmd}"
-        job_id = job_queue.add_job(db, task.name, "",
-                                   metadata={"num_nodes": task.num_nodes})
-        script_path = os.path.join(
-            cdir, constants.RUN_SCRIPT.format(job_id=job_id))
-        with open(script_path, "w") as f:
-            f.write(script)
-        job_queue.set_run_cmd(db, job_id,
-                              f"bash {shlex.quote(script_path)}")
-        self._spawn_driver(handle, job_id)
+        job_id = self._rpc(handle).submit(
+            task.name, script, task.num_nodes, workdir=bool(task.workdir))
         if not detach_run:
             self.wait_job(handle, job_id)
         return job_id
 
-    def _spawn_driver(self, handle: ClusterHandle, job_id: int) -> None:
-        cdir = paths.cluster_dir(handle.cluster_name)
-        log = os.path.join(cdir, "logs", f"driver-{job_id}.log")
-        os.makedirs(os.path.dirname(log), exist_ok=True)
-        with open(log, "ab") as f:
-            subprocess.Popen(
-                [sys.executable, "-m", "skypilot_tpu.runtime.driver",
-                 "--cluster-dir", cdir, "--job-id", str(job_id)],
-                stdout=f, stderr=subprocess.STDOUT,
-                start_new_session=True,
-                env={**os.environ,
-                     "SKYPILOT_TPU_HOME": paths.home()})
-
     def wait_job(self, handle: ClusterHandle, job_id: int,
-                 timeout: float = 3600) -> job_queue.JobStatus:
-        db = os.path.join(paths.cluster_dir(handle.cluster_name),
-                          constants.JOB_DB)
+                 timeout: float = 3600,
+                 poll_interval: float = 0.3) -> job_queue.JobStatus:
+        rpc = self._rpc(handle)
         deadline = time.time() + timeout
         while time.time() < deadline:
-            job = job_queue.get_job(db, job_id)
+            job = rpc.get_job(job_id)
             if job and job["status"].is_terminal():
                 return job["status"]
-            time.sleep(0.2)
+            time.sleep(poll_interval)
         raise TimeoutError(f"job {job_id} did not finish in {timeout}s")
 
     # -- job ops -----------------------------------------------------------
     def queue(self, handle: ClusterHandle) -> List[Dict[str, Any]]:
-        db = os.path.join(paths.cluster_dir(handle.cluster_name),
-                          constants.JOB_DB)
-        return job_queue.list_jobs(db)
+        return self._rpc(handle).list_jobs()
 
     def cancel(self, handle: ClusterHandle, job_id: int) -> None:
-        db = os.path.join(paths.cluster_dir(handle.cluster_name),
-                          constants.JOB_DB)
-        job = job_queue.get_job(db, job_id)
-        if job is None:
-            raise exceptions.JobNotFoundError(f"no job {job_id}")
-        job_queue.set_status(db, job_id, job_queue.JobStatus.CANCELLED)
-        # Drivers poll for CANCELLED; also kill job processes directly.
-        info = provision.get_cluster_info(handle.provider,
-                                          handle.cluster_name, handle.zone)
-        runners = provision.get_command_runners(info)
-        for runner, pid in zip(runners, job["pids"]):
-            runner.kill(pid)
+        self._rpc(handle).cancel(job_id)
+
+    def set_autostop(self, handle: ClusterHandle,
+                     idle_minutes: Optional[int], down: bool) -> None:
+        """Arm (or disarm) cluster-side autostop: the skylet on the head
+        stops/downs the cluster itself (reference: skylet/events.py:102
+        AutostopEvent calls the cloud API from the VM)."""
+        self._rpc(handle).set_autostop(idle_minutes, down)
 
     def job_log_paths(self, handle: ClusterHandle, job_id: int) -> List[str]:
+        """Sync job logs down from the head; returns client-local paths
+        (reference: sync_down_logs, cloud_vm_ray_backend.py:3740)."""
+        _, chunks, _ = self._rpc(handle).read_logs(job_id, {})
         d = os.path.join(paths.cluster_dir(handle.cluster_name), "logs",
-                         constants.LOG_DIR.format(job_id=job_id))
-        if not os.path.isdir(d):
-            return []
+                         f"job_{job_id}")
+        os.makedirs(d, exist_ok=True)
+        for fname, text in chunks.items():
+            with open(os.path.join(d, fname), "w") as f:
+                f.write(text)
         return sorted(
             os.path.join(d, f) for f in os.listdir(d)
             if f.startswith("rank-"))
 
     def tail_logs(self, handle: ClusterHandle, job_id: int,
-                  follow: bool = False, out=None) -> None:
+                  follow: bool = False, out=None,
+                  poll_interval: float = 0.4) -> None:
+        """Stream job logs from the head. Bounded: for a terminal job the
+        server reads status before log bytes, so the read that observes
+        a terminal status already carries every byte the job wrote — no
+        unbounded final-drain loop (a background child that keeps a rank
+        log growing cannot wedge the client)."""
         out = out if out is not None else sys.stdout
-        db = os.path.join(paths.cluster_dir(handle.cluster_name),
-                          constants.JOB_DB)
-        if job_queue.get_job(db, job_id) is None:
-            raise exceptions.JobNotFoundError(
-                f"no job {job_id} on {handle.cluster_name}")
-        log_paths = self.job_log_paths(handle, job_id)
-        offsets = {p: 0 for p in log_paths}
+        rpc = self._rpc(handle)
+        offsets: Dict[str, int] = {}
         while True:
-            for p in list(offsets):
-                if os.path.exists(p):
-                    with open(p) as f:
-                        f.seek(offsets[p])
-                        chunk = f.read()
-                        offsets[p] = f.tell()
-                    if chunk:
-                        prefix = os.path.basename(p).replace(".log", "")
-                        for line in chunk.splitlines():
-                            print(f"({prefix}) {line}", file=out)
-            job = job_queue.get_job(db, job_id)
-            if not follow or (job and job["status"].is_terminal()):
-                if follow:  # final drain
-                    continue_once = any(
-                        os.path.getsize(p) > offsets[p]
-                        for p in offsets if os.path.exists(p))
-                    if continue_once:
-                        continue
+            status, chunks, offsets = rpc.read_logs(job_id, offsets)
+            for fname in sorted(chunks):
+                prefix = fname.replace(".log", "")
+                for line in chunks[fname].splitlines():
+                    print(f"({prefix}) {line}", file=out)
+            if not follow or status.is_terminal():
                 return
-            # Pick up late-created log files.
-            for p in self.job_log_paths(handle, job_id):
-                offsets.setdefault(p, 0)
-            time.sleep(0.2)
+            time.sleep(poll_interval)
 
     # -- lifecycle ---------------------------------------------------------
     def stop(self, handle: ClusterHandle) -> None:
@@ -411,8 +376,11 @@ class TpuVmBackend:
             zone=handle.zone, region=handle["region"])
         provision.run_instances(handle.provider, config)
         provision.wait_instances(handle.provider, cluster_name, handle.zone)
+        # Re-run runtime init: restarted VMs may have new IPs, and the
+        # head needs a fresh cluster.json (autostop config and job
+        # history persist on the head's disk across stop/start).
+        _setup_and_init_runtime(handle.provider, cluster_name, handle.zone)
         state.set_cluster_status(cluster_name, state.ClusterStatus.UP)
-        _spawn_skylet(cluster_name)
         return handle
 
     def teardown(self, handle: ClusterHandle) -> None:

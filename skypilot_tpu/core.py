@@ -53,7 +53,10 @@ def down(cluster_name: str, purge: bool = False) -> None:
 
 
 def autostop(cluster_name: str, idle_minutes: int, down_: bool = False) -> None:
-    _handle(cluster_name)  # existence check
+    handle = _handle(cluster_name)
+    # Arm the cluster-side skylet (survives this client); the state-DB
+    # record is kept for `status` display only.
+    TpuVmBackend().set_autostop(handle, idle_minutes, down_)
     state.set_autostop(cluster_name, idle_minutes, down_)
 
 

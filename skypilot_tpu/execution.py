@@ -85,6 +85,7 @@ def _launch_with_config(task, cluster_name, retry_until_up,
         backend.sync_storage_mounts(handle, task.storage_mounts)
 
     if idle_minutes_to_autostop is not None:
+        backend.set_autostop(handle, idle_minutes_to_autostop, down)
         state.set_autostop(cluster_name, idle_minutes_to_autostop, down)
 
     job_id = None

@@ -18,6 +18,10 @@ class HostInfo:
     ssh_user: Optional[str] = None
     ssh_port: int = 22
     workspace: Optional[str] = None   # local provider: host directory
+    # How the head reaches this host for gang execution:
+    # "ssh" (real VMs) | "local" (fake-cloud dir, same machine) |
+    # "fake" (fake-cloud dir behaving as a remote host) | "k8s" (pod).
+    runner_kind: str = "ssh"
 
 
 @dataclasses.dataclass

@@ -66,8 +66,21 @@ def setup_runtime_on_cluster(info: ClusterInfo,
             # Self-replication: push this package so in-tree recipes can
             # `import skypilot_tpu` on the hosts (the role of the
             # reference's wheel build, backends/wheel_utils.py:140).
+            # Remote runners put $HOME/.skypilot_tpu/pkg on PYTHONPATH
+            # (command_runner.framework_invocation + the driver's job
+            # wrapper), which makes this dir importable.
             runner.rsync(_PKG_ROOT, "~/.skypilot_tpu/pkg/skypilot_tpu",
                          up=True)
+        if (runner.host_id == info.head.host_id and not runner.is_local
+                and info.ssh_key_path
+                and os.path.exists(os.path.expanduser(info.ssh_key_path))):
+            # The head runs the gang driver and must SSH to its peer
+            # hosts: give it the cluster key at the path
+            # runtime/topology.py's runners expect.
+            runner.run("mkdir -p ~/.skypilot_tpu/ssh")
+            runner.rsync(os.path.expanduser(info.ssh_key_path),
+                         "~/.skypilot_tpu/ssh/sky-key", up=True)
+            runner.run("chmod 600 ~/.skypilot_tpu/ssh/sky-key")
 
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=min(max_workers, max(len(runners), 1))) as ex:

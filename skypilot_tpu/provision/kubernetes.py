@@ -223,6 +223,7 @@ def get_cluster_info(cluster_name: str, zone: str) -> ClusterInfo:
             internal_ip=pod.get("status", {}).get("podIP", ""),
             external_ip=None,
             workspace=None,
+            runner_kind="k8s",
         ))
     info = ClusterInfo(cluster_name=cluster_name, provider="kubernetes",
                        zone=zone, hosts=hosts)
@@ -245,16 +246,18 @@ class KubernetesRunner(CommandRunner):
         super().__init__(host_id, ip)
         self.pod_name = pod_name
 
-    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None):
+    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None,
+            stdin=None):
         env_prefix = "".join(
             f"export {k}={shlex.quote(str(v))}; "
             for k, v in (env or {}).items())
         cd = f"cd {shlex.quote(cwd)}; " if cwd else ""
         full = f"{env_prefix}{cd}{cmd}"
+        exec_flags = ["-i"] if stdin is not None else []
         proc = subprocess.run(
-            [_kubectl(), "exec", self.pod_name, "--", "/bin/sh", "-c",
-             full],
-            capture_output=True, text=True, timeout=timeout)
+            [_kubectl(), "exec", *exec_flags, self.pod_name, "--",
+             "/bin/sh", "-c", full],
+            capture_output=True, text=True, timeout=timeout, input=stdin)
         if log_path:
             os.makedirs(os.path.dirname(log_path), exist_ok=True)
             with open(log_path, "ab") as f:
@@ -277,16 +280,56 @@ class KubernetesRunner(CommandRunner):
         return int(out.strip().splitlines()[-1])
 
     def rsync(self, src, dst, up=True, excludes=None):
+        # tar-over-exec instead of `kubectl cp`: cp cannot expand `~` or
+        # $HOME on the pod side, and the framework push targets
+        # ~/.skypilot_tpu/pkg. `dst`/`src` may be ~-relative; the pod's
+        # shell resolves them.
+        def pod_path(p):
+            return ('"$HOME"' + shlex.quote(p[1:])) if p.startswith("~") \
+                else shlex.quote(p)
         if up:
-            pair = [src, f"{self.pod_name}:{dst}"]
-            self.run(f"mkdir -p {shlex.quote(dst if src.endswith('/') else os.path.dirname(dst) or '.')}")
+            src = os.path.expanduser(src)
+            if os.path.isdir(src):
+                tar = subprocess.run(
+                    ["tar", "-C", src, "-cf", "-", "."],
+                    capture_output=True)
+                unpack = (f"mkdir -p {pod_path(dst)} && "
+                          f"tar -C {pod_path(dst)} -xf -")
+            else:
+                tar = subprocess.run(
+                    ["tar", "-C", os.path.dirname(src) or ".", "-cf", "-",
+                     os.path.basename(src)],
+                    capture_output=True)
+                d = os.path.dirname(dst) or "."
+                unpack = (f"mkdir -p {pod_path(d)} && "
+                          f"tar -C {pod_path(d)} -xf - && "
+                          f"mv {pod_path(d)}/{shlex.quote(os.path.basename(src))} "
+                          f"{pod_path(dst)}")
+            if tar.returncode != 0:
+                raise RuntimeError(f"tar {src} failed: {tar.stderr!r}")
+            proc = subprocess.run(
+                [_kubectl(), "exec", "-i", self.pod_name, "--", "/bin/sh",
+                 "-c", unpack], input=tar.stdout, capture_output=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pod unpack to {dst} failed: {proc.stderr!r}")
         else:
-            pair = [f"{self.pod_name}:{src}", dst]
-        rc = subprocess.run([_kubectl(), "cp", *pair],
-                            capture_output=True).returncode
-        if rc != 0:
-            raise RuntimeError(
-                f"kubectl cp {pair[0]} -> {pair[1]} failed")
+            proc = subprocess.run(
+                [_kubectl(), "exec", self.pod_name, "--", "/bin/sh", "-c",
+                 f"tar -C $(dirname {pod_path(src)}) -cf - "
+                 f"$(basename {pod_path(src)})"],
+                capture_output=True)
+            if proc.returncode != 0:
+                raise RuntimeError(f"pod pack {src} failed: {proc.stderr!r}")
+            os.makedirs(dst if os.path.isdir(dst) else
+                        os.path.dirname(dst) or ".", exist_ok=True)
+            unpack = subprocess.run(
+                ["tar", "-C", os.path.dirname(dst) or ".", "-xf", "-"],
+                input=proc.stdout, capture_output=True)
+            if unpack.returncode != 0:
+                raise RuntimeError(
+                    f"local unpack {src} -> {dst} failed: "
+                    f"{unpack.stderr!r}")
 
     def read_file(self, path: str) -> Optional[str]:
         rc, out, _ = self.run(f"cat {shlex.quote(path)}")
@@ -295,3 +338,4 @@ class KubernetesRunner(CommandRunner):
     def kill(self, pid: int) -> None:
         self.run(f"kill -TERM -- -{pid} 2>/dev/null || "
                  f"kill -TERM {pid} 2>/dev/null || true")
+    # framework_invocation: base CommandRunner default (remote contract).

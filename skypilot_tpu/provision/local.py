@@ -1,6 +1,6 @@
 """Local fake cloud: hosts are directories, instances are metadata files.
 
-Serves two purposes, mirroring the reference's offline-test strategy
+Serves three purposes, mirroring the reference's offline-test strategy
 (reference: tests/common_test_fixtures.py + LocalDockerBackend):
 
 1. Offline end-to-end tests — launch/exec/logs/cancel/down run for real
@@ -10,6 +10,14 @@ Serves two purposes, mirroring the reference's offline-test strategy
    cluster dir (or SKYTPU_LOCAL_FAIL_ATTEMPTS env) makes the next N
    ``run_instances`` calls raise CapacityError, exercising
    blocklist/re-optimize/retry paths without a cloud.
+3. Remote-cluster emulation — with SKYTPU_LOCAL_FAKE_SSH=1, hosts are
+   reached through FakeSSHRunner (scrubbed env, $HOME-rooted layout),
+   so the whole on-cluster runtime (rpc, driver, skylet, rsynced
+   framework) runs the exact code path a real SSH cluster gets.
+
+The clusters root is OUTSIDE any client's home when
+SKYTPU_LOCAL_CLUSTERS_ROOT is set — the "cloud" must survive a client
+dying, which is precisely what the client-death tests assert.
 """
 
 from __future__ import annotations
@@ -27,8 +35,13 @@ from skypilot_tpu.utils import command_runner, paths
 _META = "local_meta.json"
 
 
+def _clusters_root() -> str:
+    return os.environ.get("SKYTPU_LOCAL_CLUSTERS_ROOT",
+                          os.path.join(paths.home(), "local_clusters"))
+
+
 def _cluster_root(cluster_name: str) -> str:
-    return os.path.join(paths.home(), "local_clusters", cluster_name)
+    return os.path.join(_clusters_root(), cluster_name)
 
 
 def _meta_path(cluster_name: str) -> str:
@@ -66,6 +79,7 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
         "hosts_per_node": config.hosts_per_node,
         "status": "UP",
         "instance_ids": ids,
+        "fake_ssh": os.environ.get("SKYTPU_LOCAL_FAKE_SSH") == "1",
     }
     with open(_meta_path(config.cluster_name), "w") as f:
         json.dump(meta, f)
@@ -107,22 +121,35 @@ def wait_instances(cluster_name: str, zone: str, timeout: float = 600) -> None:
 
 def get_cluster_info(cluster_name: str, zone: str) -> ClusterInfo:
     meta = _load_meta(cluster_name)
-    meta_status = meta.get("status")
-    if meta_status == "STOPPED":
-        # Resuming a stopped local cluster is a run_instances away; info
-        # still describes the (stopped) topology.
-        pass
+    fake = bool(meta.get("fake_ssh"))
     hosts: List[HostInfo] = []
     hpn = meta["hosts_per_node"]
     for h in range(meta["num_nodes"] * hpn):
         hosts.append(HostInfo(
             host_id=h, node_id=h // hpn, worker_id=h % hpn,
             internal_ip="127.0.0.1",
-            workspace=os.path.join(_cluster_root(cluster_name), f"host{h}")))
-    return ClusterInfo(cluster_name=cluster_name, provider="local",
+            workspace=os.path.join(_cluster_root(cluster_name), f"host{h}"),
+            runner_kind="fake" if fake else "local"))
+    info = ClusterInfo(cluster_name=cluster_name, provider="local",
                        zone=meta["zone"], hosts=hosts)
+    # The cluster-side runtime must reach this fake cloud's API (its
+    # metadata files) regardless of which client launched it.
+    info.metadata["provider_env"] = {
+        "SKYTPU_LOCAL_CLUSTERS_ROOT": _clusters_root()}
+    return info
 
 
 def get_command_runners(info: ClusterInfo) -> List[command_runner.CommandRunner]:
-    return [command_runner.LocalRunner(h.host_id, h.internal_ip, h.workspace)
-            for h in info.hosts]
+    runners: List[command_runner.CommandRunner] = []
+    for h in info.hosts:
+        if h.runner_kind == "fake":
+            runners.append(command_runner.FakeSSHRunner(
+                root=h.workspace, host_id=h.host_id, ip=h.internal_ip))
+        else:
+            # Each "host" gets its own $HOME (the host dir) so
+            # `~`-relative layout matches a real multi-VM cluster.
+            runners.append(command_runner.LocalRunner(
+                h.host_id, h.internal_ip, h.workspace,
+                env_overrides={"HOME": h.workspace,
+                               "SKYPILOT_TPU_HOME": None}))
+    return runners

@@ -14,55 +14,82 @@ needed — the driver simply
      gang semantics of get_or_fail at reference :318-355),
   4. records the final JobStatus in the cluster job queue.
 
-One driver process per job, spawned detached by the backend (the role
-the skylet FIFOScheduler plays at reference sky/skylet/job_lib.py:276).
+The driver runs ON THE CLUSTER HEAD (spawned detached by the rpc
+``submit`` method — the role the skylet FIFOScheduler plays at
+reference sky/skylet/job_lib.py:276), reads the cluster's own
+cluster.json for topology, and reaches peer hosts with intra-cluster
+runners. No client state is touched: the job completes even if every
+client disappears. Runs under ``python -S``; stdlib-only imports.
 """
 
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import shlex
 import sys
 import time
 from typing import Dict, List
 
-from skypilot_tpu import exceptions, provision
-from skypilot_tpu.runtime import constants, job_queue
+from skypilot_tpu.runtime import constants, job_queue, topology
+from skypilot_tpu.utils import command_runner
+
+# How often to double-check the cloud that the slice still exists
+# (preemption / out-of-band teardown detection). Guarded: head-side
+# credentials may not allow it, and that must not break the job.
+_PROVIDER_CHECK_INTERVAL = 5.0
 
 
-def _load_cluster_meta(cluster_dir: str) -> dict:
-    with open(os.path.join(cluster_dir, "cluster.json")) as f:
-        return json.load(f)
-
-
-def build_job_env(cluster_name: str, job_id: int, info,
-                  host) -> Dict[str, str]:
+def build_job_env(meta: dict, job_id: int, host: dict) -> Dict[str, str]:
     """The full injected env for one host's job process."""
-    node_heads = {}
-    for h in info.hosts:
-        node_heads.setdefault(h.node_id, h.internal_ip)
+    node_heads: Dict[int, str] = {}
+    for h in meta["hosts"]:
+        node_heads.setdefault(h["node_id"], h["internal_ip"])
     node_ips = [node_heads[n] for n in sorted(node_heads)]
-    coordinator = f"{info.hosts[0].internal_ip}:{constants.COORDINATOR_PORT}"
+    coordinator = (f"{meta['hosts'][0]['internal_ip']}:"
+                   f"{constants.COORDINATOR_PORT}")
+    n_hosts = len(meta["hosts"])
     return {
-        constants.ENV_CLUSTER: cluster_name,
+        constants.ENV_CLUSTER: meta["cluster_name"],
         constants.ENV_JOB_ID: str(job_id),
-        constants.ENV_NODE_RANK: str(host.node_id),
+        constants.ENV_NODE_RANK: str(host["node_id"]),
         constants.ENV_NUM_NODES: str(len(node_ips)),
         constants.ENV_NODE_IPS: "\n".join(node_ips),
-        constants.ENV_HOST_ID: str(host.host_id),
-        constants.ENV_NUM_HOSTS: str(len(info.hosts)),
-        constants.ENV_WORKER_ID: str(host.worker_id),
+        constants.ENV_HOST_ID: str(host["host_id"]),
+        constants.ENV_NUM_HOSTS: str(n_hosts),
+        constants.ENV_WORKER_ID: str(host["worker_id"]),
         constants.ENV_COORDINATOR: coordinator,
-        constants.ENV_NUM_PROCESSES: str(len(info.hosts)),
-        constants.ENV_PROCESS_ID: str(host.host_id),
+        constants.ENV_NUM_PROCESSES: str(n_hosts),
+        constants.ENV_PROCESS_ID: str(host["host_id"]),
     }
 
 
-def run_job(cluster_dir: str, job_id: int, poll_interval: float = 0.2) -> int:
-    meta = _load_cluster_meta(cluster_dir)
-    db = os.path.join(cluster_dir, constants.JOB_DB)
+def _wrap_script(run_cmd: str, rc_file: str, runner, workdir: bool) -> str:
+    """Wrap the job command: make the framework importable on this host,
+    optionally enter the synced workdir, and record the exit code
+    atomically (tmp+mv) so the poll loop never reads a partial write."""
+    if runner.is_local:
+        pythonpath = (f"export PYTHONPATH="
+                      f"{shlex.quote(command_runner.PKG_PARENT)}"
+                      f":$PYTHONPATH; ")
+    else:
+        pythonpath = (f'export PYTHONPATH="$HOME/'
+                      f'{command_runner.REMOTE_PKG_DIR}:$PYTHONPATH"; ')
+    # `&&`: a missing synced workdir must fail loudly (cd's error lands
+    # in the rank log), not silently run the job in $HOME.
+    cd = "cd sky_workdir && " if workdir else ""
+    q = shlex.quote
+    return (f"{pythonpath}{cd}{run_cmd}; rc=$?; "
+            f"echo $rc > {q(rc_file + '.tmp')} && "
+            f"mv {q(rc_file + '.tmp')} {q(rc_file)}; exit $rc")
+
+
+def run_job(cluster_name: str, job_id: int,
+            poll_interval: float = 0.2) -> int:
+    cdir = topology.cluster_dir(cluster_name)
+    meta = topology.load(cdir)
+    topology.apply_provider_env(meta)
+    db = os.path.join(cdir, constants.JOB_DB)
     job = job_queue.get_job(db, job_id)
     if job is None:
         print(f"job {job_id} not found", file=sys.stderr)
@@ -83,53 +110,54 @@ def run_job(cluster_dir: str, job_id: int, poll_interval: float = 0.2) -> int:
             return 0  # cancelled (or externally transitioned) while queued
         time.sleep(poll_interval)
 
-    info = provision.get_cluster_info(meta["provider"], meta["cluster_name"],
-                                      meta["zone"])
-    runners = provision.get_command_runners(info)
-    log_dir = os.path.join(cluster_dir, "logs",
+    hosts = meta["hosts"]
+    runners = topology.build_runners(meta)
+    log_dir = os.path.join(cdir, "logs",
                            constants.LOG_DIR.format(job_id=job_id))
     os.makedirs(log_dir, exist_ok=True)
+    workdir = bool(job["metadata"].get("workdir"))
 
     job_queue.set_status(db, job_id, job_queue.JobStatus.RUNNING)
 
     pids: List[int] = []
     started = []   # (runner, pid) pairs for gang-kill
-    hostpaths = {}  # host_id -> (runner, remote rc path, remote log path)
+    hostpaths = {}  # host_id -> (runner, rc path, remote log, local log)
+    offsets: Dict[int, int] = {}  # per-host mirrored-log byte offsets
     try:
-        for host, runner in zip(info.hosts, runners):
-            env = build_job_env(meta["cluster_name"], job_id, info, host)
-            local_log = os.path.join(log_dir, f"rank-{host.host_id}.log")
+        for host, runner in zip(hosts, runners):
+            env = build_job_env(meta, job_id, host)
+            hid = host["host_id"]
+            local_log = os.path.join(log_dir, f"rank-{hid}.log")
             if runner.is_local:
-                # Head-local host: rc + log written straight into log_dir.
-                scratch = log_dir
-                rc_file = os.path.join(scratch, f"rc-{host.host_id}")
+                # Head / same-machine host: rc + log written straight
+                # into the head log dir.
+                rc_file = os.path.join(log_dir, f"rc-{hid}")
                 log_path = local_log
             else:
-                # Remote slice worker: rc + log live on the worker; the
-                # poll loop reads rc and mirrors log bytes via the runner.
-                scratch = f"~/.skypilot_tpu/job_{job_id}"
+                # Remote slice worker: rc + log live on the worker under
+                # its $HOME (relative paths — remote commands start in
+                # $HOME, and quoting keeps `~` from expanding); the poll
+                # loop reads rc and mirrors log bytes via the runner.
+                scratch = f".skypilot_tpu/job_{job_id}"
                 runner.run(f"mkdir -p {scratch}")
                 rc_file = f"{scratch}/rc"
                 log_path = f"{scratch}/out.log"
-            # Wrap: run the script, then record its rc atomically.
-            wrapped = (f"{job['run_cmd']}; rc=$?; "
-                       f"echo $rc > {shlex.quote(rc_file + '.tmp')} && "
-                       f"mv {shlex.quote(rc_file + '.tmp')} "
-                       f"{shlex.quote(rc_file)}; exit $rc")
-            pid = runner.run_detached(wrapped, env=env, cwd=host.workspace,
+            wrapped = _wrap_script(job["run_cmd"], rc_file, runner, workdir)
+            pid = runner.run_detached(wrapped, env=env,
+                                      cwd=host.get("workspace"),
                                       log_path=log_path)
             pids.append(pid)
             started.append((runner, pid))
-            hostpaths[host.host_id] = (runner, rc_file, log_path, local_log)
+            hostpaths[hid] = (runner, rc_file, log_path, local_log)
         job_queue.set_pids(db, job_id, pids)
 
         # Poll rc files (via runner: local read or `cat` over SSH) and
         # mirror remote logs head-local; fail-one-kill-all.
         done: Dict[int, int] = {}
-        offsets: Dict[int, int] = {}
-        while len(done) < len(info.hosts):
-            for host in info.hosts:
-                hid = host.host_id
+        last_provider_check = time.time()
+        while len(done) < len(hosts):
+            for host in hosts:
+                hid = host["host_id"]
                 runner, rc_file, log_path, local_log = hostpaths[hid]
                 if not runner.is_local:
                     _mirror_log(runner, log_path, local_log, offsets, hid)
@@ -145,21 +173,22 @@ def run_job(cluster_dir: str, job_id: int, poll_interval: float = 0.2) -> int:
             if any(rc != 0 for rc in done.values()):
                 break
             # Slice preempted / terminated out-of-band? rc files will
-            # never appear — detect and fail the gang.
-            if provision.query_instances(
-                    meta["provider"], meta["cluster_name"],
-                    meta["zone"]) == "NOT_FOUND":
-                raise exceptions.ClusterNotUpError(
-                    "cluster disappeared while job was running "
-                    "(slice preempted or externally terminated)")
+            # never appear — ask the cloud occasionally and fail the
+            # gang. Best-effort: head-side credentials may be absent.
+            if time.time() - last_provider_check > _PROVIDER_CHECK_INTERVAL:
+                last_provider_check = time.time()
+                if _cluster_gone(meta):
+                    raise RuntimeError(
+                        "cluster disappeared while job was running "
+                        "(slice preempted or externally terminated)")
             time.sleep(poll_interval)
 
         # Final log drain for remote hosts.
-        for host in info.hosts:
-            runner, _, log_path, local_log = hostpaths[host.host_id]
+        for host in hosts:
+            runner, _, log_path, local_log = hostpaths[host["host_id"]]
             if not runner.is_local:
                 _mirror_log(runner, log_path, local_log, offsets,
-                            host.host_id)
+                            host["host_id"])
 
         failed = [h for h, rc in done.items() if rc != 0]
         if failed:
@@ -171,8 +200,30 @@ def run_job(cluster_dir: str, job_id: int, poll_interval: float = 0.2) -> int:
     except Exception as e:  # noqa: BLE001 — driver must record failure
         print(f"driver error: {e}", file=sys.stderr)
         _kill_all(started)
+        # Drain remote logs before the terminal status write: tail_logs'
+        # bounded-read contract is that a read observing terminal status
+        # already carries every mirrored byte — the bytes explaining
+        # THIS failure most of all.
+        for host in hosts:
+            entry = hostpaths.get(host["host_id"])
+            if entry and not entry[0].is_local:
+                try:
+                    _mirror_log(entry[0], entry[2], entry[3], offsets,
+                                host["host_id"])
+                except Exception:  # noqa: BLE001 — hosts may be gone
+                    pass
         job_queue.set_status(db, job_id, job_queue.JobStatus.FAILED)
         return 1
+
+
+def _cluster_gone(meta: dict) -> bool:
+    try:
+        from skypilot_tpu import provision
+        return provision.query_instances(
+            meta["provider"], meta["cluster_name"],
+            meta["zone"]) == "NOT_FOUND"
+    except Exception:  # noqa: BLE001 — best-effort check only
+        return False
 
 
 def _mirror_log(runner, remote_path: str, local_path: str,
@@ -194,10 +245,10 @@ def _kill_all(started) -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--cluster-dir", required=True)
+    ap.add_argument("--cluster-name", required=True)
     ap.add_argument("--job-id", type=int, required=True)
     args = ap.parse_args()
-    sys.exit(run_job(args.cluster_dir, args.job_id))
+    sys.exit(run_job(args.cluster_name, args.job_id))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,278 @@
+"""Typed cluster RPC: the single client <-> cluster seam.
+
+One call = one JSON request on stdin, one framed JSON response on
+stdout, executed on the cluster head through the command runner as
+``python -S -m skypilot_tpu.runtime.rpc --cluster <name>``. This
+replaces the reference's string-codegen-over-SSH protocol
+(sky/skylet/job_lib.py:930-1077 JobLibCodeGen emits `python -c`
+snippets) with plain data — no generated source, stable wire format,
+symmetrical client in runtime/rpc_client.py.
+
+Everything here is stdlib-only and runs under ``python -S`` (~20ms per
+call vs multi-second site/jax imports), so polling RPCs are cheap.
+
+The job DB, run scripts, logs, autostop config, and the driver/skylet
+processes all live under the HEAD's home — the cluster survives client
+death, serves any number of clients, and autostops by itself
+(reference: sky/skylet/skylet.py + events.py:102).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+from typing import Any, Callable, Dict
+
+from skypilot_tpu.runtime import constants, job_queue, topology
+from skypilot_tpu.utils import command_runner
+
+MARKER = "SKYTPU-RPC1 "
+
+
+def _db(cdir: str) -> str:
+    return os.path.join(cdir, constants.JOB_DB)
+
+
+def _serialize_job(job: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(job)
+    out["status"] = job["status"].value
+    return out
+
+
+def _child_env() -> Dict[str, str]:
+    """Env for head-side daemons (driver, skylet): framework importable,
+    head home pinned."""
+    from skypilot_tpu.utils import paths
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (command_runner.PKG_PARENT + os.pathsep +
+                         env.get("PYTHONPATH", ""))
+    env["SKYPILOT_TPU_HOME"] = paths.home()
+    return env
+
+
+def _spawn_detached(argv, log_path: str) -> int:
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    with open(log_path, "ab") as f:
+        proc = subprocess.Popen(argv, stdout=f, stderr=subprocess.STDOUT,
+                                start_new_session=True, env=_child_env())
+    return proc.pid
+
+
+def _pid_alive(pidfile: str) -> bool:
+    if not os.path.exists(pidfile):
+        return False
+    try:
+        os.kill(int(open(pidfile).read().strip()), 0)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def _ensure_skylet(cluster_name: str, cdir: str) -> None:
+    pidfile = os.path.join(cdir, "skylet.pid")
+    if _pid_alive(pidfile):
+        return
+    pid = _spawn_detached(
+        [sys.executable, "-S", "-m", "skypilot_tpu.runtime.skylet",
+         "--cluster-name", cluster_name],
+        os.path.join(cdir, "skylet.log"))
+    with open(pidfile, "w") as f:
+        f.write(str(pid))
+
+
+# ---------------------------------------------------------------------------
+# Methods. Each takes (cluster_name, cdir, params) and returns a
+# JSON-serializable result.
+
+def _m_ping(cluster_name, cdir, p):
+    return {"pong": True, "home": os.path.dirname(os.path.dirname(cdir))}
+
+
+def _m_init_cluster(cluster_name, cdir, p):
+    meta = p["meta"]
+    if meta.get("launched_at") is None:
+        meta["launched_at"] = time.time()
+    topology.save(cdir, meta)
+    os.makedirs(os.path.join(cdir, "logs"), exist_ok=True)
+    # The skylet is spawned lazily by set_autostop; on re-init (cluster
+    # restart) a persisted autostop config must get its skylet back.
+    if os.path.exists(os.path.join(cdir, topology.AUTOSTOP_CONFIG)):
+        _ensure_skylet(cluster_name, cdir)
+    return {"initialized": True}
+
+
+def _m_submit(cluster_name, cdir, p):
+    job_id = job_queue.add_job(
+        _db(cdir), p.get("name"), "",
+        metadata={"num_nodes": p.get("num_nodes", 1),
+                  "workdir": bool(p.get("workdir", False))})
+    script_path = os.path.join(cdir,
+                               constants.RUN_SCRIPT.format(job_id=job_id))
+    with open(script_path, "w") as f:
+        f.write(p["script"])
+    job_queue.set_run_cmd(_db(cdir), job_id,
+                          f"bash {shlex.quote(script_path)}")
+    pid = _spawn_detached(
+        [sys.executable, "-S", "-m", "skypilot_tpu.runtime.driver",
+         "--cluster-name", cluster_name, "--job-id", str(job_id)],
+        os.path.join(cdir, "logs", f"driver-{job_id}.log"))
+    return {"job_id": job_id, "driver_pid": pid}
+
+
+def _m_get_job(cluster_name, cdir, p):
+    job = job_queue.get_job(_db(cdir), int(p["job_id"]))
+    return _serialize_job(job) if job else None
+
+
+def _m_list_jobs(cluster_name, cdir, p):
+    return [_serialize_job(j) for j in job_queue.list_jobs(_db(cdir))]
+
+
+def _m_cancel(cluster_name, cdir, p):
+    job_id = int(p["job_id"])
+    job = job_queue.get_job(_db(cdir), job_id)
+    if job is None:
+        raise _err("JobNotFoundError", f"no job {job_id}")
+    job_queue.set_status(_db(cdir), job_id, job_queue.JobStatus.CANCELLED)
+    # The driver notices CANCELLED within one poll; also kill the job
+    # processes directly in case the driver itself died.
+    if job["pids"]:
+        try:
+            meta = topology.load(cdir)
+            runners = topology.build_runners(meta)
+            for runner, pid in zip(runners, job["pids"]):
+                runner.kill(pid)
+        except (OSError, NotImplementedError):
+            pass
+    return {"cancelled": job_id}
+
+
+def _m_read_logs(cluster_name, cdir, p):
+    job_id = int(p["job_id"])
+    job = job_queue.get_job(_db(cdir), job_id)
+    if job is None:
+        raise _err("JobNotFoundError", f"no job {job_id}")
+    log_dir = os.path.join(cdir, "logs",
+                           constants.LOG_DIR.format(job_id=job_id))
+    offsets = {str(k): int(v) for k, v in (p.get("offsets") or {}).items()}
+    chunks: Dict[str, str] = {}
+    if os.path.isdir(log_dir):
+        for fname in sorted(os.listdir(log_dir)):
+            if not fname.startswith("rank-"):
+                continue
+            fpath = os.path.join(log_dir, fname)
+            off = offsets.get(fname, 0)
+            try:
+                with open(fpath, "rb") as f:
+                    f.seek(off)
+                    data = f.read()
+            except OSError:
+                continue
+            if data:
+                # Hold back a trailing partial UTF-8 sequence so a
+                # multi-byte char split across two polls is never
+                # corrupted; the held bytes re-read on the next call.
+                data = _trim_partial_utf8(data)
+            if data:
+                chunks[fname] = data.decode("utf-8", errors="replace")
+                offsets[fname] = off + len(data)
+            else:
+                offsets.setdefault(fname, off)
+    return {"status": job["status"].value, "chunks": chunks,
+            "offsets": offsets}
+
+
+def _trim_partial_utf8(data: bytes) -> bytes:
+    """Drop a trailing incomplete UTF-8 sequence (at most 3 bytes)."""
+    for back in range(1, min(4, len(data) + 1)):
+        b = data[-back]
+        if b < 0x80:        # ASCII: complete
+            return data
+        if b >= 0xC0:       # lead byte: complete iff sequence fits
+            need = 2 if b < 0xE0 else 3 if b < 0xF0 else 4
+            return data if back >= need else data[:-back]
+        # else continuation byte: keep looking back
+    return data
+
+
+def _m_set_autostop(cluster_name, cdir, p):
+    cfg_path = os.path.join(cdir, topology.AUTOSTOP_CONFIG)
+    idle = p.get("idle_minutes")
+    if idle is None or idle < 0:
+        try:
+            os.remove(cfg_path)
+        except OSError:
+            pass
+    else:
+        tmp = cfg_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"idle_minutes": idle, "down": bool(p.get("down")),
+                       "set_at": time.time()}, f)
+        os.replace(tmp, cfg_path)
+        _ensure_skylet(cluster_name, cdir)
+    return {"autostop": idle}
+
+
+def _m_is_idle(cluster_name, cdir, p):
+    return {"idle": job_queue.is_idle(_db(cdir))}
+
+
+_METHODS: Dict[str, Callable] = {
+    "ping": _m_ping,
+    "init_cluster": _m_init_cluster,
+    "submit": _m_submit,
+    "get_job": _m_get_job,
+    "list_jobs": _m_list_jobs,
+    "cancel": _m_cancel,
+    "read_logs": _m_read_logs,
+    "set_autostop": _m_set_autostop,
+    "is_idle": _m_is_idle,
+}
+
+
+class RpcMethodError(Exception):
+    """Carries a symbolic error type back over the wire."""
+
+    def __init__(self, etype: str, message: str):
+        super().__init__(message)
+        self.etype = etype
+
+
+def _err(etype: str, message: str) -> RpcMethodError:
+    return RpcMethodError(etype, message)
+
+
+def dispatch(cluster_name: str, method: str,
+             params: Dict[str, Any]) -> Any:
+    fn = _METHODS.get(method)
+    if fn is None:
+        raise _err("RpcError", f"unknown method {method!r}")
+    cdir = topology.cluster_dir(cluster_name)
+    return fn(cluster_name, cdir, params)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cluster", required=True)
+    args = ap.parse_args()
+    try:
+        req = json.loads(sys.stdin.read() or "{}")
+        result = dispatch(args.cluster, req.get("method", "ping"),
+                          req.get("params") or {})
+        resp = {"ok": True, "result": result}
+    except RpcMethodError as e:
+        resp = {"ok": False, "error": str(e), "etype": e.etype}
+    except Exception as e:  # noqa: BLE001 — the wire must always answer
+        resp = {"ok": False, "error": f"{type(e).__name__}: {e}",
+                "etype": type(e).__name__}
+    sys.stdout.write(MARKER + json.dumps(resp) + "\n")
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

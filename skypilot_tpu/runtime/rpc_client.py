@@ -1,0 +1,110 @@
+"""Client half of the typed cluster RPC (see runtime/rpc.py).
+
+Wraps a head-host command runner; every method is one JSON round trip.
+Remote error types re-raise as the matching ``skypilot_tpu.exceptions``
+class when one exists, so callers handle cluster-side failures exactly
+like local ones (the reference's codegen RPC loses this typing —
+sky/skylet/job_lib.py returns encoded strings the caller must parse).
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.runtime import job_queue
+from skypilot_tpu.runtime.rpc import MARKER
+from skypilot_tpu.utils.command_runner import CommandRunner
+
+
+class ClusterRpcError(exceptions.SkyTpuError):
+    pass
+
+
+# Read-only methods are safe to retry on transport failure (one dropped
+# SSH connection mid-poll must not crash wait_job/tail_logs while the
+# job keeps running on the head).
+_IDEMPOTENT = frozenset(
+    {"ping", "get_job", "list_jobs", "read_logs", "is_idle"})
+_TRANSPORT_RETRIES = 3
+_RETRY_BACKOFF_SECONDS = 1.0
+
+
+class ClusterRpc:
+    def __init__(self, head_runner: CommandRunner, cluster_name: str):
+        self.runner = head_runner
+        self.cluster_name = cluster_name
+
+    def call(self, method: str, **params: Any) -> Any:
+        cmd = (self.runner.framework_invocation("skypilot_tpu.runtime.rpc")
+               + f" --cluster {shlex.quote(self.cluster_name)}")
+        payload = json.dumps({"method": method, "params": params})
+        attempts = _TRANSPORT_RETRIES if method in _IDEMPOTENT else 1
+        for attempt in range(attempts):
+            rc, out, err = self.runner.run(cmd, stdin=payload, timeout=120)
+            if rc == 0:
+                break
+            if attempt + 1 < attempts:
+                time.sleep(_RETRY_BACKOFF_SECONDS * (attempt + 1))
+        if rc != 0:
+            raise ClusterRpcError(
+                f"cluster rpc {method!r} on {self.cluster_name!r} failed "
+                f"(rc={rc}): {err.strip() or out.strip()}")
+        resp = None
+        for line in reversed(out.splitlines()):
+            if line.startswith(MARKER):
+                resp = json.loads(line[len(MARKER):])
+                break
+        if resp is None:
+            raise ClusterRpcError(
+                f"cluster rpc {method!r}: no response frame in output: "
+                f"{out[-500:]!r}")
+        if not resp["ok"]:
+            exc_cls = getattr(exceptions, resp.get("etype", ""), None)
+            if isinstance(exc_cls, type) and issubclass(exc_cls, Exception):
+                raise exc_cls(resp["error"])
+            raise ClusterRpcError(f"{resp.get('etype')}: {resp['error']}")
+        return resp["result"]
+
+    # -- typed wrappers ----------------------------------------------------
+    def ping(self) -> Dict[str, Any]:
+        return self.call("ping")
+
+    def init_cluster(self, meta: Dict[str, Any]) -> None:
+        self.call("init_cluster", meta=meta)
+
+    def submit(self, name: Optional[str], script: str, num_nodes: int,
+               workdir: bool = False) -> int:
+        return self.call("submit", name=name, script=script,
+                         num_nodes=num_nodes, workdir=workdir)["job_id"]
+
+    def get_job(self, job_id: int) -> Optional[Dict[str, Any]]:
+        job = self.call("get_job", job_id=job_id)
+        return _rehydrate(job) if job else None
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return [_rehydrate(j) for j in self.call("list_jobs")]
+
+    def cancel(self, job_id: int) -> None:
+        self.call("cancel", job_id=job_id)
+
+    def read_logs(self, job_id: int, offsets: Dict[str, int]
+                  ) -> Tuple[job_queue.JobStatus, Dict[str, str],
+                             Dict[str, int]]:
+        r = self.call("read_logs", job_id=job_id, offsets=offsets)
+        return (job_queue.JobStatus(r["status"]), r["chunks"], r["offsets"])
+
+    def set_autostop(self, idle_minutes: Optional[int], down: bool) -> None:
+        self.call("set_autostop", idle_minutes=idle_minutes, down=down)
+
+    def is_idle(self) -> bool:
+        return self.call("is_idle")["idle"]
+
+
+def _rehydrate(job: Dict[str, Any]) -> Dict[str, Any]:
+    job = dict(job)
+    job["status"] = job_queue.JobStatus(job["status"])
+    return job

@@ -1,48 +1,74 @@
-"""Per-cluster skylet daemon: the autostop event loop.
+"""Cluster-side skylet daemon: the autostop event loop.
 
 Reference parity: sky/skylet/skylet.py + events.py (AutostopEvent :102 —
 idle-minutes tracking, invoking stop/down from the cluster itself).
-Spawned detached by the backend at provision/start time, one per
-cluster; exits when the cluster record disappears or stops.
-
-Currently runs client-side next to the state DB (correct for the local
-provider and for client-managed GCP clusters); moving it onto the head
-host alongside a synced config is the multi-host hardening step tracked
-for the GCP runtime milestone.
+Runs ON THE CLUSTER HEAD (spawned by rpc ``init_cluster`` /
+``set_autostop``), reads only cluster-side state (cluster.json,
+autostop.json, jobs.db), and calls the provider API from the cluster —
+so autostop fires with every client laptop closed, exactly like the
+reference's on-VM AutostopEvent. Runs under ``python -S``;
+stdlib-only imports (the zero-SDK REST providers keep that true even
+for the cloud call).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
 
+from skypilot_tpu.runtime import constants, job_queue, topology
+
+
+def _read_autostop(cdir: str):
+    try:
+        with open(os.path.join(cdir, topology.AUTOSTOP_CONFIG)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
 
 def run(cluster_name: str, poll_interval: float) -> int:
-    from skypilot_tpu import core, state
-    from skypilot_tpu.runtime import constants, job_queue
-    from skypilot_tpu.utils import paths
-
+    cdir = topology.cluster_dir(cluster_name)
+    db = os.path.join(cdir, constants.JOB_DB)
     while True:
-        rec = state.get_cluster(cluster_name)
-        if rec is None or rec["status"] != state.ClusterStatus.UP:
+        try:
+            meta = topology.load(cdir)
+        except (OSError, ValueError):
+            return 0  # cluster record gone: torn down
+        cfg = _read_autostop(cdir)
+        if cfg is None:
+            # Autostop unset (or cancelled): nothing to supervise. The
+            # rpc set_autostop method respawns us when a config appears.
             return 0
-        idle_minutes = rec["autostop_minutes"]
-        if idle_minutes is not None and idle_minutes >= 0:
-            db = os.path.join(paths.cluster_dir(cluster_name),
-                              constants.JOB_DB)
-            last = max(job_queue.last_activity_time(db), rec["launched_at"])
-            if job_queue.is_idle(db) and \
-                    time.time() - last > idle_minutes * 60:
+        if cfg.get("idle_minutes", -1) >= 0:
+            last = max(job_queue.last_activity_time(db),
+                       meta.get("launched_at") or 0.0,
+                       cfg.get("set_at") or 0.0)
+            if (job_queue.is_idle(db)
+                    and time.time() - last > cfg["idle_minutes"] * 60):
+                topology.apply_provider_env(meta)
                 try:
-                    if rec["autostop_down"]:
-                        core.down(cluster_name)
+                    from skypilot_tpu import provision
+                    if cfg.get("down"):
+                        provision.terminate_instances(
+                            meta["provider"], cluster_name, meta["zone"])
                     else:
-                        core.stop(cluster_name)
+                        provision.stop_instances(
+                            meta["provider"], cluster_name, meta["zone"])
+                    with open(os.path.join(cdir, "autostop_fired"),
+                              "w") as f:
+                        f.write(json.dumps(
+                            {"at": time.time(), "down": cfg.get("down")}))
+                    return 0
                 except Exception as e:  # noqa: BLE001
-                    print(f"autostop failed: {e}", file=sys.stderr)
-                return 0
+                    # Transient cloud error: stay alive and retry next
+                    # tick — exiting here would permanently disarm
+                    # autostop and let an idle cluster bill forever.
+                    print(f"autostop attempt failed (will retry): {e}",
+                          file=sys.stderr)
         time.sleep(poll_interval)
 
 

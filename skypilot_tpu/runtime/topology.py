@@ -1,0 +1,139 @@
+"""Head-side cluster topology: cluster.json + intra-cluster runners.
+
+The cluster's own record of itself, written once by the client at
+provision time (rpc ``init_cluster``) and read by every head-side
+component (rpc, driver, skylet). After launch, no client state is
+consulted — the cluster is autonomous (the property the reference gets
+from the on-head Ray cluster + sqlite job DB, sky/skylet/job_lib.py).
+
+Stdlib-only: head-side processes run under ``python -S``.
+
+Schema of cluster.json::
+
+    {
+      "provider": "local" | "gcp" | "kubernetes",
+      "cluster_name": ..., "zone": ..., "region": ...,
+      "num_nodes": N, "hosts_per_node": H,
+      "launched_at": <epoch seconds>,
+      "head_host_id": 0,
+      "ssh_key_path": "~/.skypilot_tpu/ssh/sky-key",   # head-side path
+      "provider_env": {"SKYTPU_LOCAL_CLUSTERS_ROOT": ...},
+      "hosts": [
+        {"host_id": 0, "node_id": 0, "worker_id": 0,
+         "internal_ip": "...", "ssh_user": ..., "ssh_port": 22,
+         "workspace": <dir or null>, "kind": "local"|"fake"|"ssh"|"k8s"}
+      ]
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+from skypilot_tpu.utils import command_runner, paths
+
+CLUSTER_META = "cluster.json"
+AUTOSTOP_CONFIG = "autostop.json"
+
+
+def cluster_dir(cluster_name: str) -> str:
+    """Head-side per-cluster dir (under the head's own home)."""
+    d = os.path.join(paths.home(), "clusters", cluster_name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def save(cdir: str, meta: Dict[str, Any]) -> None:
+    tmp = os.path.join(cdir, CLUSTER_META + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1)
+    os.replace(tmp, os.path.join(cdir, CLUSTER_META))
+
+
+def load(cdir: str) -> Dict[str, Any]:
+    with open(os.path.join(cdir, CLUSTER_META)) as f:
+        return json.load(f)
+
+
+def apply_provider_env(meta: Dict[str, Any]) -> None:
+    """Make provider API calls work from the cluster side (e.g. the
+    local fake cloud's clusters root, which must not depend on any
+    client's home)."""
+    os.environ.update(meta.get("provider_env") or {})
+
+
+def build_runners(
+        meta: Dict[str, Any]) -> List[command_runner.CommandRunner]:
+    """Intra-cluster runners for the gang driver / rpc, aligned with
+    meta["hosts"]. Must be called ON the head host."""
+    head_id = meta.get("head_host_id", 0)
+    runners: List[command_runner.CommandRunner] = []
+    for h in meta["hosts"]:
+        kind = h.get("kind", "ssh")
+        ws = h.get("workspace")
+        if h["host_id"] == head_id:
+            # The head itself: plain local execution. When the host has a
+            # workspace dir (local fake cloud), pin $HOME to it so
+            # `~`-relative layout matches a real VM.
+            runners.append(command_runner.LocalRunner(
+                h["host_id"], h.get("internal_ip", "127.0.0.1"), ws,
+                env_overrides={"HOME": ws} if ws else None))
+        elif kind == "fake":
+            runners.append(command_runner.FakeSSHRunner(
+                root=ws, host_id=h["host_id"],
+                ip=h.get("internal_ip", "127.0.0.1")))
+        elif kind == "local":
+            runners.append(command_runner.LocalRunner(
+                h["host_id"], h.get("internal_ip", "127.0.0.1"), ws,
+                env_overrides={"HOME": ws} if ws else None))
+        elif kind == "ssh":
+            runners.append(command_runner.SSHRunner(
+                ip=h["internal_ip"], user=h.get("ssh_user") or "skypilot",
+                key_path=meta.get("ssh_key_path")
+                or "~/.skypilot_tpu/ssh/sky-key",
+                host_id=h["host_id"], port=h.get("ssh_port", 22)))
+        else:
+            # kubernetes multi-pod gang execution needs a pod-to-pod
+            # exec transport on the head; not built yet. Refuse loudly
+            # rather than half-run (single-pod k8s clusters never get
+            # here: the head branch above handles them).
+            raise NotImplementedError(
+                f"intra-cluster runner kind {kind!r} (host "
+                f"{h['host_id']}): multi-pod kubernetes gang execution "
+                "is not supported yet")
+    return runners
+
+
+def from_cluster_info(info, provider_env: Dict[str, str] | None = None,
+                      ssh_key_path: str | None = None,
+                      launched_at: float | None = None) -> Dict[str, Any]:
+    """Client-side: build the cluster.json payload from a provision
+    ClusterInfo (each HostInfo carries its runner kind)."""
+    hosts = []
+    for h in info.hosts:
+        hosts.append({
+            "host_id": h.host_id,
+            "node_id": h.node_id,
+            "worker_id": h.worker_id,
+            "internal_ip": h.internal_ip,
+            "ssh_user": h.ssh_user,
+            "ssh_port": h.ssh_port,
+            "workspace": h.workspace,
+            "kind": getattr(h, "runner_kind", "ssh"),
+        })
+    return {
+        "provider": info.provider,
+        "cluster_name": info.cluster_name,
+        "zone": info.zone,
+        "num_nodes": max((h["node_id"] for h in hosts), default=0) + 1,
+        "hosts_per_node": (len(hosts) //
+                           (max((h["node_id"] for h in hosts),
+                                default=0) + 1)) if hosts else 1,
+        "launched_at": launched_at,
+        "head_host_id": hosts[0]["host_id"] if hosts else 0,
+        "ssh_key_path": ssh_key_path,
+        "provider_env": provider_env or {},
+        "hosts": hosts,
+    }

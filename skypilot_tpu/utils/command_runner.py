@@ -2,9 +2,14 @@
 hosts, local or over SSH.
 
 Reference parity: sky/utils/command_runner.py (CommandRunner ABC :165,
-SSHCommandRunner :435 with ControlMaster multiplexing). The local runner
-doubles as the fake-cloud execution path so the whole stack is testable
-on one machine.
+SSHCommandRunner :435 with ControlMaster multiplexing). Additions beyond
+the reference: ``stdin`` support (the typed cluster RPC sends one JSON
+request per call on stdin — no string codegen), and ``FakeSSHRunner``,
+which emulates a remote host rooted at a local directory so the entire
+remote code path (rsynced framework, $HOME-relative layout, log
+mirroring) runs in offline tests.
+
+Stdlib-only: head-side runtime processes import this under ``python -S``.
 """
 
 from __future__ import annotations
@@ -15,9 +20,22 @@ import subprocess
 import sys
 from typing import Dict, List, Optional, Tuple
 
+# Parent directory of the skypilot_tpu package on THIS machine — what a
+# child python needs on PYTHONPATH to import the framework.
+PKG_PARENT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Where instance_setup rsyncs the framework on remote hosts, relative to
+# the remote $HOME (reference: the wheel installed by
+# sky/backends/wheel_utils.py:140; here it is a plain package dir added
+# to PYTHONPATH).
+REMOTE_PKG_DIR = ".skypilot_tpu/pkg"
+
 
 class CommandRunner:
     """Runs shell commands on one host."""
+
+    is_local = False
 
     def __init__(self, host_id: int = 0, ip: str = "127.0.0.1"):
         self.host_id = host_id
@@ -25,7 +43,8 @@ class CommandRunner:
 
     def run(self, cmd: str, env: Optional[Dict[str, str]] = None,
             cwd: Optional[str] = None, timeout: Optional[float] = None,
-            log_path: Optional[str] = None) -> Tuple[int, str, str]:
+            log_path: Optional[str] = None,
+            stdin: Optional[str] = None) -> Tuple[int, str, str]:
         """Run to completion. Returns (rc, stdout, stderr); when
         ``log_path`` is given, output is tee'd there instead."""
         raise NotImplementedError
@@ -38,8 +57,9 @@ class CommandRunner:
 
     def rsync(self, src: str, dst: str, up: bool = True,
               excludes: Optional[List[str]] = None) -> None:
-        """Copy src -> dst. ``excludes``: rsync-style patterns to skip
-        (ignored by fallback copy paths)."""
+        """Copy src -> dst. Directory sources copy their CONTENTS into
+        dst (rsync `src/` semantics) on every transport. ``excludes``:
+        rsync-style patterns to skip (ignored by fallback copy paths)."""
         raise NotImplementedError
 
     def kill(self, pid: int) -> None:
@@ -52,40 +72,75 @@ class CommandRunner:
         a `cat` over SSH)."""
         raise NotImplementedError
 
-    @property
-    def is_local(self) -> bool:
-        return isinstance(self, LocalRunner)
+    def framework_invocation(self, module: str) -> str:
+        """Shell command that runs ``python -m <module>`` on this host
+        with the framework importable and site-packages skipped (-S: the
+        runtime layer is stdlib-only, and skipping site avoids paying the
+        multi-second jax/TPU-plugin import on every RPC). Default is the
+        remote contract (rsynced package under $HOME); LocalRunner
+        overrides with the in-tree package."""
+        return (f'PYTHONPATH="$HOME/{REMOTE_PKG_DIR}:$PYTHONPATH" '
+                f"python3 -S -m {module}")
 
 
 class LocalRunner(CommandRunner):
-    """Executes on the local machine (fake-cloud hosts = directories)."""
+    """Executes on the local machine (fake-cloud hosts = directories).
+
+    ``env_overrides`` lets the local provider give each "host" its own
+    $HOME (the host directory), so `~`-relative layout behaves per-host
+    exactly as on a real multi-VM cluster. A value of None unsets the
+    variable.
+    """
+
+    is_local = True
 
     def __init__(self, host_id: int = 0, ip: str = "127.0.0.1",
-                 workspace: Optional[str] = None):
+                 workspace: Optional[str] = None,
+                 env_overrides: Optional[Dict[str, Optional[str]]] = None):
         super().__init__(host_id, ip)
         self.workspace = workspace
+        self.env_overrides = env_overrides or {}
 
     def _env(self, env):
         full = dict(os.environ)
+        for k, v in self.env_overrides.items():
+            if v is None:
+                full.pop(k, None)
+            else:
+                full[k] = v
         if env:
             full.update(env)
         return full
 
-    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None):
+    def _expand(self, path: str) -> str:
+        """Resolve a path the way the remote host's shell would: `~` and
+        relative paths anchor at the HOST's home (the override dir),
+        never at the calling process's cwd."""
+        home = self.env_overrides.get("HOME")
+        if path == "~" or path.startswith("~/"):
+            return (home + path[1:]) if home else os.path.expanduser(path)
+        if home and not os.path.isabs(path):
+            return os.path.join(home, path)
+        return os.path.expanduser(path)
+
+    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None,
+            stdin=None):
         cwd = cwd or self.workspace
         if log_path:
             os.makedirs(os.path.dirname(log_path), exist_ok=True)
             with open(log_path, "ab") as f:
                 proc = subprocess.run(
                     ["bash", "-c", cmd], env=self._env(env), cwd=cwd,
-                    stdout=f, stderr=subprocess.STDOUT, timeout=timeout)
+                    stdout=f, stderr=subprocess.STDOUT, timeout=timeout,
+                    input=stdin.encode() if stdin is not None else None)
             return proc.returncode, "", ""
         proc = subprocess.run(
             ["bash", "-c", cmd], env=self._env(env), cwd=cwd,
-            capture_output=True, text=True, timeout=timeout)
+            capture_output=True, text=True, timeout=timeout, input=stdin)
         return proc.returncode, proc.stdout, proc.stderr
 
     def run_detached(self, cmd, env=None, cwd=None, log_path="/dev/null"):
+        log_path = self._expand(log_path)
         os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
         with open(log_path, "ab") as f:
             proc = subprocess.Popen(
@@ -96,7 +151,7 @@ class LocalRunner(CommandRunner):
 
     def read_file(self, path: str) -> Optional[str]:
         try:
-            with open(os.path.expanduser(path)) as f:
+            with open(self._expand(path)) as f:
                 return f.read()
         except OSError:
             return None
@@ -113,8 +168,8 @@ class LocalRunner(CommandRunner):
 
     def rsync(self, src: str, dst: str, up: bool = True,
               excludes: Optional[List[str]] = None) -> None:
-        src = os.path.expanduser(src)
-        dst = os.path.expanduser(dst)
+        src = self._expand(src)
+        dst = self._expand(dst)
         os.makedirs(dst if os.path.isdir(src) else os.path.dirname(dst),
                     exist_ok=True)
         # rsync if available, else cp (keeps the zero-dep property).
@@ -135,6 +190,40 @@ class LocalRunner(CommandRunner):
                             capture_output=True).returncode
         if rc != 0:
             raise RuntimeError(f"rsync {src} -> {dst} failed")
+
+    def framework_invocation(self, module: str) -> str:
+        return (f"PYTHONPATH={shlex.quote(PKG_PARENT)}:$PYTHONPATH "
+                f"{shlex.quote(sys.executable)} -S -m {module}")
+
+
+class FakeSSHRunner(LocalRunner):
+    """A "remote" host rooted at a local directory (its $HOME).
+
+    The client's SKYPILOT_TPU_HOME and PYTHONPATH are scrubbed from the
+    environment, so anything that works through this runner provably
+    works through the rsynced-package + $HOME-relative layout — the same
+    contract a real SSH host gets. Test seam for the on-cluster runtime
+    (reference analog: the codegen-boundary mocks at
+    tests/common_test_fixtures.py:203-227, made executable).
+    """
+
+    is_local = False
+
+    def __init__(self, root: str, host_id: int = 0, ip: str = "127.0.0.1"):
+        os.makedirs(root, exist_ok=True)
+        super().__init__(
+            host_id, ip, workspace=root,
+            env_overrides={
+                "HOME": root,
+                "SKYPILOT_TPU_HOME": None,
+                "PYTHONPATH": None,
+                # remote "python3" resolves to this interpreter
+                "PATH": (os.path.dirname(sys.executable) + os.pathsep +
+                         os.environ.get("PATH", "")),
+            })
+        self.root = root
+
+    framework_invocation = CommandRunner.framework_invocation
 
 
 class SSHRunner(CommandRunner):
@@ -166,7 +255,8 @@ class SSHRunner(CommandRunner):
             base += ["-o", f"ProxyCommand={self.proxy_command}"]
         return base + [f"{self.user}@{self.ip}"]
 
-    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None):
+    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None,
+            stdin=None):
         env_prefix = "".join(
             f"export {k}={shlex.quote(v)}; " for k, v in (env or {}).items())
         cd = f"cd {shlex.quote(cwd)} && " if cwd else ""
@@ -174,12 +264,12 @@ class SSHRunner(CommandRunner):
         if log_path:
             os.makedirs(os.path.dirname(log_path), exist_ok=True)
             with open(log_path, "ab") as f:
-                proc = subprocess.run(full, stdout=f,
-                                      stderr=subprocess.STDOUT,
-                                      timeout=timeout)
+                proc = subprocess.run(
+                    full, stdout=f, stderr=subprocess.STDOUT, timeout=timeout,
+                    input=stdin.encode() if stdin is not None else None)
             return proc.returncode, "", ""
         proc = subprocess.run(full, capture_output=True, text=True,
-                              timeout=timeout)
+                              timeout=timeout, input=stdin)
         return proc.returncode, proc.stdout, proc.stderr
 
     def run_detached(self, cmd, env=None, cwd=None, log_path="/dev/null"):
@@ -209,6 +299,9 @@ class SSHRunner(CommandRunner):
 
     def rsync(self, src: str, dst: str, up: bool = True,
               excludes: Optional[List[str]] = None) -> None:
+        if up and os.path.isdir(os.path.expanduser(src)):
+            # Contents-into-dst contract (matches LocalRunner.rsync).
+            src = src.rstrip("/") + "/"
         ssh_cmd = " ".join(self._ssh_base()[:-1])
         remote = f"{self.user}@{self.ip}"
         pair = ([src, f"{remote}:{dst}"] if up else [f"{remote}:{src}", dst])

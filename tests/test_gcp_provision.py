@@ -192,11 +192,9 @@ def test_http_error_mapping():
 def test_end_to_end_failover_across_zones(fake_api, tmp_path, monkeypatch):
     """Full backend failover: us-west4-a stocked out -> lands elsewhere."""
     monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "home"))
-    from skypilot_tpu.provision import instance_setup
-    monkeypatch.setattr(instance_setup, "wait_for_ssh",
-                        lambda info, **kw: None)
-    monkeypatch.setattr(instance_setup, "setup_runtime_on_cluster",
-                        lambda info, **kw: None)
+    import skypilot_tpu.backend as backend_mod
+    monkeypatch.setattr(backend_mod, "_setup_and_init_runtime",
+                        lambda provider, cluster_name, zone: None)
     from skypilot_tpu.backend import RetryingProvisioner
     from skypilot_tpu.resources import Resources
     from skypilot_tpu.task import Task

@@ -61,28 +61,38 @@ def test_cancel_pending_job():
 
 
 def test_autostop_daemon_stops_idle_cluster():
+    """The skylet stops the cluster CLOUD-side; the client's state DB is
+    reconciled on the next `status --refresh` (reference semantics:
+    skylet/events.py:102 acts on the VM, clients catch up)."""
     j, handle = sky.launch(_local_task("echo done"), cluster_name="auto1",
                            idle_minutes_to_autostop=0)
     TpuVmBackend().wait_job(handle, j, 120)
+    from skypilot_tpu.provision import local as lp
     deadline = time.time() + 10
     while time.time() < deadline:
-        rec = state.get_cluster("auto1")
-        if rec and rec["status"] == state.ClusterStatus.STOPPED:
-            return
+        if lp.query_instances("auto1", "local") == "STOPPED":
+            break
         time.sleep(0.2)
-    raise AssertionError(f"autostop did not stop cluster: {rec}")
+    else:
+        raise AssertionError("autostop did not stop cluster cloud-side")
+    records = sky.status(["auto1"], refresh=True)
+    assert records[0]["status"] == state.ClusterStatus.STOPPED
 
 
 def test_autodown_daemon_removes_cluster():
-    j, handle = sky.launch(_local_task("echo done"), cluster_name="auto2",
-                           idle_minutes_to_autostop=0, down=True)
+    j, handle = sky.launch(_local_task("echo done"), cluster_name="auto2")
     TpuVmBackend().wait_job(handle, j, 120)
+    sky.autostop("auto2", 0, down_=True)
+    from skypilot_tpu.provision import local as lp
     deadline = time.time() + 10
     while time.time() < deadline:
-        if state.get_cluster("auto2") is None:
-            return
+        if lp.query_instances("auto2", "local") == "NOT_FOUND":
+            break
         time.sleep(0.2)
-    raise AssertionError("autodown did not remove cluster")
+    else:
+        raise AssertionError("autodown did not remove cluster cloud-side")
+    assert sky.status(["auto2"], refresh=True) == []
+    assert state.get_cluster("auto2") is None
 
 
 def test_cost_report_whole_cluster_price():
