@@ -320,6 +320,18 @@ class TpuVmBackend:
         """Arm (or disarm) cluster-side autostop: the skylet on the head
         stops/downs the cluster itself (reference: skylet/events.py:102
         AutostopEvent calls the cloud API from the VM)."""
+        if (idle_minutes is not None and idle_minutes >= 0 and not down
+                and handle.provider == "gcp"
+                and str(handle.resources.accelerator_name or
+                        "").startswith("tpu-")
+                and (handle.get("num_nodes", 1) > 1
+                     or handle.get("hosts_per_node", 1) > 1)):
+            # Fail at arm time, not when the skylet eventually tries:
+            # multi-host/multislice TPUs cannot stop (reference carries
+            # the same restriction, clouds/gcp.py:206-212).
+            raise exceptions.NotSupportedError(
+                "autostop (stop mode) is not supported on multi-host/"
+                "multislice TPU clusters — use autostop --down")
         self._rpc(handle).set_autostop(idle_minutes, down)
 
     def job_log_paths(self, handle: ClusterHandle, job_id: int) -> List[str]:

@@ -31,9 +31,22 @@ from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
 from skypilot_tpu.utils import command_runner
 
 TPU_API = "https://tpu.googleapis.com/v2"
+COMPUTE_API = "https://compute.googleapis.com/compute/v1"
 
 # Generations whose capacity must go through the queued-resource API.
 QUEUED_RESOURCE_GENS = ("v5e", "v5p", "v6e")
+
+# GPU name -> Compute Engine acceleratorType, for machine families that
+# do not embed their GPUs (N1 attachments). A2/A3/G2 machine types carry
+# their GPUs implicitly.
+GPU_ACCELERATOR_TYPES = {
+    "T4": "nvidia-tesla-t4",
+    "V100": "nvidia-tesla-v100",
+    "P100": "nvidia-tesla-p100",
+}
+_BUILTIN_GPU_FAMILIES = ("a2-", "a3-", "g2-")
+
+DEFAULT_VM_IMAGE = "projects/debian-cloud/global/images/family/debian-12"
 
 Transport = Callable[[str, str, Optional[dict]], dict]
 _transport: Optional[Transport] = None
@@ -100,8 +113,9 @@ def _node_name(cluster_name: str) -> str:
     return cluster_name
 
 
-def _node_url(cluster_name: str, zone: str) -> str:
-    return f"{TPU_API}/{_parent(zone)}/nodes/{_node_name(cluster_name)}"
+def _node_url(cluster_name: str, zone: str, node_name: str = None) -> str:
+    return (f"{TPU_API}/{_parent(zone)}/nodes/"
+            f"{node_name or _node_name(cluster_name)}")
 
 
 def _qr_url(cluster_name: str, zone: str) -> str:
@@ -109,15 +123,65 @@ def _qr_url(cluster_name: str, zone: str) -> str:
             f"{_node_name(cluster_name)}")
 
 
+def _node_names_ex(cluster_name: str, zone: str) -> tuple:
+    """(node names, qr_exists). Names are ``cluster`` for a single
+    slice; for multislice, ``{prefix}-{i}`` — the names the TPU API
+    generates from the queued resource's multiNodeParams — derived from
+    the QR so callers need no extra state. ``qr_exists`` tells callers
+    this is definitely a TPU cluster (no Compute fallback probing
+    needed)."""
+    try:
+        qr = _http("GET", _qr_url(cluster_name, zone))
+    except exceptions.ClusterNotUpError:
+        return [cluster_name], False
+    specs = qr.get("body", qr).get("tpu", {}).get("nodeSpec", [])
+    for spec in specs:
+        ms = spec.get("multiNodeParams")
+        if ms:
+            prefix = ms.get("nodeIdPrefix", cluster_name)
+            return ([f"{prefix}-{i}"
+                     for i in range(int(ms["nodeCount"]))], True)
+    if specs and specs[0].get("nodeId"):
+        return [specs[0]["nodeId"]], True
+    return [cluster_name], True
+
+
+def _node_names(cluster_name: str, zone: str) -> List[str]:
+    return _node_names_ex(cluster_name, zone)[0]
+
+
+def _node_states(cluster_name: str, zone: str,
+                 names: List[str]) -> List[Optional[str]]:
+    """Per-node TPU state, None where the node does not exist."""
+    states: List[Optional[str]] = []
+    for name in names:
+        try:
+            node = _http("GET", _node_url(cluster_name, zone, name))
+            states.append(node.get("state"))
+        except exceptions.ClusterNotUpError:
+            states.append(None)
+    return states
+
+
 # -- provision API ----------------------------------------------------------
 
+def _is_tpu_config(config: ProvisionConfig) -> bool:
+    """TPU vs Compute Engine dispatch (reference: GCPNodeType selection
+    at sky/provision/gcp/instance_utils.py:1658-1666)."""
+    return bool(config.accelerator) and config.accelerator.startswith("tpu-")
+
+
 def run_instances(config: ProvisionConfig) -> ProvisionRecord:
-    if config.num_nodes != 1:
-        raise exceptions.ResourcesUnavailableError(
-            "gcp provider: multi-slice (num_nodes>1) lands with multislice "
-            "support; use one slice per cluster for now", no_failover=True)
+    if not _is_tpu_config(config):
+        return _run_compute_instances(config)
     accel = config.accelerator or ""
-    # Resume path: node already exists?
+    if config.num_nodes > 1 and _generation(accel) not in QUEUED_RESOURCE_GENS:
+        raise exceptions.ResourcesUnavailableError(
+            f"gcp provider: multislice requires a queued-resource "
+            f"generation ({'/'.join(QUEUED_RESOURCE_GENS)}); "
+            f"{accel or 'this accelerator'} slices cannot be combined",
+            no_failover=True)
+    # Resume path: node(s) already exist?
     status = query_instances(config.cluster_name, config.zone)
     if status == "UP":
         return ProvisionRecord("gcp", config.cluster_name, config.zone,
@@ -138,47 +202,71 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
         if config.use_spot else {},
     }
     if _generation(accel) in QUEUED_RESOURCE_GENS:
-        body = {
-            "tpu": {"nodeSpec": [{
-                "parent": _parent(config.zone),
-                "nodeId": _node_name(config.cluster_name),
-                "node": node_body,
-            }]},
+        spec: Dict[str, Any] = {
+            "parent": _parent(config.zone),
+            "node": node_body,
         }
+        if config.num_nodes > 1:
+            # Multislice: ONE queued resource atomically requests N
+            # slices (the API names the nodes {prefix}-{i}); DCN wiring
+            # between them is the driver's MEGASCALE_* env. The
+            # reference never implemented this (SURVEY.md §2.3
+            # north-star gap; its GCPTPUVMInstance at
+            # instance_utils.py:1191 is single-node only).
+            spec["multiNodeParams"] = {
+                "nodeCount": config.num_nodes,
+                "nodeIdPrefix": _node_name(config.cluster_name),
+            }
+        else:
+            spec["nodeId"] = _node_name(config.cluster_name)
+        body = {"tpu": {"nodeSpec": [spec]}}
         if config.use_spot:
             body["spot"] = {}
             node_body.pop("schedulingConfig", None)
         _http("POST",
               f"{TPU_API}/{_parent(config.zone)}/queuedResources"
               f"?queuedResourceId={_node_name(config.cluster_name)}", body)
+        ids = ([f"{config.cluster_name}-{i}"
+                for i in range(config.num_nodes)]
+               if config.num_nodes > 1 else [config.cluster_name])
     else:
         _http("POST",
               f"{TPU_API}/{_parent(config.zone)}/nodes"
               f"?nodeId={_node_name(config.cluster_name)}", node_body)
+        ids = [config.cluster_name]
     return ProvisionRecord("gcp", config.cluster_name, config.zone,
-                           created_instance_ids=[config.cluster_name])
+                           created_instance_ids=ids)
 
 
 def wait_instances(cluster_name: str, zone: str, timeout: float = 1800,
                    poll: float = 10.0) -> None:
-    """Wait for the node READY (queued resources: WAITING->PROVISIONING->
-    ACTIVE, then the node itself READY). Non-recoverable queue states
-    (FAILED/SUSPENDED) raise CapacityError -> failover."""
+    """Wait for every node of the cluster READY (queued resources:
+    WAITING->PROVISIONING->ACTIVE, then the node(s) READY; multislice
+    QRs gang-provision all slices atomically). Non-recoverable queue
+    states (FAILED/SUSPENDED) raise CapacityError -> failover."""
+    names, qr_exists = _node_names_ex(cluster_name, zone)
     deadline = time.time() + timeout
     while time.time() < deadline:
-        try:
-            node = _http("GET", _node_url(cluster_name, zone))
-        except exceptions.ClusterNotUpError:
-            node = None
-        if node is not None:
-            state = node.get("state")
-            if state == "READY":
-                return
-            if state in ("PREEMPTED", "TERMINATED"):
-                raise exceptions.CapacityError(
-                    f"TPU node entered {state} while waiting")
-        else:
-            # Node not yet materialized; check the queued resource.
+        if not qr_exists:
+            # QR may have materialized since the first look (direct v2/
+            # v3 nodes never have one; re-resolving is one GET).
+            names, qr_exists = _node_names_ex(cluster_name, zone)
+        states = _node_states(cluster_name, zone, names)
+        if not qr_exists and all(s is None for s in states):
+            # Not a TPU cluster (or not materialized): Compute VMs?
+            vms = _list_cluster_vms_safe(cluster_name, zone)
+            if vms:
+                if all(v.get("status") == "RUNNING" for v in vms):
+                    return
+                time.sleep(poll)
+                continue
+        if any(s in ("PREEMPTED", "TERMINATED") for s in states):
+            raise exceptions.CapacityError(
+                f"TPU node entered {states} while waiting")
+        if states and all(s == "READY" for s in states):
+            return
+        if any(s is None for s in states):
+            # Node(s) not yet materialized; check the queued resource.
             try:
                 qr = _http("GET", _qr_url(cluster_name, zone))
                 qstate = qr.get("state", {}).get("state")
@@ -195,18 +283,39 @@ def wait_instances(cluster_name: str, zone: str, timeout: float = 1800,
 
 def stop_instances(cluster_name: str, zone: str) -> None:
     # TPU-VM pods cannot stop (reference: clouds/gcp.py:206-212 carries
-    # the same restriction); single-host nodes can.
-    info = get_cluster_info(cluster_name, zone)
-    if len(info.hosts) > 1:
+    # the same restriction); single-host nodes can. The node state is
+    # read directly (not via get_cluster_info) so a STOPPED or transient
+    # node cannot mask the friendly refusal below.
+    names = _node_names(cluster_name, zone)
+    if len(names) > 1:
+        raise exceptions.ResourcesUnavailableError(
+            "multislice clusters cannot be stopped; use down instead",
+            no_failover=True)
+    try:
+        node = _http("GET", _node_url(cluster_name, zone, names[0]))
+    except exceptions.ClusterNotUpError:
+        vms = _list_cluster_vms_safe(cluster_name, zone)
+        if not vms:
+            raise
+        for vm in vms:  # Compute VMs stop per-instance
+            if vm.get("status") != "TERMINATED":
+                _http("POST", f"{_compute_zone_url(zone)}/instances/"
+                              f"{vm['name']}/stop")
+        return
+    if node.get("state") == "STOPPED":
+        return  # idempotent
+    if len(node.get("networkEndpoints", [])) > 1:
         raise exceptions.ResourcesUnavailableError(
             "multi-host TPU slices cannot be stopped; use down instead",
             no_failover=True)
-    _http("POST", _node_url(cluster_name, zone) + ":stop")
+    _http("POST", _node_url(cluster_name, zone, names[0]) + ":stop")
 
 
 def terminate_instances(cluster_name: str, zone: str) -> None:
-    for url in (_node_url(cluster_name, zone),
-                _qr_url(cluster_name, zone)):
+    urls = [_node_url(cluster_name, zone, n)
+            for n in _node_names(cluster_name, zone)]
+    urls.append(_qr_url(cluster_name, zone))
+    for url in urls:
         try:
             _http("DELETE", url + "?force=true")
         except exceptions.ClusterNotUpError:
@@ -214,34 +323,60 @@ def terminate_instances(cluster_name: str, zone: str) -> None:
         except exceptions.ResourcesUnavailableError:
             # queued resources require force delete only when provisioning
             raise
+    for vm in _list_cluster_vms_safe(cluster_name, zone):
+        try:
+            _http("DELETE",
+                  f"{_compute_zone_url(zone)}/instances/{vm['name']}")
+        except exceptions.ClusterNotUpError:
+            continue
 
 
 def query_instances(cluster_name: str, zone: str) -> str:
-    try:
-        node = _http("GET", _node_url(cluster_name, zone))
-    except exceptions.ClusterNotUpError:
-        return "NOT_FOUND"
-    state = node.get("state")
-    return {"READY": "UP", "STOPPED": "STOPPED",
-            "PREEMPTED": "NOT_FOUND", "TERMINATED": "NOT_FOUND"}.get(
-                state, "PARTIAL")
+    """Aggregate across slices: UP iff every node READY; NOT_FOUND iff
+    every node gone (incl. a preempted multislice member — slice-wide
+    preemption takes the whole gang, so recovery relaunches all)."""
+    names, _ = _node_names_ex(cluster_name, zone)
+    states = _node_states(cluster_name, zone, names)
+    mapped = [{"READY": "UP", "STOPPED": "STOPPED",
+               "PREEMPTED": None, "TERMINATED": None}.get(s, "PARTIAL")
+              if s is not None else None for s in states]
+    if all(m is None for m in mapped):
+        return _compute_status(_list_cluster_vms_safe(cluster_name, zone))
+    if all(m == "UP" for m in mapped):
+        return "UP"
+    if all(m == "STOPPED" for m in mapped):
+        return "STOPPED"
+    return "PARTIAL"
 
 
 def get_cluster_info(cluster_name: str, zone: str) -> ClusterInfo:
-    node = _http("GET", _node_url(cluster_name, zone))
     hosts: List[HostInfo] = []
-    for i, ep in enumerate(node.get("networkEndpoints", [])):
-        ext = (ep.get("accessConfig") or {}).get("externalIp")
-        hosts.append(HostInfo(
-            host_id=i, node_id=0, worker_id=i,
-            internal_ip=ep.get("ipAddress", ""),
-            external_ip=ext, ssh_user="skypilot", ssh_port=22))
+    accel_type = None
+    node_state = None
+    names = _node_names(cluster_name, zone)
+    try:
+        _http("GET", _node_url(cluster_name, zone, names[0]))
+    except exceptions.ClusterNotUpError:
+        vms = _list_cluster_vms_safe(cluster_name, zone)
+        if vms:
+            return _compute_cluster_info(cluster_name, zone, vms)
+        raise
+    for slice_id, name in enumerate(names):
+        node = _http("GET", _node_url(cluster_name, zone, name))
+        accel_type = node.get("acceleratorType")
+        node_state = node.get("state")
+        for i, ep in enumerate(node.get("networkEndpoints", [])):
+            ext = (ep.get("accessConfig") or {}).get("externalIp")
+            hosts.append(HostInfo(
+                host_id=len(hosts), node_id=slice_id, worker_id=i,
+                internal_ip=ep.get("ipAddress", ""),
+                external_ip=ext, ssh_user="skypilot", ssh_port=22))
     return ClusterInfo(cluster_name=cluster_name, provider="gcp", zone=zone,
                        hosts=hosts,
                        ssh_key_path="~/.ssh/sky-key",
-                       metadata={"accelerator_type":
-                                 node.get("acceleratorType"),
-                                 "state": node.get("state")})
+                       metadata={"accelerator_type": accel_type,
+                                 "state": node_state,
+                                 "num_slices": len(names)})
 
 
 def get_command_runners(info: ClusterInfo) -> List[command_runner.CommandRunner]:
@@ -253,3 +388,148 @@ def get_command_runners(info: ClusterInfo) -> List[command_runner.CommandRunner]
             key_path=info.ssh_key_path or "~/.ssh/sky-key",
             host_id=h.host_id, port=h.ssh_port))
     return runners
+
+
+# -- Compute Engine (GPU / CPU VMs) ----------------------------------------
+# Reference parity: GCPComputeInstance (sky/provision/gcp/
+# instance_utils.py:311). Needed for the GPU catalog rows and for CPU
+# controller VMs (jobs/serve controller-as-task); same zero-SDK REST
+# style as the TPU path.
+
+def _compute_zone_url(zone: str) -> str:
+    project = gcp_auth.get_project()
+    if not project:
+        raise exceptions.NoCloudAccessError(
+            "no GCP project configured (set GOOGLE_CLOUD_PROJECT or "
+            "`gcloud config set project`)")
+    return f"{COMPUTE_API}/projects/{project}/zones/{zone}"
+
+
+def _vm_names(config: ProvisionConfig) -> List[str]:
+    n = config.num_nodes * config.hosts_per_node
+    if n == 1:
+        return [config.cluster_name]
+    return [f"{config.cluster_name}-{i}" for i in range(n)]
+
+
+def _list_cluster_vms(cluster_name: str, zone: str) -> List[dict]:
+    resp = _http(
+        "GET",
+        f"{_compute_zone_url(zone)}/instances"
+        f"?filter=labels.skypilot-tpu-cluster%3D{cluster_name}")
+    return resp.get("items", [])
+
+
+def _list_cluster_vms_safe(cluster_name: str, zone: str) -> List[dict]:
+    """Compute list as a fallback PROBE from TPU paths: a project with
+    the Compute API disabled (TPU-only scope) must not fail TPU
+    status/teardown just because the probe errored."""
+    try:
+        return _list_cluster_vms(cluster_name, zone)
+    except exceptions.SkyTpuError:
+        return []
+
+
+def _ssh_pubkey_metadata() -> List[dict]:
+    import os
+    pub = os.path.expanduser("~/.ssh/sky-key.pub")
+    if not os.path.exists(pub):
+        return []
+    with open(pub) as f:
+        return [{"key": "ssh-keys", "value": f"skypilot:{f.read().strip()}"}]
+
+
+def _run_compute_instances(config: ProvisionConfig) -> ProvisionRecord:
+    expected = _vm_names(config)
+    existing = {v["name"]: v for v in
+                _list_cluster_vms(config.cluster_name, config.zone)}
+    # Resume stopped VMs ("TERMINATED" is Compute-speak for stopped).
+    for name, vm in existing.items():
+        if vm.get("status") == "TERMINATED":
+            _http("POST",
+                  f"{_compute_zone_url(config.zone)}/instances/"
+                  f"{name}/start")
+    # Reconcile against the expected set: a partially-failed earlier
+    # create must top up the missing VMs, not silently under-provision.
+    missing = [n for n in expected if n not in existing]
+    if not missing:
+        return ProvisionRecord("gcp", config.cluster_name, config.zone,
+                               resumed=True)
+
+    if not config.instance_type:
+        raise exceptions.ResourcesUnavailableError(
+            f"gcp VM provisioning needs an instance_type (accelerator="
+            f"{config.accelerator!r} is not a TPU)", no_failover=True)
+    accel_attach = []
+    if config.accelerator and not config.instance_type.startswith(
+            _BUILTIN_GPU_FAMILIES):
+        gpu_type = GPU_ACCELERATOR_TYPES.get(config.accelerator)
+        if gpu_type is None:
+            raise exceptions.ResourcesUnavailableError(
+                f"no Compute Engine accelerator mapping for "
+                f"{config.accelerator!r} on {config.instance_type}",
+                no_failover=True)
+        accel_attach = [{
+            "acceleratorType": (f"zones/{config.zone}/acceleratorTypes/"
+                                f"{gpu_type}"),
+            "acceleratorCount": config.accelerator_count or 1,
+        }]
+    created = []
+    for name in missing:
+        body = {
+            "name": name,
+            "machineType": (f"zones/{config.zone}/machineTypes/"
+                            f"{config.instance_type}"),
+            "disks": [{"boot": True, "autoDelete": True,
+                       "initializeParams": {
+                           "sourceImage": config.image_id
+                           or DEFAULT_VM_IMAGE,
+                           "diskSizeGb": str(config.disk_size)}}],
+            "networkInterfaces": [{
+                "network": "global/networks/default",
+                "accessConfigs": [{"type": "ONE_TO_ONE_NAT",
+                                   "name": "External NAT"}]}],
+            "labels": dict(config.labels,
+                           **{"skypilot-tpu-cluster": config.cluster_name}),
+            "metadata": {"items": _ssh_pubkey_metadata()},
+        }
+        if accel_attach:
+            body["guestAccelerators"] = accel_attach
+        if accel_attach or config.use_spot:
+            # GPUs require TERMINATE-on-maintenance; spot VMs likewise.
+            body["scheduling"] = {
+                "onHostMaintenance": "TERMINATE",
+                "preemptible": bool(config.use_spot),
+            }
+        _http("POST", f"{_compute_zone_url(config.zone)}/instances", body)
+        created.append(name)
+    return ProvisionRecord("gcp", config.cluster_name, config.zone,
+                           created_instance_ids=created,
+                           resumed=bool(existing))
+
+
+def _compute_status(vms: List[dict]) -> str:
+    if not vms:
+        return "NOT_FOUND"
+    statuses = {v.get("status") for v in vms}
+    if statuses == {"RUNNING"}:
+        return "UP"
+    if statuses == {"TERMINATED"}:
+        return "STOPPED"
+    return "PARTIAL"
+
+
+def _compute_cluster_info(cluster_name: str, zone: str,
+                          vms: List[dict]) -> ClusterInfo:
+    hosts: List[HostInfo] = []
+    for i, vm in enumerate(sorted(vms, key=lambda v: v["name"])):
+        nic = (vm.get("networkInterfaces") or [{}])[0]
+        ext = ((nic.get("accessConfigs") or [{}])[0]).get("natIP")
+        hosts.append(HostInfo(
+            host_id=i, node_id=i, worker_id=0,
+            internal_ip=nic.get("networkIP", ""),
+            external_ip=ext, ssh_user="skypilot", ssh_port=22))
+    return ClusterInfo(cluster_name=cluster_name, provider="gcp",
+                       zone=zone, hosts=hosts,
+                       ssh_key_path="~/.ssh/sky-key",
+                       metadata={"vm_cluster": True})

@@ -18,12 +18,22 @@ ENV_WORKER_ID = "SKYTPU_WORKER_ID"        # index within the slice
 ENV_CLUSTER = "SKYTPU_CLUSTER_NAME"
 ENV_JOB_ID = "SKYTPU_INTERNAL_JOB_ID"
 
-# jax.distributed contract — read natively by jax.distributed.initialize.
+# jax.distributed contract — JAX_COORDINATOR_ADDRESS is read natively
+# by jax.distributed.initialize; the process count/id pair is consumed
+# by parallel/distributed.initialize_from_env().
 ENV_COORDINATOR = "JAX_COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
 ENV_PROCESS_ID = "JAX_PROCESS_ID"
 
+# Multislice (DCN) contract — read by libtpu on real multislice TPU
+# hardware; one logical node == one slice, so slice id == node rank.
+# Reference parity: none (the reference never wired multislice).
+ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+
 COORDINATOR_PORT = 8476
+MEGASCALE_PORT = 8080
 
 JOB_DB = "jobs.db"            # per-cluster job queue (head host)
 RUN_SCRIPT = "job_{job_id}.sh"

@@ -49,7 +49,7 @@ def build_job_env(meta: dict, job_id: int, host: dict) -> Dict[str, str]:
     coordinator = (f"{meta['hosts'][0]['internal_ip']}:"
                    f"{constants.COORDINATOR_PORT}")
     n_hosts = len(meta["hosts"])
-    return {
+    env = {
         constants.ENV_CLUSTER: meta["cluster_name"],
         constants.ENV_JOB_ID: str(job_id),
         constants.ENV_NODE_RANK: str(host["node_id"]),
@@ -62,6 +62,14 @@ def build_job_env(meta: dict, job_id: int, host: dict) -> Dict[str, str]:
         constants.ENV_NUM_PROCESSES: str(n_hosts),
         constants.ENV_PROCESS_ID: str(host["host_id"]),
     }
+    if len(node_ips) > 1:
+        # Multislice: one logical node == one slice; libtpu reads the
+        # MEGASCALE_* contract to bring up DCN between slices.
+        env[constants.ENV_MEGASCALE_COORDINATOR] = (
+            f"{node_ips[0]}:{constants.MEGASCALE_PORT}")
+        env[constants.ENV_MEGASCALE_NUM_SLICES] = str(len(node_ips))
+        env[constants.ENV_MEGASCALE_SLICE_ID] = str(host["node_id"])
+    return env
 
 
 def _wrap_script(run_cmd: str, rc_file: str, runner, workdir: bool) -> str:

@@ -64,6 +64,22 @@ def run(cluster_name: str, poll_interval: float) -> int:
                             {"at": time.time(), "down": cfg.get("down")}))
                     return 0
                 except Exception as e:  # noqa: BLE001
+                    if getattr(e, "no_failover", False):
+                        # Permanent refusal (e.g. multislice/multi-host
+                        # TPU cannot stop): retrying forever would spam
+                        # the cloud API while the user believes autostop
+                        # is armed. Disarm loudly.
+                        print(f"autostop impossible, disarming: {e}",
+                              file=sys.stderr)
+                        with open(os.path.join(cdir, "autostop_failed"),
+                                  "w") as f:
+                            f.write(str(e))
+                        try:
+                            os.remove(os.path.join(
+                                cdir, topology.AUTOSTOP_CONFIG))
+                        except OSError:
+                            pass
+                        return 1
                     # Transient cloud error: stay alive and retry next
                     # tick — exiting here would permanently disarm
                     # autostop and let an idle cluster bill forever.

@@ -69,16 +69,11 @@ def main() -> None:
         ap.error("--packed is not supported with --sp > 1 "
                  "(ring attention has no segment masking)")
 
-    # Multi-host: join the slice-wide jax.distributed rendezvous using
+    # Multi-host: join the cluster-wide jax.distributed rendezvous using
     # the runtime's env contract (runtime/constants.py) before touching
-    # devices.
-    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if coord and int(os.environ.get("SKYTPU_NUM_HOSTS", "1")) > 1:
-        import jax
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(os.environ["SKYTPU_NUM_HOSTS"]),
-            process_id=int(os.environ.get("SKYTPU_HOST_ID", "0")))
+    # devices. Multislice (MEGASCALE_*) is consumed by libtpu directly.
+    from skypilot_tpu.parallel.distributed import initialize_from_env
+    initialize_from_env()
 
     import jax
 

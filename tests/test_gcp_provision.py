@@ -18,6 +18,7 @@ class FakeTpuApi:
     def __init__(self, stockout_zones=(), quota_zones=(), ready_after=1):
         self.nodes = {}        # (zone, name) -> node dict
         self.qrs = {}          # (zone, name) -> qr dict
+        self.vms = {}          # (zone, name) -> compute instance dict
         self.stockout_zones = set(stockout_zones)
         self.quota_zones = set(quota_zones)
         self.ready_after = ready_after  # GETs until node turns READY
@@ -25,6 +26,8 @@ class FakeTpuApi:
 
     def __call__(self, method, url, body):
         self.calls.append((method, url))
+        if "compute.googleapis.com" in url:
+            return self._compute(method, url, body)
         m = re.search(r"locations/([^/]+)/(queuedResources|nodes)"
                       r"(?:/([^/:?]+))?(?::(\w+))?(?:\?(.*))?$", url)
         zone, kind, name, verb, query = m.groups()
@@ -39,9 +42,17 @@ class FakeTpuApi:
                 raise exceptions.CapacityError("no more capacity in zone")
             if kind == "queuedResources":
                 self.qrs[key] = {"state": {"state": "WAITING"}, "body": body}
-                node_body = body["tpu"]["nodeSpec"][0]["node"]
-                self.nodes[key] = dict(node_body, state="CREATING",
-                                       _gets=0)
+                spec = body["tpu"]["nodeSpec"][0]
+                node_body = spec["node"]
+                ms = spec.get("multiNodeParams")
+                if ms:
+                    # Multislice: the API generates {prefix}-{i} nodes.
+                    for i in range(ms["nodeCount"]):
+                        self.nodes[(zone, f"{ms['nodeIdPrefix']}-{i}")] = \
+                            dict(node_body, state="CREATING", _gets=0)
+                else:
+                    self.nodes[key] = dict(node_body, state="CREATING",
+                                           _gets=0)
             else:
                 self.nodes[key] = dict(body, state="CREATING", _gets=0)
             return {"name": f"op-{name}"}
@@ -77,6 +88,46 @@ class FakeTpuApi:
             return {}
         raise AssertionError(f"unhandled {method} {url}")
 
+    def _compute(self, method, url, body):
+        m = re.search(r"zones/([^/]+)/instances"
+                      r"(?:/([\w-]+))?(?:/(\w+))?(?:\?(.*))?$", url)
+        zone, name, verb, query = m.groups()
+        if method == "POST" and name is None:
+            if zone in self.quota_zones:
+                raise exceptions.QuotaExceededError("quota exceeded")
+            if zone in self.stockout_zones:
+                raise exceptions.CapacityError("no capacity")
+            vm = dict(body, status="RUNNING")
+            vm.setdefault("networkInterfaces", [{}])
+            n = len(self.vms)
+            vm["networkInterfaces"][0].setdefault("networkIP",
+                                                  f"10.1.0.{n+1}")
+            vm["networkInterfaces"][0].setdefault(
+                "accessConfigs", [{"natIP": f"35.0.0.{n+1}"}])
+            self.vms[(zone, body["name"])] = vm
+            return {"name": f"op-{body['name']}"}
+        if method == "GET" and query and "filter=" in query:
+            cluster = re.search(r"skypilot-tpu-cluster%3D([\w-]+)",
+                                query).group(1)
+            items = [v for (z, n), v in self.vms.items()
+                     if z == zone and
+                     v.get("labels", {}).get("skypilot-tpu-cluster")
+                     == cluster]
+            return {"items": items}
+        key = (zone, name)
+        if method == "POST" and verb == "stop":
+            self.vms[key]["status"] = "TERMINATED"
+            return {}
+        if method == "POST" and verb == "start":
+            self.vms[key]["status"] = "RUNNING"
+            return {}
+        if method == "DELETE":
+            if key not in self.vms:
+                raise exceptions.ClusterNotUpError("not found")
+            del self.vms[key]
+            return {}
+        raise AssertionError(f"unhandled compute {method} {url}")
+
     @staticmethod
     def _n_hosts(accel_type):
         gen, _, size = accel_type.partition("-")
@@ -95,11 +146,12 @@ def fake_api(monkeypatch):
     gcp.set_transport(None)
 
 
-def _config(accel="tpu-v5e-16", zone="us-west4-a", **kw):
+def _config(accel="tpu-v5e-16", zone="us-west4-a", num_nodes=1, **kw):
     from skypilot_tpu.catalog import catalog
     info = catalog.tpu_slice_info(accel)
     return ProvisionConfig(
-        cluster_name="tputest", num_nodes=1, hosts_per_node=info["hosts"],
+        cluster_name="tputest", num_nodes=num_nodes,
+        hosts_per_node=info["hosts"],
         zone=zone, region=zone.rsplit("-", 1)[0], accelerator=accel,
         runtime_version="v2-alpha-tpuv5-lite", **kw)
 
@@ -187,6 +239,128 @@ def test_http_error_mapping():
     assert isinstance(err, exceptions.ClusterNotUpError)
     err = gcp._map_http_error(500, "boom")
     assert isinstance(err, exceptions.ResourcesUnavailableError)
+
+
+def test_multislice_single_qr_creates_n_slices(fake_api):
+    """VERDICT r1 #2: num_nodes>1 = N slices under ONE queued resource
+    (atomic gang provisioning; nodes named {prefix}-{i})."""
+    gcp.run_instances(_config(num_nodes=3))
+    assert len(fake_api.qrs) == 1
+    qr = fake_api.qrs[("us-west4-a", "tputest")]
+    ms = qr["body"]["tpu"]["nodeSpec"][0]["multiNodeParams"]
+    assert ms == {"nodeCount": 3, "nodeIdPrefix": "tputest"}
+    assert set(fake_api.nodes) == {("us-west4-a", f"tputest-{i}")
+                                   for i in range(3)}
+    gcp.wait_instances("tputest", "us-west4-a", timeout=5, poll=0.01)
+    assert gcp.query_instances("tputest", "us-west4-a") == "UP"
+
+
+def test_multislice_host_enumeration_across_slices(fake_api):
+    gcp.run_instances(_config(num_nodes=2))  # v5e-16 = 2 hosts/slice
+    gcp.wait_instances("tputest", "us-west4-a", timeout=5, poll=0.01)
+    info = gcp.get_cluster_info("tputest", "us-west4-a")
+    assert len(info.hosts) == 4
+    assert [(h.host_id, h.node_id, h.worker_id) for h in info.hosts] == [
+        (0, 0, 0), (1, 0, 1), (2, 1, 0), (3, 1, 1)]
+    assert info.metadata["num_slices"] == 2
+
+
+def test_multislice_terminate_removes_all(fake_api):
+    gcp.run_instances(_config(num_nodes=2))
+    gcp.wait_instances("tputest", "us-west4-a", timeout=5, poll=0.01)
+    gcp.terminate_instances("tputest", "us-west4-a")
+    assert not fake_api.nodes and not fake_api.qrs
+    assert gcp.query_instances("tputest", "us-west4-a") == "NOT_FOUND"
+
+
+def test_multislice_partial_preemption_visible(fake_api):
+    gcp.run_instances(_config(num_nodes=2))
+    gcp.wait_instances("tputest", "us-west4-a", timeout=5, poll=0.01)
+    del fake_api.nodes[("us-west4-a", "tputest-1")]
+    assert gcp.query_instances("tputest", "us-west4-a") == "PARTIAL"
+
+
+def test_multislice_stop_rejected(fake_api):
+    gcp.run_instances(_config(num_nodes=2))
+    gcp.wait_instances("tputest", "us-west4-a", timeout=5, poll=0.01)
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        gcp.stop_instances("tputest", "us-west4-a")
+
+
+def test_multislice_requires_queued_resource_generation(fake_api):
+    with pytest.raises(exceptions.ResourcesUnavailableError,
+                       match="queued-resource"):
+        gcp.run_instances(_config(accel="tpu-v3-32", zone="us-central1-a",
+                                  num_nodes=2))
+
+
+def _vm_config(accel=None, count=0, itype="n2-standard-4",
+               zone="us-central1-a", **kw):
+    return ProvisionConfig(
+        cluster_name="vmtest", num_nodes=1, hosts_per_node=1,
+        zone=zone, region=zone.rsplit("-", 1)[0], accelerator=accel,
+        accelerator_count=count, instance_type=itype, **kw)
+
+
+def test_gpu_row_provisions_compute_vm_not_tpu(fake_api):
+    """VERDICT r1 #4: picking A100 on gcp must hit the Compute Engine
+    API, never the TPU API."""
+    gcp.run_instances(_vm_config(accel="A100", count=8,
+                                 itype="a2-highgpu-8g"))
+    assert not fake_api.nodes and not fake_api.qrs
+    vm = fake_api.vms[("us-central1-a", "vmtest")]
+    assert vm["machineType"].endswith("machineTypes/a2-highgpu-8g")
+    # A2 family embeds its GPUs: no guestAccelerators attachment.
+    assert "guestAccelerators" not in vm
+    assert all("tpu.googleapis" not in u for _, u in fake_api.calls
+               if "POST" in _)
+
+
+def test_t4_attaches_guest_accelerator(fake_api):
+    gcp.run_instances(_vm_config(accel="T4", count=4,
+                                 itype="n1-standard-16"))
+    vm = fake_api.vms[("us-central1-a", "vmtest")]
+    assert vm["guestAccelerators"][0]["acceleratorCount"] == 4
+    assert vm["guestAccelerators"][0]["acceleratorType"].endswith(
+        "nvidia-tesla-t4")
+    assert vm["scheduling"]["onHostMaintenance"] == "TERMINATE"
+
+
+def test_cpu_vm_lifecycle(fake_api):
+    """CPU VMs (controller hosts): create -> UP -> stop -> start ->
+    terminate, all through the compute path."""
+    gcp.run_instances(_vm_config())
+    gcp.wait_instances("vmtest", "us-central1-a", timeout=5, poll=0.01)
+    assert gcp.query_instances("vmtest", "us-central1-a") == "UP"
+    info = gcp.get_cluster_info("vmtest", "us-central1-a")
+    assert len(info.hosts) == 1
+    assert info.hosts[0].internal_ip.startswith("10.1.0.")
+    assert info.metadata.get("vm_cluster")
+    gcp.stop_instances("vmtest", "us-central1-a")
+    assert gcp.query_instances("vmtest", "us-central1-a") == "STOPPED"
+    gcp.run_instances(_vm_config())  # resume
+    assert gcp.query_instances("vmtest", "us-central1-a") == "UP"
+    gcp.terminate_instances("vmtest", "us-central1-a")
+    assert gcp.query_instances("vmtest", "us-central1-a") == "NOT_FOUND"
+
+
+def test_gpu_launch_end_to_end_via_optimizer(fake_api, tmp_path,
+                                             monkeypatch):
+    """The done-when for VERDICT #4: `launch --gpus A100` provisions a
+    VM through optimizer -> failover provisioner -> compute API."""
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "home"))
+    import skypilot_tpu.backend as backend_mod
+    monkeypatch.setattr(backend_mod, "_setup_and_init_runtime",
+                        lambda provider, cluster_name, zone: None)
+    from skypilot_tpu.backend import RetryingProvisioner
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    t = Task(name="t", run="echo x")
+    t.set_resources(Resources(accelerators="A100:8", cloud="gcp"))
+    handle = RetryingProvisioner().provision(t, "vmtest")
+    assert handle.provider == "gcp"
+    assert any("compute.googleapis" in u for _, u in fake_api.calls)
+    assert fake_api.vms
 
 
 def test_end_to_end_failover_across_zones(fake_api, tmp_path, monkeypatch):
