@@ -334,15 +334,11 @@ def jobs_cancel(job_ids):
 @click.option("--controller", is_flag=True, default=False)
 def jobs_logs(job_id, controller):
     """Show a managed job's (controller) logs."""
-    from skypilot_tpu.jobs import core as jobs_core, state as jobs_state
+    from skypilot_tpu.jobs import core as jobs_core
     if controller:
         jobs_core.tail_controller_log(job_id)
         return
-    rec = jobs_state.get(job_id)
-    if rec is None or not rec["cluster_name"]:
-        click.echo("No cluster yet for this job.", err=True)
-        return
-    sky.tail_logs(rec["cluster_name"], None, follow=False)
+    jobs_core.tail_job_output(job_id)
 
 
 @cli.group()
@@ -377,10 +373,23 @@ def serve_status(service_name):
         return
     for s in services:
         click.echo(f"{s['name']}: {s['status'].value} "
-                   f"(endpoint http://127.0.0.1:{s['lb_port']})")
+                   f"v{s.get('version', 1)} (lb port {s['lb_port']})")
         for r in s["replicas"]:
-            click.echo(f"  replica {r['replica_id']}: "
+            click.echo(f"  replica {r['replica_id']} "
+                       f"(v{r.get('version', 1)}): "
                        f"{r['status'].value} {r['url'] or ''}")
+
+
+@serve.command(name="update")
+@click.argument("yaml_path")
+@click.argument("service_name")
+def serve_update(yaml_path, service_name):
+    """Rolling-update a running service to a new task/spec version."""
+    from skypilot_tpu.serve import core as serve_core
+    task = Task.from_yaml(yaml_path)
+    info = serve_core.update(task, service_name)
+    click.echo(f"Service {service_name!r} updating to "
+               f"version {info['version']}.")
 
 
 @serve.command(name="down")

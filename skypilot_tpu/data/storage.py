@@ -68,7 +68,7 @@ class AbstractStore:
     def create(self, region: Optional[str] = None) -> None:
         raise NotImplementedError
 
-    def upload(self, source: str) -> None:
+    def upload(self, source: str, subpath: str = "") -> None:
         raise NotImplementedError
 
     def delete(self) -> None:
@@ -101,15 +101,16 @@ class GcsStore(AbstractStore):
             raise exceptions.StorageError(
                 f"creating gs://{self.name} failed: {out.strip()}")
 
-    def upload(self, source: str) -> None:
+    def upload(self, source: str, subpath: str = "") -> None:
         excl = storage_utils.gsutil_exclude_regex(source)
         xflag = f" -x {shlex.quote(excl)}" if excl else ""
+        dst = f"gs://{self.name}/{subpath}" if subpath else f"gs://{self.name}"
         rc, out = self._run(
             f"gcloud storage rsync -r{xflag} {shlex.quote(source)} "
-            f"gs://{self.name}")
+            f"{dst}")
         if rc != 0:
             raise exceptions.StorageError(
-                f"upload {source} -> gs://{self.name} failed: {out.strip()}")
+                f"upload {source} -> {dst} failed: {out.strip()}")
 
     def delete(self) -> None:
         rc, out = self._run(f"gcloud storage rm -r gs://{self.name}")
@@ -212,6 +213,13 @@ class Storage:
             self.store.create(region)
         if self.source:
             self.store.upload(self.source)
+
+    def upload_subpath(self, source: str, subpath: str) -> None:
+        """Upload one local dir/file under a bucket subpath (controller
+        file-mount translation, reference controller_utils.py:696)."""
+        if not self.store.exists():
+            self.store.create()
+        self.store.upload(source, subpath)
 
     def attach_commands(self, mount_path: str) -> List[str]:
         """Commands to run on every cluster host to make this storage

@@ -2,11 +2,11 @@
 
 Reference parity: sky/jobs/controller.py (JobsController:53,
 _run_one_task:120 — launch via strategy, poll, detect preemption,
-recover, cleanup). Runs as a detached local process per job (the
-reference runs it on a jobs-controller *cluster*; controller-as-task
-recursion is wired through jobs/core.py the same way once a remote
-controller cluster is configured — the control logic here is identical
-either way).
+recover, cleanup). Runs as a detached process per job ON the jobs
+controller cluster head (spawned by the rpc ``jobs_submit`` method,
+with the head's own state home and provider env), recursively calling
+the framework's launch path to manage the per-job cluster — the
+reference's controller-as-task recursion (jobs-controller.yaml.j2).
 """
 
 from __future__ import annotations
@@ -39,13 +39,29 @@ class JobsController:
             rec["recovery_strategy"], self.task, self.cluster_name)
         self.backend = TpuVmBackend()
 
+    def _log(self, msg: str) -> None:
+        print(f"[managed job {self.job_id}] {msg}", flush=True)
+
     def run(self) -> None:
         try:
+            self._log(f"starting; cluster {self.cluster_name}, "
+                      f"strategy {type(self.strategy).__name__}")
             state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
             state.set_cluster(self.job_id, self.cluster_name)
-            job_id, handle = self.strategy.launch()
+            # Launching-parallelism gate (reference: sky/jobs/
+            # scheduler.py:72 — at most 4 concurrent launches per CPU).
+            state.acquire_launch_slot(self.job_id)
+            try:
+                job_id, handle = self.strategy.launch()
+            finally:
+                state.release_launch_slot(self.job_id)
+            self._log(f"cluster up; job {job_id} running")
             state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
             self._monitor(job_id, handle)
+            self._snapshot_output(job_id, handle)
+            final = state.get(self.job_id)
+            if final:
+                self._log(f"finished: {final['status'].value}")
         except exceptions.ResourcesUnavailableError as e:
             state.set_status(self.job_id,
                              state.ManagedJobStatus.FAILED_NO_RESOURCE,
@@ -56,6 +72,19 @@ class JobsController:
                              error=f"{type(e).__name__}: {e}")
         finally:
             self._cleanup()
+
+    def _snapshot_output(self, job_id: int, handle: ClusterHandle) -> None:
+        """Persist the job's output logs before the per-job cluster is
+        torn down, so `jobs logs` works after completion (reference:
+        the controller's log download at sky/jobs/controller.py)."""
+        from skypilot_tpu.utils import paths
+        out_path = os.path.join(paths.logs_dir(),
+                                f"jobs-output-{self.job_id}.log")
+        try:
+            with open(out_path, "w") as f:
+                self.backend.tail_logs(handle, job_id, follow=False, out=f)
+        except exceptions.SkyTpuError as e:
+            self._log(f"output snapshot failed: {e}")
 
     # -- monitor loop ------------------------------------------------------
     def _monitor(self, job_id: int, handle: ClusterHandle) -> None:
@@ -100,7 +129,11 @@ class JobsController:
             return None
         state.set_status(self.job_id, state.ManagedJobStatus.RECOVERING)
         try:
-            job_id, handle = self.strategy.recover()
+            state.acquire_launch_slot(self.job_id)
+            try:
+                job_id, handle = self.strategy.recover()
+            finally:
+                state.release_launch_slot(self.job_id)
         except exceptions.ResourcesUnavailableError as e:
             state.set_status(self.job_id,
                              state.ManagedJobStatus.FAILED_NO_RESOURCE,

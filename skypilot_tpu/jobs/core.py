@@ -1,93 +1,126 @@
 """Managed-jobs client ops: launch/queue/cancel/logs.
 
-Reference parity: sky/jobs/server/core.py + scheduler limits
-(sky/jobs/scheduler.py:66-72 — launching <= 4x CPUs, alive <= mem/350MB,
-hard cap 2000).
+Controller-as-task (reference: sky/jobs/server/core.py — the client
+fills jobs-controller.yaml.j2 and sky.launches a controller cluster,
+then codegen-RPCs into it): here the controller cluster is provisioned
+through the same framework launch path (controller_utils), and every
+operation below is one typed RPC to its head, where the managed-jobs
+state DB and the per-job controller processes live. Managed jobs
+therefore survive this client and are visible to every client.
 """
 
 from __future__ import annotations
 
-import os
-import subprocess
 import sys
 import time
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu import exceptions
-from skypilot_tpu.jobs import state
+from skypilot_tpu import controller_utils, exceptions, state as cluster_state
+from skypilot_tpu.backend import ClusterHandle
+from skypilot_tpu.jobs.state import ManagedJobStatus
 from skypilot_tpu.task import Task
-from skypilot_tpu.utils import paths
-
-MAX_JOB_LIMIT = 2000  # reference: sky/jobs/scheduler.py:70
 
 
-def _alive_limit() -> int:
-    try:
-        mem_bytes = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
-        by_mem = int(mem_bytes / (350 * 1024 * 1024))
-    except (ValueError, OSError):
-        by_mem = MAX_JOB_LIMIT
-    return min(by_mem, MAX_JOB_LIMIT)
+def _controller_handle(create_for: Optional[Task] = None) -> ClusterHandle:
+    if create_for is not None:
+        return controller_utils.ensure_controller_cluster(
+            controller_utils.JOBS_CONTROLLER_CLUSTER, create_for, "jobs")
+    rec = cluster_state.get_cluster(
+        controller_utils.JOBS_CONTROLLER_CLUSTER)
+    if rec is None:
+        raise exceptions.ManagedJobError(
+            "no jobs controller cluster; launch a managed job first")
+    return ClusterHandle(rec["handle"])
+
+
+def _rpc(handle: ClusterHandle):
+    return controller_utils.controller_rpc(handle)
 
 
 def launch(task: Task, name: Optional[str] = None) -> int:
-    """Submit a managed job; a detached controller process owns it."""
-    if state.count_alive() >= _alive_limit():
-        raise exceptions.ManagedJobError(
-            f"managed-job limit reached ({_alive_limit()}); wait for "
-            f"running jobs to finish")
+    """Submit a managed job; a controller process on the jobs controller
+    cluster owns it end to end."""
+    handle = _controller_handle(create_for=task)
+    task = controller_utils.translate_local_file_mounts(task, handle)
     strategy = None
     for r in task.resources:
         strategy = r.job_recovery or strategy
-    job_id = state.add(name or task.name, task.to_yaml_config(),
-                       strategy or "EAGER_NEXT_ZONE")
-    log = os.path.join(paths.logs_dir(), f"jobs-controller-{job_id}.log")
-    with open(log, "ab") as f:
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "skypilot_tpu.jobs.controller",
-             "--job-id", str(job_id)],
-            stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
-            env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
-    state.set_controller_pid(job_id, proc.pid)
-    state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
-    return job_id
+    result = _rpc(handle).call(
+        "jobs_submit", name=name or task.name,
+        task_config=task.to_yaml_config(),
+        strategy=strategy or "EAGER_NEXT_ZONE")
+    return result["job_id"]
+
+
+def _rehydrate(rec: Dict[str, Any]) -> Dict[str, Any]:
+    rec = dict(rec)
+    rec["status"] = ManagedJobStatus(rec["status"])
+    return rec
 
 
 def queue() -> List[Dict[str, Any]]:
-    return state.list_jobs()
+    return [_rehydrate(r)
+            for r in _rpc(_controller_handle()).call("jobs_list")]
+
+
+def get(job_id: int) -> Optional[Dict[str, Any]]:
+    rec = _rpc(_controller_handle()).call("jobs_get", job_id=job_id)
+    return _rehydrate(rec) if rec else None
 
 
 def cancel(job_id: int) -> None:
-    rec = state.get(job_id)
-    if rec is None:
-        raise exceptions.ManagedJobError(f"no managed job {job_id}")
-    if rec["status"].is_terminal():
-        return
-    state.set_status(job_id, state.ManagedJobStatus.CANCELLING)
-    # Controller notices CANCELLING and tears the cluster down; if the
-    # controller itself died, finalize here.
-    pid = rec["controller_pid"]
-    if pid is not None:
-        try:
-            os.kill(pid, 0)
-            return  # alive; it will finish the cancellation
-        except OSError:
-            pass
-    state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+    _rpc(_controller_handle()).call("jobs_cancel", job_id=job_id)
 
 
-def wait(job_id: int, timeout: float = 600) -> state.ManagedJobStatus:
+def wait(job_id: int, timeout: float = 600,
+         poll: Optional[float] = None) -> ManagedJobStatus:
+    handle = _controller_handle()
+    rpc = _rpc(handle)
+    # Each poll is a full RPC round trip (SSH exec on cloud
+    # controllers): poll gently there, snappily on local ones.
+    if poll is None:
+        poll = 0.3 if handle.provider == "local" else 3.0
     deadline = time.time() + timeout
     while time.time() < deadline:
-        rec = state.get(job_id)
-        if rec and rec["status"].is_terminal():
-            return rec["status"]
-        time.sleep(0.3)
+        rec = rpc.call("jobs_get", job_id=job_id)
+        if rec and ManagedJobStatus(rec["status"]).is_terminal():
+            return ManagedJobStatus(rec["status"])
+        time.sleep(poll)
     raise TimeoutError(f"managed job {job_id} not terminal in {timeout}s")
 
 
-def tail_controller_log(job_id: int, out=None) -> None:
+def tail_job_output(job_id: int, out=None) -> None:
+    """Fetch the managed job's task output logs via the controller
+    cluster (which holds the per-job cluster handle)."""
     out = out or sys.stdout
-    p = os.path.join(paths.logs_dir(), f"jobs-controller-{job_id}.log")
-    if os.path.exists(p):
-        out.write(open(p).read())
+    r = _rpc(_controller_handle()).call("jobs_tail", job_id=job_id)
+    if r["text"]:
+        out.write(r["text"])
+    if r.get("note"):
+        print(r["note"], file=sys.stderr)
+
+
+def tail_controller_log(job_id: int, out=None, follow: bool = False,
+                        poll: Optional[float] = None) -> None:
+    """Stream the controller log for one managed job from the controller
+    cluster (reference: sky jobs logs --controller)."""
+    out = out or sys.stdout
+    handle = _controller_handle()
+    rpc = _rpc(handle)
+    if poll is None:
+        poll = 0.5 if handle.provider == "local" else 3.0
+    offset = 0
+    while True:
+        r = rpc.call("jobs_log", job_id=job_id, offset=offset)
+        if r["text"]:
+            out.write(r["text"])
+        offset = r["offset"]
+        if not follow:
+            return
+        rec = rpc.call("jobs_get", job_id=job_id)
+        if rec and ManagedJobStatus(rec["status"]).is_terminal():
+            r = rpc.call("jobs_log", job_id=job_id, offset=offset)
+            if r["text"]:
+                out.write(r["text"])
+            return
+        time.sleep(poll)

@@ -52,19 +52,54 @@ CREATE TABLE IF NOT EXISTS managed_jobs (
     recovery_count INTEGER DEFAULT 0,
     recovery_strategy TEXT,
     controller_pid INTEGER,
-    last_error TEXT
+    last_error TEXT,
+    launch_started_at REAL,
+    launch_ended_at REAL
 );
 """
+
+MAX_JOB_LIMIT = 2000  # reference: sky/jobs/scheduler.py:70
+LAUNCHES_PER_CPU = 4  # reference: sky/jobs/scheduler.py:72
+
+
+def alive_limit() -> int:
+    try:
+        mem_bytes = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+        by_mem = int(mem_bytes / (350 * 1024 * 1024))
+    except (ValueError, OSError):
+        by_mem = MAX_JOB_LIMIT
+    return min(by_mem, MAX_JOB_LIMIT)
+
+
+def launch_limit() -> int:
+    override = os.environ.get("SKYTPU_JOBS_MAX_LAUNCHES")
+    if override:
+        return int(override)
+    return LAUNCHES_PER_CPU * (os.cpu_count() or 1)
 
 
 def _db_path() -> str:
     return os.path.join(paths.home(), "managed_jobs.db")
 
 
+# Columns added after the first release: CREATE TABLE IF NOT EXISTS is
+# a no-op on existing DBs, so they need explicit ALTERs (idempotent —
+# duplicate-column errors are expected and ignored).
+_MIGRATIONS = (
+    "ALTER TABLE managed_jobs ADD COLUMN launch_started_at REAL",
+    "ALTER TABLE managed_jobs ADD COLUMN launch_ended_at REAL",
+)
+
+
 @contextlib.contextmanager
 def _db():
     conn = sqlite3.connect(_db_path(), timeout=10)
     conn.executescript(_SCHEMA)
+    for mig in _MIGRATIONS:
+        try:
+            conn.execute(mig)
+        except sqlite3.OperationalError:
+            pass  # column already exists
     try:
         yield conn
         conn.commit()
@@ -133,6 +168,74 @@ def list_jobs() -> List[Dict[str, Any]]:
     return [_rec(r) for r in rows]
 
 
+def acquire_launch_slot(job_id: int, poll: float = 0.2,
+                        timeout: float = 3600) -> None:
+    """Block until a provisioning slot is free, then claim it.
+
+    Bounds concurrent cluster launches across all managed jobs
+    (reference: sky/jobs/scheduler.py:72 — launching <= 4x CPUs). The
+    claim is atomic: count-and-set under BEGIN IMMEDIATE.
+    """
+    limit = launch_limit()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        _reap_dead_launch_slots()
+        with _db() as c:
+            c.execute("BEGIN IMMEDIATE")
+            n = int(c.execute(
+                "SELECT COUNT(*) FROM managed_jobs WHERE"
+                " launch_started_at IS NOT NULL AND"
+                " launch_ended_at IS NULL").fetchone()[0])
+            if n < limit:
+                c.execute(
+                    "UPDATE managed_jobs SET launch_started_at=?,"
+                    " launch_ended_at=NULL WHERE job_id=?",
+                    (time.time(), job_id))
+                return
+        time.sleep(poll)
+    raise TimeoutError(
+        f"no launch slot for managed job {job_id} within {timeout}s")
+
+
+def _reap_dead_launch_slots() -> None:
+    """Free slots whose controller process died between acquire and
+    release (SIGKILL/OOM): the count must not include corpses, or dead
+    slots eventually starve every new launch. Runs on the controller
+    host, so pid liveness is a local check."""
+    with _db() as c:
+        rows = c.execute(
+            "SELECT job_id, controller_pid FROM managed_jobs WHERE"
+            " launch_started_at IS NOT NULL AND launch_ended_at IS NULL"
+        ).fetchall()
+        for job_id, pid in rows:
+            dead = pid is None
+            if pid is not None:
+                try:
+                    os.kill(pid, 0)
+                except OSError:
+                    dead = True
+            if dead:
+                c.execute(
+                    "UPDATE managed_jobs SET launch_ended_at=?"
+                    " WHERE job_id=? AND launch_ended_at IS NULL",
+                    (time.time(), job_id))
+
+
+def release_launch_slot(job_id: int) -> None:
+    with _db() as c:
+        c.execute("UPDATE managed_jobs SET launch_ended_at=?"
+                  " WHERE job_id=? AND launch_ended_at IS NULL",
+                  (time.time(), job_id))
+
+
+def launch_window(job_id: int):
+    with _db() as c:
+        row = c.execute(
+            "SELECT launch_started_at, launch_ended_at FROM managed_jobs"
+            " WHERE job_id=?", (job_id,)).fetchone()
+    return tuple(row) if row else (None, None)
+
+
 def count_alive() -> int:
     with _db() as c:
         return int(c.execute(
@@ -146,16 +249,19 @@ def count_alive() -> int:
 
 _SELECT = ("SELECT job_id, name, task_config, status, submitted_at,"
            " started_at, ended_at, cluster_name, recovery_count,"
-           " recovery_strategy, controller_pid, last_error FROM managed_jobs")
+           " recovery_strategy, controller_pid, last_error,"
+           " launch_started_at, launch_ended_at FROM managed_jobs")
 
 
 def _rec(row) -> Dict[str, Any]:
     (jid, name, cfg, status, sub, start, end, cluster, rec_n, strat, pid,
-     err) = row
+     err, launch_start, launch_end) = row
     return {"job_id": jid, "name": name,
             "task_config": json.loads(cfg),
             "status": ManagedJobStatus(status),
             "submitted_at": sub, "started_at": start, "ended_at": end,
             "cluster_name": cluster, "recovery_count": rec_n,
             "recovery_strategy": strat, "controller_pid": pid,
+            "launch_started_at": launch_start,
+            "launch_ended_at": launch_end,
             "last_error": err}

@@ -222,6 +222,206 @@ def _m_is_idle(cluster_name, cdir, p):
     return {"idle": job_queue.is_idle(_db(cdir))}
 
 
+# -- controller-as-task methods --------------------------------------------
+# Managed-jobs and serve controllers run as processes on THIS host (the
+# controller cluster head, reference: jobs-controller.yaml.j2 /
+# sky-serve-controller templates); their state DBs live in this host's
+# home. Imports are lazy so the plain job-queue RPC stays stdlib-light.
+
+def _controller_env(cdir: str) -> Dict[str, str]:
+    env = _child_env()
+    try:
+        env.update(topology.load(cdir).get("provider_env") or {})
+    except (OSError, ValueError):
+        pass
+    return env
+
+
+def _serialize_enum_rec(rec):
+    out = dict(rec)
+    for k, v in out.items():
+        if hasattr(v, "value") and not isinstance(v, (int, float, str)):
+            out[k] = v.value
+    return out
+
+
+def _m_jobs_submit(cluster_name, cdir, p):
+    from skypilot_tpu.jobs import state as jstate
+    limit = jstate.alive_limit()
+    if jstate.count_alive() >= limit:
+        raise _err("ManagedJobError",
+                   f"managed-job limit reached ({limit}); wait for "
+                   f"running jobs to finish")
+    job_id = jstate.add(p.get("name"), p["task_config"],
+                        p.get("strategy") or "EAGER_NEXT_ZONE")
+    from skypilot_tpu.utils import paths
+    log = os.path.join(paths.logs_dir(), f"jobs-controller-{job_id}.log")
+    os.makedirs(os.path.dirname(log), exist_ok=True)
+    with open(log, "ab") as f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.jobs.controller",
+             "--job-id", str(job_id)],
+            stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
+            env=_controller_env(cdir))
+    jstate.set_controller_pid(job_id, proc.pid)
+    jstate.set_status(job_id, jstate.ManagedJobStatus.SUBMITTED)
+    return {"job_id": job_id}
+
+
+def _m_jobs_list(cluster_name, cdir, p):
+    from skypilot_tpu.jobs import state as jstate
+    return [_serialize_enum_rec(r) for r in jstate.list_jobs()]
+
+
+def _m_jobs_get(cluster_name, cdir, p):
+    from skypilot_tpu.jobs import state as jstate
+    rec = jstate.get(int(p["job_id"]))
+    return _serialize_enum_rec(rec) if rec else None
+
+
+def _m_jobs_cancel(cluster_name, cdir, p):
+    from skypilot_tpu.jobs import state as jstate
+    job_id = int(p["job_id"])
+    rec = jstate.get(job_id)
+    if rec is None:
+        raise _err("ManagedJobError", f"no managed job {job_id}")
+    if rec["status"].is_terminal():
+        return {"cancelled": job_id}
+    jstate.set_status(job_id, jstate.ManagedJobStatus.CANCELLING)
+    pid = rec["controller_pid"]
+    if pid is not None:
+        try:
+            os.kill(pid, 0)
+            return {"cancelled": job_id}  # controller will finish it
+        except OSError:
+            pass
+    jstate.set_status(job_id, jstate.ManagedJobStatus.CANCELLED)
+    return {"cancelled": job_id}
+
+
+def _m_jobs_log(cluster_name, cdir, p):
+    from skypilot_tpu.utils import paths
+    job_id = int(p["job_id"])
+    path = os.path.join(paths.logs_dir(),
+                        f"jobs-controller-{job_id}.log")
+    try:
+        with open(path, "rb") as f:
+            f.seek(int(p.get("offset", 0)))
+            data = f.read()
+    except OSError:
+        return {"text": "", "offset": int(p.get("offset", 0))}
+    data = _trim_partial_utf8(data)
+    return {"text": data.decode("utf-8", errors="replace"),
+            "offset": int(p.get("offset", 0)) + len(data)}
+
+
+def _m_jobs_tail(cluster_name, cdir, p):
+    """Fetch a managed job's OUTPUT logs. The per-job cluster handle
+    lives in this host's cluster state, so the fetch runs here — in a
+    full (non -S) python, since it needs the orchestration stack."""
+    from skypilot_tpu.jobs import state as jstate
+    rec = jstate.get(int(p["job_id"]))
+    if rec is None:
+        raise _err("ManagedJobError", f"no managed job {p['job_id']}")
+    if not rec["cluster_name"]:
+        return {"text": "", "note": "no cluster yet"}
+    if rec["status"].is_terminal():
+        # The per-job cluster is (being) torn down; serve the snapshot
+        # the controller saved before cleanup.
+        from skypilot_tpu.utils import paths
+        snap = os.path.join(paths.logs_dir(),
+                            f"jobs-output-{rec['job_id']}.log")
+        try:
+            with open(snap) as f:
+                return {"text": f.read(), "note": None}
+        except OSError:
+            pass  # no snapshot (e.g. failed before running): live path
+    code = ("from skypilot_tpu import core\n"
+            f"core.tail_logs({rec['cluster_name']!r}, None, follow=False)\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         env=_controller_env(cdir), capture_output=True,
+                         text=True, timeout=120)
+    if out.returncode != 0:
+        lines = out.stderr.strip().splitlines()
+        reason = lines[-1] if lines else "unknown error"
+        return {"text": out.stdout,
+                "note": f"log fetch failed (cluster may be cleaned up): "
+                        f"{reason}"}
+    return {"text": out.stdout, "note": None}
+
+
+def _m_serve_up(cluster_name, cdir, p):
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.utils import paths
+    name = p["service_name"]
+    if serve_state.get_service(name) is not None:
+        raise _err("ServeError", f"service {name!r} already exists")
+    import socket
+    with socket.socket() as s:
+        s.bind(("", int(p.get("lb_port") or 0)))
+        lb_port = s.getsockname()[1]
+    serve_state.add_service(name, p["spec"], p["task_config"], lb_port)
+    log = os.path.join(paths.logs_dir(), f"serve-controller-{name}.log")
+    os.makedirs(os.path.dirname(log), exist_ok=True)
+    with open(log, "ab") as f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.serve.controller",
+             "--service", name],
+            stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
+            env=_controller_env(cdir))
+    serve_state.set_controller_pid(name, proc.pid)
+    return {"lb_port": lb_port}
+
+
+def _m_serve_update(cluster_name, cdir, p):
+    from skypilot_tpu.serve import serve_state
+    name = p["service_name"]
+    if serve_state.get_service(name) is None:
+        raise _err("ServeError", f"no service {name!r}")
+    version = serve_state.update_service(name, p["spec"], p["task_config"])
+    return {"version": version}
+
+
+def _m_serve_status(cluster_name, cdir, p):
+    from skypilot_tpu.serve import serve_state
+    name = p.get("service_name")
+    services = ([serve_state.get_service(name)] if name
+                else serve_state.list_services())
+    out = []
+    for s in services:
+        if s is None:
+            continue
+        replicas = [_serialize_enum_rec(r)
+                    for r in serve_state.list_replicas(s["name"])]
+        out.append(dict(_serialize_enum_rec(s), replicas=replicas))
+    return out
+
+
+def _m_serve_down(cluster_name, cdir, p):
+    from skypilot_tpu.serve import serve_state
+    name = p["service_name"]
+    rec = serve_state.get_service(name)
+    if rec is None:
+        return {"down": name, "missing": True}
+    serve_state.set_service_status(
+        name, serve_state.ServiceStatus.SHUTTING_DOWN)
+    pid = rec["controller_pid"]
+    alive = False
+    if pid is not None:
+        try:
+            os.kill(pid, 0)
+            alive = True
+        except OSError:
+            pass
+    return {"down": name, "controller_alive": alive}
+
+
+def _m_serve_remove(cluster_name, cdir, p):
+    from skypilot_tpu.serve import serve_state
+    serve_state.remove_service(p["service_name"])
+    return {"removed": p["service_name"]}
+
+
 _METHODS: Dict[str, Callable] = {
     "ping": _m_ping,
     "init_cluster": _m_init_cluster,
@@ -232,6 +432,17 @@ _METHODS: Dict[str, Callable] = {
     "read_logs": _m_read_logs,
     "set_autostop": _m_set_autostop,
     "is_idle": _m_is_idle,
+    "jobs_submit": _m_jobs_submit,
+    "jobs_list": _m_jobs_list,
+    "jobs_get": _m_jobs_get,
+    "jobs_cancel": _m_jobs_cancel,
+    "jobs_log": _m_jobs_log,
+    "jobs_tail": _m_jobs_tail,
+    "serve_up": _m_serve_up,
+    "serve_update": _m_serve_update,
+    "serve_status": _m_serve_status,
+    "serve_down": _m_serve_down,
+    "serve_remove": _m_serve_remove,
 }
 
 

@@ -28,7 +28,8 @@ class ClusterRpcError(exceptions.SkyTpuError):
 # SSH connection mid-poll must not crash wait_job/tail_logs while the
 # job keeps running on the head).
 _IDEMPOTENT = frozenset(
-    {"ping", "get_job", "list_jobs", "read_logs", "is_idle"})
+    {"ping", "get_job", "list_jobs", "read_logs", "is_idle",
+     "jobs_get", "jobs_list", "jobs_log", "jobs_tail", "serve_status"})
 _TRANSPORT_RETRIES = 3
 _RETRY_BACKOFF_SECONDS = 1.0
 

@@ -28,9 +28,10 @@ def run(service_name: str) -> int:
     if rec is None:
         print(f"no service {service_name}", file=sys.stderr)
         return 1
-    spec = SkyServiceSpec(**rec["spec"])
-    manager = replica_managers.ReplicaManager(service_name, spec,
-                                              rec["task_config"])
+    spec = SkyServiceSpec.from_yaml_config(rec["spec"])
+    manager = replica_managers.ReplicaManager(
+        service_name, spec, rec["task_config"],
+        version=rec.get("version", 1))
     autoscaler = autoscalers.Autoscaler.from_spec(spec)
 
     # Start the LB as a child; it dies with us.
@@ -51,6 +52,15 @@ def run(service_name: str) -> int:
             rec = serve_state.get_service(service_name)
             if rec is None or rec["status"] == ServiceStatus.SHUTTING_DOWN:
                 break
+            if rec.get("version", 1) != manager.version:
+                # Rolling update: new version launches fresh replicas;
+                # old ones keep serving until drained below.
+                spec = SkyServiceSpec.from_yaml_config(rec["spec"])
+                autoscaler = autoscalers.Autoscaler.from_spec(spec)
+                manager.apply_update(spec, rec["task_config"],
+                                     rec["version"])
+                print(f"rolling update to version {rec['version']}",
+                      flush=True)
             manager.probe_all()
             replicas = serve_state.list_replicas(service_name)
             ready = [r for r in replicas
@@ -69,6 +79,7 @@ def run(service_name: str) -> int:
             decision = autoscaler.decide(serve_state.qps(service_name),
                                          len(ready), len(alive))
             manager.scale_to(decision.target)
+            manager.drain_old_versions(decision.target)
     finally:
         lb.terminate()
         manager.terminate_all()

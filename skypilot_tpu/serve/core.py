@@ -1,28 +1,39 @@
 """SkyServe client ops: up/down/status.
 
-Reference parity: sky/serve/server/core.py.
+Controller-as-task (reference: sky/serve/server/core.py — the client
+launches a sky-serve-controller cluster and the controller + load
+balancer run there, service.py:_start_service): the serve controller
+cluster is provisioned through the framework's own launch path, the
+controller and LB processes run on its head, and the service endpoint
+is the HEAD's address on the LB port — publicly reachable wherever the
+head is, never a client loopback.
 """
 
 from __future__ import annotations
 
-import os
-import socket
-import subprocess
-import sys
 import time
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu import exceptions
-from skypilot_tpu.serve import serve_state
-from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu import controller_utils, exceptions, state as cluster_state
+from skypilot_tpu.backend import ClusterHandle
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.task import Task
-from skypilot_tpu.utils import paths
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
+def _controller_handle(create_for: Optional[Task] = None) -> ClusterHandle:
+    if create_for is not None:
+        return controller_utils.ensure_controller_cluster(
+            controller_utils.SERVE_CONTROLLER_CLUSTER, create_for, "serve")
+    rec = cluster_state.get_cluster(
+        controller_utils.SERVE_CONTROLLER_CLUSTER)
+    if rec is None:
+        raise exceptions.ServeError(
+            "no serve controller cluster; `serve up` a service first")
+    return ClusterHandle(rec["handle"])
+
+
+def _rpc(handle: ClusterHandle):
+    return controller_utils.controller_rpc(handle)
 
 
 def up(task: Task, service_name: str,
@@ -30,70 +41,90 @@ def up(task: Task, service_name: str,
     if task.service is None:
         raise exceptions.ServeError(
             "task has no `service:` section; add one to serve it")
-    if serve_state.get_service(service_name) is not None:
+    handle = _controller_handle(create_for=task)
+    task = controller_utils.translate_local_file_mounts(task, handle)
+    spec_dict = task.service.to_yaml_config()
+    result = _rpc(handle).call(
+        "serve_up", service_name=service_name, spec=spec_dict,
+        task_config=task.to_yaml_config(), lb_port=lb_port)
+    host = controller_utils.controller_endpoint_host(handle)
+    return {"name": service_name,
+            "endpoint": f"http://{host}:{result['lb_port']}",
+            "lb_port": result["lb_port"]}
+
+
+def update(task: Task, service_name: str) -> Dict[str, Any]:
+    """Rolling update: new-version replicas come up first; old ones are
+    drained only once the new version is READY (zero downtime;
+    reference: sky/serve/serve_utils.py version machinery)."""
+    if task.service is None:
         raise exceptions.ServeError(
-            f"service {service_name!r} already exists")
-    lb_port = lb_port or _free_port()
-    spec_dict = {k: v for k, v in vars(task.service).items()}
-    serve_state.add_service(service_name, spec_dict, task.to_yaml_config(),
-                            lb_port)
-    log = os.path.join(paths.logs_dir(),
-                       f"serve-controller-{service_name}.log")
-    with open(log, "ab") as f:
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "skypilot_tpu.serve.controller",
-             "--service", service_name],
-            stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
-            env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
-    serve_state.set_controller_pid(service_name, proc.pid)
-    return {"name": service_name, "endpoint": f"http://127.0.0.1:{lb_port}",
-            "lb_port": lb_port}
+            "task has no `service:` section; add one to serve it")
+    handle = _controller_handle()
+    task = controller_utils.translate_local_file_mounts(task, handle)
+    r = _rpc(handle).call(
+        "serve_update", service_name=service_name,
+        spec=task.service.to_yaml_config(),
+        task_config=task.to_yaml_config())
+    return {"name": service_name, "version": r["version"]}
 
 
 def down(service_name: str, purge: bool = False) -> None:
-    rec = serve_state.get_service(service_name)
-    if rec is None:
+    handle = _controller_handle()
+    rpc = _rpc(handle)
+    r = rpc.call("serve_down", service_name=service_name)
+    if r.get("missing"):
         if purge:
             return
         raise exceptions.ServeError(f"no service {service_name!r}")
-    serve_state.set_service_status(service_name, ServiceStatus.SHUTTING_DOWN)
-    # Controller notices and tears everything down; wait briefly, then
-    # reap the record.
+    # The controller notices SHUTTING_DOWN and tears replicas down;
+    # wait for it (or its death), then reap the record.
     deadline = time.time() + 120
-    pid = rec["controller_pid"]
     while time.time() < deadline:
-        cur = serve_state.get_service(service_name)
-        if cur is None or cur["status"] in (ServiceStatus.SHUTDOWN,
-                                            ServiceStatus.FAILED):
+        rows = rpc.call("serve_status", service_name=service_name)
+        if not rows:
             break
-        if pid is not None:
-            try:
-                os.kill(pid, 0)
-            except OSError:
-                break  # controller is gone
-        time.sleep(0.3)
-    serve_state.remove_service(service_name)
+        status = ServiceStatus(rows[0]["status"])
+        if status in (ServiceStatus.SHUTDOWN, ServiceStatus.FAILED):
+            break
+        if not r.get("controller_alive"):
+            break
+        time.sleep(0.3 if handle.provider == "local" else 2.0)
+    rpc.call("serve_remove", service_name=service_name)
 
 
 def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
-    services = ([serve_state.get_service(service_name)]
-                if service_name else serve_state.list_services())
+    try:
+        handle = _controller_handle()
+    except exceptions.ServeError:
+        return []
+    rows = _rpc(handle).call("serve_status", service_name=service_name)
     out = []
-    for s in services:
-        if s is None:
-            continue
-        out.append(dict(s, replicas=serve_state.list_replicas(s["name"])))
+    for s in rows:
+        s = dict(s)
+        s["status"] = ServiceStatus(s["status"])
+        s["replicas"] = [
+            dict(r, status=ReplicaStatus(r["status"]))
+            for r in s.get("replicas", [])]
+        out.append(s)
     return out
 
 
-def wait_ready(service_name: str, timeout: float = 120) -> None:
+def wait_ready(service_name: str, timeout: float = 120,
+               poll: Optional[float] = None) -> None:
+    handle = _controller_handle()
+    rpc = _rpc(handle)
+    if poll is None:
+        poll = 0.3 if handle.provider == "local" else 3.0
     deadline = time.time() + timeout
     while time.time() < deadline:
-        rec = serve_state.get_service(service_name)
-        if rec and rec["status"] == ServiceStatus.READY:
-            return
-        if rec and rec["status"].is_terminal():
-            raise exceptions.ServeError(
-                f"service entered {rec['status'].value}")
-        time.sleep(0.3)
+        rows = rpc.call("serve_status", service_name=service_name)
+        if rows:
+            st = ServiceStatus(rows[0]["status"])
+            if st == ServiceStatus.READY:
+                return
+            if st.is_terminal():
+                raise exceptions.ServeError(
+                    f"service entered {st.value}")
+        time.sleep(poll)
     raise TimeoutError(f"service {service_name} not READY in {timeout}s")

@@ -28,10 +28,11 @@ PROBE_FAILURES_BEFORE_NOT_READY = 3
 
 class ReplicaManager:
     def __init__(self, service_name: str, spec: SkyServiceSpec,
-                 task_config: dict):
+                 task_config: dict, version: int = 1):
         self.service = service_name
         self.spec = spec
         self.task_config = task_config
+        self.version = version
         self.backend = TpuVmBackend()
         self._next_replica_id = 1 + max(
             [r["replica_id"] for r in serve_state.list_replicas(service_name)]
@@ -41,25 +42,57 @@ class ReplicaManager:
         self._launching: set = set()
         self._lock = threading.Lock()
 
+    # -- rolling updates ---------------------------------------------------
+    def apply_update(self, spec: SkyServiceSpec, task_config: dict,
+                     version: int) -> None:
+        """Switch to a new service version: subsequent launches use the
+        new task/spec; old-version replicas are drained by
+        drain_old_versions once enough new ones are READY (reference:
+        sky/serve/serve_utils.py version machinery)."""
+        self.spec = spec
+        self.task_config = task_config
+        self.version = version
+
+    def drain_old_versions(self, target: int) -> None:
+        """Terminate old-version replicas only after the current version
+        can carry the load — zero-downtime rollover."""
+        live = self._live_replicas()
+        old = [r for r in live if r.get("version", 1) != self.version]
+        if not old:
+            return
+        ready_cur = [r for r in live
+                     if r.get("version", 1) == self.version
+                     and r["status"] == ReplicaStatus.READY]
+        if len(ready_cur) >= max(1, target):
+            for r in old:
+                self._terminate_replica(r["replica_id"])
+
     # -- scaling -----------------------------------------------------------
+    def _live_replicas(self):
+        return [r for r in serve_state.list_replicas(self.service)
+                if r["status"] not in (ReplicaStatus.SHUTTING_DOWN,
+                                       ReplicaStatus.SHUTDOWN,
+                                       ReplicaStatus.FAILED,
+                                       ReplicaStatus.PREEMPTED)]
+
     def scale_to(self, target: int) -> None:
-        replicas = [r for r in serve_state.list_replicas(self.service)
-                    if r["status"] not in (ReplicaStatus.SHUTTING_DOWN,
-                                           ReplicaStatus.SHUTDOWN,
-                                           ReplicaStatus.FAILED,
-                                           ReplicaStatus.PREEMPTED)]
+        # Launch decisions count only CURRENT-version replicas, so an
+        # update immediately provisions the new version while the old
+        # one keeps serving (drained separately).
+        cur = [r for r in self._live_replicas()
+               if r.get("version", 1) == self.version]
         with self._lock:
-            n_current = len(replicas) + len(self._launching)
+            n_current = len(cur) + len(self._launching)
         if target > n_current:
             for _ in range(target - n_current):
                 self._launch_replica()
-        elif target < len(replicas):
+        elif target < len(cur):
             # Scale down the newest non-ready first, then newest ready.
             order = sorted(
-                replicas,
+                cur,
                 key=lambda r: (r["status"] == ReplicaStatus.READY,
                                -r["replica_id"]))
-            for r in order[:len(replicas) - target]:
+            for r in order[:len(cur) - target]:
                 self._terminate_replica(r["replica_id"])
 
     def _launch_replica(self) -> None:
@@ -68,24 +101,30 @@ class ReplicaManager:
             self._next_replica_id += 1
             self._launching.add(rid)
         cluster = f"sky-serve-{self.service}-{rid}"
+        version = self.version
         serve_state.upsert_replica(self.service, rid, cluster,
-                                   ReplicaStatus.PROVISIONING, None)
-        self._pool.submit(self._launch_replica_blocking, rid, cluster)
+                                   ReplicaStatus.PROVISIONING, None,
+                                   version=version)
+        self._pool.submit(self._launch_replica_blocking, rid, cluster,
+                          version, dict(self.task_config))
 
-    def _launch_replica_blocking(self, rid: int, cluster: str) -> None:
+    def _launch_replica_blocking(self, rid: int, cluster: str,
+                                 version: int, task_config: dict) -> None:
         try:
-            task = Task.from_yaml_config(dict(self.task_config))
+            task = Task.from_yaml_config(task_config)
             task.update_envs({"SKYTPU_REPLICA_ID": str(rid),
                               "SKYTPU_REPLICA_PORT": str(self._port(rid))})
             job_id, handle = execution.launch(task, cluster_name=cluster,
                                               retry_until_up=True)
             url = self._replica_url(handle, rid)
             serve_state.upsert_replica(self.service, rid, cluster,
-                                       ReplicaStatus.STARTING, url)
+                                       ReplicaStatus.STARTING, url,
+                                       version=version)
         except Exception as e:  # noqa: BLE001 — replica failure is a state
             print(f"replica {rid} launch failed: {e}", flush=True)
             serve_state.upsert_replica(self.service, rid, cluster,
-                                       ReplicaStatus.FAILED, None)
+                                       ReplicaStatus.FAILED, None,
+                                       version=version)
         finally:
             with self._lock:
                 self._launching.discard(rid)

@@ -50,7 +50,8 @@ CREATE TABLE IF NOT EXISTS services (
     status TEXT,
     controller_pid INTEGER,
     lb_port INTEGER,
-    created_at REAL
+    created_at REAL,
+    version INTEGER DEFAULT 1
 );
 CREATE TABLE IF NOT EXISTS replicas (
     service TEXT,
@@ -59,6 +60,7 @@ CREATE TABLE IF NOT EXISTS replicas (
     status TEXT,
     url TEXT,
     launched_at REAL,
+    version INTEGER DEFAULT 1,
     PRIMARY KEY (service, replica_id)
 );
 CREATE TABLE IF NOT EXISTS lb_requests (
@@ -72,10 +74,23 @@ def _db_path() -> str:
     return os.path.join(paths.home(), "serve.db")
 
 
+# Columns added after the first release: existing DBs need explicit
+# idempotent ALTERs (CREATE TABLE IF NOT EXISTS won't add them).
+_MIGRATIONS = (
+    "ALTER TABLE services ADD COLUMN version INTEGER DEFAULT 1",
+    "ALTER TABLE replicas ADD COLUMN version INTEGER DEFAULT 1",
+)
+
+
 @contextlib.contextmanager
 def _db():
     conn = sqlite3.connect(_db_path(), timeout=10)
     conn.executescript(_SCHEMA)
+    for mig in _MIGRATIONS:
+        try:
+            conn.execute(mig)
+        except sqlite3.OperationalError:
+            pass  # column already exists
     try:
         yield conn
         conn.commit()
@@ -95,6 +110,22 @@ def add_service(name: str, spec: Dict[str, Any], task_config: Dict[str, Any],
              ServiceStatus.CONTROLLER_INIT.value, lb_port, time.time()))
 
 
+def update_service(name: str, spec: Dict[str, Any],
+                   task_config: Dict[str, Any]) -> int:
+    """Record a new service version (rolling update, reference:
+    sky/serve/serve_utils.py version machinery). Returns the version."""
+    with _db() as c:
+        c.execute(
+            "UPDATE services SET spec=?, task_config=?,"
+            " version=version+1 WHERE name=?",
+            (json.dumps(spec), json.dumps(task_config), name))
+        row = c.execute("SELECT version FROM services WHERE name=?",
+                        (name,)).fetchone()
+    if row is None:
+        raise KeyError(f"no service {name!r}")
+    return int(row[0])
+
+
 def set_service_status(name: str, status: ServiceStatus) -> None:
     with _db() as c:
         c.execute("UPDATE services SET status=? WHERE name=?",
@@ -111,13 +142,14 @@ def get_service(name: str) -> Optional[Dict[str, Any]]:
     with _db() as c:
         row = c.execute(
             "SELECT name, spec, task_config, status, controller_pid, lb_port,"
-            " created_at FROM services WHERE name=?", (name,)).fetchone()
+            " created_at, version FROM services WHERE name=?",
+            (name,)).fetchone()
     if row is None:
         return None
     return {"name": row[0], "spec": json.loads(row[1]),
             "task_config": json.loads(row[2]),
             "status": ServiceStatus(row[3]), "controller_pid": row[4],
-            "lb_port": row[5], "created_at": row[6]}
+            "lb_port": row[5], "created_at": row[6], "version": row[7]}
 
 
 def list_services() -> List[Dict[str, Any]]:
@@ -136,16 +168,17 @@ def remove_service(name: str) -> None:
 # -- replicas ---------------------------------------------------------------
 
 def upsert_replica(service: str, replica_id: int, cluster_name: str,
-                   status: ReplicaStatus, url: Optional[str]) -> None:
+                   status: ReplicaStatus, url: Optional[str],
+                   version: int = 1) -> None:
     with _db() as c:
         c.execute(
             "INSERT INTO replicas (service, replica_id, cluster_name,"
-            " status, url, launched_at) VALUES (?,?,?,?,?,?)"
+            " status, url, launched_at, version) VALUES (?,?,?,?,?,?,?)"
             " ON CONFLICT(service, replica_id) DO UPDATE SET"
             " cluster_name=excluded.cluster_name, status=excluded.status,"
-            " url=excluded.url",
+            " url=excluded.url, version=excluded.version",
             (service, replica_id, cluster_name, status.value, url,
-             time.time()))
+             time.time(), version))
 
 
 def set_replica_status(service: str, replica_id: int,
@@ -164,12 +197,12 @@ def remove_replica(service: str, replica_id: int) -> None:
 def list_replicas(service: str) -> List[Dict[str, Any]]:
     with _db() as c:
         rows = c.execute(
-            "SELECT replica_id, cluster_name, status, url, launched_at"
-            " FROM replicas WHERE service=? ORDER BY replica_id",
+            "SELECT replica_id, cluster_name, status, url, launched_at,"
+            " version FROM replicas WHERE service=? ORDER BY replica_id",
             (service,)).fetchall()
     return [{"replica_id": r[0], "cluster_name": r[1],
              "status": ReplicaStatus(r[2]), "url": r[3],
-             "launched_at": r[4]} for r in rows]
+             "launched_at": r[4], "version": r[5]} for r in rows]
 
 
 def ready_urls(service: str) -> List[str]:
