@@ -290,8 +290,14 @@ class TpuVmBackend:
             f"export {k}={shlex.quote(str(v))}\n"
             for k, v in task.envs.items())
         script = f"{env_exports}{setup}{run_cmd}"
+        # The job runs inside ~/sky_workdir when a workdir was synced —
+        # directly (sync_workdir) or via the controller's bucket
+        # translation, which rewrites workdir into a ~/sky_workdir
+        # file mount (controller_utils.translate_local_file_mounts).
+        has_workdir = bool(task.workdir) or "~/sky_workdir" in (
+            task.file_mounts or {})
         job_id = self._rpc(handle).submit(
-            task.name, script, task.num_nodes, workdir=bool(task.workdir))
+            task.name, script, task.num_nodes, workdir=has_workdir)
         if not detach_run:
             self.wait_job(handle, job_id)
         return job_id

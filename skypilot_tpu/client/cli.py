@@ -371,9 +371,17 @@ def serve_status(service_name):
             sys.exit(1)
         click.echo("No services.")
         return
+    from skypilot_tpu import controller_utils
+    from skypilot_tpu.serve.core import _controller_handle
+    try:
+        host = controller_utils.controller_endpoint_host(
+            _controller_handle())
+    except Exception:  # noqa: BLE001 — controller may be unreachable
+        host = "127.0.0.1"
     for s in services:
         click.echo(f"{s['name']}: {s['status'].value} "
-                   f"v{s.get('version', 1)} (lb port {s['lb_port']})")
+                   f"v{s.get('version', 1)} "
+                   f"(endpoint http://{host}:{s['lb_port']})")
         for r in s["replicas"]:
             click.echo(f"  replica {r['replica_id']} "
                        f"(v{r.get('version', 1)}): "

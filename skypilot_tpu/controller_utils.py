@@ -76,6 +76,39 @@ def controller_endpoint_host(handle: ClusterHandle) -> str:
     return info.head.external_ip or info.head.internal_ip
 
 
+def _owner_suffix() -> str:
+    """A stable per-owner suffix: GCS bucket names are GLOBALLY unique,
+    so a fixed name would collide across every deployment worldwide."""
+    import getpass
+    import hashlib
+    import socket
+    try:
+        from skypilot_tpu.provision import gcp_auth
+        seed = gcp_auth.get_project() or ""
+    except Exception:  # noqa: BLE001 — any auth failure: fall through
+        seed = ""
+    if not seed:
+        seed = f"{getpass.getuser()}@{socket.gethostname()}"
+    return hashlib.sha1(seed.encode()).hexdigest()[:8]
+
+
+def get_or_create_controller(cluster_name: str, kind: str,
+                             missing_exc: type,
+                             create_for: Optional[Task] = None
+                             ) -> ClusterHandle:
+    """Shared jobs/serve lookup: return the controller cluster handle,
+    provisioning it when ``create_for`` is given, else raising
+    ``missing_exc`` if it does not exist."""
+    if create_for is not None:
+        return ensure_controller_cluster(cluster_name, create_for, kind)
+    rec = state.get_cluster(cluster_name)
+    if rec is None:
+        raise missing_exc(
+            f"no {kind} controller cluster; launch through `{kind}` "
+            f"first")
+    return ClusterHandle(rec["handle"])
+
+
 def translate_local_file_mounts(task: Task, handle: ClusterHandle) -> Task:
     """Make client-local file sources reachable from the controller
     cluster (reference: maybe_translate_local_file_mounts_and_sync_up,
@@ -94,8 +127,8 @@ def translate_local_file_mounts(task: Task, handle: ClusterHandle) -> Task:
     import uuid
 
     from skypilot_tpu.data import storage as storage_lib
-    bucket_name = f"skytpu-controller-{handle.cluster_name}".replace(
-        "_", "-")
+    bucket_name = (f"skytpu-controller-{handle.cluster_name}-"
+                   f"{_owner_suffix()}").replace("_", "-")
     # Per-submission prefix: concurrent/successive submissions must not
     # clobber each other's files in the shared controller bucket.
     run_prefix = f"run-{uuid.uuid4().hex[:10]}"

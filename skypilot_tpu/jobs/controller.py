@@ -57,7 +57,9 @@ class JobsController:
                 state.release_launch_slot(self.job_id)
             self._log(f"cluster up; job {job_id} running")
             state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
-            self._monitor(job_id, handle)
+            # _monitor returns the FINAL (job_id, handle) — recovery may
+            # have moved the job to a fresh cluster in another zone.
+            job_id, handle = self._monitor(job_id, handle)
             self._snapshot_output(job_id, handle)
             final = state.get(self.job_id)
             if final:
@@ -87,23 +89,25 @@ class JobsController:
             self._log(f"output snapshot failed: {e}")
 
     # -- monitor loop ------------------------------------------------------
-    def _monitor(self, job_id: int, handle: ClusterHandle) -> None:
+    def _monitor(self, job_id: int, handle: ClusterHandle):
+        """Returns the final (job_id, handle) — possibly a recovered
+        cluster, which is the one whose logs are worth snapshotting."""
         while True:
             time.sleep(POLL_SECONDS)
             rec = state.get(self.job_id)
             if rec["status"] == state.ManagedJobStatus.CANCELLING:
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.CANCELLED)
-                return
+                return job_id, handle
             js = self._cluster_job_status(handle, job_id)
             if js == JobStatus.SUCCEEDED:
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.SUCCEEDED)
-                return
+                return job_id, handle
             if js == JobStatus.CANCELLED:
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.CANCELLED)
-                return
+                return job_id, handle
             if js is None or js in (JobStatus.FAILED,
                                     JobStatus.FAILED_SETUP):
                 # Cluster gone (slice preempted) or job died with the
@@ -113,10 +117,10 @@ class JobsController:
                     state.set_status(self.job_id,
                                      state.ManagedJobStatus.FAILED,
                                      error="task failed on healthy cluster")
-                    return
+                    return job_id, handle
                 recovered = self._recover()
                 if recovered is None:
-                    return
+                    return job_id, handle
                 job_id, handle = recovered
 
     def _recover(self):

@@ -15,22 +15,16 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu import controller_utils, exceptions, state as cluster_state
+from skypilot_tpu import controller_utils, exceptions
 from skypilot_tpu.backend import ClusterHandle
 from skypilot_tpu.jobs.state import ManagedJobStatus
 from skypilot_tpu.task import Task
 
 
 def _controller_handle(create_for: Optional[Task] = None) -> ClusterHandle:
-    if create_for is not None:
-        return controller_utils.ensure_controller_cluster(
-            controller_utils.JOBS_CONTROLLER_CLUSTER, create_for, "jobs")
-    rec = cluster_state.get_cluster(
-        controller_utils.JOBS_CONTROLLER_CLUSTER)
-    if rec is None:
-        raise exceptions.ManagedJobError(
-            "no jobs controller cluster; launch a managed job first")
-    return ClusterHandle(rec["handle"])
+    return controller_utils.get_or_create_controller(
+        controller_utils.JOBS_CONTROLLER_CLUSTER, "jobs",
+        exceptions.ManagedJobError, create_for)
 
 
 def _rpc(handle: ClusterHandle):

@@ -391,9 +391,17 @@ def _m_serve_status(cluster_name, cdir, p):
     for s in services:
         if s is None:
             continue
+        alive = False
+        if s.get("controller_pid") is not None:
+            try:
+                os.kill(s["controller_pid"], 0)
+                alive = True
+            except OSError:
+                pass
         replicas = [_serialize_enum_rec(r)
                     for r in serve_state.list_replicas(s["name"])]
-        out.append(dict(_serialize_enum_rec(s), replicas=replicas))
+        out.append(dict(_serialize_enum_rec(s), replicas=replicas,
+                        controller_alive=alive))
     return out
 
 

@@ -14,22 +14,16 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu import controller_utils, exceptions, state as cluster_state
+from skypilot_tpu import controller_utils, exceptions
 from skypilot_tpu.backend import ClusterHandle
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.task import Task
 
 
 def _controller_handle(create_for: Optional[Task] = None) -> ClusterHandle:
-    if create_for is not None:
-        return controller_utils.ensure_controller_cluster(
-            controller_utils.SERVE_CONTROLLER_CLUSTER, create_for, "serve")
-    rec = cluster_state.get_cluster(
-        controller_utils.SERVE_CONTROLLER_CLUSTER)
-    if rec is None:
-        raise exceptions.ServeError(
-            "no serve controller cluster; `serve up` a service first")
-    return ClusterHandle(rec["handle"])
+    return controller_utils.get_or_create_controller(
+        controller_utils.SERVE_CONTROLLER_CLUSTER, "serve",
+        exceptions.ServeError, create_for)
 
 
 def _rpc(handle: ClusterHandle):
@@ -87,7 +81,9 @@ def down(service_name: str, purge: bool = False) -> None:
         status = ServiceStatus(rows[0]["status"])
         if status in (ServiceStatus.SHUTDOWN, ServiceStatus.FAILED):
             break
-        if not r.get("controller_alive"):
+        # Re-probe liveness each pass: a controller that dies mid-
+        # teardown must not make us wait out the full deadline.
+        if not rows[0].get("controller_alive", True):
             break
         time.sleep(0.3 if handle.provider == "local" else 2.0)
     rpc.call("serve_remove", service_name=service_name)
