@@ -55,9 +55,17 @@ def main() -> None:
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--warmup", type=int, default=2)
-    ap.add_argument("--remat-policy", default="dots",
+    ap.add_argument("--remat-policy", default=None,
                     choices=("none", "dots"))
     ap.add_argument("--xent-chunk", type=int, default=None)
+    ap.add_argument("--param-dtype", default=None,
+                    choices=("float32", "bfloat16"))
+    ap.add_argument("--mu-dtype", default=None,
+                    choices=("float32", "bfloat16"))
+    ap.add_argument("--serve", dest="serve", action="store_true",
+                    default=None, help="append serving TTFT/throughput "
+                    "metrics (default: on TPU only)")
+    ap.add_argument("--no-serve", dest="serve", action="store_false")
     args = ap.parse_args()
 
     import jax
@@ -74,23 +82,45 @@ def main() -> None:
     log(f"bench: {n_chips}x {kind} backend={jax.default_backend()}")
 
     if args.config is None:
-        args.config = "llama3-tiny" if on_cpu else "llama3-400m"
+        # North-star scale on a real chip: the 1B-class config (pure
+        # bf16 train state + chunked xent + full remat fit ~1.5B params
+        # inside 16 GB).
+        args.config = "llama3-tiny" if on_cpu else "llama3-1b"
+    if args.config == "llama3-1b" and not on_cpu:
+        # Measured sweet spot on a 16G v5e: batch 6, full recompute,
+        # bf16 params+moments, 512-token xent chunks -> MFU 0.645.
+        if args.xent_chunk is None:
+            args.xent_chunk = 512
+        if args.mu_dtype is None:
+            args.mu_dtype = "bfloat16"
+        if args.param_dtype is None:
+            args.param_dtype = "bfloat16"
+        if args.remat_policy is None:
+            args.remat_policy = "none"
     if args.batch is None:
-        # batch 6/chip + "dots" remat is the measured sweet spot on a
-        # 16G v5e (MFU 0.574 vs 0.520 at batch 4 + full remat).
+        # batch 6/chip is the sweet spot for both 400M (dots remat) and
+        # 1B (full remat) on a 16G v5e.
         args.batch = 2 if on_cpu else 6 * max(n_chips, 1)
     if on_cpu and args.seq > 256:
         args.seq = 128
 
+    if args.remat_policy is None:
+        args.remat_policy = "dots"
     cfg = llama.CONFIGS[args.config]
     import dataclasses
+
+    import jax.numpy as jnp
     cfg = dataclasses.replace(cfg, remat_policy=args.remat_policy)
     if args.xent_chunk is not None:
         cfg = dataclasses.replace(cfg, xent_chunk=args.xent_chunk)
+    if args.param_dtype is not None:
+        cfg = dataclasses.replace(cfg,
+                                  param_dtype=jnp.dtype(args.param_dtype))
     seq = min(args.seq, cfg.max_seq_len)
     mesh = mesh_lib.make_mesh() if n_chips > 1 else None
 
-    tc = trainer.TrainConfig(warmup_steps=10, total_steps=1000)
+    tc = trainer.TrainConfig(warmup_steps=10, total_steps=1000,
+                             mu_dtype=args.mu_dtype)
     t0 = time.time()
     state = trainer.create_train_state(cfg, tc, mesh)
     step = trainer.make_train_step(cfg, tc, mesh)
@@ -139,6 +169,29 @@ def main() -> None:
         "baseline_note": "vs_baseline = MFU ratio vs reference "
                          "Llama-3-8B@v6e-8 anchor (MFU 2.56%, BASELINE.md)",
     }
+
+    # Serving metrics in the same artifact (reference anchors: JetStream
+    # Llama-2-7B on v6e — median TTFT 1829.33 ms, 2147.98 out tok/s).
+    if args.serve is None:
+        args.serve = not on_cpu
+    if args.serve:
+        # Free the train state before loading the serve model.
+        del state, step, batch
+        import gc
+        gc.collect()
+        try:
+            from skypilot_tpu.infer import bench_serve
+            serve = bench_serve.run(config=None, requests=16, slots=16,
+                                    prompt_len=96, new_tokens=64)
+            out.update({
+                "serve_median_ttft_ms": serve["median_ttft_ms"],
+                "serve_out_tok_s": serve["out_tok_s"],
+                "serve_vs_baseline_ttft": serve["vs_baseline_ttft"],
+                "serve_config": serve["config"],
+            })
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"serve bench failed: {e}")
+            out["serve_error"] = str(e)[:200]
     print(json.dumps(out), flush=True)
 
 

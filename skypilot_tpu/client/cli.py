@@ -377,11 +377,15 @@ def serve_status(service_name):
         host = controller_utils.controller_endpoint_host(
             _controller_handle())
     except Exception:  # noqa: BLE001 — controller may be unreachable
-        host = "127.0.0.1"
+        # Never print a fabricated address (a wrong-but-plausible
+        # loopback endpoint reads as "service down").
+        host = None
     for s in services:
+        ep = (f"endpoint http://{host}:{s['lb_port']}" if host
+              else f"endpoint unknown (controller unreachable), "
+                   f"lb port {s['lb_port']}")
         click.echo(f"{s['name']}: {s['status'].value} "
-                   f"v{s.get('version', 1)} "
-                   f"(endpoint http://{host}:{s['lb_port']})")
+                   f"v{s.get('version', 1)} ({ep})")
         for r in s["replicas"]:
             click.echo(f"  replica {r['replica_id']} "
                        f"(v{r.get('version', 1)}): "

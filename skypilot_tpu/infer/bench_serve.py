@@ -29,16 +29,11 @@ REF_TTFT_MS = 1829.33
 REF_TOK_S = 2147.98
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--config", default=None)
-    ap.add_argument("--requests", type=int, default=16)
-    ap.add_argument("--slots", type=int, default=16)
-    ap.add_argument("--prompt-len", type=int, default=96)
-    ap.add_argument("--new-tokens", type=int, default=64)
-    ap.add_argument("--max-burst", type=int, default=32)
-    args = ap.parse_args()
-
+def run(config=None, requests=16, slots=16, prompt_len=96,
+        new_tokens=64, max_burst=32) -> dict:
+    """Run the serving benchmark; returns the metrics dict (also usable
+    by the repo-root bench.py to fold serving numbers into its single
+    JSON artifact)."""
     import jax
     import numpy as np
 
@@ -46,32 +41,31 @@ def main() -> None:
     from skypilot_tpu.models import llama
 
     on_cpu = jax.default_backend() == "cpu"
-    if args.config is None:
-        args.config = "llama3-tiny" if on_cpu else "llama3-400m"
-    cfg = llama.CONFIGS[args.config]
-    log(f"serve bench: {args.config} on {jax.devices()[0].device_kind}")
+    if config is None:
+        config = "llama3-tiny" if on_cpu else "llama3-400m"
+    cfg = llama.CONFIGS[config]
+    log(f"serve bench: {config} on {jax.devices()[0].device_kind}")
 
     params = llama.init_params(jax.random.key(0), cfg)
-    max_len = args.prompt_len + args.new_tokens + 8
-    e = eng.InferenceEngine(params, cfg, n_slots=args.slots,
+    max_len = prompt_len + new_tokens + 8
+    e = eng.InferenceEngine(params, cfg, n_slots=slots,
                             max_len=max_len,
-                            prompt_buckets=(args.prompt_len,))
+                            prompt_buckets=(prompt_len,))
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(1, cfg.vocab_size,
-                            args.prompt_len).tolist()
-               for _ in range(args.requests)]
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(requests)]
 
     # Warmup: compile the full-wave admission program and the burst
     # decode programs at the measured run's own burst size.
-    for p in [prompts[0]] * args.slots:
-        e.add_request(p, max_new_tokens=args.new_tokens)
-    e.run_to_completion(max_burst=args.max_burst)
+    for p in [prompts[0]] * slots:
+        e.add_request(p, max_new_tokens=new_tokens)
+    e.run_to_completion(max_burst=max_burst)
     e.finished.clear()
 
     t0 = time.time()
     for p in prompts:
-        e.add_request(p, max_new_tokens=args.new_tokens)
-    done = e.run_to_completion(max_burst=args.max_burst)
+        e.add_request(p, max_new_tokens=new_tokens)
+    done = e.run_to_completion(max_burst=max_burst)
     # Force a host sync so the wall clock is honest (axon relay:
     # block_until_ready does not synchronize; a host fetch does).
     float(e.cache["length"][0])
@@ -85,14 +79,35 @@ def main() -> None:
 
     log(f"requests={len(done)} wall={wall:.2f}s median_ttft={med_ttft:.1f}ms "
         f"tok/s={tok_s:.1f} req/s={req_s:.2f}")
+    return {
+        "median_ttft_ms": round(med_ttft, 2),
+        "out_tok_s": round(tok_s, 2),
+        "req_per_s": round(req_s, 3),
+        "vs_baseline_ttft": round(REF_TTFT_MS / max(med_ttft, 1e-9), 3),
+        "config": config,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--prompt-len", type=int, default=96)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--max-burst", type=int, default=32)
+    args = ap.parse_args()
+    r = run(config=args.config, requests=args.requests, slots=args.slots,
+            prompt_len=args.prompt_len, new_tokens=args.new_tokens,
+            max_burst=args.max_burst)
     print(json.dumps({
         "metric": "serve_median_ttft",
-        "value": round(med_ttft, 2),
+        "value": r["median_ttft_ms"],
         "unit": "ms",
-        "vs_baseline": round(REF_TTFT_MS / max(med_ttft, 1e-9), 3),
-        "output_tok_per_s": round(tok_s, 2),
-        "req_per_s": round(req_s, 3),
-        "config": args.config,
+        "vs_baseline": r["vs_baseline_ttft"],
+        "output_tok_per_s": r["out_tok_s"],
+        "req_per_s": r["req_per_s"],
+        "config": r["config"],
     }))
 
 

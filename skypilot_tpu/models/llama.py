@@ -83,8 +83,12 @@ class LlamaConfig:
 CONFIGS: Dict[str, LlamaConfig] = {
     "llama3-8b": LlamaConfig(vocab_size=128_256, d_model=4096, n_layers=32,
                              n_heads=32, n_kv_heads=8, d_ff=14_336),
+    # 1B-class config at Llama-3.2-1B proportions, with head_dim 128
+    # (16 heads instead of 32): identical parameter count and FLOPs, but
+    # the head dim matches the MXU lane width / Mosaic tiling so the
+    # Pallas flash kernels engage.
     "llama3-1b": LlamaConfig(vocab_size=128_256, d_model=2048, n_layers=16,
-                             n_heads=32, n_kv_heads=8, d_ff=8192),
+                             n_heads=16, n_kv_heads=8, d_ff=8192),
     "llama3-tiny": LlamaConfig(vocab_size=512, d_model=128, n_layers=2,
                                n_heads=4, n_kv_heads=2, d_ff=256,
                                max_seq_len=256),
@@ -209,21 +213,6 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh=None, rules=None,
     hardcoded here.
     """
     from skypilot_tpu.ops import attention as attn_ops
-    if segment_ids is not None:
-        # Packed sequences: segment masking (XLA path; ring attention
-        # has no segment support — refuse loudly rather than silently
-        # materializing O(S^2) scores at context-parallel lengths).
-        if mesh is not None:
-            from skypilot_tpu.parallel import sharding as sh
-            r = rules if rules is not None else sh.ACT_RULES
-            seq_axis = r.get("seq")
-            if isinstance(seq_axis, str) and mesh.shape.get(seq_axis,
-                                                            1) > 1:
-                raise ValueError(
-                    "packed sequences (segment_ids) are not supported "
-                    "with sequence/context parallelism (sp > 1)")
-        return attn_ops.gqa_attention(q, k, v, causal=True,
-                                      segment_ids=segment_ids)
     if mesh is not None:
         from skypilot_tpu.parallel import ring_attention as ra
         from skypilot_tpu.parallel import sharding as sh
@@ -233,13 +222,18 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh=None, rules=None,
                 and mesh.shape.get(seq_axis, 1) > 1
                 and q.shape[1] % mesh.shape[seq_axis] == 0):
             # (seq not divisible by the ring size falls through to local
-            # attention — same degrade-to-replicated convention as spec_for.)
+            # attention — same degrade-to-replicated convention as
+            # spec_for.) Packed sequences ride the ring: segment ids
+            # circulate with their K/V blocks.
             heads_axis = rules.get("heads")
             return ra.ring_attention(
                 q, k, v, mesh, causal=True, axis=seq_axis,
                 batch_axes=rules.get("batch"),
-                heads_axis=heads_axis if isinstance(heads_axis, str) else None)
-    return attn_ops.gqa_attention(q, k, v, causal=True)
+                heads_axis=heads_axis if isinstance(heads_axis, str)
+                else None,
+                segment_ids=segment_ids)
+    return attn_ops.gqa_attention(q, k, v, causal=True,
+                                  segment_ids=segment_ids)
 
 
 def decoder_layer(cfg: LlamaConfig, x: jax.Array, layer: Params,
@@ -285,7 +279,15 @@ def forward_hidden(params: Params, tokens: jax.Array, cfg: LlamaConfig,
         constrain = lambda x, axes: x
 
     B, S = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    tokens = constrain(tokens, ("batch", "seq"))
+    # Lookup-friendly table layout: vocab stays sharded (canonical
+    # order), embed replicated — the gather then propagates the token
+    # sharding straight to [batch, seq, embed-replicated], which IS the
+    # activation layout; no cross-layout transition (and no involuntary
+    # full rematerialization from the SPMD partitioner).
+    table = constrain(params["embed"].astype(cfg.dtype),
+                      ("vocab", "embed"))
+    x = table[tokens]
     x = constrain(x, ("batch", "seq", "embed"))
     if positions is None:
         positions = jnp.arange(S)

@@ -244,7 +244,13 @@ def forward_hidden(params: Params, tokens: jax.Array, cfg: MoEConfig,
         constrain = lambda x, axes: x
 
     B, S = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    tokens = constrain(tokens, ("batch", "seq"))
+    # Lookup-friendly table layout (see models/llama.forward_hidden):
+    # vocab sharded canonically, embed replicated -> gather output IS
+    # the activation layout, no SPMD full-remat transition.
+    table = constrain(params["embed"].astype(cfg.dtype),
+                      ("vocab", "embed"))
+    x = table[tokens]
     x = constrain(x, ("batch", "seq", "embed"))
     if positions is None:
         positions = jnp.arange(S)

@@ -74,8 +74,13 @@ def gqa_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
     seq = q.shape[1]
+    # Mosaic tiling slices along head_dim: non-128-multiples fail at
+    # COMPILE time (inside the enclosing jit, past the except below), so
+    # they must be routed to XLA at trace time.
+    head_dim_ok = q.shape[-1] % 128 == 0
     use_flash = (impl == "flash" or
-                 (impl == "auto" and _on_tpu() and seq >= _FLASH_MIN_SEQ))
+                 (impl == "auto" and _on_tpu() and seq >= _FLASH_MIN_SEQ
+                  and head_dim_ok))
     if use_flash:
         try:
             from skypilot_tpu.ops import flash_attention as fa

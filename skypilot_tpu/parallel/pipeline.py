@@ -126,7 +126,9 @@ def forward_hidden(params: Params, tokens: jax.Array, cfg: PipelineConfig,
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     b = B // M
 
-    x = params["embed"].astype(cfg.dtype)[tokens]        # [B, S, D]
+    table = constrain(params["embed"].astype(cfg.dtype),
+                      ("vocab", "embed"))
+    x = table[tokens]                                    # [B, S, D]
     micro = x.reshape(M, b, S, x.shape[-1])
     micro = constrain(micro, ("micro", "batch", "seq", "embed"))
     positions = jnp.arange(S)

@@ -19,6 +19,13 @@ traffic (and can overlap `ppermute` with the block matmuls):
   full sequences for a head subset, and reshards back. Cheaper in
   collective volume when heads >= sp; requires heads % sp == 0.
 
+**Packed sequences** (`segment_ids` [B, S], 0 = padding) compose with
+both schemes: in the ring, each block's segment ids circulate WITH its
+K/V, and the ring body masks cross-segment pairs — so packed long-
+context training runs under sequence parallelism (the flagship TPU
+workload). Ulysses all-gathers the (tiny) id vector to mask the full
+sequence locally.
+
 Reference parity: the reference has NO sequence/context parallelism
 anywhere (SURVEY.md §2.11 — long-context is delegated to workload
 engines like vLLM/DeepSpeed). Here it is first-class, per the TPU-native
@@ -34,10 +41,11 @@ follow-up optimization; correctness and memory scaling come first.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -55,6 +63,19 @@ def _block_mask(my_idx, kv_idx, s_q: int, s_k: int):
     return q_pos[:, None] >= k_pos[None, :]
 
 
+def _allowed_mask(causal, has_seg, my_idx, kv_idx, s_q, s_k, q_seg, k_seg):
+    """Combined causal+segment mask, broadcastable to [B,Hkv,G,Sq,Sk].
+    None means everything is allowed."""
+    allowed = None
+    if causal:
+        allowed = _block_mask(my_idx, kv_idx, s_q, s_k)  # [Sq, Sk]
+    if has_seg:
+        same = (q_seg[:, None, None, :, None]
+                == k_seg[:, None, None, None, :])        # [B,1,1,Sq,Sk]
+        allowed = same if allowed is None else (allowed & same)
+    return allowed
+
+
 def _group(q, n_kv: int):
     """[B, S, Hq, D] -> [B, S, Hkv, G, D] with G = Hq // Hkv."""
     B, S, Hq, D = q.shape
@@ -65,47 +86,50 @@ def _group(q, n_kv: int):
 # Forward ring
 # ---------------------------------------------------------------------------
 
-def _ring_fwd(axis_name: str, axis_size: int, causal: bool, q, k, v):
-    """Local q [B,S,Hq,D]; k/v [B,S,Hkv,D], Hq % Hkv == 0.
+def _ring_fwd(axis_name: str, axis_size: int, causal: bool, has_seg: bool,
+              q, k, v, seg):
+    """Local q [B,S,Hq,D]; k/v [B,S,Hkv,D], Hq % Hkv == 0; seg [B,S].
 
     Returns (o [B,S,Hq,D], lse [B,Hkv,G,S]). Grouped (GQA) einsums: the
-    circulating K/V stay at Hkv heads.
+    circulating K/V stay at Hkv heads. With has_seg, the K/V block's
+    segment ids ride the ring and cross-segment pairs are masked.
     """
     scale = q.shape[-1] ** -0.5
     my_idx = lax.axis_index(axis_name)
     B, S, Hq, D = q.shape
     Sk, Hkv = k.shape[1], k.shape[2]
-    G = Hq // Hkv
     perm = _ring_perm(axis_size)
     q5 = _group(q, Hkv)  # [B, S, Hkv, G, D]
 
-    o0 = jnp.zeros((B, S, Hkv, G, D), jnp.float32)
-    m0 = jnp.full((B, Hkv, G, S), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, Hkv, G, S), jnp.float32)
+    o0 = jnp.zeros((B, S, Hkv, Hq // Hkv, D), jnp.float32)
+    m0 = jnp.full((B, Hkv, Hq // Hkv, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, Hq // Hkv, S), jnp.float32)
 
     def step(carry, i):
-        o, m, l, k, v = carry
+        o, m, l, k, v, kseg = carry
         kv_idx = (my_idx - i) % axis_size
         s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k,
                        preferred_element_type=jnp.float32) * scale
-        if causal:
-            mask = _block_mask(my_idx, kv_idx, S, Sk)
-            s = jnp.where(mask, s, NEG_INF)
+        allowed = _allowed_mask(causal, has_seg, my_idx, kv_idx, S, Sk,
+                                seg, kseg)
+        if allowed is not None:
+            s = jnp.where(allowed, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
-        if causal:
-            p = jnp.where(mask, p, 0.0)
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
         l = l * alpha + p.sum(axis=-1)
         pv = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v,
                         preferred_element_type=jnp.float32)
         o = o * alpha.transpose(0, 3, 1, 2)[..., None] + pv
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
-        return (o, m_new, l, k, v), None
+        kseg = lax.ppermute(kseg, axis_name, perm)
+        return (o, m_new, l, k, v, kseg), None
 
-    (o, m, l, k, v), _ = lax.scan(step, (o0, m0, l0, k, v),
-                                  jnp.arange(axis_size))
+    (o, m, l, k, v, _), _ = lax.scan(step, (o0, m0, l0, k, v, seg),
+                                     jnp.arange(axis_size))
     # axis_size permutes = identity: k/v are home again (used by the bwd).
     l_safe = jnp.maximum(l, 1e-30)
     o = (o / l_safe.transpose(0, 3, 1, 2)[..., None]).astype(q.dtype)
@@ -117,8 +141,9 @@ def _ring_fwd(axis_name: str, axis_size: int, causal: bool, q, k, v):
 # Backward ring: dK/dV accumulators travel with their K/V blocks.
 # ---------------------------------------------------------------------------
 
-def _ring_bwd(axis_name: str, axis_size: int, causal: bool, res, do):
-    q, k, v, o, lse = res
+def _ring_bwd(axis_name: str, axis_size: int, causal: bool, has_seg: bool,
+              res, do):
+    q, k, v, o, lse, seg = res
     scale = q.shape[-1] ** -0.5
     my_idx = lax.axis_index(axis_name)
     B, S, Hq, D = q.shape
@@ -136,14 +161,15 @@ def _ring_bwd(axis_name: str, axis_size: int, causal: bool, res, do):
     dv0 = jnp.zeros_like(v, jnp.float32)
 
     def step(carry, i):
-        dq, k, v, dk, dv = carry
+        dq, k, v, dk, dv, kseg = carry
         kv_idx = (my_idx - i) % axis_size
         s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k,
                        preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse[..., None])
-        if causal:
-            mask = _block_mask(my_idx, kv_idx, S, Sk)
-            p = jnp.where(mask, p, 0.0)
+        allowed = _allowed_mask(causal, has_seg, my_idx, kv_idx, S, Sk,
+                                seg, kseg)
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
         dv = dv + jnp.einsum("bhgqk,bqhgd->bkhd", p.astype(do.dtype), do5,
                              preferred_element_type=jnp.float32)
         dp = jnp.einsum("bqhgd,bkhd->bhgqk", do5, v,
@@ -158,23 +184,26 @@ def _ring_bwd(axis_name: str, axis_size: int, causal: bool, res, do):
         v = lax.ppermute(v, axis_name, perm)
         dk = lax.ppermute(dk, axis_name, perm)
         dv = lax.ppermute(dv, axis_name, perm)
-        return (dq, k, v, dk, dv), None
+        kseg = lax.ppermute(kseg, axis_name, perm)
+        return (dq, k, v, dk, dv, kseg), None
 
-    (dq, k, v, dk, dv), _ = lax.scan(step, (dq0, k, v, dk0, dv0),
-                                     jnp.arange(axis_size))
+    (dq, k, v, dk, dv, _), _ = lax.scan(step, (dq0, k, v, dk0, dv0, seg),
+                                        jnp.arange(axis_size))
+    dseg = np.zeros(seg.shape, dtype=jax.dtypes.float0)
     return (dq.reshape(B, S, Hq, D).astype(q.dtype),
-            dk.astype(k.dtype), dv.astype(v.dtype))
+            dk.astype(k.dtype), dv.astype(v.dtype), dseg)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def _ring_attn(axis_name: str, axis_size: int, causal: bool, q, k, v):
-    o, _ = _ring_fwd(axis_name, axis_size, causal, q, k, v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _ring_attn(axis_name: str, axis_size: int, causal: bool, has_seg: bool,
+               q, k, v, seg):
+    o, _ = _ring_fwd(axis_name, axis_size, causal, has_seg, q, k, v, seg)
     return o
 
 
-def _ring_attn_fwd(axis_name, axis_size, causal, q, k, v):
-    o, lse = _ring_fwd(axis_name, axis_size, causal, q, k, v)
-    return o, (q, k, v, o, lse)
+def _ring_attn_fwd(axis_name, axis_size, causal, has_seg, q, k, v, seg):
+    o, lse = _ring_fwd(axis_name, axis_size, causal, has_seg, q, k, v, seg)
+    return o, (q, k, v, o, lse, seg)
 
 
 _ring_attn.defvjp(_ring_attn_fwd, _ring_bwd)
@@ -184,14 +213,20 @@ _ring_attn.defvjp(_ring_attn_fwd, _ring_bwd)
 # Ulysses (all-to-all) local body
 # ---------------------------------------------------------------------------
 
-def _ulysses_local(axis_name: str, axis_size: int, causal: bool, q, k, v):
+def _ulysses_local(axis_name: str, axis_size: int, causal: bool,
+                   has_seg: bool, q, k, v, seg):
     """[B, S/n, H, D] local -> attention over full seq on H/n heads."""
     from skypilot_tpu.ops import attention as attn_ops
     # seq-sharded -> head-sharded: split heads (axis 2), concat seq (axis 1)
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    o = attn_ops.gqa_attention(q, k, v, causal=causal)
+    seg_full = None
+    if has_seg:
+        # The id vector is tiny: gather the full sequence's ids locally.
+        seg_full = lax.all_gather(seg, axis_name, axis=1, tiled=True)
+    o = attn_ops.gqa_attention(q, k, v, causal=causal,
+                               segment_ids=seg_full)
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
 
@@ -230,40 +265,53 @@ def _qkv_specs(mesh: Mesh, axis: str, batch_axes, heads_axis, q, k):
         hspec = None
     q_spec = P(bspec, axis, hspec, None)
     kv_spec = P(bspec, axis, hspec, None)
-    return q_spec, kv_spec
+    seg_spec = P(bspec, axis)
+    return q_spec, kv_spec, seg_spec
+
+
+def _dummy_seg(q):
+    return jnp.zeros((q.shape[0], q.shape[1]), jnp.int32)
 
 
 def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
                    axis: str = "sp", batch_axes=("dp", "fsdp"),
-                   heads_axis: Optional[str] = "tp"):
+                   heads_axis: Optional[str] = "tp",
+                   segment_ids=None):
     """Ring attention over `axis`. q [B,S,Hq,D]; k/v [B,S,Hkv,D] (GQA ok:
-    Hq % Hkv == 0; unrepeated K/V heads circulate the ring)."""
+    Hq % Hkv == 0; unrepeated K/V heads circulate the ring).
+    ``segment_ids`` [B, S] enables packed-sequence masking."""
     n = mesh.shape[axis]
     if q.shape[1] % n != 0:
         raise ValueError(f"seq {q.shape[1]} not divisible by {axis}={n}")
     if q.shape[2] % k.shape[2] != 0:
         raise ValueError(f"q heads {q.shape[2]} not a multiple of kv heads "
                          f"{k.shape[2]}")
-    q_spec, kv_spec = _qkv_specs(mesh, axis, batch_axes, heads_axis, q, k)
+    q_spec, kv_spec, seg_spec = _qkv_specs(mesh, axis, batch_axes,
+                                           heads_axis, q, k)
+    has_seg = segment_ids is not None
+    seg = segment_ids if has_seg else _dummy_seg(q)
     fn = jax.shard_map(
-        functools.partial(_ring_attn, axis, n, causal),
-        mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
-        check_vma=False)
-    return fn(q, k, v)
+        functools.partial(_ring_attn, axis, n, causal, has_seg),
+        mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
+        out_specs=q_spec, check_vma=False)
+    return fn(q, k, v, seg)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
                       axis: str = "sp", batch_axes=("dp", "fsdp"),
-                      heads_axis: Optional[str] = "tp"):
+                      heads_axis: Optional[str] = "tp",
+                      segment_ids=None):
     """All-to-all (Ulysses) sequence parallelism over `axis`.
 
     Requires per-shard head counts (q and kv) divisible by the sp size:
     the all_to_all converts the seq shard into a head shard.
+    ``segment_ids`` [B, S] enables packed-sequence masking.
     """
     n = mesh.shape[axis]
     if q.shape[1] % n != 0:
         raise ValueError(f"seq {q.shape[1]} not divisible by {axis}={n}")
-    q_spec, kv_spec = _qkv_specs(mesh, axis, batch_axes, heads_axis, q, k)
+    q_spec, kv_spec, seg_spec = _qkv_specs(mesh, axis, batch_axes,
+                                           heads_axis, q, k)
     tp = mesh.shape[heads_axis] if q_spec[2] is not None else 1
     for name, arr in (("q", q), ("kv", k)):
         local_heads = arr.shape[2] // (tp if arr.shape[2] % tp == 0 else 1)
@@ -271,11 +319,13 @@ def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
             raise ValueError(
                 f"{name} heads/shard = {local_heads} not divisible by "
                 f"{axis}={n}; use ring_attention instead")
+    has_seg = segment_ids is not None
+    seg = segment_ids if has_seg else _dummy_seg(q)
     fn = jax.shard_map(
-        functools.partial(_ulysses_local, axis, n, causal),
-        mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
-        check_vma=False)
-    return fn(q, k, v)
+        functools.partial(_ulysses_local, axis, n, causal, has_seg),
+        mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
+        out_specs=q_spec, check_vma=False)
+    return fn(q, k, v, seg)
 
 
 def context_parallel_attention(q, k, v, mesh: Mesh, causal: bool = True,

@@ -65,9 +65,6 @@ def main() -> None:
         ap.error("--lora currently supports --model llama only")
     if args.lora < 0:
         ap.error("--lora rank must be positive")
-    if args.packed and args.sp > 1:
-        ap.error("--packed is not supported with --sp > 1 "
-                 "(ring attention has no segment masking)")
 
     # Multi-host: join the cluster-wide jax.distributed rendezvous using
     # the runtime's env contract (runtime/constants.py) before touching

@@ -40,6 +40,10 @@ class TrainConfig:
     grad_clip: float = 1.0
     warmup_steps: int = 100
     total_steps: int = 10_000
+    # Storage dtype for Adam's first moment ("bfloat16" halves that
+    # buffer — how billion-param configs fit a 16 GB chip). None keeps
+    # the params' dtype.
+    mu_dtype: Optional[str] = None
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -53,7 +57,8 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
         optax.adamw(schedule, b1=tc.beta1, b2=tc.beta2,
-                    weight_decay=tc.weight_decay),
+                    weight_decay=tc.weight_decay,
+                    mu_dtype=tc.mu_dtype),
     )
 
 

@@ -94,6 +94,91 @@ def test_ring_gqa_gradients(sp_mesh):
                                    err_msg=f"d{name} mismatch")
 
 
+def _segments(b=2, s=32, seed=5):
+    """Random packed-segment ids: contiguous, increasing, some padding 0."""
+    rng = np.random.RandomState(seed)
+    out = np.zeros((b, s), np.int32)
+    for i in range(b):
+        pos = 0
+        sid = 1
+        while pos < s - 2:
+            length = rng.randint(3, max(4, s // 3))
+            out[i, pos:pos + length] = sid
+            pos += length
+            sid += 1
+        # tail left as 0 = padding
+    return jnp.asarray(out)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_segments_match_xla(sp_mesh, causal):
+    """VERDICT r1 #7: packed+sp>1 — ring with circulating segment ids
+    must match the segment-masked XLA oracle."""
+    q, k, v = _qkv()
+    seg = _segments()
+    want = attn_ops.xla_attention(q, k, v, causal=causal, segment_ids=seg)
+    got = ra.ring_attention(q, k, v, sp_mesh, causal=causal,
+                            segment_ids=seg)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ring_segments_gradients(sp_mesh):
+    q, k, v = _qkv(s=16)
+    seg = _segments(s=16)
+    w = jax.random.normal(jax.random.key(9), q.shape)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ra.ring_attention(q, k, v, sp_mesh,
+                                         segment_ids=seg) * w)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(attn_ops.xla_attention(q, k, v,
+                                              segment_ids=seg) * w)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for gr, gx, name in zip(g_ring, g_xla, "qkv"):
+        np.testing.assert_allclose(gr, gx, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_ring_segments_gqa_sp4(sp4_mesh):
+    q, _, _ = _qkv(b=1, s=64, h=4)
+    _, k, v = _qkv(b=1, s=64, h=2, seed=3)
+    seg = _segments(b=1, s=64)
+    want = attn_ops.xla_attention(q, attn_ops.repeat_kv(k, 2),
+                                  attn_ops.repeat_kv(v, 2), causal=True,
+                                  segment_ids=seg)
+    got = ra.ring_attention(q, k, v, sp4_mesh, causal=True,
+                            segment_ids=seg)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_segments_match_xla(sp_mesh):
+    q, k, v = _qkv()
+    seg = _segments()
+    want = attn_ops.xla_attention(q, k, v, causal=True, segment_ids=seg)
+    got = ra.ulysses_attention(q, k, v, sp_mesh, causal=True,
+                               segment_ids=seg)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_packed_model_with_sp(tiny_cfg, sp_mesh):
+    """Packed llama training composes with sp>1: same loss as sp=1."""
+    from skypilot_tpu.models import llama
+    params = llama.init_params(jax.random.key(0), tiny_cfg)
+    B, S = 2, 64
+    tokens = jax.random.randint(jax.random.key(1), (B, S), 1,
+                                tiny_cfg.vocab_size, dtype=jnp.int32)
+    seg = _segments(b=B, s=S, seed=7)
+    pos = jnp.broadcast_to(jnp.arange(S), (B, S)).astype(jnp.int32)
+    batch = {"tokens": tokens, "segment_ids": seg, "positions": pos}
+    loss_sp, _ = llama.loss_fn(params, batch, tiny_cfg, mesh=sp_mesh)
+    loss_local, _ = llama.loss_fn(params, batch, tiny_cfg, mesh=None)
+    np.testing.assert_allclose(np.asarray(loss_sp),
+                               np.asarray(loss_local), rtol=2e-4)
+
+
 def test_ring_nondivisible_dims_replicate(sp_mesh):
     """Batch=3 (not divisible by dp*fsdp) and heads=3 (not by tp): the
     spec falls back to replication instead of erroring."""
