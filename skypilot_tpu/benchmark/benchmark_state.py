@@ -13,13 +13,13 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu.utils import paths
+from skypilot_tpu.utils import db, paths
 
 
 def _db():
     """Context manager: connection that commits AND closes on exit."""
     path = os.path.join(paths.home(), "benchmark.db")
-    conn = sqlite3.connect(path, timeout=30)
+    conn = db.connect(path, timeout=30)
     conn.execute("""CREATE TABLE IF NOT EXISTS benchmarks (
         name TEXT PRIMARY KEY,
         task_yaml TEXT,

@@ -102,6 +102,46 @@ def tpu_slice_info(accelerator: str, cloud: str = "gcp") -> Dict[str, int]:
     return {"chips": int(row["chips"]), "hosts": int(row["hosts"])}
 
 
+# Peak dense bf16 (fp16 for older parts) TFLOP/s per chip, used only for
+# RELATIVE runtime scaling in the optimizer; 1.0 unit == one v5e chip.
+_PEAK_TFLOPS = {
+    "tpu-v2": 45, "tpu-v3": 123, "tpu-v4": 275, "tpu-v5e": 197,
+    "tpu-v5p": 459, "tpu-v6e": 918,
+    "A100": 312, "A100-80GB": 312, "H100": 989, "L4": 121,
+    "T4": 65, "V100": 125, "P100": 21,
+}
+_V5E_TFLOPS = 197.0
+
+
+def compute_units(accelerator: Optional[str],
+                  accelerator_count: int = 0,
+                  cloud: str = "gcp") -> float:
+    """Relative compute of one node of this offering, in v5e-chip
+    equivalents (chips x per-chip peak / v5e peak). CPU-only instance
+    types count as one unit — runtime scaling across CPU VMs is not
+    meaningful."""
+    if not accelerator:
+        return 1.0
+    if is_tpu(accelerator):
+        gen = accelerator.rsplit("-", 1)[0]  # tpu-v5e-16 -> tpu-v5e
+        peak = _PEAK_TFLOPS.get(gen)
+        if peak is None:
+            return 1.0
+        # Accelerator names are cloud-agnostic hardware specs: always
+        # resolve chip counts from the gcp catalog (a 'local'/'k8s'
+        # cloud has no catalog of its own — querying it would
+        # misgenerate one).
+        try:
+            chips = tpu_slice_info(accelerator, "gcp")["chips"]
+        except (ValueError, KeyError):
+            return 1.0
+        return chips * peak / _V5E_TFLOPS
+    peak = _PEAK_TFLOPS.get(accelerator)
+    if peak is None:
+        return 1.0
+    return max(accelerator_count, 1) * peak / _V5E_TFLOPS
+
+
 def cpu_instance_types(min_cpus: float = 0, min_memory_gb: float = 0,
                        cloud: str = "gcp") -> pd.DataFrame:
     df = _df(cloud)

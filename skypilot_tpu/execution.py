@@ -70,7 +70,9 @@ def _launch_with_config(task, cluster_name, retry_until_up,
 
     if dryrun:
         from skypilot_tpu import optimizer
-        launchable = optimizer.optimize_task(task)
+        # quiet=False: print the reference-style plan comparison table
+        # (sky/optimizer.py:717) alongside the decision.
+        launchable = optimizer.optimize_task(task, quiet=False)
         print(f"Dryrun: would launch {cluster_name} with {launchable}")
         return None, None
 

@@ -14,7 +14,7 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu.utils import paths
+from skypilot_tpu.utils import db, paths
 
 
 class ManagedJobStatus(enum.Enum):
@@ -93,7 +93,7 @@ _MIGRATIONS = (
 
 @contextlib.contextmanager
 def _db():
-    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn = db.connect(_db_path(), timeout=10)
     conn.executescript(_SCHEMA)
     for mig in _MIGRATIONS:
         try:

@@ -27,6 +27,9 @@ DEFAULT_RUNTIME_ESTIMATE_S = 3600.0
 
 # $/GB egress between regions (cross-continent flat rate; intra-region 0).
 _EGRESS_PER_GB = 0.12
+# Nominal cross-region transfer bandwidth for the TIME objective
+# (reference: sky/optimizer.py:93 egress_time uses the same idea).
+_EGRESS_GB_PER_S = 1.0
 
 
 class OptimizeTarget(enum.Enum):
@@ -38,16 +41,34 @@ class OptimizeTarget(enum.Enum):
 class Candidate:
     resources: Resources
     cost: float          # $ for the task's estimated runtime, all nodes
-    time_s: float        # estimated runtime
+    time_s: float        # estimated runtime on THIS candidate
 
 
 def _candidates_for(task: Task, blocked: BlockedSet) -> List[Candidate]:
-    est = task.estimated_runtime_seconds or DEFAULT_RUNTIME_ESTIMATE_S
+    """Launchable candidates with per-accelerator runtime scaling
+    (reference: _estimate_nodes_cost_or_time, sky/optimizer.py:236).
+
+    ``task.estimated_runtime_seconds`` is wall time on ONE v5e-chip
+    equivalent; a candidate with more/faster chips finishes
+    proportionally sooner (catalog.compute_units). Without an estimate,
+    a flat default duration applies to every candidate — a duration, not
+    an amount of work, so it does NOT scale.
+    """
+    from skypilot_tpu.catalog import catalog
+    est = task.estimated_runtime_seconds
     out: List[Candidate] = []
     for r in task.resources:
         for launchable in r.launchables(blocked):
-            cost = launchable.get_cost(est) * task.num_nodes
-            out.append(Candidate(launchable, cost, est))
+            if est is not None:
+                units = catalog.compute_units(
+                    launchable.accelerator_name,
+                    launchable.accelerator_count,
+                    launchable.cloud or "gcp") * task.num_nodes
+                time_s = est / max(units, 1e-9)
+            else:
+                time_s = DEFAULT_RUNTIME_ESTIMATE_S
+            cost = launchable.get_cost(time_s) * task.num_nodes
+            out.append(Candidate(launchable, cost, time_s))
     if not out:
         raise exceptions.ResourcesUnavailableError(
             f"no feasible resources for {task} "
@@ -55,10 +76,23 @@ def _candidates_for(task: Task, blocked: BlockedSet) -> List[Candidate]:
     return out
 
 
-def _egress_cost(a: Resources, b: Resources, gigabytes: float = 0.0) -> float:
+def _egress_cost(a: Resources, b: Resources, gigabytes: float) -> float:
+    """Cross-region data movement between consecutive chain tasks ($)."""
     if gigabytes <= 0 or a.region == b.region:
         return 0.0
     return gigabytes * _EGRESS_PER_GB
+
+
+def _egress_time(a: Resources, b: Resources, gigabytes: float) -> float:
+    """Cross-region transfer wall time (seconds) for the TIME target —
+    the edge term must share units with the node objective."""
+    if gigabytes <= 0 or a.region == b.region:
+        return 0.0
+    return gigabytes / _EGRESS_GB_PER_S
+
+
+def _edge_gigabytes(upstream: Task) -> float:
+    return float(upstream.estimated_outputs_gb or 0.0)
 
 
 @timeline.event
@@ -82,8 +116,12 @@ def optimize(dag: dag_lib.Dag,
         return {}
 
     per_task = {t: _candidates_for(t, blocked) for t in order}
-    key = (lambda c: c.cost) if minimize is OptimizeTarget.COST else \
-        (lambda c: c.time_s)
+    if minimize is OptimizeTarget.COST:
+        key = lambda c: c.cost
+        edge_fn = _egress_cost
+    else:
+        key = lambda c: c.time_s
+        edge_fn = _egress_time
 
     # DP over the chain: best[i][j] = min objective ending at task i using
     # candidate j, including egress from the chosen parent candidate.
@@ -98,9 +136,10 @@ def optimize(dag: dag_lib.Dag,
                 brow.append(-1)
                 continue
             prev_cands = per_task[order[i - 1]]
+            edge_gb = _edge_gigabytes(order[i - 1])
             best_val, best_k = None, -1
             for k, pc in enumerate(prev_cands):
-                egress = _egress_cost(pc.resources, c.resources)
+                egress = edge_fn(pc.resources, c.resources, edge_gb)
                 v = best[i - 1][k] + key(c) + egress
                 if best_val is None or v < best_val:
                     best_val, best_k = v, k
@@ -122,19 +161,37 @@ def optimize(dag: dag_lib.Dag,
 
 
 def optimize_task(task: Task,
-                  blocked_resources: Optional[BlockedSet] = None
-                  ) -> Resources:
+                  blocked_resources: Optional[BlockedSet] = None,
+                  quiet: bool = True) -> Resources:
     """Single-task fast path (the common `launch` case)."""
     d = dag_lib.Dag()
     d.add(task)
-    return optimize(d, blocked_resources=blocked_resources)[task]
+    return optimize(d, blocked_resources=blocked_resources,
+                    quiet=quiet)[task]
 
 
 def _print_plan(order, per_task, plan) -> None:
-    print(f"{'TASK':<24}{'CHOSEN':<44}{'$/HR':>8}  ALTERNATIVES")
+    """Reference-style comparison table (sky/optimizer.py:717): the
+    chosen candidate per task plus the best per-accelerator
+    alternatives with their estimated cost/time."""
+    print(f"{'TASK':<20}{'RESOURCES':<40}{'$/HR':>8}{'EST $':>9}"
+          f"{'EST TIME':>10}  CHOSEN")
     for t in order:
         chosen = plan[t]
-        alts = len(per_task[t]) - 1
-        print(f"{(t.name or '-'):<24}{str(chosen):<44}"
-              f"{chosen.price if chosen.price is not None else 0:>8.2f}"
-              f"  {alts}")
+        # Best (cheapest) candidate per distinct accelerator — but the
+        # CHOSEN candidate always keeps its row (an egress-steered pick
+        # may not be its accelerator's cheapest).
+        by_accel = {}
+        for c in per_task[t]:
+            a = c.resources.accelerator_name or c.resources.instance_type
+            if c.resources == chosen:
+                a = f"{a} (chosen)"
+            if a not in by_accel or c.cost < by_accel[a].cost:
+                by_accel[a] = c
+        rows = sorted(by_accel.values(), key=lambda c: c.cost)
+        for c in rows[:4]:
+            mark = "  <-" if c.resources == chosen else ""
+            price = c.resources.price or 0.0
+            print(f"{(t.name or '-'):<20}{str(c.resources):<40}"
+                  f"{price:>8.2f}{c.cost:>9.2f}"
+                  f"{c.time_s / 60:>9.1f}m{mark}")

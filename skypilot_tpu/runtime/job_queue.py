@@ -14,6 +14,8 @@ import enum
 import json
 import os
 import sqlite3
+
+from skypilot_tpu.utils import db
 import time
 from typing import Any, Dict, List, Optional
 
@@ -51,7 +53,7 @@ CREATE TABLE IF NOT EXISTS jobs (
 @contextlib.contextmanager
 def _db(db_path: str):
     os.makedirs(os.path.dirname(db_path), exist_ok=True)
-    conn = sqlite3.connect(db_path, timeout=10)
+    conn = db.connect(db_path, timeout=10)
     conn.executescript(_SCHEMA)
     try:
         yield conn

@@ -16,7 +16,7 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu.utils import paths
+from skypilot_tpu.utils import db, paths
 
 
 class ServiceStatus(enum.Enum):
@@ -84,7 +84,7 @@ _MIGRATIONS = (
 
 @contextlib.contextmanager
 def _db():
-    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn = db.connect(_db_path(), timeout=10)
     conn.executescript(_SCHEMA)
     for mig in _MIGRATIONS:
         try:

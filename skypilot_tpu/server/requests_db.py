@@ -15,7 +15,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu.utils import paths
+from skypilot_tpu.utils import db, paths
 
 
 class RequestStatus(enum.Enum):
@@ -47,7 +47,7 @@ CREATE TABLE IF NOT EXISTS requests (
 
 @contextlib.contextmanager
 def _db():
-    conn = sqlite3.connect(paths.requests_db(), timeout=10)
+    conn = db.connect(paths.requests_db(), timeout=10)
     conn.executescript(_SCHEMA)
     try:
         yield conn

@@ -12,7 +12,7 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu.utils import paths
+from skypilot_tpu.utils import db, paths
 
 
 class ClusterStatus(enum.Enum):
@@ -49,7 +49,7 @@ CREATE TABLE IF NOT EXISTS storage (
 
 @contextlib.contextmanager
 def _db():
-    conn = sqlite3.connect(paths.state_db(), timeout=10)
+    conn = db.connect(paths.state_db(), timeout=10)
     conn.executescript(_SCHEMA)
     try:
         yield conn

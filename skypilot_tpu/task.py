@@ -47,7 +47,13 @@ class Task:
         self.storage_mounts = dict(storage_mounts or {})
         self.resources: List[Resources] = [Resources()]
         self.service: Optional[Any] = None  # serve.SkyServiceSpec
+        # Wall seconds on ONE v5e-chip-equivalent (the optimizer
+        # scales it by each candidate's compute units); None = unknown
+        # (flat default, no cross-accelerator scaling).
         self.estimated_runtime_seconds: Optional[float] = None
+        # Output data this task hands to its DAG successor, in GB —
+        # feeds the optimizer's cross-region egress term.
+        self.estimated_outputs_gb: Optional[float] = None
         # Per-task global-config overrides (reference:
         # experimental.config_overrides, sky/skypilot_config.py).
         self.config_overrides: Optional[Dict[str, Any]] = None
@@ -92,6 +98,12 @@ class Task:
             storage_mounts=storage_mounts,
         )
         task.config_overrides = config_overrides
+        ert = config.pop("estimated_runtime_seconds", None)
+        if ert is not None:
+            task.estimated_runtime_seconds = float(ert)
+        eog = config.pop("estimated_outputs_gb", None)
+        if eog is not None:
+            task.estimated_outputs_gb = float(eog)
         if config:
             raise exceptions.InvalidTaskError(
                 f"unknown task fields: {sorted(config)}")
@@ -145,6 +157,10 @@ class Task:
             out["service"] = self.service.to_yaml_config()
         if self.config_overrides:
             out["config_overrides"] = dict(self.config_overrides)
+        if self.estimated_runtime_seconds is not None:
+            out["estimated_runtime_seconds"] = self.estimated_runtime_seconds
+        if self.estimated_outputs_gb is not None:
+            out["estimated_outputs_gb"] = self.estimated_outputs_gb
         return out
 
     def to_yaml(self, path: str) -> None:

@@ -1,0 +1,18 @@
+"""Shared sqlite connection discipline.
+
+WAL journaling (readers never block the single writer — controllers and
+RPC handlers share these DBs concurrently) + a busy handler matched to
+the caller's timeout. One helper so tuning changes hit every DB at once.
+Stdlib-only: imported by head-side runtime modules under ``python -S``.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+
+
+def connect(path: str, timeout: float = 10) -> sqlite3.Connection:
+    conn = sqlite3.connect(path, timeout=timeout)
+    conn.execute("PRAGMA journal_mode=WAL")
+    conn.execute(f"PRAGMA busy_timeout={int(timeout * 1000)}")
+    return conn

@@ -81,6 +81,10 @@ TASK_SCHEMA: Dict[str, Any] = {
         "name": {"type": ["string", "null"]},
         "workdir": {"type": ["string", "null"]},
         "num_nodes": {"type": ["integer", "null"], "minimum": 1},
+        "estimated_runtime_seconds": {"type": ["number", "null"],
+                                      "exclusiveMinimum": 0},
+        "estimated_outputs_gb": {"type": ["number", "null"],
+                                 "minimum": 0},
         "setup": {"type": ["string", "null"]},
         "run": {"type": ["string", "null"]},
         "envs": {

@@ -27,6 +27,10 @@ def _chain(n_tasks, rng):
             cfg["use_spot"] = True
         t.set_resources(Resources.from_yaml_config(
             {k: v for k, v in cfg.items() if v is not None}))
+        if rng.random() < 0.5:
+            t.estimated_outputs_gb = rng.choice([1.0, 50.0, 500.0])
+        if rng.random() < 0.5:
+            t.estimated_runtime_seconds = rng.choice([600.0, 3600.0])
         d.add(t)
         if prev is not None:
             d.add_edge(prev, t)
@@ -39,8 +43,10 @@ def _brute_force_cost(tasks, per_task):
     best = None
     for combo in itertools.product(*(per_task[t] for t in tasks)):
         total = sum(c.cost for c in combo)
-        for a, b in zip(combo, combo[1:]):
-            total += optimizer._egress_cost(a.resources, b.resources)
+        for (ta, a), (_, b) in zip(zip(tasks, combo),
+                                   list(zip(tasks, combo))[1:]):
+            total += optimizer._egress_cost(
+                a.resources, b.resources, optimizer._edge_gigabytes(ta))
         if best is None or total < best:
             best = total
     return best
@@ -67,5 +73,6 @@ def test_dp_matches_brute_force(seed):
     # terms made a non-greedy pick cheaper (DP includes them, the `got`
     # sum here recomputes the same way).
     for a, b in zip(tasks, tasks[1:]):
-        got += optimizer._egress_cost(plan[a], plan[b])
+        got += optimizer._egress_cost(plan[a], plan[b],
+                                      optimizer._edge_gigabytes(a))
     assert got == pytest.approx(want, rel=1e-9)
