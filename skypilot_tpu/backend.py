@@ -122,6 +122,23 @@ class RetryingProvisioner:
 
     def _provision_one(self, task: Task, cluster_name: str,
                        launchable: Resources) -> ClusterHandle:
+        # Capability gates BEFORE any instance is created — a cluster
+        # that can never run its gang must not be provisioned and
+        # billed first (reference: requested_features collection at
+        # sky/execution.py:209-244).
+        provider = launchable.cloud or "gcp"
+        if task.num_nodes > 1 and not provision.supports(
+                provider, provision.Feature.MULTI_NODE):
+            raise exceptions.NotSupportedError(
+                f"{provider} cannot provision multi-node clusters "
+                f"(Feature.MULTI_NODE)")
+        if (task.num_nodes * launchable.hosts_per_node > 1
+                and not provision.supports(
+                    provider, provision.Feature.MULTI_NODE_EXEC)):
+            raise exceptions.NotSupportedError(
+                f"{provider} cannot gang-execute across "
+                f"{task.num_nodes * launchable.hosts_per_node} hosts yet "
+                f"(Feature.MULTI_NODE_EXEC)")
         handle = ClusterHandle.create(cluster_name, launchable,
                                       task.num_nodes)
         state.set_cluster(cluster_name, dict(handle), state.ClusterStatus.INIT,
@@ -277,6 +294,15 @@ class TpuVmBackend:
 
     def execute(self, handle: ClusterHandle, task: Task,
                 detach_run: bool = True) -> int:
+        n_hosts = (handle.get("num_nodes", 1)
+                   * handle.get("hosts_per_node", 1))
+        if n_hosts > 1 and not provision.supports(
+                handle.provider, provision.Feature.MULTI_NODE_EXEC):
+            # Refuse at submit time with the contract's words, not at
+            # runtime inside the head-side driver.
+            raise exceptions.NotSupportedError(
+                f"{handle.provider} cannot gang-execute across "
+                f"{n_hosts} hosts yet (Feature.MULTI_NODE_EXEC)")
         setup = f"{task.setup}\n" if task.setup else ""
         if task.run is None:
             run_cmd = "true"
@@ -377,6 +403,11 @@ class TpuVmBackend:
 
     # -- lifecycle ---------------------------------------------------------
     def stop(self, handle: ClusterHandle) -> None:
+        if not provision.supports(handle.provider,
+                                  provision.Feature.STOP):
+            raise exceptions.NotSupportedError(
+                f"{handle.provider} instances cannot stop; use down "
+                f"(Feature.STOP)")
         provision.stop_instances(handle.provider, handle.cluster_name,
                                  handle.zone)
         state.set_cluster_status(handle.cluster_name,

@@ -114,12 +114,12 @@ _V5E_TFLOPS = 197.0
 
 
 def compute_units(accelerator: Optional[str],
-                  accelerator_count: int = 0,
-                  cloud: str = "gcp") -> float:
+                  accelerator_count: int = 0) -> float:
     """Relative compute of one node of this offering, in v5e-chip
-    equivalents (chips x per-chip peak / v5e peak). CPU-only instance
-    types count as one unit — runtime scaling across CPU VMs is not
-    meaningful."""
+    equivalents (chips x per-chip peak / v5e peak). Accelerator names
+    are cloud-agnostic hardware specs, so no cloud parameter. CPU-only
+    instance types count as one unit — runtime scaling across CPU VMs
+    is not meaningful."""
     if not accelerator:
         return 1.0
     if is_tpu(accelerator):

@@ -51,15 +51,22 @@ def ensure_controller_cluster(cluster_name: str, task: Task,
                               kind: str) -> ClusterHandle:
     """Provision (or reuse) the controller cluster via the framework's
     own launch path. Idempotent: an UP cluster is returned as-is."""
+    from skypilot_tpu import provision
     from skypilot_tpu.resources import Resources
     backend = TpuVmBackend()
     rec = state.get_cluster(cluster_name)
     if rec is not None and rec["status"] == state.ClusterStatus.UP:
         return ClusterHandle(rec["handle"])
+    cfg = controller_resources_config(task, kind)
+    provider = cfg.get("cloud") or "gcp"
+    if not provision.supports(provider,
+                              provision.Feature.HOST_CONTROLLERS):
+        raise exceptions.NotSupportedError(
+            f"{provider} cannot host {kind} controllers "
+            f"(Feature.HOST_CONTROLLERS); set "
+            f"{kind}.controller_resources in config")
     ctrl_task = Task(name=f"{kind}-controller", run=None)
-    ctrl_task.set_resources(
-        Resources.from_yaml_config(
-            controller_resources_config(task, kind)))
+    ctrl_task.set_resources(Resources.from_yaml_config(cfg))
     return backend.provision(ctrl_task, cluster_name)
 
 

@@ -41,6 +41,21 @@ class GcsCloudStorage(CloudStorage):
                 f"gcloud storage cp {shlex.quote(source)} {dst}")
 
 
+class S3CloudStorage(CloudStorage):
+    """s3:// via the aws CLI (file_mounts with S3 sources pull directly
+    on the host; reference: sky/cloud_stores.py S3CloudStorage)."""
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p {dst} && "
+                f"aws s3 sync {shlex.quote(source)} {dst}")
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p $(dirname {dst}) && "
+                f"aws s3 cp {shlex.quote(source)} {dst}")
+
+
 class HttpCloudStorage(CloudStorage):
     """https:// single-file fetch via curl."""
 
@@ -55,6 +70,7 @@ class HttpCloudStorage(CloudStorage):
 
 _REGISTRY: Dict[str, CloudStorage] = {
     "gs": GcsCloudStorage(),
+    "s3": S3CloudStorage(),
     "https": HttpCloudStorage(),
     "http": HttpCloudStorage(),
 }

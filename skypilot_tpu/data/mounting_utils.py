@@ -57,3 +57,21 @@ def get_mount_with_install_cmd(bucket: str, mount_path: str,
                                only_dir: str | None = None) -> str:
     return (f"({GCSFUSE_INSTALL_CMD}) && "
             f"{get_mount_cmd(bucket, mount_path, readonly, only_dir)}")
+
+
+# goofys: the reference's S3 FUSE tool (sky/data/mounting_utils.py:26).
+GOOFYS_INSTALL_CMD = (
+    "command -v goofys >/dev/null || "
+    "(sudo wget -q https://github.com/kahing/goofys/releases/latest/"
+    "download/goofys -O /usr/local/bin/goofys && "
+    "sudo chmod +x /usr/local/bin/goofys)")
+
+
+def get_s3_mount_cmd(bucket: str, mount_path: str,
+                     only_dir: str | None = None) -> str:
+    """Mount an S3 bucket with goofys (install if missing)."""
+    bucket = bucket.removeprefix("s3://").split("/", 1)[0]
+    target = f"{bucket}:{only_dir}" if only_dir else bucket
+    return (f"({GOOFYS_INSTALL_CMD}) && "
+            f"mkdir -p {shlex.quote(mount_path)} && "
+            f"goofys {shlex.quote(target)} {shlex.quote(mount_path)}")

@@ -128,7 +128,56 @@ class GcsStore(AbstractStore):
                 f"gcloud storage rsync -r {self.url} {dst}")
 
 
-_STORE_TYPES: Dict[str, type] = {"gs": GcsStore}
+class S3Store(AbstractStore):
+    """S3 bucket via the aws CLI (reference: S3Store,
+    sky/data/storage.py:1284). Under TPU scope, S3 is mostly a data
+    SOURCE (file_mounts: s3://...), but the full lifecycle is supported
+    for parity; MOUNT uses goofys like the reference."""
+
+    SCHEME = "s3"
+
+    def exists(self) -> bool:
+        rc, _ = self._run(f"aws s3api head-bucket --bucket {self.name}")
+        return rc == 0
+
+    def create(self, region: Optional[str] = None) -> None:
+        loc = (f" --create-bucket-configuration "
+               f"LocationConstraint={shlex.quote(region)}"
+               if region and region != "us-east-1" else "")
+        rc, out = self._run(
+            f"aws s3api create-bucket --bucket {self.name}{loc}")
+        if rc != 0 and "alreadyownedbyyou" not in out.lower().replace(
+                " ", ""):
+            raise exceptions.StorageError(
+                f"creating s3://{self.name} failed: {out.strip()}")
+
+    def upload(self, source: str, subpath: str = "") -> None:
+        excl = storage_utils.aws_exclude_args(source)
+        dst = (f"s3://{self.name}/{subpath}" if subpath
+               else f"s3://{self.name}")
+        rc, out = self._run(
+            f"aws s3 sync {excl}{shlex.quote(source)} {dst}")
+        if rc != 0:
+            raise exceptions.StorageError(
+                f"upload {source} -> {dst} failed: {out.strip()}")
+
+    def delete(self) -> None:
+        rc, out = self._run(f"aws s3 rb s3://{self.name} --force")
+        if rc != 0 and "nosuchbucket" not in out.lower().replace(" ", ""):
+            raise exceptions.StorageError(
+                f"deleting s3://{self.name} failed: {out.strip()}")
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.get_s3_mount_cmd(
+            self.name, mount_path, only_dir=self.subpath or None)
+
+    def copy_down_command(self, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p {dst} && "
+                f"aws s3 sync {self.url} {dst}")
+
+
+_STORE_TYPES: Dict[str, type] = {"gs": GcsStore, "s3": S3Store}
 
 
 class Storage:
@@ -199,6 +248,8 @@ class Storage:
             out["source"] = self.source
         else:
             out["name"] = self.name
+            if self.store.SCHEME != "gs":
+                out["store"] = self.store.SCHEME
             if self.source:
                 out["source"] = self.source
         return out

@@ -64,3 +64,17 @@ def gsutil_exclude_regex(src_dir: str) -> str:
         prefix = "^" if anchored else "(^|.*/)"
         parts.append(f"{prefix}{rx}(/.*)?$")
     return "|".join(parts)
+
+
+def aws_exclude_args(src_dir: str) -> str:
+    """``--exclude P `` args for ``aws s3 sync`` from the ignore
+    patterns (aws globs are close enough to rsync's for these). Each
+    pattern is shell-quoted — ignore-file content is untrusted input to
+    a ``bash -c`` command line."""
+    import shlex as _shlex
+    out = ["--exclude " + _shlex.quote(".git/*")]
+    for pat in read_ignore_patterns(src_dir):
+        pat = pat.strip("/")
+        out.append(f"--exclude {_shlex.quote(pat)} "
+                   f"--exclude {_shlex.quote(pat + '/*')}")
+    return " ".join(out) + " "

@@ -59,11 +59,10 @@ def _candidates_for(task: Task, blocked: BlockedSet) -> List[Candidate]:
     out: List[Candidate] = []
     for r in task.resources:
         for launchable in r.launchables(blocked):
-            if est is not None:
+            if est is not None and est > 0:
                 units = catalog.compute_units(
                     launchable.accelerator_name,
-                    launchable.accelerator_count,
-                    launchable.cloud or "gcp") * task.num_nodes
+                    launchable.accelerator_count) * task.num_nodes
                 time_s = est / max(units, 1e-9)
             else:
                 time_s = DEFAULT_RUNTIME_ESTIMATE_S
@@ -189,7 +188,12 @@ def _print_plan(order, per_task, plan) -> None:
             if a not in by_accel or c.cost < by_accel[a].cost:
                 by_accel[a] = c
         rows = sorted(by_accel.values(), key=lambda c: c.cost)
-        for c in rows[:4]:
+        chosen_rows = [c for c in rows if c.resources == chosen]
+        rows = rows[:4]
+        # The chosen row survives truncation unconditionally.
+        if chosen_rows and chosen_rows[0] not in rows:
+            rows[-1] = chosen_rows[0]
+        for c in rows:
             mark = "  <-" if c.resources == chosen else ""
             price = c.resources.price or 0.0
             print(f"{(t.name or '-'):<20}{str(c.resources):<40}"

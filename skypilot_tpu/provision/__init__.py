@@ -9,13 +9,40 @@ provider directly.
 
 from __future__ import annotations
 
+import enum
 import importlib
-from typing import Any
+from typing import Any, FrozenSet
 
 from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
                                            ProvisionConfig, ProvisionRecord)
 
 _PROVIDERS = {}
+
+
+class Feature(enum.Enum):
+    """Capability negotiation (reference: CloudImplementationFeatures,
+    sky/clouds/cloud.py:29 — STOP, MULTI_NODE, AUTO_TERMINATE, ... with
+    per-cloud NotSupportedError refusals). Providers declare a FEATURES
+    frozenset; callers refuse early with a clear message instead of
+    rediscovering the contract ad hoc per provider."""
+
+    STOP = "stop"                      # instances can stop (vs only down)
+    MULTI_NODE = "multi_node"          # >1 logical node per cluster
+    MULTI_NODE_EXEC = "multi_node_exec"  # head can gang-exec across hosts
+    HOST_CONTROLLERS = "host_controllers"  # can host jobs/serve controllers
+
+
+ALL_FEATURES: FrozenSet[Feature] = frozenset(Feature)
+
+
+def supports(provider: str, feature: Feature) -> bool:
+    mod = _impl(provider)
+    if not hasattr(mod, "FEATURES"):
+        # Fail loudly at wiring time, not deep inside a skylet: every
+        # provider must state its capabilities.
+        raise AttributeError(
+            f"provision provider {provider!r} declares no FEATURES set")
+    return feature in mod.FEATURES
 
 
 def _impl(provider: str):

@@ -48,6 +48,11 @@ _BUILTIN_GPU_FAMILIES = ("a2-", "a3-", "g2-")
 
 DEFAULT_VM_IMAGE = "projects/debian-cloud/global/images/family/debian-12"
 
+# Full feature set; STOP is additionally resource-dependent (multi-host/
+# multislice TPUs cannot stop — refused in stop_instances/set_autostop).
+from skypilot_tpu.provision import Feature as _F  # noqa: E402
+FEATURES = frozenset(_F)
+
 Transport = Callable[[str, str, Optional[dict]], dict]
 _transport: Optional[Transport] = None
 

@@ -30,6 +30,11 @@ from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
 from skypilot_tpu.utils.command_runner import CommandRunner
 
 LABEL = "skypilot-tpu/cluster"
+# Pods neither stop nor (yet) gang-exec across peers from the head pod
+# (no pod-to-pod exec transport); single-pod clusters run end to end.
+from skypilot_tpu.provision import Feature as _F  # noqa: E402
+FEATURES = frozenset(_F) - {_F.STOP, _F.MULTI_NODE_EXEC}
+
 NODE_LABEL = "skypilot-tpu/node"
 WORKER_LABEL = "skypilot-tpu/worker"
 DEFAULT_IMAGE = "python:3.11-slim"

@@ -34,6 +34,10 @@ from skypilot_tpu.utils import command_runner, paths
 
 _META = "local_meta.json"
 
+# Everything works on the fake cloud (it exists to exercise all paths).
+from skypilot_tpu.provision import Feature as _F  # noqa: E402
+FEATURES = frozenset(_F)
+
 
 def _clusters_root() -> str:
     return os.environ.get("SKYTPU_LOCAL_CLUSTERS_ROOT",

@@ -58,7 +58,7 @@ class Resources:
             catalog.parse_accelerator(self.accelerators)  # validate
         parse_count(self.cpus, "cpus")
         parse_count(self.memory, "memory")
-        if self.cloud not in (None, "gcp", "local"):
+        if self.cloud not in (None, "gcp", "kubernetes", "local"):
             raise ValueError(f"unknown cloud {self.cloud!r}")
         if self.is_tpu() and self.runtime_version is None:
             object.__setattr__(self, "runtime_version",

@@ -188,3 +188,52 @@ def test_data_transfer_rejects_gs_to_s3_and_copies_files(tmp_path):
     f.write_text("x")
     dt.transfer(str(f), "gs://bkt/ckpt/model.bin", run=run)
     assert rec and rec[-1].startswith("gcloud storage cp ")
+
+
+def test_s3_store_lifecycle_commands():
+    run = FakeRun()
+    st = storage.S3Store("mybkt", run=run)
+    st.create(region="us-west-2")
+    st.upload("/tmp/data")
+    st.delete()
+    assert any("create-bucket --bucket mybkt" in c
+               and "us-west-2" in c for c in run.cmds)
+    assert any("s3 sync" in c and "s3://mybkt" in c for c in run.cmds)
+    assert any("s3 rb s3://mybkt --force" in c for c in run.cmds)
+
+
+def test_s3_external_source_copy_and_mount():
+    run = FakeRun()
+    st = storage.Storage(source="s3://corp-data/sets/v1",
+                        mode=storage.StorageMode.COPY, run=run)
+    cmds = st.attach_commands("/data")
+    assert any("aws s3 sync s3://corp-data/sets/v1" in c for c in cmds)
+    st2 = storage.Storage(source="s3://corp-data/sets/v1",
+                          mode=storage.StorageMode.MOUNT, run=run)
+    (mount_cmd,) = st2.attach_commands("/data")
+    assert "goofys" in mount_cmd and "corp-data:sets/v1" in mount_cmd
+
+
+def test_s3_store_yaml_roundtrip():
+    run = FakeRun()
+    st = storage.Storage(name="newbkt", store="s3", run=run,
+                         mode=storage.StorageMode.COPY, persistent=False)
+    cfg = st.to_yaml_config()
+    st2 = storage.Storage.from_yaml_config(cfg, run=run)
+    assert st2.mode == storage.StorageMode.COPY
+    assert not st2.persistent
+
+
+def test_s3_cloud_store_file_mount_commands():
+    st = cloud_stores.get_storage_from_path("s3://bkt/dir")
+    assert "aws s3 sync" in st.make_sync_dir_command("s3://bkt/dir", "/d")
+    assert "aws s3 cp" in st.make_sync_file_command("s3://bkt/f.txt", "/d/f")
+
+
+def test_storage_yaml_preserves_s3_scheme():
+    run = FakeRun()
+    st = storage.Storage(name="nb", store="s3", run=run)
+    cfg = st.to_yaml_config()
+    assert cfg["store"] == "s3"
+    st2 = storage.Storage.from_yaml_config(cfg, run=run)
+    assert st2.store.SCHEME == "s3"
