@@ -176,11 +176,22 @@ def _setup_and_init_runtime(provider: str, cluster_name: str,
     instance_setup.wait_for_ssh(info)
     instance_setup.setup_runtime_on_cluster(info)
     uses_ssh = any(h.runner_kind == "ssh" for h in info.hosts)
+    agent_token = None
+    if any(h.runner_kind == "k8s" for h in info.hosts):
+        # Pods have no sshd: the head's gang driver reaches peers via
+        # the per-pod hostd agent.
+        import secrets
+        # start_host_agents returns the token in force (an existing
+        # cluster token wins — live agents only know the one they
+        # started with).
+        agent_token = instance_setup.start_host_agents(
+            info, secrets.token_hex(16))
     meta = topology.from_cluster_info(
         info,
         provider_env=info.metadata.get("provider_env"),
         ssh_key_path=_HEAD_SSH_KEY if uses_ssh else None,
-        launched_at=time.time())
+        launched_at=time.time(),
+        agent_token=agent_token)
     _rpc_for_info(info, cluster_name).init_cluster(meta)
     return info
 

@@ -87,6 +87,50 @@ def setup_runtime_on_cluster(info: ClusterInfo,
         list(ex.map(setup_one, runners))
 
 
+def start_host_agents(info: ClusterInfo, token: str,
+                      port: int = command_runner.AGENT_PORT) -> str:
+    """Start runtime/hostd.py on every host that needs a non-SSH exec
+    transport (kubernetes pods): push the shared token, launch the
+    agent detached, under `python -S` with the rsynced package.
+
+    Returns the token actually IN FORCE: hostd reads its token file once
+    at startup and the launch guard keeps a running agent alive, so a
+    re-provision must reuse the existing cluster token rather than
+    overwrite it with a fresh one the live agents would reject."""
+    import shlex
+    runners = _runners(info)
+    agent_hosts = [(h, r) for h, r in zip(info.hosts, runners)
+                   if h.runner_kind == "k8s"]
+    if not agent_hosts:
+        return token
+    existing = agent_hosts[0][1].read_file("~/.skypilot_tpu/agent_token")
+    if existing and existing.strip():
+        token = existing.strip()
+
+    def start_one(pair) -> None:
+        host, runner = pair
+        rc, _, err = runner.run(
+            f"mkdir -p ~/.skypilot_tpu && "
+            f"printf %s {shlex.quote(token)} > ~/.skypilot_tpu/agent_token"
+            f" && chmod 600 ~/.skypilot_tpu/agent_token")
+        if rc != 0:
+            raise exceptions.CommandError(rc, "push agent token", err)
+        # Logging is handled inside the command ($HOME expands in the
+        # pod's shell — a quoted log_path argument would not).
+        runner.run_detached(
+            f'pgrep -f skypilot_tpu.runtime.hostd >/dev/null || '
+            f'(cd "$HOME" && mkdir -p .skypilot_tpu && '
+            f'PYTHONPATH="$HOME/{command_runner.REMOTE_PKG_DIR}'
+            f':$PYTHONPATH" python3 -S -m skypilot_tpu.runtime.hostd '
+            f"--port {port} >> .skypilot_tpu/hostd.log 2>&1)",
+            log_path="/dev/null")
+
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, max(len(agent_hosts), 1))) as ex:
+        list(ex.map(start_one, agent_hosts))
+    return token
+
+
 def _runners(info: ClusterInfo) -> List[command_runner.CommandRunner]:
     from skypilot_tpu import provision
     return provision.get_command_runners(info)

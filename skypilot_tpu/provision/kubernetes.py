@@ -30,10 +30,11 @@ from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
 from skypilot_tpu.utils.command_runner import CommandRunner
 
 LABEL = "skypilot-tpu/cluster"
-# Pods neither stop nor (yet) gang-exec across peers from the head pod
-# (no pod-to-pod exec transport); single-pod clusters run end to end.
+# Pods cannot stop (delete/recreate is the k8s lifecycle). Multi-pod
+# gang execution works through the per-pod hostd agent
+# (runtime/hostd.py), started by instance_setup.start_host_agents.
 from skypilot_tpu.provision import Feature as _F  # noqa: E402
-FEATURES = frozenset(_F) - {_F.STOP, _F.MULTI_NODE_EXEC}
+FEATURES = frozenset(_F) - {_F.STOP}
 
 NODE_LABEL = "skypilot-tpu/node"
 WORKER_LABEL = "skypilot-tpu/worker"
@@ -337,7 +338,10 @@ class KubernetesRunner(CommandRunner):
                     f"{unpack.stderr!r}")
 
     def read_file(self, path: str) -> Optional[str]:
-        rc, out, _ = self.run(f"cat {shlex.quote(path)}")
+        # `~` must expand pod-side; shlex.quote would make it literal.
+        quoted = ('"$HOME"' + shlex.quote(path[1:])
+                  if path.startswith("~") else shlex.quote(path))
+        rc, out, _ = self.run(f"cat {quoted}")
         return out if rc == 0 else None
 
     def kill(self, pid: int) -> None:

@@ -1,0 +1,142 @@
+"""Per-host exec agent: the gang driver's transport where SSH does not
+exist (kubernetes pods).
+
+A ~150-line TCP server speaking line-delimited JSON, started on every
+pod at provision time. The head pod's driver reaches peers through
+``TcpAgentRunner`` exactly like it reaches SSH hosts — run /
+run_detached / read_file / kill — so multi-pod gang execution uses the
+identical driver code path. This replaces the role Ray's on-cluster
+actor transport plays in the reference (sky/provision/instance_setup.py
+starts Ray workers; here the agent is ~two orders of magnitude smaller
+and stdlib-only, run under ``python -S``).
+
+Security: requests must carry the cluster's shared token (pushed to
+every pod at provision). Pod networks are cluster-internal; the token
+is defense in depth, not a perimeter.
+
+Protocol: one JSON object per line in, one per line out.
+
+  {"token": T, "op": "run", "cmd": ..., "env": {..}, "cwd": ...,
+   "timeout": N}                  -> {"ok": true, "rc", "out", "err"}
+  {"token": T, "op": "run_detached", "cmd", "env", "cwd", "log_path"}
+                                  -> {"ok": true, "pid": N}
+  {"token": T, "op": "read_file", "path": P} -> {"ok": true,
+                                                 "content": str|null}
+  {"token": T, "op": "kill", "pid": N}       -> {"ok": true}
+  {"token": T, "op": "ping"}                 -> {"ok": true}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socketserver
+import subprocess
+import sys
+
+DEFAULT_PORT = 8477
+
+
+def _expand(path: str) -> str:
+    return os.path.expanduser(path)
+
+
+def _full_env(env):
+    full = dict(os.environ)
+    if env:
+        full.update(env)
+    return full
+
+
+def handle_request(req: dict) -> dict:
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "home": os.path.expanduser("~")}
+    if op == "run":
+        proc = subprocess.run(
+            ["bash", "-c", req["cmd"]], env=_full_env(req.get("env")),
+            cwd=req.get("cwd") or os.path.expanduser("~"),
+            capture_output=True, text=True, timeout=req.get("timeout"))
+        return {"ok": True, "rc": proc.returncode, "out": proc.stdout,
+                "err": proc.stderr}
+    if op == "run_detached":
+        log_path = _expand(req.get("log_path") or "/dev/null")
+        if not os.path.isabs(log_path):
+            log_path = os.path.join(os.path.expanduser("~"), log_path)
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        with open(log_path, "ab") as f:
+            proc = subprocess.Popen(
+                ["bash", "-c", req["cmd"]], env=_full_env(req.get("env")),
+                cwd=req.get("cwd") or os.path.expanduser("~"),
+                stdout=f, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        return {"ok": True, "pid": proc.pid}
+    if op == "read_file":
+        path = _expand(req["path"])
+        if not os.path.isabs(path):
+            path = os.path.join(os.path.expanduser("~"), path)
+        try:
+            with open(path) as f:
+                return {"ok": True, "content": f.read()}
+        except OSError:
+            return {"ok": True, "content": None}
+    if op == "kill":
+        pid = int(req["pid"])
+        try:
+            os.killpg(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+        return {"ok": True}
+    return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for raw in self.rfile:
+            try:
+                req = json.loads(raw)
+                if req.get("token") != self.server.token:  # type: ignore
+                    resp = {"ok": False, "error": "bad token"}
+                else:
+                    resp = handle_request(req)
+            except Exception as e:  # noqa: BLE001 — agent must answer
+                resp = {"ok": False,
+                        "error": f"{type(e).__name__}: {e}"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(port: int, token: str, host: str = "0.0.0.0") -> None:
+    srv = _Server((host, port), _Handler)
+    srv.token = token  # type: ignore[attr-defined]
+    srv.serve_forever()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--token-file",
+                    default="~/.skypilot_tpu/agent_token")
+    args = ap.parse_args()
+    try:
+        with open(os.path.expanduser(args.token_file)) as f:
+            token = f.read().strip()
+    except OSError:
+        print(f"no token file at {args.token_file}", file=sys.stderr)
+        sys.exit(1)
+    serve(args.port, token, args.host)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,21 +94,32 @@ def build_runners(
                 key_path=meta.get("ssh_key_path")
                 or "~/.skypilot_tpu/ssh/sky-key",
                 host_id=h["host_id"], port=h.get("ssh_port", 22)))
+        elif kind == "k8s":
+            # Pods have no sshd; the per-pod hostd agent (started at
+            # provision) is the exec transport.
+            token = meta.get("agent_token")
+            if not token:
+                raise RuntimeError(
+                    "k8s host without an agent token in cluster.json — "
+                    "was start_host_agents skipped at provision?")
+            # `or`, not a dict default: the key is serialized as null
+            # when unset.
+            port = meta.get("agent_port") or command_runner.AGENT_PORT
+            runners.append(command_runner.TcpAgentRunner(
+                ip=h["internal_ip"], port=port,
+                token=token, host_id=h["host_id"]))
         else:
-            # kubernetes multi-pod gang execution needs a pod-to-pod
-            # exec transport on the head; not built yet. Refuse loudly
-            # rather than half-run (single-pod k8s clusters never get
-            # here: the head branch above handles them).
             raise NotImplementedError(
                 f"intra-cluster runner kind {kind!r} (host "
-                f"{h['host_id']}): multi-pod kubernetes gang execution "
-                "is not supported yet")
+                f"{h['host_id']})")
     return runners
 
 
 def from_cluster_info(info, provider_env: Dict[str, str] | None = None,
                       ssh_key_path: str | None = None,
-                      launched_at: float | None = None) -> Dict[str, Any]:
+                      launched_at: float | None = None,
+                      agent_token: str | None = None,
+                      agent_port: int | None = None) -> Dict[str, Any]:
     """Client-side: build the cluster.json payload from a provision
     ClusterInfo (each HostInfo carries its runner kind)."""
     hosts = []
@@ -134,6 +145,8 @@ def from_cluster_info(info, provider_env: Dict[str, str] | None = None,
         "launched_at": launched_at,
         "head_host_id": hosts[0]["host_id"] if hosts else 0,
         "ssh_key_path": ssh_key_path,
+        "agent_token": agent_token,
+        "agent_port": agent_port,
         "provider_env": provider_env or {},
         "hosts": hosts,
     }

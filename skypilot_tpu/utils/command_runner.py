@@ -31,6 +31,9 @@ PKG_PARENT = os.path.dirname(
 # to PYTHONPATH).
 REMOTE_PKG_DIR = ".skypilot_tpu/pkg"
 
+# Default port of the per-host exec agent (runtime/hostd.py).
+AGENT_PORT = 8477
+
 
 class CommandRunner:
     """Runs shell commands on one host."""
@@ -226,6 +229,92 @@ class FakeSSHRunner(LocalRunner):
     framework_invocation = CommandRunner.framework_invocation
 
 
+class TcpAgentRunner(CommandRunner):
+    """Reaches a host through its runtime/hostd.py agent (line-delimited
+    JSON over TCP). The gang driver's transport on kubernetes pods,
+    where there is no sshd — same CommandRunner contract, so the driver
+    code path is identical to SSH clusters."""
+
+    def __init__(self, ip: str, port: int, token: str, host_id: int = 0,
+                 connect_timeout: float = 10.0):
+        super().__init__(host_id, ip)
+        self.port = port
+        self.token = token
+        self.connect_timeout = connect_timeout
+        self._sock = None  # persistent connection (hostd loops per line)
+
+    def _connect(self):
+        import socket
+        self._sock = socket.create_connection(
+            (self.ip, self.port), timeout=self.connect_timeout)
+        return self._sock
+
+    def _exchange(self, payload: bytes, timeout) -> bytes:
+        s = self._sock or self._connect()
+        # None = block until the agent answers (the CommandRunner
+        # contract: timeout=None runs to completion).
+        s.settimeout(timeout + 10 if timeout else None)
+        s.sendall(payload)
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError("agent closed connection")
+            buf += chunk
+        return buf
+
+    def _call(self, req: Dict, timeout: Optional[float] = None) -> Dict:
+        import json
+        payload = (json.dumps(dict(req, token=self.token)) + "\n").encode()
+        try:
+            buf = self._exchange(payload, timeout)
+        except (OSError, ConnectionError):
+            # Stale persistent socket (agent restart, idle teardown):
+            # one fresh-connection retry.
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            buf = self._exchange(payload, timeout)
+        resp = json.loads(buf or b"{}")
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"host agent {self.ip}:{self.port} error: "
+                f"{resp.get('error')}")
+        return resp
+
+    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None,
+            stdin=None):
+        if stdin is not None:
+            cmd = f"cat <<'SKYTPU_STDIN_EOF' | {cmd}\n{stdin}\nSKYTPU_STDIN_EOF"
+        resp = self._call({"op": "run", "cmd": cmd, "env": env,
+                           "cwd": cwd, "timeout": timeout},
+                          timeout=timeout)
+        if log_path:
+            os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            with open(log_path, "ab") as f:
+                f.write((resp["out"] + resp["err"]).encode())
+            return resp["rc"], "", ""
+        return resp["rc"], resp["out"], resp["err"]
+
+    def run_detached(self, cmd, env=None, cwd=None, log_path="/dev/null"):
+        return self._call({"op": "run_detached", "cmd": cmd, "env": env,
+                           "cwd": cwd, "log_path": log_path})["pid"]
+
+    def read_file(self, path: str) -> Optional[str]:
+        return self._call({"op": "read_file", "path": path})["content"]
+
+    def kill(self, pid: int) -> None:
+        self._call({"op": "kill", "pid": pid})
+
+    def rsync(self, src, dst, up=True, excludes=None):
+        raise NotImplementedError(
+            "TcpAgentRunner is an exec transport; file sync to pods goes "
+            "through the kubernetes runner (tar-over-exec)")
+
+
 class SSHRunner(CommandRunner):
     """SSH with ControlMaster multiplexing (one handshake per host)."""
 
@@ -289,7 +378,10 @@ class SSHRunner(CommandRunner):
         return int(out.strip().splitlines()[-1])
 
     def read_file(self, path: str) -> Optional[str]:
-        rc, out, _ = self.run(f"cat {shlex.quote(path)} 2>/dev/null")
+        # `~` must expand host-side; shlex.quote would make it literal.
+        quoted = ('"$HOME"' + shlex.quote(path[1:])
+                  if path.startswith("~") else shlex.quote(path))
+        rc, out, _ = self.run(f"cat {quoted} 2>/dev/null")
         return out if rc == 0 else None
 
     def kill(self, pid: int) -> None:

@@ -134,41 +134,8 @@ def test_feature_negotiation_registry():
     from skypilot_tpu import provision
     from skypilot_tpu.provision import Feature
     assert not provision.supports("kubernetes", Feature.STOP)
-    assert not provision.supports("kubernetes", Feature.MULTI_NODE_EXEC)
+    assert provision.supports("kubernetes", Feature.MULTI_NODE_EXEC)
     assert provision.supports("kubernetes",
                               Feature.HOST_CONTROLLERS)
     assert provision.supports("gcp", Feature.MULTI_NODE_EXEC)
     assert provision.supports("local", Feature.STOP)
-
-
-def test_multi_pod_exec_refused_at_submit():
-    from skypilot_tpu import exceptions
-    from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
-    from skypilot_tpu.task import Task
-    handle = ClusterHandle(cluster_name="kc", provider="kubernetes",
-                           zone="gke", region="gke", num_nodes=2,
-                           hosts_per_node=1, resources={}, price_per_hour=0)
-    with pytest.raises(exceptions.NotSupportedError, match="gang-execute"):
-        TpuVmBackend().execute(handle, Task(name="t", run="true"))
-
-
-def test_multi_pod_cluster_refused_before_provisioning(monkeypatch):
-    """The gate fires BEFORE any pod is created — a cluster that can
-    never run its gang must not be provisioned and billed first."""
-    from skypilot_tpu import exceptions
-    from skypilot_tpu.backend import RetryingProvisioner
-    from skypilot_tpu.resources import Resources
-    from skypilot_tpu.task import Task
-    from skypilot_tpu.provision import kubernetes as k8s
-    created = []
-    monkeypatch.setattr(k8s, "run_instances",
-                        lambda cfg: created.append(cfg))
-    t = Task(name="t", run="true", num_nodes=2)
-    launchable = Resources(cloud="kubernetes",
-                           accelerators="tpu-v5e-8").copy(
-        region="gke", zone="gke", _price=0.0)
-    t.set_resources(launchable)
-    with pytest.raises(exceptions.NotSupportedError,
-                       match="gang-execute"):
-        RetryingProvisioner()._provision_one(t, "kc2", launchable)
-    assert not created
