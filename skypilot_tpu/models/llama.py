@@ -226,11 +226,17 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh=None, rules=None,
             # spec_for.) Packed sequences ride the ring: segment ids
             # circulate with their K/V blocks.
             heads_axis = rules.get("heads")
+            hspec = heads_axis if isinstance(heads_axis, str) else None
+            if rules.get("seq_layout") == "zigzag":
+                # forward_hidden already put activations/positions/segs
+                # in the zigzag layout (it owns the decision + permute).
+                return ra.zigzag_ring_attention(
+                    q, k, v, mesh, axis=seq_axis,
+                    batch_axes=rules.get("batch"), heads_axis=hspec,
+                    segment_ids=segment_ids)
             return ra.ring_attention(
                 q, k, v, mesh, causal=True, axis=seq_axis,
-                batch_axes=rules.get("batch"),
-                heads_axis=heads_axis if isinstance(heads_axis, str)
-                else None,
+                batch_axes=rules.get("batch"), heads_axis=hspec,
                 segment_ids=segment_ids)
     return attn_ops.gqa_attention(q, k, v, causal=True,
                                   segment_ids=segment_ids)
@@ -291,17 +297,48 @@ def forward_hidden(params: Params, tokens: jax.Array, cfg: LlamaConfig,
     x = constrain(x, ("batch", "seq", "embed"))
     if positions is None:
         positions = jnp.arange(S)
+
+    # Zigzag sequence layout (load-balanced causal ring, ~2x attention
+    # FLOPs saving at large sp): permute embeddings + positions + seg
+    # ids ONCE here, run every decoder block in the permuted order
+    # (elementwise/matmul ops are order-agnostic; rope follows
+    # positions), un-permute once at the end. Decided here so the
+    # attention dispatch and the layout always agree.
+    use_zigzag = False
+    if mesh is not None and rules is not None \
+            and rules.get("seq_layout") == "zigzag":
+        from skypilot_tpu.parallel import ring_attention as ra
+        seq_axis = rules.get("seq")
+        n_sp = (mesh.shape.get(seq_axis, 1)
+                if isinstance(seq_axis, str) else 1)
+        use_zigzag = n_sp > 1 and S % (2 * n_sp) == 0
+        if use_zigzag:
+            x = ra.zigzag_permute(x, n_sp)
+            positions = ra.zigzag_permute(
+                positions, n_sp, axis=positions.ndim - 1)
+            if segment_ids is not None:
+                segment_ids = ra.zigzag_permute(segment_ids, n_sp)
+    layer_rules = rules
+    if rules is not None and rules.get("seq_layout") == "zigzag" \
+            and not use_zigzag:
+        # Divisibility fallback: drop the layout key so the attention
+        # dispatch agrees with the (unpermuted) layout.
+        layer_rules = {k: v for k, v in rules.items()
+                       if k != "seq_layout"}
     cos, sin = rope_frequencies(cfg, positions)
 
     def body(carry, layer):
         y = decoder_layer(cfg, carry, layer, cos, sin, constrain, mesh,
-                          rules, segment_ids)
+                          layer_rules, segment_ids)
         return y, None
 
     if cfg.remat:
         body = jax.checkpoint(body, policy=remat_policy(cfg))
 
     x, _ = lax.scan(body, x, params["blocks"])
+    if use_zigzag:
+        from skypilot_tpu.parallel import ring_attention as ra
+        x = ra.zigzag_unpermute(x, n_sp)
     return rms_norm(x, params["final_norm"], cfg.norm_eps)
 
 

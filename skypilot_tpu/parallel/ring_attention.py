@@ -32,10 +32,13 @@ engines like vLLM/DeepSpeed). Here it is first-class, per the TPU-native
 mandate: sequence parallelism shapes the core mesh design (the ``sp``
 axis in parallel.mesh) rather than being an external recipe concern.
 
-Causal note: blocks entirely in the masked future still do the matmul
-and are zeroed (uniform work per ring step keeps the collective schedule
-static). A zigzag layout that load-balances causal work is a known
-follow-up optimization; correctness and memory scaling come first.
+Causal note: the plain ring computes blocks entirely in the masked
+future and zeroes them (uniform work per step keeps the collective
+schedule static). `zigzag_ring_attention` removes that waste: the
+zigzag chunk layout makes every step's needed work a single maskless
+half-block einsum, balanced across devices — ~2x attention FLOPs saving
+as sp grows. Models opt in via the activation-rule key
+``seq_layout: zigzag`` (llama permutes once after the embedding).
 """
 
 from __future__ import annotations
@@ -207,6 +210,286 @@ def _ring_attn_fwd(axis_name, axis_size, causal, has_seg, q, k, v, seg):
 
 
 _ring_attn.defvjp(_ring_attn_fwd, _ring_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag ring: load-balanced causal context parallelism
+# ---------------------------------------------------------------------------
+# The plain causal ring wastes work: at every step some device's whole
+# K/V block is in its masked future, yet lockstep ppermutes mean nobody
+# finishes early. The zigzag layout splits the sequence into 2n chunks
+# and gives device i chunks (i, 2n-1-i). Then for any remote block from
+# device j, exactly one of two MASKLESS half-einsums is needed:
+#
+#   i > j : ALL local queries attend the block's LOW chunk only
+#           (q[2c] x k[:c]) — its high chunk is entirely future.
+#   i < j : only the local HIGH-chunk queries attend, but to the whole
+#           block (q[c:] x k[2c]) — low queries see only future.
+#
+# Both cases cost 2c^2 (vs the plain ring's 4c^2 per step), every
+# device does the same amount at every step, and only the t=0 local
+# block needs a mask at all. ~2x attention FLOPs saving as n grows.
+# (This is the zigzag scheme from public ring-flash-attention work,
+# expressed as lax.cond branches whose outputs share one accumulator
+# pytree — XLA executes exactly one branch per step.)
+
+def zigzag_indices(seq_len: int, n: int):
+    """Global row order for the zigzag layout: shard i holds chunks
+    (i, 2n-1-i). Returns (permute_idx, unpermute_idx)."""
+    if seq_len % (2 * n) != 0:
+        raise ValueError(f"seq {seq_len} not divisible by 2*{n}")
+    c = seq_len // (2 * n)
+    order = []
+    for i in range(n):
+        order.extend(range(i * c, (i + 1) * c))
+        order.extend(range((2 * n - 1 - i) * c, (2 * n - i) * c))
+    perm = np.asarray(order, dtype=np.int32)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq_len, dtype=np.int32)
+    return perm, inv
+
+
+def zigzag_permute(x, n: int, axis: int = 1):
+    perm, _ = zigzag_indices(x.shape[axis], n)
+    return jnp.take(x, perm, axis=axis)
+
+
+def zigzag_unpermute(x, n: int, axis: int = 1):
+    _, inv = zigzag_indices(x.shape[axis], n)
+    return jnp.take(x, inv, axis=axis)
+
+
+def _zz_positions(my_idx, n: int, c: int):
+    """Global positions of this device's 2c local rows."""
+    lo = my_idx * c + jnp.arange(c)
+    hi = (2 * n - 1 - my_idx) * c + jnp.arange(c)
+    return jnp.concatenate([lo, hi])
+
+
+def _zz_seg_mask(q_seg, k_seg):
+    """[B,1,1,Sq,Sk] same-segment mask (None when unsegmented)."""
+    return (q_seg[:, None, None, :, None]
+            == k_seg[:, None, None, None, :])
+
+
+def _online_update(o, m, l, s, v5, allowed, v_dtype):
+    """One online-softmax accumulation of scores s against values v5.
+    o [B,S,Hkv,G,D] (S rows matching s's q dim), m/l [B,Hkv,G,S]."""
+    if allowed is not None:
+        s = jnp.where(allowed, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    if allowed is not None:
+        p = jnp.where(allowed, p, 0.0)
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_dtype), v5,
+                    preferred_element_type=jnp.float32)
+    o_new = o * alpha.transpose(0, 3, 1, 2)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def _zigzag_fwd(axis_name: str, axis_size: int, has_seg: bool,
+                q, k, v, seg):
+    """Zigzag-layout causal forward. Local q/k/v hold chunks
+    (i, 2n-1-i) concatenated; returns (o, lse) in the same layout."""
+    scale = q.shape[-1] ** -0.5
+    n = axis_size
+    my_idx = lax.axis_index(axis_name)
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    c = S // 2
+    perm = _ring_perm(n)
+    q5 = _group(q, Hkv)
+    pos_q = _zz_positions(my_idx, n, c)
+
+    o0 = jnp.zeros((B, S, Hkv, Hq // Hkv, D), jnp.float32)
+    m0 = jnp.full((B, Hkv, Hq // Hkv, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, Hq // Hkv, S), jnp.float32)
+
+    def step(carry, t):
+        o, m, l, k, v, kseg = carry
+        j = (my_idx - t) % n
+
+        def local_block(_):
+            # t == 0: the only masked step — full local attention with
+            # the zigzag-position causal mask.
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k,
+                           preferred_element_type=jnp.float32) * scale
+            allowed = (pos_q[:, None] >= pos_q[None, :])
+            if has_seg:
+                allowed = allowed & _zz_seg_mask(seg, kseg)
+            return _online_update(o, m, l, s, v, allowed, v.dtype)
+
+        def low_only(_):
+            # i > j: everything attends the block's low chunk; maskless.
+            ka, va = k[:, :c], v[:, :c]
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, ka,
+                           preferred_element_type=jnp.float32) * scale
+            allowed = (_zz_seg_mask(seg, kseg[:, :c]) if has_seg
+                       else None)
+            return _online_update(o, m, l, s, va, allowed, v.dtype)
+
+        def high_rows(_):
+            # i < j: only the high-chunk queries attend, to everything;
+            # maskless. Low-row accumulators pass through untouched.
+            qb = q5[:, c:]
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", qb, k,
+                           preferred_element_type=jnp.float32) * scale
+            allowed = (_zz_seg_mask(seg[:, c:], kseg) if has_seg
+                       else None)
+            o_hi, m_hi, l_hi = _online_update(
+                o[:, c:], m[..., c:], l[..., c:], s, v, allowed, v.dtype)
+            return (jnp.concatenate([o[:, :c], o_hi], axis=1),
+                    jnp.concatenate([m[..., :c], m_hi], axis=-1),
+                    jnp.concatenate([l[..., :c], l_hi], axis=-1))
+
+        o, m, l = lax.cond(
+            t == 0, local_block,
+            lambda _: lax.cond(my_idx > j, low_only, high_rows, _),
+            None)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        kseg = lax.ppermute(kseg, axis_name, perm)
+        return (o, m, l, k, v, kseg), None
+
+    (o, m, l, k, v, _), _ = lax.scan(step, (o0, m0, l0, k, v, seg),
+                                     jnp.arange(n))
+    l_safe = jnp.maximum(l, 1e-30)
+    o = (o / l_safe.transpose(0, 3, 1, 2)[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l_safe)
+    return o.reshape(B, S, Hq, D), lse
+
+
+def _zigzag_bwd(axis_name: str, axis_size: int, has_seg: bool, res, do):
+    q, k, v, o, lse, seg = res
+    scale = q.shape[-1] ** -0.5
+    n = axis_size
+    my_idx = lax.axis_index(axis_name)
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    c = S // 2
+    perm = _ring_perm(n)
+    q5 = _group(q, Hkv)
+    do5 = _group(do, Hkv)
+    pos_q = _zz_positions(my_idx, n, c)
+    delta = jnp.einsum("bqhgd,bqhgd->bhgq", do5.astype(jnp.float32),
+                       _group(o, Hkv).astype(jnp.float32))
+
+    dq0 = jnp.zeros(q5.shape, jnp.float32)
+    dk0 = jnp.zeros_like(k, jnp.float32)
+    dv0 = jnp.zeros_like(v, jnp.float32)
+
+    def _block_grads(qp, dop, lsep, deltap, kp, vp, allowed):
+        """Gradients of one maskless-or-masked sub-block.
+        Returns (dq_part, dk_part, dv_part)."""
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qp, kp,
+                       preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lsep[..., None])
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
+        dv = jnp.einsum("bhgqk,bqhgd->bkhd", p.astype(dop.dtype), dop,
+                        preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqhgd,bkhd->bhgqk", dop, vp,
+                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - deltap[..., None]) * scale).astype(q.dtype)
+        dq = jnp.einsum("bhgqk,bkhd->bqhgd", ds, kp,
+                        preferred_element_type=jnp.float32)
+        dk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qp,
+                        preferred_element_type=jnp.float32)
+        return dq, dk, dv
+
+    def step(carry, t):
+        dq, k, v, dk, dv, kseg = carry
+        j = (my_idx - t) % n
+
+        def local_block(_):
+            allowed = (pos_q[:, None] >= pos_q[None, :])
+            if has_seg:
+                allowed = allowed & _zz_seg_mask(seg, kseg)
+            dq_p, dk_p, dv_p = _block_grads(q5, do5, lse, delta, k, v,
+                                            allowed)
+            return dq + dq_p, dk + dk_p, dv + dv_p
+
+        def low_only(_):
+            allowed = (_zz_seg_mask(seg, kseg[:, :c]) if has_seg
+                       else None)
+            dq_p, dk_p, dv_p = _block_grads(q5, do5, lse, delta,
+                                            k[:, :c], v[:, :c], allowed)
+            zeros_k = jnp.zeros_like(dk[:, c:])
+            return (dq + dq_p,
+                    dk + jnp.concatenate([dk_p, zeros_k], axis=1),
+                    dv + jnp.concatenate([dv_p, zeros_k], axis=1))
+
+        def high_rows(_):
+            allowed = (_zz_seg_mask(seg[:, c:], kseg) if has_seg
+                       else None)
+            dq_p, dk_p, dv_p = _block_grads(
+                q5[:, c:], do5[:, c:], lse[..., c:], delta[..., c:],
+                k, v, allowed)
+            dq_new = jnp.concatenate([dq[:, :c], dq[:, c:] + dq_p],
+                                     axis=1)
+            return dq_new, dk + dk_p, dv + dv_p
+
+        dq, dk, dv = lax.cond(
+            t == 0, local_block,
+            lambda _: lax.cond(my_idx > j, low_only, high_rows, _),
+            None)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        kseg = lax.ppermute(kseg, axis_name, perm)
+        return (dq, k, v, dk, dv, kseg), None
+
+    (dq, k, v, dk, dv, _), _ = lax.scan(step, (dq0, k, v, dk0, dv0, seg),
+                                        jnp.arange(n))
+    dseg = np.zeros(seg.shape, dtype=jax.dtypes.float0)
+    return (dq.reshape(B, S, Hq, D).astype(q.dtype),
+            dk.astype(k.dtype), dv.astype(v.dtype), dseg)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _zigzag_attn(axis_name: str, axis_size: int, has_seg: bool,
+                 q, k, v, seg):
+    o, _ = _zigzag_fwd(axis_name, axis_size, has_seg, q, k, v, seg)
+    return o
+
+
+def _zigzag_attn_fwd(axis_name, axis_size, has_seg, q, k, v, seg):
+    o, lse = _zigzag_fwd(axis_name, axis_size, has_seg, q, k, v, seg)
+    return o, (q, k, v, o, lse, seg)
+
+
+_zigzag_attn.defvjp(_zigzag_attn_fwd, _zigzag_bwd)
+
+
+def zigzag_ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                          batch_axes=("dp", "fsdp"),
+                          heads_axis: Optional[str] = "tp",
+                          segment_ids=None):
+    """Load-balanced CAUSAL ring attention over `axis`. Inputs must be
+    in the zigzag layout (`zigzag_permute` along the sequence, together
+    with positions/segment ids); the output stays in that layout, so a
+    model that permutes once at the input never pays a resharding
+    (the loss is order-invariant under a jointly-permuted mask)."""
+    n = mesh.shape[axis]
+    if q.shape[1] % (2 * n) != 0:
+        raise ValueError(
+            f"zigzag needs seq divisible by 2*{axis}={2 * n}, got "
+            f"{q.shape[1]}")
+    if q.shape[2] % k.shape[2] != 0:
+        raise ValueError(f"q heads {q.shape[2]} not a multiple of kv "
+                         f"heads {k.shape[2]}")
+    q_spec, kv_spec, seg_spec = _qkv_specs(mesh, axis, batch_axes,
+                                           heads_axis, q, k)
+    has_seg = segment_ids is not None
+    seg = segment_ids if has_seg else _dummy_seg(q)
+    fn = jax.shard_map(
+        functools.partial(_zigzag_attn, axis, n, has_seg),
+        mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
+        out_specs=q_spec, check_vma=False)
+    return fn(q, k, v, seg)
 
 
 # ---------------------------------------------------------------------------

@@ -51,6 +51,9 @@ def main() -> None:
     ap.add_argument("--packed", action="store_true",
                     help="packed-sequence input pipeline (segment-aware "
                          "attention) over synthetic variable-length docs")
+    ap.add_argument("--zigzag", action="store_true",
+                    help="zigzag (load-balanced causal) ring attention "
+                         "for sp>1; llama only")
     ap.add_argument("--lora", type=int, default=0, metavar="RANK",
                     help="LoRA finetune: train rank-RANK adapters over "
                          "frozen base weights (llama only)")
@@ -65,6 +68,13 @@ def main() -> None:
         ap.error("--lora currently supports --model llama only")
     if args.lora < 0:
         ap.error("--lora rank must be positive")
+    if args.zigzag and args.model != "llama":
+        # Only llama's forward applies the zigzag permute; letting the
+        # rule reach another model would silently mis-mask attention.
+        ap.error("--zigzag currently supports --model llama only")
+    if args.zigzag and args.lora:
+        ap.error("--zigzag with --lora is not wired yet (the LoRA step "
+                 "builds its own activation rules); drop one flag")
 
     # Multi-host: join the cluster-wide jax.distributed rendezvous using
     # the runtime's env contract (runtime/constants.py) before touching
@@ -76,6 +86,7 @@ def main() -> None:
 
     import skypilot_tpu.callbacks as sky_callback
     from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel import sharding as sh_rules
     from skypilot_tpu.train import trainer
 
     if args.model == "llama":
@@ -138,7 +149,11 @@ def main() -> None:
                                                  base_sh=base_sh)
         step_fn = lambda s, b: raw_step(s, base_params, b)
     else:
-        step_fn = trainer.make_train_step(cfg, tc, mesh, model=model)
+        act_rules = sh_rules.ACT_RULES
+        if args.zigzag:
+            act_rules = dict(act_rules, seq_layout="zigzag")
+        step_fn = trainer.make_train_step(cfg, tc, mesh, model=model,
+                                          act_rules=act_rules)
         if mgr and args.resume and mgr.latest_step() is not None:
             target = trainer.create_abstract_state(cfg, tc, mesh,
                                                    model=model)

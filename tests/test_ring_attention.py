@@ -179,6 +179,124 @@ def test_packed_model_with_sp(tiny_cfg, sp_mesh):
                                np.asarray(loss_local), rtol=2e-4)
 
 
+def test_zigzag_layout_roundtrip():
+    x = jnp.arange(2 * 32 * 3).reshape(2, 32, 3)
+    z = ra.zigzag_permute(x, n=4)
+    back = ra.zigzag_unpermute(z, n=4)
+    np.testing.assert_array_equal(back, x)
+    # Shard i holds chunks (i, 2n-1-i): first shard starts with chunk 0
+    # then chunk 7.
+    c = 32 // 8
+    np.testing.assert_array_equal(z[:, :c], x[:, :c])
+    np.testing.assert_array_equal(z[:, c:2 * c], x[:, 7 * c:8 * c])
+
+
+@pytest.mark.parametrize("n_name,mesh_fix", [("sp2", "sp_mesh"),
+                                             ("sp4", "sp4_mesh")])
+def test_zigzag_matches_xla(n_name, mesh_fix, request):
+    """Zigzag ring == causal oracle, via permute -> attend -> unpermute
+    (the layout a zigzag training run lives in end to end)."""
+    mesh = request.getfixturevalue(mesh_fix)
+    n = mesh.shape["sp"]
+    q, k, v = _qkv(s=32)
+    want = attn_ops.xla_attention(q, k, v, causal=True)
+    qz = ra.zigzag_permute(q, n)
+    kz = ra.zigzag_permute(k, n)
+    vz = ra.zigzag_permute(v, n)
+    oz = ra.zigzag_ring_attention(qz, kz, vz, mesh)
+    got = ra.zigzag_unpermute(oz, n)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_zigzag_gradients_match(sp_mesh):
+    n = sp_mesh.shape["sp"]
+    q, k, v = _qkv(s=16)
+    w = jax.random.normal(jax.random.key(9), q.shape)
+
+    def loss_zz(q, k, v):
+        o = ra.zigzag_ring_attention(
+            ra.zigzag_permute(q, n), ra.zigzag_permute(k, n),
+            ra.zigzag_permute(v, n), sp_mesh)
+        return jnp.sum(ra.zigzag_unpermute(o, n) * w)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(attn_ops.xla_attention(q, k, v, causal=True) * w)
+
+    g_zz = jax.grad(loss_zz, argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for gz, gx, name in zip(g_zz, g_xla, "qkv"):
+        np.testing.assert_allclose(gz, gx, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_zigzag_gqa_and_segments(sp4_mesh):
+    n = sp4_mesh.shape["sp"]
+    q, _, _ = _qkv(b=1, s=64, h=4)
+    _, k, v = _qkv(b=1, s=64, h=2, seed=3)
+    seg = _segments(b=1, s=64)
+    want = attn_ops.xla_attention(q, attn_ops.repeat_kv(k, 2),
+                                  attn_ops.repeat_kv(v, 2), causal=True,
+                                  segment_ids=seg)
+    oz = ra.zigzag_ring_attention(
+        ra.zigzag_permute(q, n), ra.zigzag_permute(k, n),
+        ra.zigzag_permute(v, n), sp4_mesh,
+        segment_ids=ra.zigzag_permute(seg, n))
+    got = ra.zigzag_unpermute(oz, n)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_zigzag_under_jit(sp_mesh):
+    n = sp_mesh.shape["sp"]
+    q, k, v = _qkv(s=32)
+
+    @jax.jit
+    def f(q, k, v):
+        return ra.zigzag_ring_attention(q, k, v, sp_mesh)
+
+    want = ra.zigzag_unpermute(
+        f(ra.zigzag_permute(q, n), ra.zigzag_permute(k, n),
+          ra.zigzag_permute(v, n)), n)
+    ref = attn_ops.xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(want, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_model_zigzag_matches_contiguous(tiny_cfg, sp_mesh):
+    """Full llama loss under rules seq_layout=zigzag == the plain-ring
+    loss (the model permutes once after embedding, unpermutes before
+    the head; packed segments ride along)."""
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import sharding as sh
+    params = llama.init_params(jax.random.key(0), tiny_cfg)
+    B, S = 2, 64
+    tokens = jax.random.randint(jax.random.key(1), (B, S), 1,
+                                tiny_cfg.vocab_size, dtype=jnp.int32)
+    seg = _segments(b=B, s=S, seed=7)
+    pos = jnp.broadcast_to(jnp.arange(S), (B, S)).astype(jnp.int32)
+    batch = {"tokens": tokens, "segment_ids": seg, "positions": pos}
+    zz_rules = dict(sh.ACT_RULES, seq_layout="zigzag")
+    loss_zz, _ = llama.loss_fn(params, batch, tiny_cfg, mesh=sp_mesh,
+                               rules=zz_rules)
+    loss_plain, _ = llama.loss_fn(params, batch, tiny_cfg, mesh=sp_mesh)
+    np.testing.assert_allclose(np.asarray(loss_zz),
+                               np.asarray(loss_plain), rtol=2e-4)
+
+
+def test_model_zigzag_nondivisible_falls_back(tiny_cfg, sp_mesh):
+    """Seq not divisible by 2*sp: the layout key is dropped and the
+    model runs the contiguous path instead of mis-permuting."""
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import sharding as sh
+    params = llama.init_params(jax.random.key(0), tiny_cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 66), 1,
+                                tiny_cfg.vocab_size, dtype=jnp.int32)
+    zz_rules = dict(sh.ACT_RULES, seq_layout="zigzag")
+    out = llama.forward(params, tokens, tiny_cfg, mesh=sp_mesh,
+                        rules=zz_rules)
+    ref = llama.forward(params, tokens, tiny_cfg, mesh=sp_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_ring_nondivisible_dims_replicate(sp_mesh):
     """Batch=3 (not divisible by dp*fsdp) and heads=3 (not by tp): the
     spec falls back to replication instead of erroring."""
