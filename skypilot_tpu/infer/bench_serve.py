@@ -30,7 +30,7 @@ REF_TOK_S = 2147.98
 
 
 def run(config=None, requests=16, slots=16, prompt_len=96,
-        new_tokens=64, max_burst=32) -> dict:
+        new_tokens=64, max_burst=32, kv_int8=False) -> dict:
     """Run the serving benchmark; returns the metrics dict (also usable
     by the repo-root bench.py to fold serving numbers into its single
     JSON artifact)."""
@@ -50,7 +50,8 @@ def run(config=None, requests=16, slots=16, prompt_len=96,
     max_len = prompt_len + new_tokens + 8
     e = eng.InferenceEngine(params, cfg, n_slots=slots,
                             max_len=max_len,
-                            prompt_buckets=(prompt_len,))
+                            prompt_buckets=(prompt_len,),
+                            kv_int8=kv_int8)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
                for _ in range(requests)]
@@ -85,6 +86,7 @@ def run(config=None, requests=16, slots=16, prompt_len=96,
         "req_per_s": round(req_s, 3),
         "vs_baseline_ttft": round(REF_TTFT_MS / max(med_ttft, 1e-9), 3),
         "config": config,
+        "kv_int8": kv_int8,
     }
 
 
@@ -96,10 +98,11 @@ def main() -> None:
     ap.add_argument("--prompt-len", type=int, default=96)
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--max-burst", type=int, default=32)
+    ap.add_argument("--kv-int8", action="store_true")
     args = ap.parse_args()
     r = run(config=args.config, requests=args.requests, slots=args.slots,
             prompt_len=args.prompt_len, new_tokens=args.new_tokens,
-            max_burst=args.max_burst)
+            max_burst=args.max_burst, kv_int8=args.kv_int8)
     print(json.dumps({
         "metric": "serve_median_ttft",
         "value": r["median_ttft_ms"],
@@ -108,6 +111,7 @@ def main() -> None:
         "output_tok_per_s": r["out_tok_s"],
         "req_per_s": r["req_per_s"],
         "config": r["config"],
+        "kv_int8": r["kv_int8"],
     }))
 
 

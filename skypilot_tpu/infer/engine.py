@@ -59,7 +59,8 @@ class InferenceEngine:
                  n_slots: int = 8, max_len: int = 1024,
                  prompt_buckets: Tuple[int, ...] = (128, 512, 1024),
                  sampling_params: sampling.SamplingParams = sampling.SamplingParams(),
-                 eos_id: Optional[int] = None, seed: int = 0):
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 kv_int8: bool = False):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -70,7 +71,8 @@ class InferenceEngine:
         # One hidden spare slot (index n_slots): batched admission pads
         # its wave with dummy prefills targeting the spare, so one
         # compiled program serves every wave size.
-        self.cache = kvcache.init_cache(cfg, n_slots + 1, max_len)
+        self.cache = kvcache.init_cache(cfg, n_slots + 1, max_len,
+                                        kv_int8=kv_int8)
         self.rng = jax.random.key(seed)
 
         self.free_slots = list(range(n_slots))

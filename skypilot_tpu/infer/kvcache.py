@@ -56,25 +56,61 @@ def _ffn(cfg: llama.LlamaConfig, h: jax.Array, layer: Dict) -> jax.Array:
 
 
 def init_cache(cfg: llama.LlamaConfig, n_slots: int,
-               max_len: int) -> Cache:
-    """Pre-allocated decode state for ``n_slots`` concurrent requests."""
+               max_len: int, kv_int8: bool = False) -> Cache:
+    """Pre-allocated decode state for ``n_slots`` concurrent requests.
+
+    ``kv_int8``: store K/V rows as int8 with a per-(row, kv-head) absmax
+    scale. Decode is HBM-bandwidth-bound on cache reads, so halving the
+    bytes raises decode throughput AND doubles the requests that fit —
+    the standard TPU serving trade (XLA fuses the dequant multiply into
+    the attention einsums).
+    """
     L, G, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-    return {
-        "k": jnp.zeros((L, n_slots, max_len, G, hd), cfg.dtype),
-        "v": jnp.zeros((L, n_slots, max_len, G, hd), cfg.dtype),
+    cache: Cache = {
         # Tokens generated + prompt rows present, per slot (0 = free).
         "length": jnp.zeros((n_slots,), jnp.int32),
         "last_token": jnp.zeros((n_slots,), jnp.int32),
     }
+    if kv_int8:
+        cache["k"] = jnp.zeros((L, n_slots, max_len, G, hd), jnp.int8)
+        cache["v"] = jnp.zeros((L, n_slots, max_len, G, hd), jnp.int8)
+        cache["k_scale"] = jnp.zeros((L, n_slots, max_len, G),
+                                     jnp.float32)
+        cache["v_scale"] = jnp.zeros((L, n_slots, max_len, G),
+                                     jnp.float32)
+    else:
+        cache["k"] = jnp.zeros((L, n_slots, max_len, G, hd), cfg.dtype)
+        cache["v"] = jnp.zeros((L, n_slots, max_len, G, hd), cfg.dtype)
+    return cache
 
 
-def cache_logical_axes() -> Dict[str, Tuple]:
-    return {
+def quantize_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[..., G, hd] -> (int8 values, [..., G] absmax scales)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q: jax.Array, scale: jax.Array,
+                    dtype=jnp.float32) -> jax.Array:
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def cache_logical_axes(cache: Cache | None = None) -> Dict[str, Tuple]:
+    """Axes for the given cache's keys (quantization is derived from the
+    cache itself, like insert/decode_step do; None = fp layout)."""
+    axes = {
         "k": ("layer", "batch", "seq_cache", "kv_heads", "head_dim"),
         "v": ("layer", "batch", "seq_cache", "kv_heads", "head_dim"),
         "length": ("batch",),
         "last_token": ("batch",),
     }
+    if cache is not None and "k_scale" in cache:
+        axes["k_scale"] = ("layer", "batch", "seq_cache", "kv_heads")
+        axes["v_scale"] = ("layer", "batch", "seq_cache", "kv_heads")
+    return axes
 
 
 # ---------------------------------------------------------------------------
@@ -128,16 +164,22 @@ def insert(cache: Cache, prefix: Cache, slot: jax.Array,
     prefix k/v: [L, S_bucket, G, hd]; rows >= true_len are padding but
     harmless — decode masks by ``length``.
     """
-    k = lax.dynamic_update_slice(
-        cache["k"], prefix["k"][:, None], (0, slot, 0, 0, 0))
-    v = lax.dynamic_update_slice(
-        cache["v"], prefix["v"][:, None], (0, slot, 0, 0, 0))
-    return {
-        "k": k,
-        "v": v,
-        "length": cache["length"].at[slot].set(true_len),
-        "last_token": cache["last_token"].at[slot].set(first_token),
-    }
+    out = dict(cache)
+    pk, pv = prefix["k"], prefix["v"]
+    if "k_scale" in cache:
+        pk, ks = quantize_rows(pk)
+        pv, vs = quantize_rows(pv)
+        out["k_scale"] = lax.dynamic_update_slice(
+            cache["k_scale"], ks[:, None], (0, slot, 0, 0))
+        out["v_scale"] = lax.dynamic_update_slice(
+            cache["v_scale"], vs[:, None], (0, slot, 0, 0))
+    out["k"] = lax.dynamic_update_slice(
+        cache["k"], pk[:, None], (0, slot, 0, 0, 0))
+    out["v"] = lax.dynamic_update_slice(
+        cache["v"], pv[:, None], (0, slot, 0, 0, 0))
+    out["length"] = cache["length"].at[slot].set(true_len)
+    out["last_token"] = cache["last_token"].at[slot].set(first_token)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -168,49 +210,71 @@ def decode_step(params: llama.Params, cache: Cache,
     scale = hd ** -0.5
     batch_ix = jnp.arange(B)
 
+    quant = "k_scale" in cache
+
     def body(carry, layer_kv):
         x = carry
-        layer, ck, cv = layer_kv                              # ck [B,M,G,hd]
+        if quant:
+            layer, ck, cv, cks, cvs = layer_kv              # ck int8
+        else:
+            layer, ck, cv = layer_kv                        # ck [B,M,G,hd]
+            cks = cvs = None
         h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
         q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
         k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
         v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
-        ck = ck.at[batch_ix, pos].set(k[:, 0])
-        cv = cv.at[batch_ix, pos].set(v[:, 0])
+        if quant:
+            kq, ks = quantize_rows(k[:, 0])
+            vq, vs = quantize_rows(v[:, 0])
+            ck = ck.at[batch_ix, pos].set(kq)
+            cv = cv.at[batch_ix, pos].set(vq)
+            cks = cks.at[batch_ix, pos].set(ks)
+            cvs = cvs.at[batch_ix, pos].set(vs)
+            # Dequant fuses into the einsums: HBM reads stay int8.
+            ck_f = dequantize_rows(ck, cks)
+            cv_f = dequantize_rows(cv, cvs)
+        else:
+            ck = ck.at[batch_ix, pos].set(k[:, 0])
+            cv = cv.at[batch_ix, pos].set(v[:, 0])
+            ck_f = ck.astype(jnp.float32)
+            cv_f = cv.astype(jnp.float32)
         qh = q[:, 0].reshape(B, G, rep, hd)
         s = jnp.einsum("bgrk,bmgk->bgrm", qh.astype(jnp.float32),
-                       ck.astype(jnp.float32)) * scale
+                       ck_f) * scale
         s = jnp.where(valid[:, None, None, :], s, neg)
         w = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bgrm,bmgk->bgrk", w, cv.astype(jnp.float32))
+        o = jnp.einsum("bgrm,bmgk->bgrk", w, cv_f)
         o = o.reshape(B, 1, cfg.n_heads, hd).astype(cfg.dtype)
         o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
         x = x + o
         h = llama.rms_norm(x, layer["ln2"], cfg.norm_eps)
-        return x + _ffn(cfg, h, layer), (ck, cv)
+        out_kv = (ck, cv, cks, cvs) if quant else (ck, cv)
+        return x + _ffn(cfg, h, layer), out_kv
 
-    x, (new_k, new_v) = lax.scan(
-        body, x, (params["blocks"], cache["k"], cache["v"]))
+    if quant:
+        xs = (params["blocks"], cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"])
+    else:
+        xs = (params["blocks"], cache["k"], cache["v"])
+    x, new_kv = lax.scan(body, x, xs)
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("bsd,dv->bsv", x,
                         head.astype(cfg.dtype))[:, 0].astype(jnp.float32)
-    return {
-        "k": new_k,
-        "v": new_v,
-        "length": cache["length"],
-        "last_token": cache["last_token"],
-    }, logits
+    out = dict(cache)
+    if quant:
+        out["k"], out["v"], out["k_scale"], out["v_scale"] = new_kv
+    else:
+        out["k"], out["v"] = new_kv
+    return out, logits
 
 
 def commit_tokens(cache: Cache, tokens: jax.Array,
                   active: jax.Array) -> Cache:
     """Append sampled tokens on active slots: bump lengths, set last."""
-    return {
-        "k": cache["k"],
-        "v": cache["v"],
-        "length": cache["length"] + active.astype(jnp.int32),
-        "last_token": jnp.where(active, tokens, cache["last_token"]),
-    }
+    return dict(
+        cache,
+        length=cache["length"] + active.astype(jnp.int32),
+        last_token=jnp.where(active, tokens, cache["last_token"]))

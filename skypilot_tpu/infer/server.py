@@ -164,6 +164,8 @@ def main() -> None:
     ap.add_argument("--port", type=int, default=8080)
     ap.add_argument("--slots", type=int, default=16)
     ap.add_argument("--max-len", type=int, default=1024)
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="int8 KV cache: half the HBM per token")
     ap.add_argument("--temperature", type=float, default=0.0)
     args = ap.parse_args()
 
@@ -181,7 +183,8 @@ def main() -> None:
         prompt_buckets=(128, min(512, args.max_len),
                         args.max_len),
         sampling_params=sampling.SamplingParams(
-            temperature=args.temperature))
+            temperature=args.temperature),
+        kv_int8=args.kv_int8)
     model, httpd = serve(engine, port=args.port)
     print(f"serving on :{args.port}", file=sys.stderr, flush=True)
     try:
