@@ -178,20 +178,21 @@ def base_param_shardings(cfg: llama.LlamaConfig, mesh: Mesh, model=llama):
 def make_lora_train_step(cfg: llama.LlamaConfig, lc: LoRAConfig,
                          tc: trainer.TrainConfig,
                          mesh: Optional[Mesh],
-                         model=llama, base_sh=None) -> Callable:
+                         model=llama, base_sh=None,
+                         act_rules: sh.Rules = sh.ACT_RULES) -> Callable:
     """step(lora_state, base_params, batch) -> (lora_state, metrics).
 
     base_params are a frozen input (no gradient, no donation): the same
     base tree serves every step. Pass ``base_sh`` if already computed.
     """
     opt = trainer.make_optimizer(tc)
-    constrain = sh.make_constrain(mesh, sh.ACT_RULES)
+    constrain = sh.make_constrain(mesh, act_rules)
 
     def step(state, base_params, batch):
         def lossf(adapters):
             params = merge(base_params, adapters, lc)
             return model.loss_fn(params, batch, cfg, constrain, mesh,
-                                 sh.ACT_RULES)
+                                 act_rules)
 
         (loss, metrics), grads = jax.value_and_grad(
             lossf, has_aux=True)(state["params"])

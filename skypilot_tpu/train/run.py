@@ -72,9 +72,6 @@ def main() -> None:
         # Only llama's forward applies the zigzag permute; letting the
         # rule reach another model would silently mis-mask attention.
         ap.error("--zigzag currently supports --model llama only")
-    if args.zigzag and args.lora:
-        ap.error("--zigzag with --lora is not wired yet (the LoRA step "
-                 "builds its own activation rules); drop one flag")
 
     # Multi-host: join the cluster-wide jax.distributed rendezvous using
     # the runtime's env contract (runtime/constants.py) before touching
@@ -120,6 +117,10 @@ def main() -> None:
                              warmup_steps=max(1, min(100, args.steps // 10)),
                              total_steps=args.steps)
 
+    act_rules = sh_rules.ACT_RULES
+    if args.zigzag:
+        act_rules = dict(act_rules, seq_layout="zigzag")
+
     mgr = None
     start_step = 0
     state = None
@@ -146,12 +147,10 @@ def main() -> None:
             state = lora_lib.create_lora_state(cfg, lc, tc, mesh)
         raw_step = lora_lib.make_lora_train_step(cfg, lc, tc, mesh,
                                                  model=model,
-                                                 base_sh=base_sh)
+                                                 base_sh=base_sh,
+                                                 act_rules=act_rules)
         step_fn = lambda s, b: raw_step(s, base_params, b)
     else:
-        act_rules = sh_rules.ACT_RULES
-        if args.zigzag:
-            act_rules = dict(act_rules, seq_layout="zigzag")
         step_fn = trainer.make_train_step(cfg, tc, mesh, model=model,
                                           act_rules=act_rules)
         if mgr and args.resume and mgr.latest_step() is not None:
