@@ -98,7 +98,10 @@ def test_serve_up_ready_balance_down():
     try:
         serve_core.wait_ready("websvc", timeout=300)
         # Wait until both replicas are READY (LB retries mask one).
-        deadline = time.time() + 240
+        # Generous: under full-suite load, two RPC-launched replica
+        # clusters + the controller compete with other tests' process
+        # storms.
+        deadline = time.time() + 420
         while time.time() < deadline:
             ready = _ready_urls("websvc")
             if len(ready) == 2:
