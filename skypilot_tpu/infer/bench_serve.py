@@ -30,7 +30,8 @@ REF_TOK_S = 2147.98
 
 
 def run(config=None, requests=16, slots=16, prompt_len=96,
-        new_tokens=64, max_burst=32, kv_int8=False) -> dict:
+        new_tokens=64, max_burst=32, kv_int8=False,
+        weights_int8=False) -> dict:
     """Run the serving benchmark; returns the metrics dict (also usable
     by the repo-root bench.py to fold serving numbers into its single
     JSON artifact)."""
@@ -46,12 +47,23 @@ def run(config=None, requests=16, slots=16, prompt_len=96,
     cfg = llama.CONFIGS[config]
     log(f"serve bench: {config} on {jax.devices()[0].device_kind}")
 
-    params = llama.init_params(jax.random.key(0), cfg)
     max_len = prompt_len + new_tokens + 8
-    e = eng.InferenceEngine(params, cfg, n_slots=slots,
-                            max_len=max_len,
-                            prompt_buckets=(prompt_len,),
-                            kv_int8=kv_int8)
+    if weights_int8:
+        # Build int8 weights directly — the fp init of an 8B-class
+        # config (32 GB) would never fit the chip that the int8 model
+        # (8 GB) serves from.
+        from skypilot_tpu.infer import kvcache
+        params, qw = kvcache.random_quantized_params(cfg)
+        e = eng.InferenceEngine(params, cfg, n_slots=slots,
+                                max_len=max_len,
+                                prompt_buckets=(prompt_len,),
+                                kv_int8=kv_int8, qweights=qw)
+    else:
+        params = llama.init_params(jax.random.key(0), cfg)
+        e = eng.InferenceEngine(params, cfg, n_slots=slots,
+                                max_len=max_len,
+                                prompt_buckets=(prompt_len,),
+                                kv_int8=kv_int8)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
                for _ in range(requests)]
@@ -87,6 +99,7 @@ def run(config=None, requests=16, slots=16, prompt_len=96,
         "vs_baseline_ttft": round(REF_TTFT_MS / max(med_ttft, 1e-9), 3),
         "config": config,
         "kv_int8": kv_int8,
+        "weights_int8": weights_int8,
     }
 
 
@@ -99,10 +112,12 @@ def main() -> None:
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--max-burst", type=int, default=32)
     ap.add_argument("--kv-int8", action="store_true")
+    ap.add_argument("--weights-int8", action="store_true")
     args = ap.parse_args()
     r = run(config=args.config, requests=args.requests, slots=args.slots,
             prompt_len=args.prompt_len, new_tokens=args.new_tokens,
-            max_burst=args.max_burst, kv_int8=args.kv_int8)
+            max_burst=args.max_burst, kv_int8=args.kv_int8,
+            weights_int8=args.weights_int8)
     print(json.dumps({
         "metric": "serve_median_ttft",
         "value": r["median_ttft_ms"],
@@ -112,6 +127,7 @@ def main() -> None:
         "req_per_s": r["req_per_s"],
         "config": r["config"],
         "kv_int8": r["kv_int8"],
+        "weights_int8": r["weights_int8"],
     }))
 
 

@@ -60,7 +60,8 @@ class InferenceEngine:
                  prompt_buckets: Tuple[int, ...] = (128, 512, 1024),
                  sampling_params: sampling.SamplingParams = sampling.SamplingParams(),
                  eos_id: Optional[int] = None, seed: int = 0,
-                 kv_int8: bool = False):
+                 kv_int8: bool = False, weights_int8: bool = False,
+                 qweights=None):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -73,6 +74,22 @@ class InferenceEngine:
         # compiled program serves every wave size.
         self.cache = kvcache.init_cache(cfg, n_slots + 1, max_len,
                                         kv_int8=kv_int8)
+        # w8a8 serving: int8 weights for BOTH prefill and decode, so no
+        # fp copy of the seven block matrices (or the head) is kept —
+        # the memory halving that fits an 8B-class model on a 16 GB
+        # chip. ``qweights`` may be passed pre-built (with a slim
+        # params tree: embed + norms only). Not wired for MoE experts.
+        self.qweights = qweights
+        if weights_int8 and qweights is None:
+            if hasattr(cfg, "n_experts"):
+                raise NotImplementedError(
+                    "weights_int8 is not supported for MoE configs yet")
+            self.qweights = jax.jit(lambda prm: {
+                "blocks": kvcache.quantize_block_weights(prm),
+                "head": kvcache.quantize_head(prm, cfg),
+            })(params)
+        if self.qweights is not None:
+            self.params = params = kvcache.slim_params(params)
         self.rng = jax.random.key(seed)
 
         self.free_slots = list(range(n_slots))
@@ -92,13 +109,14 @@ class InferenceEngine:
         @functools.partial(jax.jit, donate_argnums=(1,),
                            static_argnames=("bucket",))
         def _admit_wave(params, cache, tokens_b, true_lens, slots, rng,
-                        *, bucket):
+                        *, bucket, qweights=None):
             del bucket
             from jax import lax as _lax
 
             def body(c, xs):
                 toks, tl, slot, key = xs
-                prefix, logits = kvcache.prefill(params, toks, tl, cfg)
+                prefix, logits = kvcache.prefill(params, toks, tl, cfg,
+                                                 qweights=qweights)
                 tok = sampling.sample(logits, key, sp)
                 c = kvcache.insert(c, prefix, slot, tl, tok)
                 return c, tok
@@ -109,8 +127,9 @@ class InferenceEngine:
             return cache, first
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def _decode(params, cache, rng, active):
-            cache, logits = kvcache.decode_step(params, cache, cfg)
+        def _decode(params, cache, rng, active, qweights=None):
+            cache, logits = kvcache.decode_step(params, cache, cfg,
+                                                qweights=qweights)
             toks = sampling.sample(logits, rng, sp)
             cache = kvcache.commit_tokens(cache, toks, active)
             return cache, toks
@@ -120,11 +139,13 @@ class InferenceEngine:
         # per-token compute (small models, remote/relayed TPUs).
         @functools.partial(jax.jit, donate_argnums=(1,),
                            static_argnames=("k",))
-        def _decode_burst(params, cache, rng, active, *, k):
+        def _decode_burst(params, cache, rng, active, *, k,
+                          qweights=None):
             from jax import lax as _lax
 
             def body(c, key):
-                c, logits = kvcache.decode_step(params, c, cfg)
+                c, logits = kvcache.decode_step(params, c, cfg,
+                                                qweights=qweights)
                 toks = sampling.sample(logits, key, sp)
                 c = kvcache.commit_tokens(c, toks, active)
                 return c, toks
@@ -184,7 +205,7 @@ class InferenceEngine:
         self.cache, first = self._admit_wave_fn(
             self.params, self.cache, jnp.asarray(tokens_b),
             jnp.asarray(true_lens), jnp.asarray(slot_ids), sub,
-            bucket=bucket)
+            bucket=bucket, qweights=self.qweights)
         first = np.asarray(first)
         now = time.time()
         # Spare-slot bookkeeping must not linger.
@@ -250,7 +271,8 @@ class InferenceEngine:
             active[s] = True
         self.rng, sub = jax.random.split(self.rng)
         self.cache, toks = self._decode_burst_fn(
-            self.params, self.cache, sub, jnp.asarray(active), k=k)
+            self.params, self.cache, sub, jnp.asarray(active), k=k,
+            qweights=self.qweights)
         toks = np.asarray(toks)                    # [k, slots]
         out: Dict[int, List[int]] = {}
         for slot, req in list(self.slot_req.items()):
@@ -274,7 +296,8 @@ class InferenceEngine:
             active[s] = True
         self.rng, sub = jax.random.split(self.rng)
         self.cache, toks = self._decode_fn(self.params, self.cache, sub,
-                                           jnp.asarray(active))
+                                           jnp.asarray(active),
+                                           qweights=self.qweights)
         toks = np.asarray(toks)
         out: Dict[int, int] = {}
         for slot, req in list(self.slot_req.items()):

@@ -166,6 +166,8 @@ def main() -> None:
     ap.add_argument("--max-len", type=int, default=1024)
     ap.add_argument("--kv-int8", action="store_true",
                     help="int8 KV cache: half the HBM per token")
+    ap.add_argument("--weights-int8", action="store_true",
+                    help="w8a8 decode: int8 weights + activations")
     ap.add_argument("--temperature", type=float, default=0.0)
     args = ap.parse_args()
 
@@ -184,7 +186,11 @@ def main() -> None:
                         args.max_len),
         sampling_params=sampling.SamplingParams(
             temperature=args.temperature),
-        kv_int8=args.kv_int8)
+        kv_int8=args.kv_int8, weights_int8=args.weights_int8)
+    # The engine slims its own tree under weights_int8; drop main()'s
+    # reference too or the fp block weights stay resident for the whole
+    # server lifetime and the memory halving never happens.
+    del params
     model, httpd = serve(engine, port=args.port)
     print(f"serving on :{args.port}", file=sys.stderr, flush=True)
     try:

@@ -220,3 +220,32 @@ def test_kv_int8_engine_matches_fp_closely(cfg, params):
     # The rest run over the int8 cache; demand strong agreement.
     same = sum(a == b for a, b in zip(out_q, out_fp))
     assert same >= len(out_fp) - 1, (out_fp, out_q)
+
+
+def test_weights_int8_engine_generates_sensibly(cfg, params):
+    """w8a8 decode: greedy output stays close to the fp engine (per-
+    channel weight + per-token activation int8; ~1% matmul error)."""
+    prompt = list(range(1, 20))
+    sp = sampling.SamplingParams(temperature=0.0)
+    e_fp = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                               prompt_buckets=(32,), sampling_params=sp)
+    e_q = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                              prompt_buckets=(32,), sampling_params=sp,
+                              weights_int8=True)
+    out_fp = e_fp.generate([prompt], max_new_tokens=6)[0]
+    out_q = e_q.generate([prompt], max_new_tokens=6)[0]
+    assert len(out_q) == len(out_fp)
+    # Prefill AND decode are quantized (that is what frees the fp
+    # weights): demand strong but not exact agreement.
+    same = sum(a == b for a, b in zip(out_q, out_fp))
+    assert same >= len(out_fp) - 2, (out_fp, out_q)
+
+
+def test_weights_int8_composes_with_kv_int8(cfg, params):
+    sp = sampling.SamplingParams(temperature=0.0)
+    e = eng.InferenceEngine(params, cfg, n_slots=1, max_len=48,
+                            prompt_buckets=(16,), sampling_params=sp,
+                            kv_int8=True, weights_int8=True)
+    out = e.generate([[5, 9, 31]], max_new_tokens=5)[0]
+    assert len(out) == 5
+    assert all(0 <= t < cfg.vocab_size for t in out)
