@@ -149,7 +149,15 @@ def translate_local_file_mounts(task: Task, handle: ClusterHandle) -> Task:
         if not src.startswith(("gs://", "s3://", "http://", "https://")):
             sub = f"{run_prefix}/mount{len(uploads)}"
             uploads[sub] = src
-            mounts[dst] = f"gs://{bucket_name}/{sub}"
+            if os.path.isfile(os.path.expanduser(src)):
+                # Single-file mounts upload as {sub}/{basename} (see
+                # GcsStore.upload); the rewritten URL must carry the
+                # basename so the cluster-side file/dir heuristic
+                # (data/storage.py materialize) picks a cp, not rsync.
+                base = os.path.basename(os.path.expanduser(src).rstrip("/"))
+                mounts[dst] = f"gs://{bucket_name}/{sub}/{base}"
+            else:
+                mounts[dst] = f"gs://{bucket_name}/{sub}"
     if not uploads:
         return task
     store = storage_lib.Storage(name=bucket_name, source=None,

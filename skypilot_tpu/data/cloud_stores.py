@@ -26,6 +26,17 @@ class CloudStorage:
     def make_sync_file_command(self, source: str, destination: str) -> str:
         raise NotImplementedError
 
+    def make_sync_auto_command(self, source: str, destination: str) -> str:
+        """Single file OR directory prefix, decided host-side.
+
+        A URL alone cannot say whether ``gs://b/sub/name`` is one object
+        or a prefix (dot-in-basename guessing silently materializes an
+        empty dir for extensionless files), so the generated command
+        probes the object authoritatively on the cluster and picks
+        cp vs rsync there.
+        """
+        raise NotImplementedError
+
 
 class GcsCloudStorage(CloudStorage):
     """gs:// via the gcloud storage CLI (preinstalled on TPU-VMs)."""
@@ -39,6 +50,13 @@ class GcsCloudStorage(CloudStorage):
         dst = shlex.quote(destination)
         return (f"mkdir -p $(dirname {dst}) && "
                 f"gcloud storage cp {shlex.quote(source)} {dst}")
+
+    def make_sync_auto_command(self, source: str, destination: str) -> str:
+        src = shlex.quote(source)
+        return (f"if gcloud storage objects describe {src} "
+                f">/dev/null 2>&1; then "
+                f"{self.make_sync_file_command(source, destination)}; "
+                f"else {self.make_sync_dir_command(source, destination)}; fi")
 
 
 class S3CloudStorage(CloudStorage):
@@ -54,6 +72,13 @@ class S3CloudStorage(CloudStorage):
         dst = shlex.quote(destination)
         return (f"mkdir -p $(dirname {dst}) && "
                 f"aws s3 cp {shlex.quote(source)} {dst}")
+
+    def make_sync_auto_command(self, source: str, destination: str) -> str:
+        bucket, _, key = source[len("s3://"):].partition("/")
+        return (f"if aws s3api head-object --bucket {shlex.quote(bucket)} "
+                f"--key {shlex.quote(key)} >/dev/null 2>&1; then "
+                f"{self.make_sync_file_command(source, destination)}; "
+                f"else {self.make_sync_dir_command(source, destination)}; fi")
 
 
 class HttpCloudStorage(CloudStorage):

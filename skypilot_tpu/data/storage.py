@@ -14,6 +14,7 @@ sky/data/data_utils.py (bucket URL parsing).
 from __future__ import annotations
 
 import enum
+import os
 import shlex
 import subprocess
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -102,12 +103,19 @@ class GcsStore(AbstractStore):
                 f"creating gs://{self.name} failed: {out.strip()}")
 
     def upload(self, source: str, subpath: str = "") -> None:
-        excl = storage_utils.gsutil_exclude_regex(source)
-        xflag = f" -x {shlex.quote(excl)}" if excl else ""
         dst = f"gs://{self.name}/{subpath}" if subpath else f"gs://{self.name}"
-        rc, out = self._run(
-            f"gcloud storage rsync -r{xflag} {shlex.quote(source)} "
-            f"{dst}")
+        if os.path.isfile(os.path.expanduser(source)):
+            # rsync requires directory sources; a single-file mount
+            # (file_mounts: {~/cfg.json: ./cfg.json}) goes via cp, the
+            # object keeping its basename under the subpath.
+            rc, out = self._run(
+                f"gcloud storage cp {shlex.quote(source)} {dst}/")
+        else:
+            excl = storage_utils.gsutil_exclude_regex(source)
+            xflag = f" -x {shlex.quote(excl)}" if excl else ""
+            rc, out = self._run(
+                f"gcloud storage rsync -r{xflag} {shlex.quote(source)} "
+                f"{dst}")
         if rc != 0:
             raise exceptions.StorageError(
                 f"upload {source} -> {dst} failed: {out.strip()}")
@@ -152,11 +160,16 @@ class S3Store(AbstractStore):
                 f"creating s3://{self.name} failed: {out.strip()}")
 
     def upload(self, source: str, subpath: str = "") -> None:
-        excl = storage_utils.aws_exclude_args(source)
         dst = (f"s3://{self.name}/{subpath}" if subpath
                else f"s3://{self.name}")
-        rc, out = self._run(
-            f"aws s3 sync {excl}{shlex.quote(source)} {dst}")
+        if os.path.isfile(os.path.expanduser(source)):
+            # s3 sync requires directory sources (see GcsStore.upload).
+            rc, out = self._run(
+                f"aws s3 cp {shlex.quote(source)} {dst}/")
+        else:
+            excl = storage_utils.aws_exclude_args(source)
+            rc, out = self._run(
+                f"aws s3 sync {excl}{shlex.quote(source)} {dst}")
         if rc != 0:
             raise exceptions.StorageError(
                 f"upload {source} -> {dst} failed: {out.strip()}")
@@ -301,13 +314,12 @@ def mount_or_copy(handle, dst: str, src: str) -> None:
         # An http(s) source is always a single file.
         cmd = store.make_sync_file_command(src, dst)
     else:
-        # Bucket *subpaths* with a dotted basename look like files;
-        # bucket roots (dotted or not) are directories. The rsync
-        # command degrades to an empty copy for a missing prefix.
+        # Bucket roots are always directories; for subpaths the
+        # object-vs-prefix decision is made authoritatively on the
+        # cluster host (URL guessing silently produced empty dirs for
+        # extensionless single files).
         _, sub = split_bucket_url(src)
-        is_file = ("." in sub.rsplit("/", 1)[-1]
-                   and not src.endswith("/")) if sub else False
-        cmd = (store.make_sync_file_command(src, dst) if is_file
+        cmd = (store.make_sync_auto_command(src.rstrip("/"), dst) if sub
                else store.make_sync_dir_command(src, dst))
     for runner in provision.get_command_runners(info):
         rc, out, err = runner.run(cmd)

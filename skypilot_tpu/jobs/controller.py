@@ -56,7 +56,14 @@ class JobsController:
             finally:
                 state.release_launch_slot(self.job_id)
             self._log(f"cluster up; job {job_id} running")
-            state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
+            if not state.transition_to_running(self.job_id):
+                # A cancel landed while we were provisioning — honor it
+                # instead of resurrecting the job (the cluster is torn
+                # down by _cleanup in the finally block).
+                self._log("cancelled during launch; tearing down")
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.CANCELLED)
+                return
             # _monitor returns the FINAL (job_id, handle) — recovery may
             # have moved the job to a fresh cluster in another zone.
             job_id, handle = self._monitor(job_id, handle)
@@ -143,7 +150,10 @@ class JobsController:
                              state.ManagedJobStatus.FAILED_NO_RESOURCE,
                              error=str(e))
             return None
-        state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
+        if not state.transition_to_running(self.job_id):
+            self._log("cancelled during recovery; tearing down")
+            state.set_status(self.job_id, state.ManagedJobStatus.CANCELLED)
+            return None
         return job_id, handle
 
     # -- probes ------------------------------------------------------------

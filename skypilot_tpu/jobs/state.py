@@ -136,6 +136,27 @@ def set_status(job_id: int, status: ManagedJobStatus,
                       (status.value, error, job_id))
 
 
+def transition_to_running(job_id: int) -> bool:
+    """Conditionally move a job to RUNNING after a launch/recover.
+
+    Provisioning takes minutes; a ``jobs cancel`` that lands mid-launch
+    sets CANCELLING, and an unconditional RUNNING write afterwards would
+    silently resurrect the job (it would then run to completion despite
+    a successful cancel reply). The UPDATE applies only when the job is
+    not CANCELLING/terminal; returns False when the caller should take
+    the cancellation path instead.
+    """
+    blocked = [ManagedJobStatus.CANCELLING.value] + [
+        s.value for s in ManagedJobStatus if s.is_terminal()]
+    with _db() as c:
+        cur = c.execute(
+            "UPDATE managed_jobs SET status=?, started_at="
+            "COALESCE(started_at, ?) WHERE job_id=? AND status NOT IN"
+            f" ({','.join('?' * len(blocked))})",
+            (ManagedJobStatus.RUNNING.value, time.time(), job_id, *blocked))
+        return cur.rowcount > 0
+
+
 def set_cluster(job_id: int, cluster_name: str) -> None:
     with _db() as c:
         c.execute("UPDATE managed_jobs SET cluster_name=? WHERE job_id=?",
@@ -179,9 +200,12 @@ def acquire_launch_slot(job_id: int, poll: float = 0.2,
     limit = launch_limit()
     deadline = time.time() + timeout
     while time.time() < deadline:
-        _reap_dead_launch_slots()
         with _db() as c:
             c.execute("BEGIN IMMEDIATE")
+            # Reap inside the same IMMEDIATE transaction as the
+            # count-and-claim, so a concurrent acquire can't count a
+            # corpse we're about to free (or vice versa).
+            _reap_dead_launch_slots(c)
             n = int(c.execute(
                 "SELECT COUNT(*) FROM managed_jobs WHERE"
                 " launch_started_at IS NOT NULL AND"
@@ -197,28 +221,38 @@ def acquire_launch_slot(job_id: int, poll: float = 0.2,
         f"no launch slot for managed job {job_id} within {timeout}s")
 
 
-def _reap_dead_launch_slots() -> None:
+# A slot whose controller_pid is still NULL may simply be newly spawned:
+# jobs_submit records the pid only after Popen, so the controller can
+# claim its slot before set_controller_pid commits. Give such rows a
+# grace window before treating NULL pid as a corpse.
+_NULL_PID_GRACE_SECONDS = 30.0
+
+
+def _reap_dead_launch_slots(c) -> None:
     """Free slots whose controller process died between acquire and
     release (SIGKILL/OOM): the count must not include corpses, or dead
     slots eventually starve every new launch. Runs on the controller
-    host, so pid liveness is a local check."""
-    with _db() as c:
-        rows = c.execute(
-            "SELECT job_id, controller_pid FROM managed_jobs WHERE"
-            " launch_started_at IS NOT NULL AND launch_ended_at IS NULL"
-        ).fetchall()
-        for job_id, pid in rows:
-            dead = pid is None
-            if pid is not None:
-                try:
-                    os.kill(pid, 0)
-                except OSError:
-                    dead = True
-            if dead:
-                c.execute(
-                    "UPDATE managed_jobs SET launch_ended_at=?"
-                    " WHERE job_id=? AND launch_ended_at IS NULL",
-                    (time.time(), job_id))
+    host, so pid liveness is a local check. Operates on the caller's
+    open transaction."""
+    rows = c.execute(
+        "SELECT job_id, controller_pid, launch_started_at FROM"
+        " managed_jobs WHERE launch_started_at IS NOT NULL AND"
+        " launch_ended_at IS NULL").fetchall()
+    for job_id, pid, started in rows:
+        if pid is None:
+            age = time.time() - (started or 0)
+            dead = age > _NULL_PID_GRACE_SECONDS
+        else:
+            try:
+                os.kill(pid, 0)
+                dead = False
+            except OSError:
+                dead = True
+        if dead:
+            c.execute(
+                "UPDATE managed_jobs SET launch_ended_at=?"
+                " WHERE job_id=? AND launch_ended_at IS NULL",
+                (time.time(), job_id))
 
 
 def release_launch_slot(job_id: int) -> None:

@@ -304,27 +304,9 @@ def forward_hidden(params: Params, tokens: jax.Array, cfg: LlamaConfig,
     # (elementwise/matmul ops are order-agnostic; rope follows
     # positions), un-permute once at the end. Decided here so the
     # attention dispatch and the layout always agree.
-    use_zigzag = False
-    if mesh is not None and rules is not None \
-            and rules.get("seq_layout") == "zigzag":
-        from skypilot_tpu.parallel import ring_attention as ra
-        seq_axis = rules.get("seq")
-        n_sp = (mesh.shape.get(seq_axis, 1)
-                if isinstance(seq_axis, str) else 1)
-        use_zigzag = n_sp > 1 and S % (2 * n_sp) == 0
-        if use_zigzag:
-            x = ra.zigzag_permute(x, n_sp)
-            positions = ra.zigzag_permute(
-                positions, n_sp, axis=positions.ndim - 1)
-            if segment_ids is not None:
-                segment_ids = ra.zigzag_permute(segment_ids, n_sp)
-    layer_rules = rules
-    if rules is not None and rules.get("seq_layout") == "zigzag" \
-            and not use_zigzag:
-        # Divisibility fallback: drop the layout key so the attention
-        # dispatch agrees with the (unpermuted) layout.
-        layer_rules = {k: v for k, v in rules.items()
-                       if k != "seq_layout"}
+    from skypilot_tpu.parallel import ring_attention as ra
+    (x, positions, segment_ids, layer_rules, use_zigzag,
+     n_sp) = ra.apply_zigzag_layout(x, positions, segment_ids, mesh, rules)
     cos, sin = rope_frequencies(cfg, positions)
 
     def body(carry, layer):
@@ -337,7 +319,6 @@ def forward_hidden(params: Params, tokens: jax.Array, cfg: LlamaConfig,
 
     x, _ = lax.scan(body, x, params["blocks"])
     if use_zigzag:
-        from skypilot_tpu.parallel import ring_attention as ra
         x = ra.zigzag_unpermute(x, n_sp)
     return rms_norm(x, params["final_norm"], cfg.norm_eps)
 

@@ -254,12 +254,21 @@ def forward_hidden(params: Params, tokens: jax.Array, cfg: MoEConfig,
     x = constrain(x, ("batch", "seq", "embed"))
     if positions is None:
         positions = jnp.arange(S)
+
+    # Zigzag sequence layout (shared contract — the forward owns the
+    # decision + permute so the attention dispatch and the layout
+    # always agree). The MoE FFN is order-agnostic per token (capacity
+    # priority follows the permuted order, still a valid priority), so
+    # only attention needs the layout contract.
+    from skypilot_tpu.parallel import ring_attention as ra
+    (x, positions, segment_ids, layer_rules, use_zigzag,
+     n_sp) = ra.apply_zigzag_layout(x, positions, segment_ids, mesh, rules)
     cos, sin = llama.rope_frequencies(cfg, positions)
 
     def body(carry, layer):
         x, aux_sum = carry
         y, aux = decoder_layer(cfg, x, layer, cos, sin, constrain, mesh,
-                               rules, segment_ids)
+                               layer_rules, segment_ids)
         return (y, aux_sum + aux), None
 
     if cfg.remat:
@@ -267,6 +276,8 @@ def forward_hidden(params: Params, tokens: jax.Array, cfg: MoEConfig,
 
     (x, aux_sum), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
                                params["blocks"])
+    if use_zigzag:
+        x = ra.zigzag_unpermute(x, n_sp)
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     return x, aux_sum / cfg.n_layers
 

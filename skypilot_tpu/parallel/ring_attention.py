@@ -259,6 +259,44 @@ def zigzag_unpermute(x, n: int, axis: int = 1):
     return jnp.take(x, inv, axis=axis)
 
 
+def apply_zigzag_layout(x, positions, segment_ids, mesh, rules):
+    """The model-side half of the zigzag layout contract, shared by
+    every decoder model (llama, moe): decide whether the zigzag layout
+    applies (rules ask for it, the sequence mesh-axis is > 1, and S
+    divides 2*n), permute activations/positions/segment ids once, and
+    strip the layout key on fallback so the attention dispatch always
+    agrees with the actual layout.
+
+    x: [B, S, D] post-embedding activations. Returns
+    ``(x, positions, segment_ids, layer_rules, use_zigzag, n_sp)``;
+    the caller runs its decoder stack under ``layer_rules`` and, when
+    ``use_zigzag``, un-permutes the final hidden states with
+    ``zigzag_unpermute(x, n_sp)``.
+    """
+    use_zigzag, n_sp = False, 1
+    if mesh is not None and rules is not None \
+            and rules.get("seq_layout") == "zigzag":
+        S = x.shape[1]
+        seq_axis = rules.get("seq")
+        n_sp = (mesh.shape.get(seq_axis, 1)
+                if isinstance(seq_axis, str) else 1)
+        use_zigzag = n_sp > 1 and S % (2 * n_sp) == 0
+        if use_zigzag:
+            x = zigzag_permute(x, n_sp)
+            positions = zigzag_permute(positions, n_sp,
+                                       axis=positions.ndim - 1)
+            if segment_ids is not None:
+                segment_ids = zigzag_permute(segment_ids, n_sp)
+    layer_rules = rules
+    if rules is not None and rules.get("seq_layout") == "zigzag" \
+            and not use_zigzag:
+        # Divisibility fallback: drop the layout key so the attention
+        # dispatch agrees with the (unpermuted) layout.
+        layer_rules = {k: v for k, v in rules.items()
+                       if k != "seq_layout"}
+    return x, positions, segment_ids, layer_rules, use_zigzag, n_sp
+
+
 def _zz_positions(my_idx, n: int, c: int):
     """Global positions of this device's 2c local rows."""
     lo = my_idx * c + jnp.arange(c)

@@ -116,12 +116,26 @@ def start_host_agents(info: ClusterInfo, token: str,
         if rc != 0:
             raise exceptions.CommandError(rc, "push agent token", err)
         # Logging is handled inside the command ($HOME expands in the
-        # pod's shell — a quoted log_path argument would not).
+        # pod's shell — a quoted log_path argument would not). A live
+        # agent speaking an older wire protocol (recorded in
+        # hostd.protocol at its startup) is killed first, or it would
+        # silently drop newer request fields forever.
+        from skypilot_tpu.runtime import hostd
+        want = hostd.PROTOCOL_VERSION
+        # pgrep/pkill must not match this script's own detached wrapper
+        # shell, whose argv contains the whole script text — so the
+        # module path never appears as a contiguous literal anywhere in
+        # it: it is assembled in $m from a split literal, and the agent
+        # is launched via "$m" too.
         runner.run_detached(
-            f'pgrep -f skypilot_tpu.runtime.hostd >/dev/null || '
-            f'(cd "$HOME" && mkdir -p .skypilot_tpu && '
+            'm=skypilot_tpu.runtime.host; m="${m}d"; '
+            'v=$(cat "$HOME/.skypilot_tpu/hostd.protocol" 2>/dev/null'
+            f' || echo 0); if [ "$v" != "{want}" ]; then '
+            'pkill -f "$m"; sleep 0.2; fi; '
+            'pgrep -f "$m" >/dev/null || '
+            '(cd "$HOME" && mkdir -p .skypilot_tpu && '
             f'PYTHONPATH="$HOME/{command_runner.REMOTE_PKG_DIR}'
-            f':$PYTHONPATH" python3 -S -m skypilot_tpu.runtime.hostd '
+            ':$PYTHONPATH" python3 -S -m "$m" '
             f"--port {port} >> .skypilot_tpu/hostd.log 2>&1)",
             log_path="/dev/null")
 

@@ -17,7 +17,7 @@ is defense in depth, not a perimeter.
 Protocol: one JSON object per line in, one per line out.
 
   {"token": T, "op": "run", "cmd": ..., "env": {..}, "cwd": ...,
-   "timeout": N}                  -> {"ok": true, "rc", "out", "err"}
+   "timeout": N, "stdin": S|null} -> {"ok": true, "rc", "out", "err"}
   {"token": T, "op": "run_detached", "cmd", "env", "cwd", "log_path"}
                                   -> {"ok": true, "pid": N}
   {"token": T, "op": "read_file", "path": P} -> {"ok": true,
@@ -53,11 +53,16 @@ def _full_env(env):
 def handle_request(req: dict) -> dict:
     op = req.get("op")
     if op == "ping":
-        return {"ok": True, "home": os.path.expanduser("~")}
+        return {"ok": True, "home": os.path.expanduser("~"),
+                "protocol": PROTOCOL_VERSION}
     if op == "run":
+        # stdin rides the protocol as data (never spliced into the
+        # shell line — a heredoc wrapper would let stdin content
+        # execute as shell on the pod).
         proc = subprocess.run(
             ["bash", "-c", req["cmd"]], env=_full_env(req.get("env")),
             cwd=req.get("cwd") or os.path.expanduser("~"),
+            input=req.get("stdin"),
             capture_output=True, text=True, timeout=req.get("timeout"))
         return {"ok": True, "rc": proc.returncode, "out": proc.stdout,
                 "err": proc.stderr}
@@ -116,9 +121,27 @@ class _Server(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
+# Bumped on wire-protocol changes (v2: dedicated "stdin" field on "run").
+# The running agent records its version so instance_setup can detect a
+# stale daemon after a re-provision and restart it — the launch guard
+# alone would keep an old-protocol agent alive forever.
+PROTOCOL_VERSION = 2
+
+
+def _record_protocol_version() -> None:
+    try:
+        d = os.path.expanduser("~/.skypilot_tpu")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "hostd.protocol"), "w") as f:
+            f.write(str(PROTOCOL_VERSION))
+    except OSError:
+        pass  # advisory only; worst case setup restarts the agent
+
+
 def serve(port: int, token: str, host: str = "0.0.0.0") -> None:
     srv = _Server((host, port), _Handler)
     srv.token = token  # type: ignore[attr-defined]
+    _record_protocol_version()
     srv.serve_forever()
 
 

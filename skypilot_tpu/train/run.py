@@ -68,10 +68,11 @@ def main() -> None:
         ap.error("--lora currently supports --model llama only")
     if args.lora < 0:
         ap.error("--lora rank must be positive")
-    if args.zigzag and args.model != "llama":
-        # Only llama's forward applies the zigzag permute; letting the
-        # rule reach another model would silently mis-mask attention.
-        ap.error("--zigzag currently supports --model llama only")
+    if args.zigzag and args.model not in ("llama", "moe"):
+        # Only llama's and moe's forwards apply the zigzag permute;
+        # letting the rule reach another model would silently mis-mask
+        # attention.
+        ap.error("--zigzag supports --model llama or moe only")
 
     # Multi-host: join the cluster-wide jax.distributed rendezvous using
     # the runtime's env contract (runtime/constants.py) before touching

@@ -285,12 +285,28 @@ class TcpAgentRunner(CommandRunner):
                 f"{resp.get('error')}")
         return resp
 
+    def _agent_protocol(self) -> int:
+        """Protocol version of the live agent (probed once). Agents
+        predating the version field are v1."""
+        if getattr(self, "_protocol", None) is None:
+            self._protocol = int(
+                self._call({"op": "ping"}).get("protocol", 1))
+        return self._protocol
+
     def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None,
             stdin=None):
-        if stdin is not None:
-            cmd = f"cat <<'SKYTPU_STDIN_EOF' | {cmd}\n{stdin}\nSKYTPU_STDIN_EOF"
+        if stdin is not None and self._agent_protocol() < 2:
+            # v1 agents (still running from before a client upgrade)
+            # don't know the "stdin" field. Base64 keeps the payload
+            # data-safe inside the shell line (a raw heredoc would let
+            # stdin content execute as shell).
+            import base64
+            b64 = base64.b64encode(stdin.encode()).decode()
+            cmd = f"printf %s {b64} | base64 -d | {{ {cmd} ; }}"
+            stdin = None
         resp = self._call({"op": "run", "cmd": cmd, "env": env,
-                           "cwd": cwd, "timeout": timeout},
+                           "cwd": cwd, "timeout": timeout,
+                           "stdin": stdin},
                           timeout=timeout)
         if log_path:
             os.makedirs(os.path.dirname(log_path), exist_ok=True)

@@ -1,0 +1,211 @@
+"""Regression tests for round-3 advisor findings: cancel-during-launch,
+MoE zigzag layout, launch-slot reap race, hostd stdin transport,
+single-file mount uploads."""
+
+import dataclasses
+import time
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def sky_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+
+
+# -- jobs: cancel during launch must not be resurrected ---------------------
+
+def test_transition_to_running_honors_cancelling():
+    from skypilot_tpu.jobs import state
+    jid = state.add("j", {"run": "true"}, "FAILOVER")
+    state.set_status(jid, state.ManagedJobStatus.STARTING)
+    assert state.transition_to_running(jid)
+    assert state.get(jid)["status"] == state.ManagedJobStatus.RUNNING
+
+    jid2 = state.add("j2", {"run": "true"}, "FAILOVER")
+    state.set_status(jid2, state.ManagedJobStatus.STARTING)
+    # A cancel lands mid-provision...
+    state.set_status(jid2, state.ManagedJobStatus.CANCELLING)
+    # ...so the post-launch RUNNING write must not apply.
+    assert not state.transition_to_running(jid2)
+    assert state.get(jid2)["status"] == state.ManagedJobStatus.CANCELLING
+
+
+def test_transition_to_running_honors_terminal():
+    from skypilot_tpu.jobs import state
+    jid = state.add("j", {"run": "true"}, "FAILOVER")
+    state.set_status(jid, state.ManagedJobStatus.CANCELLED)
+    assert not state.transition_to_running(jid)
+    assert state.get(jid)["status"] == state.ManagedJobStatus.CANCELLED
+
+
+# -- jobs: launch-slot reaping ----------------------------------------------
+
+def test_fresh_null_pid_slot_not_reaped(monkeypatch):
+    """A slot whose controller hasn't recorded its pid yet (Popen just
+    returned) must survive reaping; only a stale NULL-pid slot frees."""
+    from skypilot_tpu.jobs import state
+    monkeypatch.setenv("SKYTPU_JOBS_MAX_LAUNCHES", "1")
+    j1 = state.add("a", {"run": "true"}, "FAILOVER")
+    j2 = state.add("b", {"run": "true"}, "FAILOVER")
+    state.acquire_launch_slot(j1)  # pid still NULL — newly spawned
+    with pytest.raises(TimeoutError):
+        state.acquire_launch_slot(j2, poll=0.05, timeout=0.3)
+    # Backdate j1's claim beyond the grace window -> corpse, reapable.
+    with state._db() as c:
+        c.execute(
+            "UPDATE managed_jobs SET launch_started_at=? WHERE job_id=?",
+            (time.time() - 2 * state._NULL_PID_GRACE_SECONDS, j1))
+    state.acquire_launch_slot(j2, poll=0.05, timeout=5)
+    assert state.launch_window(j2)[0] is not None
+
+
+def test_live_pid_slot_not_reaped(monkeypatch):
+    import os
+
+    from skypilot_tpu.jobs import state
+    monkeypatch.setenv("SKYTPU_JOBS_MAX_LAUNCHES", "1")
+    j1 = state.add("a", {"run": "true"}, "FAILOVER")
+    j2 = state.add("b", {"run": "true"}, "FAILOVER")
+    state.set_controller_pid(j1, os.getpid())  # alive forever (us)
+    state.acquire_launch_slot(j1)
+    with state._db() as c:
+        c.execute(
+            "UPDATE managed_jobs SET launch_started_at=? WHERE job_id=?",
+            (time.time() - 3600, j1))
+    with pytest.raises(TimeoutError):
+        state.acquire_launch_slot(j2, poll=0.05, timeout=0.3)
+
+
+# -- hostd: stdin is data, never shell --------------------------------------
+
+def test_hostd_stdin_marker_passthrough():
+    """stdin containing the old heredoc EOF marker must pass through
+    byte-for-byte (previously it truncated the input and executed the
+    remainder as shell on the pod)."""
+    from skypilot_tpu.runtime import hostd
+    payload = "line1\nSKYTPU_STDIN_EOF\necho pwned\n"
+    resp = hostd.handle_request(
+        {"op": "run", "cmd": "cat", "stdin": payload})
+    assert resp["ok"] and resp["rc"] == 0
+    assert resp["out"] == payload
+
+
+def test_hostd_run_without_stdin_still_works():
+    from skypilot_tpu.runtime import hostd
+    resp = hostd.handle_request({"op": "run", "cmd": "echo hi"})
+    assert resp["ok"] and resp["out"].strip() == "hi"
+
+
+# -- storage: single-file mounts --------------------------------------------
+
+class FakeRun:
+    def __init__(self):
+        self.cmds = []
+
+    def __call__(self, cmd):
+        self.cmds.append(cmd)
+        return 0, ""
+
+
+def test_gcs_upload_file_uses_cp(tmp_path):
+    from skypilot_tpu.data import storage
+    f = tmp_path / "cfg.json"
+    f.write_text("{}")
+    run = FakeRun()
+    storage.GcsStore("b", run=run).upload(str(f), "run1/mount0")
+    assert len(run.cmds) == 1
+    assert "storage cp" in run.cmds[0]
+    assert run.cmds[0].endswith("gs://b/run1/mount0/")
+    assert "rsync" not in run.cmds[0]
+
+
+def test_gcs_upload_dir_still_rsyncs(tmp_path):
+    from skypilot_tpu.data import storage
+    d = tmp_path / "src"
+    d.mkdir()
+    run = FakeRun()
+    storage.GcsStore("b", run=run).upload(str(d), "run1/workdir")
+    assert any("rsync -r" in c for c in run.cmds)
+
+
+def test_s3_upload_file_uses_cp(tmp_path):
+    from skypilot_tpu.data import storage
+    f = tmp_path / "cfg.json"
+    f.write_text("{}")
+    run = FakeRun()
+    storage.S3Store("b", run=run).upload(str(f), "run1/mount0")
+    assert any("s3 cp" in c for c in run.cmds)
+    assert not any("s3 sync" in c for c in run.cmds)
+
+
+def test_sync_auto_command_probes_object(tmp_path):
+    """Cluster-side materialize must not guess file-vs-dir from the URL
+    (extensionless files materialized as empty dirs); the generated
+    command probes the object and picks cp or rsync host-side."""
+    from skypilot_tpu.data import cloud_stores
+    gs = cloud_stores.get_storage_from_path("gs://b/run1/mount0/run_task")
+    cmd = gs.make_sync_auto_command("gs://b/run1/mount0/run_task",
+                                    "/home/u/bin/run_task")
+    assert "gcloud storage objects describe" in cmd
+    assert "gcloud storage cp" in cmd and "rsync -r" in cmd
+    s3 = cloud_stores.get_storage_from_path("s3://bkt/sub/name")
+    cmd = s3.make_sync_auto_command("s3://bkt/sub/name", "/d/name")
+    assert "head-object --bucket bkt --key sub/name" in cmd
+    assert "s3 cp" in cmd and "s3 sync" in cmd
+
+
+# -- MoE zigzag layout -------------------------------------------------------
+
+def test_moe_zigzag_matches_contiguous():
+    """MoE forward under rules seq_layout=zigzag == the plain-ring
+    forward (moe.forward_hidden now owns the permute, like llama's).
+    Full capacity so routing keeps every token — drop priority is
+    order-dependent, everything else is order-agnostic. float32: bf16
+    summation-reorder noise flips borderline top-k expert picks, which
+    discretely amplifies into large output diffs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu.models import moe
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel import sharding as sh
+    cfg = dataclasses.replace(
+        moe.CONFIGS["moe-tiny"],
+        capacity_factor=float(moe.CONFIGS["moe-tiny"].n_experts),
+        dtype=jnp.float32)
+    params = moe.init_params(jax.random.key(0), cfg)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(dp=2, sp=2, tp=2))
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 1,
+                                cfg.vocab_size, dtype=jnp.int32)
+    zz_rules = dict(sh.ACT_RULES, seq_layout="zigzag")
+    logits_zz, aux_zz = moe.forward(params, tokens, cfg, mesh=mesh,
+                                    rules=zz_rules)
+    logits, aux = moe.forward(params, tokens, cfg, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(logits_zz), np.asarray(logits),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(aux_zz), np.asarray(aux),
+                               rtol=1e-5)
+
+
+def test_moe_zigzag_nondivisible_falls_back():
+    """Seq not divisible by 2*sp: the layout key is dropped and the
+    contiguous path runs instead of mis-permuting."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu.models import moe
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel import sharding as sh
+    cfg = moe.CONFIGS["moe-tiny"]
+    params = moe.init_params(jax.random.key(0), cfg)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(dp=2, sp=2, tp=2))
+    tokens = jax.random.randint(jax.random.key(1), (2, 66), 1,
+                                cfg.vocab_size, dtype=jnp.int32)
+    zz_rules = dict(sh.ACT_RULES, seq_layout="zigzag")
+    out_zz, _ = moe.forward(params, tokens, cfg, mesh=mesh, rules=zz_rules)
+    out, _ = moe.forward(params, tokens, cfg, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out_zz), np.asarray(out),
+                               rtol=2e-4, atol=2e-4)

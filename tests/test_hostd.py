@@ -89,8 +89,26 @@ def test_agent_rejects_bad_token(agent):
 
 def test_agent_stdin_support(agent):
     runner, _ = agent
+    # stdin rides the protocol as data, byte-exact (no heredoc newline).
     rc, out, _ = runner.run("wc -c", stdin="12345")
-    assert rc == 0 and out.strip().endswith("6")  # 5 bytes + newline
+    assert rc == 0 and out.strip().endswith("5")
+
+
+def test_agent_ping_reports_protocol(agent):
+    from skypilot_tpu.runtime import hostd
+    runner, _ = agent
+    assert runner._agent_protocol() == hostd.PROTOCOL_VERSION
+
+
+def test_agent_stdin_v1_fallback(agent, monkeypatch):
+    """Against a v1 agent (no stdin field) the runner base64-wraps the
+    payload into the command line — data-safe even when stdin contains
+    shell or the old heredoc EOF marker."""
+    runner, _ = agent
+    monkeypatch.setattr(type(runner), "_agent_protocol", lambda self: 1)
+    payload = "a\nSKYTPU_STDIN_EOF\necho pwned\n"
+    rc, out, _ = runner.run("cat", stdin=payload)
+    assert rc == 0 and out == payload
 
 
 def test_driver_gang_over_host_agents(tmp_path, monkeypatch):
