@@ -14,6 +14,20 @@ import shlex
 from typing import Dict
 
 
+def _probe_then_dispatch(probe_cmd: str, not_found_regex: str,
+                         file_cmd: str, dir_cmd: str) -> str:
+    """Shared probe scaffolding for make_sync_auto_command: object
+    exists -> file copy; definitive not-found -> prefix sync (which
+    exits 0 even for an empty prefix); any other probe failure — auth
+    hiccup, metadata-server timeout — fails loudly, or a single-file
+    mount would silently materialize as an empty directory."""
+    return (f"skytpu_probe=$({probe_cmd} 2>&1); skytpu_rc=$?; "
+            f"if [ $skytpu_rc -eq 0 ]; then {file_cmd}; "
+            f"elif printf %s \"$skytpu_probe\" | "
+            f"grep -qiE '{not_found_regex}'; then {dir_cmd}; "
+            f"else printf %s \"$skytpu_probe\" >&2; exit 1; fi")
+
+
 class CloudStorage:
     """Command builders for one URL scheme."""
 
@@ -52,11 +66,11 @@ class GcsCloudStorage(CloudStorage):
                 f"gcloud storage cp {shlex.quote(source)} {dst}")
 
     def make_sync_auto_command(self, source: str, destination: str) -> str:
-        src = shlex.quote(source)
-        return (f"if gcloud storage objects describe {src} "
-                f">/dev/null 2>&1; then "
-                f"{self.make_sync_file_command(source, destination)}; "
-                f"else {self.make_sync_dir_command(source, destination)}; fi")
+        return _probe_then_dispatch(
+            f"gcloud storage objects describe {shlex.quote(source)}",
+            "not found|no such object|404",
+            self.make_sync_file_command(source, destination),
+            self.make_sync_dir_command(source, destination))
 
 
 class S3CloudStorage(CloudStorage):
@@ -75,10 +89,12 @@ class S3CloudStorage(CloudStorage):
 
     def make_sync_auto_command(self, source: str, destination: str) -> str:
         bucket, _, key = source[len("s3://"):].partition("/")
-        return (f"if aws s3api head-object --bucket {shlex.quote(bucket)} "
-                f"--key {shlex.quote(key)} >/dev/null 2>&1; then "
-                f"{self.make_sync_file_command(source, destination)}; "
-                f"else {self.make_sync_dir_command(source, destination)}; fi")
+        return _probe_then_dispatch(
+            f"aws s3api head-object --bucket {shlex.quote(bucket)} "
+            f"--key {shlex.quote(key)}",
+            "not found|404",
+            self.make_sync_file_command(source, destination),
+            self.make_sync_dir_command(source, destination))
 
 
 class HttpCloudStorage(CloudStorage):

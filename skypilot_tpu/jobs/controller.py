@@ -46,7 +46,13 @@ class JobsController:
         try:
             self._log(f"starting; cluster {self.cluster_name}, "
                       f"strategy {type(self.strategy).__name__}")
-            state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
+            if not state.set_status(self.job_id,
+                                    state.ManagedJobStatus.STARTING):
+                # Cancel landed between submit and controller startup.
+                self._log("cancelled before launch")
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.CANCELLED)
+                return
             state.set_cluster(self.job_id, self.cluster_name)
             # Launching-parallelism gate (reference: sky/jobs/
             # scheduler.py:72 — at most 4 concurrent launches per CPU).
@@ -138,7 +144,12 @@ class JobsController:
             state.set_status(self.job_id, state.ManagedJobStatus.FAILED,
                              error="max recovery attempts exceeded")
             return None
-        state.set_status(self.job_id, state.ManagedJobStatus.RECOVERING)
+        if not state.set_status(self.job_id,
+                                state.ManagedJobStatus.RECOVERING):
+            # Cancel landed while _monitor was probing — don't relaunch.
+            self._log("cancelled during recovery; tearing down")
+            state.set_status(self.job_id, state.ManagedJobStatus.CANCELLED)
+            return None
         try:
             state.acquire_launch_slot(self.job_id)
             try:

@@ -120,41 +120,51 @@ def add(name: Optional[str], task_config: Dict[str, Any],
 
 
 def set_status(job_id: int, status: ManagedJobStatus,
-               error: Optional[str] = None) -> None:
+               error: Optional[str] = None) -> bool:
+    """Write a status; forward (non-terminal) writes are guarded.
+
+    Provisioning/recovery takes minutes; a ``jobs cancel`` that lands
+    mid-flight sets CANCELLING, and an unconditional forward write
+    (STARTING/RUNNING/RECOVERING) afterwards would silently resurrect
+    the job — it would then run to completion despite a successful
+    cancel reply. So every forward write applies only when the job is
+    not already CANCELLING/terminal, and CANCELLING itself never
+    overwrites a terminal state. Terminal writes are unconditional.
+    Returns False when the write did not apply — the caller should take
+    the cancellation path.
+    """
+    terminal = [s.value for s in ManagedJobStatus if s.is_terminal()]
+    if status.is_terminal():
+        blocked: list = []
+    elif status == ManagedJobStatus.CANCELLING:
+        blocked = terminal
+    else:
+        blocked = [ManagedJobStatus.CANCELLING.value] + terminal
+    guard = (f" AND status NOT IN ({','.join('?' * len(blocked))})"
+             if blocked else "")
     with _db() as c:
         if status == ManagedJobStatus.RUNNING:
-            c.execute("UPDATE managed_jobs SET status=?, started_at="
-                      "COALESCE(started_at, ?) WHERE job_id=?",
-                      (status.value, time.time(), job_id))
+            cur = c.execute(
+                "UPDATE managed_jobs SET status=?, started_at="
+                f"COALESCE(started_at, ?) WHERE job_id=?{guard}",
+                (status.value, time.time(), job_id, *blocked))
         elif status.is_terminal():
-            c.execute("UPDATE managed_jobs SET status=?, ended_at=?,"
-                      " last_error=COALESCE(?, last_error) WHERE job_id=?",
-                      (status.value, time.time(), error, job_id))
+            cur = c.execute(
+                "UPDATE managed_jobs SET status=?, ended_at=?,"
+                " last_error=COALESCE(?, last_error) WHERE job_id=?",
+                (status.value, time.time(), error, job_id))
         else:
-            c.execute("UPDATE managed_jobs SET status=?,"
-                      " last_error=COALESCE(?, last_error) WHERE job_id=?",
-                      (status.value, error, job_id))
+            cur = c.execute(
+                "UPDATE managed_jobs SET status=?, last_error="
+                f"COALESCE(?, last_error) WHERE job_id=?{guard}",
+                (status.value, error, job_id, *blocked))
+        return cur.rowcount > 0
 
 
 def transition_to_running(job_id: int) -> bool:
-    """Conditionally move a job to RUNNING after a launch/recover.
-
-    Provisioning takes minutes; a ``jobs cancel`` that lands mid-launch
-    sets CANCELLING, and an unconditional RUNNING write afterwards would
-    silently resurrect the job (it would then run to completion despite
-    a successful cancel reply). The UPDATE applies only when the job is
-    not CANCELLING/terminal; returns False when the caller should take
-    the cancellation path instead.
-    """
-    blocked = [ManagedJobStatus.CANCELLING.value] + [
-        s.value for s in ManagedJobStatus if s.is_terminal()]
-    with _db() as c:
-        cur = c.execute(
-            "UPDATE managed_jobs SET status=?, started_at="
-            "COALESCE(started_at, ?) WHERE job_id=? AND status NOT IN"
-            f" ({','.join('?' * len(blocked))})",
-            (ManagedJobStatus.RUNNING.value, time.time(), job_id, *blocked))
-        return cur.rowcount > 0
+    """Conditionally move a job to RUNNING after a launch/recover (see
+    the forward-write guard in set_status)."""
+    return set_status(job_id, ManagedJobStatus.RUNNING)
 
 
 def set_cluster(job_id: int, cluster_name: str) -> None:

@@ -131,7 +131,15 @@ def start_host_agents(info: ClusterInfo, token: str,
             'm=skypilot_tpu.runtime.host; m="${m}d"; '
             'v=$(cat "$HOME/.skypilot_tpu/hostd.protocol" 2>/dev/null'
             f' || echo 0); if [ "$v" != "{want}" ]; then '
-            'pkill -f "$m"; sleep 0.2; fi; '
+            # Bounded wait for the old agent to actually exit: a fixed
+            # 0.2s sleep races a slow-dying process, and the pgrep
+            # start-guard below would then suppress the relaunch,
+            # leaving the pod with no agent at all.
+            'pkill -f "$m"; i=0; '
+            'while pgrep -f "$m" >/dev/null && [ "$i" -lt 25 ]; do '
+            'sleep 0.2; i=$((i+1)); done; '
+            'pgrep -f "$m" >/dev/null && { pkill -9 -f "$m"; sleep 0.3; }; '
+            'fi; '
             'pgrep -f "$m" >/dev/null || '
             '(cd "$HOME" && mkdir -p .skypilot_tpu && '
             f'PYTHONPATH="$HOME/{command_runner.REMOTE_PKG_DIR}'

@@ -155,6 +155,65 @@ def test_sync_auto_command_probes_object(tmp_path):
     assert "s3 cp" in cmd and "s3 sync" in cmd
 
 
+def test_sync_auto_command_behavior(tmp_path):
+    """Run the generated gs auto-command against a stub gcloud: object
+    -> cp; definitive not-found -> rsync; any other probe failure (auth,
+    metadata timeout) -> loud non-zero exit, NO silent empty dir."""
+    import subprocess
+
+    from skypilot_tpu.data import cloud_stores
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    log = tmp_path / "calls.log"
+    stub = bindir / "gcloud"
+    stub.write_text(f"""#!/bin/sh
+case "$*" in
+  *"objects describe"*isfile*) exit 0;;
+  *"objects describe"*isdir*) echo "ERROR: Not Found (404)"; exit 1;;
+  *"objects describe"*) echo "ERROR: could not refresh credentials"; exit 1;;
+  *" cp "*) echo CP >> {log}; exit 0;;
+  *rsync*) echo RSYNC >> {log}; exit 0;;
+esac
+exit 2
+""")
+    stub.chmod(0o755)
+    gs = cloud_stores.get_storage_from_path("gs://b/x")
+    env = {"PATH": f"{bindir}:/usr/bin:/bin", "HOME": str(tmp_path)}
+
+    def run(src):
+        cmd = gs.make_sync_auto_command(src, str(tmp_path / "dst"))
+        return subprocess.run(["bash", "-c", cmd], env=env,
+                              capture_output=True, text=True)
+
+    assert run("gs://b/sub/isfile").returncode == 0
+    assert log.read_text().strip() == "CP"
+    log.write_text("")
+    assert run("gs://b/sub/isdir").returncode == 0
+    assert log.read_text().strip() == "RSYNC"
+    log.write_text("")
+    r = run("gs://b/sub/authfail")
+    assert r.returncode != 0
+    assert "credentials" in r.stderr
+    assert log.read_text() == ""  # neither cp nor rsync ran
+
+
+def test_set_status_guards_forward_writes():
+    """RECOVERING/STARTING must not clobber CANCELLING, and CANCELLING
+    must not clobber a terminal state (the recovery-path half of the
+    cancel-during-launch race)."""
+    from skypilot_tpu.jobs import state
+    jid = state.add("j", {"run": "true"}, "FAILOVER")
+    state.set_status(jid, state.ManagedJobStatus.CANCELLING)
+    assert not state.set_status(jid, state.ManagedJobStatus.RECOVERING)
+    assert not state.set_status(jid, state.ManagedJobStatus.STARTING)
+    assert state.get(jid)["status"] == state.ManagedJobStatus.CANCELLING
+    # Terminal writes are unconditional (cancel completes).
+    assert state.set_status(jid, state.ManagedJobStatus.CANCELLED)
+    # CANCELLING never resurrects a finished job.
+    assert not state.set_status(jid, state.ManagedJobStatus.CANCELLING)
+    assert state.get(jid)["status"] == state.ManagedJobStatus.CANCELLED
+
+
 # -- MoE zigzag layout -------------------------------------------------------
 
 def test_moe_zigzag_matches_contiguous():
