@@ -1,0 +1,201 @@
+"""Live GCP pricing fetcher: Cloud Billing SKUs API -> catalog CSV.
+
+Reference parity: sky/clouds/service_catalog/data_fetchers/fetch_gcp.py
+(get_skus:209 pulls services/<id>/skus pages; TPU prices are matched by
+SKU description, get_tpu_df:616). Zero-SDK by design: plain REST via
+urllib with a `gcloud auth print-access-token` bearer token, so it runs
+on any TPU-VM without the google-api-python-client stack.
+
+Design split: the static table (generate_static.py) owns *topology* —
+generations, slice sizes, host shapes, zones — which changes rarely and
+needs no API; this fetcher owns *prices*, overlaying live on-demand /
+spot rates onto the static rows. Offline (zero-egress) environments
+keep the static snapshot; `skytpu catalog fetch` refreshes prices where
+the billing API is reachable.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.fetch_gcp [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import io
+import json
+import subprocess
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+BILLING_URL = "https://cloudbilling.googleapis.com/v1"
+# Public, stable service ids (the billing catalog's name for a product).
+COMPUTE_SERVICE_ID = "6F81-5844-456A"   # Compute Engine (v5e/v5p/v6e TPUs)
+TPU_SERVICE_ID = "E000-3F24-B8AA"       # Cloud TPU (v2-v4)
+
+Fetch = Callable[[str], Dict[str, Any]]
+
+
+def _default_fetch(url: str) -> Dict[str, Any]:
+    token = subprocess.run(
+        ["gcloud", "auth", "print-access-token"], capture_output=True,
+        text=True, check=True).stdout.strip()
+    req = urllib.request.Request(
+        url, headers={"Authorization": f"Bearer {token}"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def get_skus(service_id: str, fetch: Fetch = _default_fetch,
+             page_size: int = 500) -> List[Dict[str, Any]]:
+    """All SKUs of one billing service (paginated skus.list)."""
+    skus: List[Dict[str, Any]] = []
+    page_token = ""
+    while True:
+        q = {"pageSize": str(page_size)}
+        if page_token:
+            q["pageToken"] = page_token
+        url = (f"{BILLING_URL}/services/{service_id}/skus?"
+               f"{urllib.parse.urlencode(q)}")
+        resp = fetch(url)
+        skus.extend(resp.get("skus", []))
+        page_token = resp.get("nextPageToken", "")
+        if not page_token:
+            return skus
+
+
+def unit_price(sku: Dict[str, Any]) -> Optional[float]:
+    """$/unit/hr from the SKU's first pricing tier (units + nanos)."""
+    try:
+        rate = (sku["pricingInfo"][0]["pricingExpression"]
+                ["tieredRates"][0]["unitPrice"])
+        return int(rate.get("units") or 0) + rate.get("nanos", 0) / 1e9
+    except (KeyError, IndexError, TypeError):
+        return None
+
+
+# -- TPU price matching ------------------------------------------------------
+#
+# Billing SKU descriptions name TPUs as e.g.
+#   "Tpu-v2 Pod accelerator core running in Americas"      (TPU service)
+#   "TpuV5e chip hour in us-west4" / "Preemptible TpuV6e..." (Compute)
+# Per-chip-hour generations (v5e/v5p/v6e) price one chip; v2-v4 price
+# one *core*, with separate device vs Pod SKUs.
+
+_DESC_TOKEN = {
+    "v2": "Tpu-v2", "v3": "Tpu-v3", "v4": "Tpu-v4",
+    "v5e": "TpuV5e", "v5p": "TpuV5p", "v6e": "TpuV6e",
+}
+# Units the SKU price is quoted per, in chips.
+_CHIPS_PER_SKU_UNIT = {
+    "v2": 0.5, "v3": 0.5, "v4": 0.5,   # priced per core (2 cores/chip)
+    "v5e": 1.0, "v6e": 1.0,            # per chip
+    "v5p": 1.0,                        # per chip (quoted per 2-core chip)
+}
+
+
+def tpu_chip_price(skus: Iterable[Dict[str, Any]], gen: str, region: str,
+                   spot: bool, is_pod: bool) -> Optional[float]:
+    """$/chip/hr for one TPU generation in one region, or None."""
+    token = _DESC_TOKEN[gen]
+    per_unit_chips = _CHIPS_PER_SKU_UNIT[gen]
+    pod_aware = gen in ("v2", "v3")   # v4+ SKUs carry no Pod split
+    for sku in skus:
+        desc = sku.get("description", "")
+        if token not in desc:
+            continue
+        if region not in sku.get("serviceRegions", []):
+            continue
+        # NOTE (matches the billing catalog, not intuition): preemptible
+        # TPU SKUs say "Preemptible" in the description while usageType
+        # can still read OnDemand.
+        if spot != ("Preemptible" in desc):
+            continue
+        if pod_aware and is_pod != ("Pod" in desc):
+            continue
+        price = unit_price(sku)
+        if price is not None:
+            return price / per_unit_chips
+    return None
+
+
+def merge_live_prices(rows: List[List[Any]], header: List[str],
+                      compute_skus: List[Dict[str, Any]],
+                      tpu_skus: List[Dict[str, Any]]) -> Tuple[int, int]:
+    """Overlay live TPU prices onto static catalog rows, in place.
+
+    Returns (updated, total_tpu_rows). Rows whose price the API doesn't
+    carry keep their static snapshot — the catalog never loses offerings
+    because a SKU page changed shape.
+    """
+    col = {name: i for i, name in enumerate(header)}
+    updated = total = 0
+    all_skus = list(compute_skus) + list(tpu_skus)
+    # Every slice size of one (gen, region) shares a chip price; the
+    # real SKU list is tens of thousands of entries, so resolve each
+    # (gen, region, spot, is_pod) once.
+    cache: Dict[Tuple[str, str, bool, bool], Optional[float]] = {}
+
+    def chip_price(gen, region, spot, is_pod):
+        key = (gen, region, spot, is_pod)
+        if key not in cache:
+            cache[key] = tpu_chip_price(all_skus, gen, region, spot=spot,
+                                        is_pod=is_pod)
+        return cache[key]
+
+    for row in rows:
+        itype = row[col["instance_type"]]
+        if not str(itype).startswith("tpu-"):
+            continue
+        total += 1
+        gen = str(itype)[len("tpu-"):]
+        if gen not in _DESC_TOKEN:
+            continue
+        chips = int(row[col["chips"]])
+        region = row[col["region"]]
+        is_pod = chips > 4
+        od = chip_price(gen, region, False, is_pod)
+        sp = chip_price(gen, region, True, is_pod)
+        if od is not None:
+            row[col["price"]] = round(od * chips, 2)
+        if sp is not None:
+            row[col["spot_price"]] = round(sp * chips, 2)
+        if od is not None or sp is not None:
+            updated += 1
+    return updated, total
+
+
+def fetch_and_write(out_path: Optional[str] = None,
+                    fetch: Fetch = _default_fetch) -> Tuple[str, int, int]:
+    """Regenerate the static CSV, then overlay live prices onto it.
+
+    SKUs are fetched BEFORE the CSV is touched, so a network/auth
+    failure leaves the existing catalog byte-identical.
+    """
+    from skypilot_tpu.catalog.fetchers import generate_static
+    compute_skus = get_skus(COMPUTE_SERVICE_ID, fetch)
+    tpu_skus = get_skus(TPU_SERVICE_ID, fetch)
+    out_path = generate_static.main(out_path)
+    with open(out_path, newline="") as f:
+        reader = csv.reader(f)
+        header = next(reader)
+        rows = [r for r in reader]
+    updated, total = merge_live_prices(rows, header, compute_skus, tpu_skus)
+    buf = io.StringIO()
+    w = csv.writer(buf)
+    w.writerow(header)
+    w.writerows(rows)
+    with open(out_path, "w", newline="") as f:
+        f.write(buf.getvalue())
+    return out_path, updated, total
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    path, updated, total = fetch_and_write(args.out)
+    print(f"{path}: live prices on {updated}/{total} TPU rows")
+
+
+if __name__ == "__main__":
+    main()

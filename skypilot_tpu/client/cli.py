@@ -199,6 +199,31 @@ def autostop(cluster, idle_minutes, down_):
                f"{' (down)' if down_ else ''}.")
 
 
+@cli.group()
+def catalog():
+    """Catalog maintenance (pricing data)."""
+
+
+@catalog.command(name="fetch")
+@click.option("--out", default=None,
+              help="CSV path (default: the packaged gcp.csv)")
+def catalog_fetch(out):
+    """Refresh GCP prices from the Cloud Billing SKUs API.
+
+    Regenerates the static catalog (topology: generations, slice sizes,
+    zones) and overlays live on-demand/spot prices where the billing
+    API carries them; offline environments keep the static snapshot.
+    """
+    from skypilot_tpu.catalog.fetchers import fetch_gcp
+    try:
+        path, updated, total = fetch_gcp.fetch_and_write(out)
+    except Exception as e:  # noqa: BLE001 — network/auth surface
+        raise click.ClickException(
+            f"billing API fetch failed ({e}); the static catalog is "
+            f"unchanged") from e
+    click.echo(f"{path}: live prices on {updated}/{total} TPU rows")
+
+
 @cli.command(name="show-gpus")
 @click.argument("name_filter", required=False)
 def show_gpus(name_filter):

@@ -1,0 +1,139 @@
+"""Live GCP pricing fetcher against recorded SKU fixtures (offline).
+
+The fixture mimics the Cloud Billing skus.list response shape
+(pagination, pricingInfo tiers, description conventions) so the parse +
+merge pipeline runs for real without egress.
+"""
+
+import csv
+
+import pytest
+
+from skypilot_tpu.catalog.fetchers import fetch_gcp
+
+
+def _sku(desc, regions, units, nanos, usage="OnDemand"):
+    return {
+        "description": desc,
+        "serviceRegions": regions,
+        "category": {"resourceFamily": "Compute", "usageType": usage},
+        "pricingInfo": [{
+            "pricingExpression": {
+                "tieredRates": [{
+                    "unitPrice": {"units": str(units), "nanos": nanos},
+                }],
+            },
+        }],
+    }
+
+
+FIXTURE_PAGES = {
+    # Compute Engine service carries v5e/v5p/v6e per-chip SKUs.
+    fetch_gcp.COMPUTE_SERVICE_ID: [
+        {"skus": [
+            _sku("TpuV5e chip hour in us-west4", ["us-west4"], 1, 56e7),
+            _sku("Preemptible TpuV5e chip hour in us-west4",
+                 ["us-west4"], 0, 62e7),
+            _sku("TpuV6e chip hour in us-east5", ["us-east5"], 2, 97e7),
+        ], "nextPageToken": "page2"},
+        {"skus": [
+            _sku("TpuV5p chip hour in us-east5", ["us-east5"], 4, 2e8),
+        ]},
+    ],
+    # Cloud TPU service carries v2-v4 per-core SKUs, Pod/device split.
+    fetch_gcp.TPU_SERVICE_ID: [
+        {"skus": [
+            _sku("Tpu-v3 accelerator core running in Americas",
+                 ["us-central1"], 1, 0),
+            _sku("Tpu-v3 Pod accelerator core running in Americas",
+                 ["us-central1"], 1, 25e7),
+        ]},
+    ],
+}
+
+
+@pytest.fixture
+def fake_fetch():
+    state = {"pages": {}, "calls": []}
+
+    def fetch(url):
+        state["calls"].append(url)
+        for sid, pages in FIXTURE_PAGES.items():
+            if f"/services/{sid}/" in url:
+                i = state["pages"].get(sid, 0)
+                if "pageToken" in url:
+                    assert i > 0, "pageToken on first call"
+                state["pages"][sid] = i + 1
+                return pages[i]
+        raise AssertionError(f"unexpected url {url}")
+
+    fetch.state = state
+    return fetch
+
+
+def test_get_skus_paginates(fake_fetch):
+    skus = fetch_gcp.get_skus(fetch_gcp.COMPUTE_SERVICE_ID, fake_fetch)
+    assert len(skus) == 4
+    assert len(fake_fetch.state["calls"]) == 2
+    assert "pageToken=page2" in fake_fetch.state["calls"][1]
+
+
+def test_unit_price_units_plus_nanos():
+    sku = _sku("x", [], 2, 97e7)
+    assert abs(fetch_gcp.unit_price(sku) - 2.97) < 1e-9
+    assert fetch_gcp.unit_price({"pricingInfo": []}) is None
+
+
+def test_tpu_chip_price_per_chip_generations():
+    skus = FIXTURE_PAGES[fetch_gcp.COMPUTE_SERVICE_ID][0]["skus"]
+    od = fetch_gcp.tpu_chip_price(skus, "v5e", "us-west4", spot=False,
+                                  is_pod=True)
+    sp = fetch_gcp.tpu_chip_price(skus, "v5e", "us-west4", spot=True,
+                                  is_pod=True)
+    assert abs(od - 1.56) < 1e-9
+    assert abs(sp - 0.62) < 1e-9
+    # Wrong region -> no match, keep static price.
+    assert fetch_gcp.tpu_chip_price(skus, "v5e", "europe-west4",
+                                    spot=False, is_pod=False) is None
+
+
+def test_tpu_chip_price_per_core_pod_split():
+    skus = FIXTURE_PAGES[fetch_gcp.TPU_SERVICE_ID][0]["skus"]
+    dev = fetch_gcp.tpu_chip_price(skus, "v3", "us-central1", spot=False,
+                                   is_pod=False)
+    pod = fetch_gcp.tpu_chip_price(skus, "v3", "us-central1", spot=False,
+                                   is_pod=True)
+    # Per-core SKU -> per-chip price is 2x (2 cores per chip).
+    assert abs(dev - 2.00) < 1e-9
+    assert abs(pod - 2.50) < 1e-9
+
+
+def test_fetch_and_write_overlays_live_prices(tmp_path, fake_fetch):
+    out = tmp_path / "gcp.csv"
+    path, updated, total = fetch_gcp.fetch_and_write(str(out), fake_fetch)
+    assert updated > 0 and total >= updated
+    with open(path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    # v5e-16 in us-west4: 16 chips x live $1.56 = $24.96 (static was
+    # 16 x $1.20 = $19.20).
+    v5e = [r for r in rows if r["instance_type"] == "tpu-v5e"
+           and r["zone"] == "us-west4-a" and r["chips"] == "16"]
+    assert v5e and float(v5e[0]["price"]) == 24.96
+    assert float(v5e[0]["spot_price"]) == 16 * 0.62
+    # Rows the fixture has no SKU for keep their static snapshot.
+    v2 = [r for r in rows if r["instance_type"] == "tpu-v2"]
+    assert v2 and all(float(r["price"]) > 0 for r in v2)
+
+
+def test_catalog_loads_fetched_csv(tmp_path, fake_fetch, monkeypatch):
+    """The query layer reads a fetched CSV identically to the static."""
+    from skypilot_tpu.catalog import catalog
+    fetch_gcp.fetch_and_write(str(tmp_path / "gcp.csv"), fake_fetch)
+    monkeypatch.setattr(catalog, "_DATA_DIR", str(tmp_path))
+    catalog.reload()
+    try:
+        cost = catalog.get_hourly_cost("tpu-v5e-16", use_spot=False,
+                                       zone="us-west4-a")
+        assert cost == 24.96  # live price, not the static 19.20
+    finally:
+        catalog.reload()
