@@ -38,32 +38,11 @@ def run(config=None, requests=16, slots=16, prompt_len=96,
     import jax
     import numpy as np
 
-    from skypilot_tpu.infer import engine as eng
-    from skypilot_tpu.models import llama
-
     on_cpu = jax.default_backend() == "cpu"
     if config is None:
         config = "llama3-tiny" if on_cpu else "llama3-400m"
-    cfg = llama.CONFIGS[config]
-    log(f"serve bench: {config} on {jax.devices()[0].device_kind}")
-
-    max_len = prompt_len + new_tokens + 8
-    if weights_int8:
-        # Build int8 weights directly — the fp init of an 8B-class
-        # config (32 GB) would never fit the chip that the int8 model
-        # (8 GB) serves from.
-        from skypilot_tpu.infer import kvcache
-        params, qw = kvcache.random_quantized_params(cfg)
-        e = eng.InferenceEngine(params, cfg, n_slots=slots,
-                                max_len=max_len,
-                                prompt_buckets=(prompt_len,),
-                                kv_int8=kv_int8, qweights=qw)
-    else:
-        params = llama.init_params(jax.random.key(0), cfg)
-        e = eng.InferenceEngine(params, cfg, n_slots=slots,
-                                max_len=max_len,
-                                prompt_buckets=(prompt_len,),
-                                kv_int8=kv_int8)
+    cfg, e = _build_engine(config, slots, prompt_len, new_tokens,
+                           kv_int8, weights_int8)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
                for _ in range(requests)]
@@ -103,6 +82,161 @@ def run(config=None, requests=16, slots=16, prompt_len=96,
     }
 
 
+def _build_engine(config, slots, prompt_len, new_tokens, kv_int8,
+                  weights_int8):
+    import jax
+
+    from skypilot_tpu.infer import engine as eng
+    from skypilot_tpu.models import llama
+    cfg = llama.CONFIGS[config]
+    log(f"serve bench: {config} on {jax.devices()[0].device_kind}")
+    max_len = prompt_len + new_tokens + 8
+    if weights_int8:
+        # Build int8 weights directly — the fp init of an 8B-class
+        # config (32 GB) would never fit the chip that the int8 model
+        # (8 GB) serves from.
+        from skypilot_tpu.infer import kvcache
+        params, qw = kvcache.random_quantized_params(cfg)
+        return cfg, eng.InferenceEngine(
+            params, cfg, n_slots=slots, max_len=max_len,
+            prompt_buckets=(prompt_len,), kv_int8=kv_int8, qweights=qw)
+    params = llama.init_params(jax.random.key(0), cfg)
+    return cfg, eng.InferenceEngine(
+        params, cfg, n_slots=slots, max_len=max_len,
+        prompt_buckets=(prompt_len,), kv_int8=kv_int8)
+
+
+def run_http(config=None, requests=16, slots=16, prompt_len=96,
+             new_tokens=64, max_burst=8, kv_int8=False,
+             weights_int8=False) -> dict:
+    """End-to-end streaming bench: requests go over HTTP through a REAL
+    load balancer to the model server, and TTFT is the wall time to the
+    FIRST STREAMED BYTE of each response — the JetStream comparison
+    (reference: examples/tpu/v6e/README.md measures streaming TTFT),
+    not an engine-internal timestamp.
+    """
+    import json as _json
+    import os
+    import socket
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax
+
+    on_cpu = jax.default_backend() == "cpu"
+    if config is None:
+        config = "llama3-tiny" if on_cpu else "llama3-400m"
+
+    home = tempfile.mkdtemp(prefix="skytpu-bench-serve-")
+    os.environ["SKYPILOT_TPU_HOME"] = home
+
+    from skypilot_tpu.infer import server as srv
+    from skypilot_tpu.serve import load_balancer, serve_state
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+
+    cfg, engine = _build_engine(config, slots, prompt_len, new_tokens,
+                                kv_int8, weights_int8)
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    model_port, lb_port = free_port(), free_port()
+    model, httpd = srv.serve(engine, host="127.0.0.1", port=model_port,
+                             max_burst=max_burst)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    assert model._ready.wait(timeout=600), "model warmup timed out"
+
+    serve_state.add_service("bench", {}, {}, lb_port)
+    serve_state.upsert_replica("bench", 1, "bench-replica",
+                               ReplicaStatus.READY,
+                               f"http://127.0.0.1:{model_port}")
+    lb = load_balancer._ThreadingServer(
+        ("127.0.0.1", lb_port),
+        load_balancer.make_handler("bench",
+                                   load_balancer.LeastLoadPolicy()))
+    threading.Thread(target=lb.serve_forever, daemon=True).start()
+    endpoint = f"http://127.0.0.1:{lb_port}/generate"
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(requests)]
+
+    results = {}
+
+    def one(i, record):
+        body = _json.dumps({"tokens": prompts[i],
+                            "max_new_tokens": new_tokens,
+                            "stream": True}).encode()
+        req = urllib.request.Request(
+            endpoint, data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.time()
+        first = None
+        n_tok = 0
+        buf = b""
+        with urllib.request.urlopen(req, timeout=600) as r:
+            while True:
+                piece = r.read1(65536)
+                if not piece:
+                    break
+                if first is None:
+                    first = time.time()
+                buf += piece
+        for line in buf.split(b"\n"):
+            if line.strip():
+                n_tok += len(_json.loads(line).get("tokens", []))
+        if record:
+            results[i] = ((first - t0) * 1e3, n_tok, time.time() - t0)
+
+    # Warmup wave: compile admission/burst programs at the measured
+    # shapes, outside the timed window.
+    warm = [threading.Thread(target=one, args=(i % len(prompts), False))
+            for i in range(min(slots, requests))]
+    for t in warm:
+        t.start()
+    for t in warm:
+        t.join(timeout=600)
+
+    t0 = time.time()
+    threads = [threading.Thread(target=one, args=(i, True))
+               for i in range(requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.time() - t0
+
+    lb.shutdown()
+    httpd.shutdown()
+    model.shutdown()
+
+    assert len(results) == requests, f"only {len(results)} completed"
+    ttfts = sorted(v[0] for v in results.values())
+    med_ttft = ttfts[len(ttfts) // 2]
+    p99_ttft = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+    total_tokens = sum(v[1] for v in results.values())
+    tok_s = total_tokens / wall
+    req_s = requests / wall
+    log(f"http/lb streaming: requests={requests} wall={wall:.2f}s "
+        f"median_ttft={med_ttft:.1f}ms p99={p99_ttft:.1f}ms "
+        f"tok/s={tok_s:.1f}")
+    return {
+        "median_ttft_ms": round(med_ttft, 2),
+        "p99_ttft_ms": round(p99_ttft, 2),
+        "out_tok_s": round(tok_s, 2),
+        "req_per_s": round(req_s, 3),
+        "vs_baseline_ttft": round(REF_TTFT_MS / max(med_ttft, 1e-9), 3),
+        "config": config,
+        "kv_int8": kv_int8,
+        "weights_int8": weights_int8,
+        "transport": "http_lb_streaming",
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None)
@@ -113,12 +247,22 @@ def main() -> None:
     ap.add_argument("--max-burst", type=int, default=32)
     ap.add_argument("--kv-int8", action="store_true")
     ap.add_argument("--weights-int8", action="store_true")
+    ap.add_argument("--engine-only", action="store_true",
+                    help="bench the engine directly (no HTTP/LB; "
+                         "engine-internal TTFT)")
     args = ap.parse_args()
-    r = run(config=args.config, requests=args.requests, slots=args.slots,
-            prompt_len=args.prompt_len, new_tokens=args.new_tokens,
-            max_burst=args.max_burst, kv_int8=args.kv_int8,
-            weights_int8=args.weights_int8)
-    print(json.dumps({
+    if args.engine_only:
+        r = run(config=args.config, requests=args.requests,
+                slots=args.slots, prompt_len=args.prompt_len,
+                new_tokens=args.new_tokens, max_burst=args.max_burst,
+                kv_int8=args.kv_int8, weights_int8=args.weights_int8)
+    else:
+        r = run_http(config=args.config, requests=args.requests,
+                     slots=args.slots, prompt_len=args.prompt_len,
+                     new_tokens=args.new_tokens,
+                     max_burst=args.max_burst, kv_int8=args.kv_int8,
+                     weights_int8=args.weights_int8)
+    out = {
         "metric": "serve_median_ttft",
         "value": r["median_ttft_ms"],
         "unit": "ms",
@@ -128,7 +272,11 @@ def main() -> None:
         "config": r["config"],
         "kv_int8": r["kv_int8"],
         "weights_int8": r["weights_int8"],
-    }))
+    }
+    if "p99_ttft_ms" in r:
+        out["p99_ttft_ms"] = r["p99_ttft_ms"]
+        out["transport"] = r["transport"]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -8,16 +8,24 @@ Endpoints:
   GET  /health              -> 200 {"status": "ok"} once warm
   POST /generate            {"tokens": [...], "max_new_tokens": N}
                             -> {"tokens": [...], "ttft_ms": ..., ...}
+  POST /generate + "stream": true
+                            -> Transfer-Encoding: chunked, one JSON
+                               line per emission ({"tokens": [...]}),
+                               closing line {"done": true, "ttft_ms":.}
+                               Tokens stream AS DECODED — TTFT is one
+                               prefill away, not one full generation.
 
 Reference parity: the reference's serving recipes wrap external engines
 (reference: llm/vllm/serve.yaml, JetStream in examples/tpu/v6e) — this
-is the in-tree TPU-native equivalent.
+is the in-tree TPU-native equivalent; streaming mirrors what the
+JetStream benchmark measures (examples/tpu/v6e/README.md TTFT).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import queue
 import sys
 import threading
 import time
@@ -27,33 +35,78 @@ from typing import Dict, Optional
 
 
 class _Pending:
-    def __init__(self):
+    def __init__(self, req=None):
         self.event = threading.Event()
         self.result: Optional[Dict] = None
+        self.enqueued_s = time.time()
+        self.stream = False
+        # Streaming: the engine loop pushes token batches as decoded
+        # ({"tokens": [...]}); a {"done"/"error"} dict terminates.
+        self.req = req            # engine Request (tokens grow in place)
+        self.cursor = 0           # tokens already pushed to the stream
+        self.chunks: queue.Queue = queue.Queue()
 
 
 class ModelServer:
-    """Engine + request queue + batching loop."""
+    """Engine + request queue + batching loop.
 
-    def __init__(self, engine):
+    Ownership model: the step loop thread is the ONLY thread that
+    touches the engine. Handler threads drop (tokens, pending) into an
+    inbox under a tiny lock and wait on their pending's event/queue.
+    (An earlier design guarded the engine with one big lock; the
+    busy loop re-acquired it back-to-back and barge-starved admissions
+    on a single core — concurrent TTFTs collapsed to full-batch wall.)
+    """
+
+    def __init__(self, engine, max_burst: int = 8):
         self.engine = engine
-        self._lock = threading.Lock()
-        self._pending: Dict[int, _Pending] = {}
+        self.max_burst = max_burst
+        self._inbox_lock = threading.Lock()
+        self._inbox: list = []
+        self._pending: Dict[int, _Pending] = {}   # loop-thread only
         self._ready = threading.Event()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def submit(self, tokens, max_new_tokens: int) -> Dict:
+    def _add(self, tokens, max_new_tokens: int,
+             stream: bool = False) -> _Pending:
+        from skypilot_tpu.infer import engine as eng
+        # Validate eagerly (oversized prompt -> clean 400) without
+        # touching the engine from this thread.
+        eng._bucket(len(tokens), self.engine.buckets)
         p = _Pending()
+        p.stream = stream
+        with self._inbox_lock:
+            self._inbox.append((list(tokens), max_new_tokens, p))
+        return p
+
+    def submit(self, tokens, max_new_tokens: int) -> Dict:
+        p = self._add(tokens, max_new_tokens)
         t0 = time.time()
-        with self._lock:
-            rid = self.engine.add_request(list(tokens), max_new_tokens)
-            self._pending[rid] = p
         p.event.wait()
         out = dict(p.result or {})
         out["total_ms"] = round((time.time() - t0) * 1e3, 2)
         return out
+
+    def submit_stream(self, tokens, max_new_tokens: int):
+        """Iterator of chunk dicts: {"tokens": [...]} as decoded, then
+        one {"done": true, "ttft_ms": ...} (or {"error": ...}).
+
+        Admission validation happens EAGERLY (before any bytes are
+        written), so an oversized prompt raises here as a clean 400 —
+        not mid-stream after a 200 went out.
+        """
+        p = self._add(tokens, max_new_tokens, stream=True)
+
+        def gen():
+            while True:
+                chunk = p.chunks.get()
+                yield chunk
+                if "done" in chunk or "error" in chunk:
+                    return
+
+        return gen()
 
     def _loop(self) -> None:
         # Warm the compile path before /health flips: the load balancer
@@ -70,34 +123,65 @@ class ModelServer:
             except Exception as e:  # noqa: BLE001 — fail the in-flight
                 # requests loudly; never let the serving thread die
                 # while /health reports ok.
-                with self._lock:
-                    for p in self._pending.values():
-                        p.result = {"error": f"engine failure: {e}"}
-                        p.event.set()
-                    self._pending.clear()
+                for p in self._pending.values():
+                    p.result = {"error": f"engine failure: {e}"}
+                    if p.stream:
+                        p.chunks.put({"error": p.result["error"]})
+                    p.event.set()
+                self._pending.clear()
                 busy = False
             if not busy:
                 time.sleep(0.002)
 
+    def _drain_inbox(self) -> None:
+        with self._inbox_lock:
+            new, self._inbox = self._inbox, []
+        for tokens, max_new, p in new:
+            rid = self.engine.add_request(tokens, max_new)
+            # add_request appends to engine.waiting; keep the Request so
+            # emitted tokens can be diffed without a rid->req search.
+            p.req = self.engine.waiting[-1]
+            assert p.req.rid == rid
+            # TTFT counts from when the handler enqueued the request,
+            # not when the loop got around to admitting it.
+            p.req.submit_s = p.enqueued_s
+            self._pending[rid] = p
+
+    def _flush_streams(self) -> None:
+        """Push newly decoded tokens to every pending stream. Works for
+        admission-time first tokens and burst tokens alike — it diffs
+        req.tokens against the cursor. Blocking requests skip the chunk
+        queue entirely (nobody drains it)."""
+        for p in self._pending.values():
+            if p.req is None or not p.stream:
+                continue
+            new = p.req.tokens[p.cursor:]
+            if new:
+                p.cursor += len(new)
+                p.chunks.put({"tokens": list(new)})
+
     def _step(self) -> bool:
-        with self._lock:
-            busy = bool(self.engine.waiting or self.engine.slot_req)
-            if not busy:
-                return False
-            self.engine.step_burst(max_burst=8)
-            for req in self.engine.finished:
-                p = self._pending.pop(req.rid, None)
-                if p is None:
-                    continue
-                ttft = ((req.first_token_s - req.submit_s) * 1e3
-                        if req.first_token_s is not None else None)
-                p.result = {
-                    "tokens": req.tokens,
-                    "ttft_ms": (round(ttft, 2)
-                                if ttft is not None else None),
-                }
-                p.event.set()
-            self.engine.finished.clear()
+        self._drain_inbox()
+        if not (self.engine.waiting or self.engine.slot_req):
+            return False
+        self.engine.step_burst(max_burst=self.max_burst)
+        self._flush_streams()
+        for req in self.engine.finished:
+            p = self._pending.pop(req.rid, None)
+            if p is None:
+                continue
+            ttft = ((req.first_token_s - req.submit_s) * 1e3
+                    if req.first_token_s is not None else None)
+            ttft = round(ttft, 2) if ttft is not None else None
+            p.result = {
+                "tokens": req.tokens,
+                "ttft_ms": ttft,
+            }
+            if p.stream:
+                p.chunks.put({"done": True, "ttft_ms": ttft,
+                              "n_tokens": len(req.tokens)})
+            p.event.set()
+        self.engine.finished.clear()
         return True
 
     def shutdown(self) -> None:
@@ -128,6 +212,29 @@ def make_handler(model: ModelServer):
                 return self._json(503, {"status": "warming"})
             return self._json(404, {"error": "not found"})
 
+        def _stream(self, chunks):
+            """Chunked NDJSON: tokens flow as the engine decodes them."""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                for chunk in chunks:
+                    write_chunk(json.dumps(chunk).encode() + b"\n")
+            except BrokenPipeError:
+                return  # client went away mid-stream
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except BrokenPipeError:
+                pass
+
         def do_POST(self):
             if self.path != "/generate":
                 return self._json(404, {"error": "not found"})
@@ -136,8 +243,15 @@ def make_handler(model: ModelServer):
                 body = json.loads(self.rfile.read(length) or b"{}")
                 tokens = [int(t) for t in body["tokens"]]
                 max_new = int(body.get("max_new_tokens", 64))
+                stream = bool(body.get("stream", False))
             except (ValueError, TypeError, KeyError) as e:
                 return self._json(400, {"error": f"bad request: {e}"})
+            if stream:
+                try:
+                    chunks = model.submit_stream(tokens, max_new)
+                except ValueError as e:  # oversized prompt etc.
+                    return self._json(400, {"error": str(e)})
+                return self._stream(chunks)
             try:
                 out = model.submit(tokens, max_new)
             except ValueError as e:      # oversized prompt etc.
@@ -152,8 +266,9 @@ def make_handler(model: ModelServer):
     return Handler
 
 
-def serve(engine, host: str = "0.0.0.0", port: int = 8080):
-    model = ModelServer(engine)
+def serve(engine, host: str = "0.0.0.0", port: int = 8080,
+          max_burst: int = 8):
+    model = ModelServer(engine, max_burst=max_burst)
     httpd = _Threading((host, port), make_handler(model))
     return model, httpd
 
@@ -169,6 +284,9 @@ def main() -> None:
     ap.add_argument("--weights-int8", action="store_true",
                     help="w8a8 decode: int8 weights + activations")
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--max-burst", type=int, default=8,
+                    help="decode tokens per device call (streaming "
+                         "granularity vs dispatch amortization)")
     args = ap.parse_args()
 
     import jax
@@ -191,7 +309,8 @@ def main() -> None:
     # reference too or the fp block weights stay resident for the whole
     # server lifetime and the memory halving never happens.
     del params
-    model, httpd = serve(engine, port=args.port)
+    model, httpd = serve(engine, port=args.port,
+                         max_burst=args.max_burst)
     print(f"serving on :{args.port}", file=sys.stderr, flush=True)
     try:
         httpd.serve_forever()

@@ -82,6 +82,7 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
                 body = self.rfile.read(length)
             urls = serve_state.ready_urls(service)
             tried = []
+            self._response_started = False
             for _ in range(min(max_retries, max(len(urls), 1))):
                 url = policy.select([u for u in urls if u not in tried])
                 if url is None:
@@ -93,6 +94,12 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
                     return
                 except Exception:  # noqa: BLE001 — try next replica
                     policy.done(url)
+                    if self._response_started:
+                        # Bytes already reached the client: a retry
+                        # would corrupt the stream. Drop the connection
+                        # so the client sees a clean truncation.
+                        self.close_connection = True
+                        return
             self.send_response(503)
             msg = b"no ready replicas"
             self.send_header("Content-Length", str(len(msg)))
@@ -100,20 +107,61 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
             self.wfile.write(msg)
 
         def _forward(self, base_url: str, body: Optional[bytes]):
+            """Streaming reverse proxy: chunks reach the client AS the
+            replica produces them (first streamed token is one prefill
+            away, not one full generation — the TTFT that the serve
+            bench measures goes through this path). Reference parity:
+            sky/serve/load_balancer.py:174 StreamingResponse proxy.
+
+            Retries happen only before the first forwarded byte; a 4xx
+            from the replica is forwarded as-is (deterministic client
+            error), while connect errors and 5xx raise to the retry
+            loop in _proxy.
+            """
             url = base_url + self.path
             headers = {k: v for k, v in self.headers.items()
                        if k.lower() not in _HOP_HEADERS}
             req = urllib.request.Request(url, data=body, headers=headers,
                                          method=self.command)
-            with urllib.request.urlopen(req, timeout=120) as resp:
-                payload = resp.read()
+            try:
+                resp = urllib.request.urlopen(req, timeout=120)
+            except urllib.error.HTTPError as e:
+                if 400 <= e.code < 500:
+                    resp = e      # forward the replica's client error
+                else:
+                    raise
+            with resp:
+                self._response_started = True
                 self.send_response(resp.status)
+                length = resp.headers.get("Content-Length")
                 for k, v in resp.headers.items():
                     if k.lower() not in _HOP_HEADERS | {"content-length"}:
                         self.send_header(k, v)
-                self.send_header("Content-Length", str(len(payload)))
+                chunked = length is None
+                if chunked:
+                    self.send_header("Transfer-Encoding", "chunked")
+                else:
+                    self.send_header("Content-Length", length)
                 self.end_headers()
-                self.wfile.write(payload)
+                # read1: return as soon as ANY data is available
+                # (urllib decodes the upstream chunking; we re-frame
+                # for our client). A full read() would buffer the
+                # entire generation and destroy streaming TTFT.
+                # (HTTPError bodies may lack read1 — tiny, read whole.)
+                read1 = getattr(resp, "read1", None)
+                while True:
+                    chunk = read1(65536) if read1 else resp.read()
+                    if not chunk:
+                        break
+                    if chunked:
+                        self.wfile.write(f"{len(chunk):x}\r\n".encode())
+                        self.wfile.write(chunk + b"\r\n")
+                    else:
+                        self.wfile.write(chunk)
+                    self.wfile.flush()
+                if chunked:
+                    self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
 
         do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _proxy
 

@@ -111,6 +111,7 @@ _SLOW_TESTS = {
     "tests/test_serve.py::test_rolling_update_zero_downtime",
     "tests/test_serve.py::test_serve_survives_client_death",
     "tests/test_serve.py::test_serve_up_ready_balance_down",
+    "tests/test_serve.py::test_streaming_through_lb",
     "tests/test_sharding.py::test_multislice_mesh_virtual_slices",
     "tests/test_sharding.py::test_sharded_matches_unsharded",
     "tests/test_sharding.py::test_sharded_train_step_runs",

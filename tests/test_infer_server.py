@@ -85,3 +85,57 @@ def test_bad_requests(model_server):
     code, out = _post(f"{url}/generate",
                       {"tokens": list(range(99)), "max_new_tokens": 2})
     assert code == 400  # prompt exceeds the largest bucket
+
+
+def _post_stream(url, payload, timeout=300):
+    """POST with stream:true; returns [(arrival_time, chunk_dict)]."""
+    import time
+    req = urllib.request.Request(
+        url, data=json.dumps(dict(payload, stream=True)).encode(),
+        headers={"Content-Type": "application/json"})
+    chunks = []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.status == 200
+        assert r.headers.get("Content-Type") == "application/x-ndjson"
+        buf = b""
+        while True:
+            piece = r.read1(65536)
+            if not piece:
+                break
+            buf += piece
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if line.strip():
+                    chunks.append((time.time(), json.loads(line)))
+    return chunks
+
+
+def test_streaming_tokens_match_blocking(model_server):
+    """Streamed chunks concatenate to exactly the blocking result, and
+    the first token chunk lands BEFORE generation finishes (the whole
+    point of streaming TTFT)."""
+    url, _, _ = model_server
+    prompt = [5, 9, 2]
+    _, blocking = _post(f"{url}/generate",
+                        {"tokens": prompt, "max_new_tokens": 24})
+    chunks = _post_stream(f"{url}/generate",
+                          {"tokens": prompt, "max_new_tokens": 24})
+    assert "done" in chunks[-1][1]
+    streamed = [t for _, c in chunks for t in c.get("tokens", [])]
+    assert streamed == blocking["tokens"]
+    assert chunks[-1][1]["ttft_ms"] is not None
+    # Multiple emissions (burst=8 over 24 tokens -> >= 3 token chunks),
+    # and the first arrives strictly before the done chunk.
+    token_chunks = [c for _, c in chunks if "tokens" in c]
+    assert len(token_chunks) >= 3
+    first_t = next(t for t, c in chunks if "tokens" in c)
+    done_t = chunks[-1][0]
+    assert first_t < done_t
+
+
+def test_streaming_oversized_prompt_clean_400(model_server):
+    url, _, _ = model_server
+    code, out = _post(f"{url}/generate",
+                      {"tokens": list(range(99)), "max_new_tokens": 2,
+                       "stream": True})
+    assert code == 400 and "error" in out

@@ -126,6 +126,56 @@ def test_serve_up_ready_balance_down():
                                   "local") == "NOT_FOUND"
 
 
+# A replica that dribbles 5 chunks ~0.3s apart over chunked encoding:
+# only a streaming LB delivers the first chunk before the last exists.
+STREAM_RUN = (
+    "python3 -c \""
+    "import http.server, os, time\n"
+    "class H(http.server.BaseHTTPRequestHandler):\n"
+    "    protocol_version = 'HTTP/1.1'\n"
+    "    def do_GET(self):\n"
+    "        self.send_response(200)\n"
+    "        self.send_header('Transfer-Encoding', 'chunked')\n"
+    "        self.end_headers()\n"
+    "        for i in range(5):\n"
+    "            data = ('tick%d' % i).encode()\n"
+    "            self.wfile.write(('%x' % len(data)).encode()"
+    " + b'\\r\\n' + data + b'\\r\\n')\n"
+    "            self.wfile.flush()\n"
+    "            time.sleep(0.3)\n"
+    "        self.wfile.write(b'0\\r\\n\\r\\n')\n"
+    "    def log_message(self, *a): pass\n"
+    "http.server.ThreadingHTTPServer(('127.0.0.1', "
+    "int(os.environ['SKYTPU_REPLICA_PORT'])), H).serve_forever()\""
+)
+
+
+def test_streaming_through_lb():
+    """First chunk must reach the client through the LB while the
+    replica is still producing — the LB proxies chunk-by-chunk instead
+    of buffering whole responses (the JetStream-style TTFT path)."""
+    cfg = _service_task(replicas=1, port=18270).to_yaml_config()
+    cfg["run"] = STREAM_RUN
+    info = serve_core.up(Task.from_yaml_config(cfg), "streamsvc")
+    try:
+        serve_core.wait_ready("streamsvc", timeout=300)
+        times = []
+        with urllib.request.urlopen(info["endpoint"] + "/",
+                                    timeout=60) as r:
+            assert r.headers.get("Transfer-Encoding") == "chunked"
+            while True:
+                piece = r.read1(65536)
+                if not piece:
+                    break
+                times.append(time.time())
+        # All 5 ticks arrived, spread over the replica's ~1.2s dribble —
+        # a buffering LB would deliver everything in one instant burst.
+        assert len(times) >= 3
+        assert times[-1] - times[0] > 0.5
+    finally:
+        serve_core.down("streamsvc")
+
+
 def test_replica_failure_recovery():
     info = serve_core.up(_service_task(replicas=1), "failsvc")
     try:
