@@ -182,18 +182,21 @@ def slim_params(params: llama.Params) -> llama.Params:
 def random_quantized_params(cfg: llama.LlamaConfig, seed: int = 0):
     """(slim fp params, qweights) with random int8 weights, built
     WITHOUT ever materializing the fp tree — how an 8B-class benchmark
-    fits a 16 GB chip (the fp init alone would be 32 GB)."""
-    import numpy as _np
-    rng = _np.random.RandomState(seed)
+    fits a 16 GB chip (the fp init alone would be 32 GB). Every leaf is
+    generated ON DEVICE (jax.random): a host-side numpy tree would ship
+    ~8 GB through PCIe — or a tunneled relay, where that transfer
+    stalls for tens of minutes."""
     d, ff, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    keys = iter(jax.random.split(jax.random.key(seed), 16))
 
     def q(shape, out_ndim):
-        w = rng.randint(-127, 128, size=shape).astype(_np.int8)
-        s = _np.full(shape[-out_ndim:] if out_ndim else (),
-                     0.02 / 127.0, _np.float32)
-        s = _np.broadcast_to(s, shape[:1] + shape[-out_ndim:]).copy()             if len(shape) > out_ndim + 1 else s
-        return {"w": jnp.asarray(w), "s": jnp.asarray(s)}
+        w = jax.random.randint(next(keys), shape, -127, 128,
+                               dtype=jnp.int8)
+        sshape = ((shape[0],) + tuple(shape[-out_ndim:])
+                  if len(shape) > out_ndim + 1
+                  else tuple(shape[-out_ndim:]))
+        return {"w": w, "s": jnp.full(sshape, 0.02 / 127.0, jnp.float32)}
 
     blocks = {
         "wq": q((L, d, nh, hd), 2),
@@ -204,13 +207,12 @@ def random_quantized_params(cfg: llama.LlamaConfig, seed: int = 0):
         "w_up": q((L, d, ff), 1),
         "w_down": q((L, ff, d), 1),
     }
-    head = {"w": jnp.asarray(
-        rng.randint(-127, 128, size=(d, v), dtype=_np.int8)),
-        "s": jnp.full((v,), 0.02 / 127.0, jnp.float32)}
+    head = {"w": jax.random.randint(next(keys), (d, v), -127, 128,
+                                    dtype=jnp.int8),
+            "s": jnp.full((v,), 0.02 / 127.0, jnp.float32)}
     params = {
-        "embed": jnp.asarray(
-            rng.standard_normal((v, d)).astype(_np.float32) * 0.02
-        ).astype(jnp.bfloat16),
+        "embed": (jax.random.normal(next(keys), (v, d), jnp.bfloat16)
+                  * 0.02),
         "final_norm": jnp.ones((d,), jnp.float32),
         "blocks": {"ln1": jnp.ones((L, d), jnp.float32),
                    "ln2": jnp.ones((L, d), jnp.float32)},

@@ -191,6 +191,9 @@ class ModelServer:
 
 class _Threading(ThreadingMixIn, HTTPServer):
     daemon_threads = True
+    # A burst of concurrent clients (the LB fan-in) overflows the
+    # default listen backlog of 5 -> connection resets under load.
+    request_queue_size = 128
 
 
 def make_handler(model: ModelServer):

@@ -380,14 +380,18 @@ def packed_loss_mask(batch: Dict[str, jax.Array]):
 
 def xent_metrics(params: Params, h: jax.Array, tokens: jax.Array,
                  mask: Optional[jax.Array], cfg: LlamaConfig,
-                 constrain=lambda x, axes: x):
+                 constrain=lambda x, axes: x, head: Optional[jax.Array] = None):
     """Shared LM-head + next-token cross-entropy epilogue.
 
     h: final-norm hidden states [B, S, D]. Returns (loss, acc, denom).
     Honors ``cfg.xent_chunk`` (see LlamaConfig) — used by the llama,
-    moe, and pipeline loss functions alike.
+    moe, pipeline, and qlora loss functions alike. ``head`` overrides
+    the [D, V] head matrix (qlora passes a dequantized head; the slim
+    param tree carries no fp lm_head).
     """
-    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    if head is None:
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
     head = head.astype(cfg.dtype)
     if not cfg.xent_chunk:
         logits = jnp.einsum("bsd,dv->bsv", h, head)

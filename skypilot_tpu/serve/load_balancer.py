@@ -174,6 +174,9 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
 class _ThreadingServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # Request bursts overflow the default listen backlog of 5 ->
+    # connection resets before the handler ever runs.
+    request_queue_size = 128
 
 
 def serve(service: str, port: int, policy_name: str = "least_load"):

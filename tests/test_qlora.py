@@ -1,0 +1,91 @@
+"""QLoRA: LoRA adapters over a frozen int8 base (the 8B-on-one-chip
+finetune path). Oracles against the fp model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import kvcache
+from skypilot_tpu.models import llama
+from skypilot_tpu.train import qlora, trainer
+from skypilot_tpu.train.lora import LoRAConfig, init_lora_params
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.CONFIGS["llama3-tiny"]
+
+
+@pytest.fixture(scope="module")
+def quantized(cfg):
+    params = llama.init_params(jax.random.key(0), cfg)
+    qw = {"blocks": kvcache.quantize_block_weights(params),
+          "head": kvcache.quantize_head(params, cfg)}
+    return params, qw, kvcache.slim_params(params)
+
+
+@pytest.fixture(scope="module")
+def batch(cfg):
+    tokens = jax.random.randint(jax.random.key(2), (2, 32), 1,
+                                cfg.vocab_size, dtype=jnp.int32)
+    return {"tokens": tokens}
+
+
+def test_zero_adapters_match_fp_model(cfg, quantized, batch):
+    """With B=0 adapters the int8 forward is the base model up to
+    quantization error (measured ~0.04% on the loss)."""
+    params, qw, fp = quantized
+    lc = LoRAConfig(rank=4)
+    adapters = init_lora_params(jax.random.key(1), cfg, lc)
+    loss_q, metrics = jax.jit(
+        lambda a: qlora.loss_fn(qw, fp, a, batch, cfg, lc))(adapters)
+    loss_fp, _ = jax.jit(lambda p: llama.loss_fn(p, batch, cfg))(params)
+    np.testing.assert_allclose(float(loss_q), float(loss_fp), rtol=5e-3)
+    assert np.isfinite(float(metrics["accuracy"]))
+
+
+def test_qlora_adapters_learn(cfg, quantized, batch):
+    """Gradients flow through the dequantized matmuls into the
+    adapters: loss drops on a fixed batch with the base frozen."""
+    _, qw, fp = quantized
+    lc = LoRAConfig(rank=8)
+    tc = trainer.TrainConfig(learning_rate=1e-2, warmup_steps=1)
+    step = qlora.make_qlora_train_step(cfg, lc, tc)
+    state = qlora.create_qlora_state(cfg, lc, tc)
+    first = last = None
+    for _ in range(8):
+        state, metrics = step(state, qw, fp, batch)
+        loss = float(metrics["loss"])
+        first = loss if first is None else first
+        last = loss
+    assert last < first - 0.5, (first, last)
+    assert float(metrics["grad_norm"]) > 0
+
+
+def test_qlora_grads_only_adapters(cfg, quantized, batch):
+    """value_and_grad wrt adapters only — every adapter leaf gets a
+    finite gradient, and wq's B-grad is nonzero (B=0 start still gets
+    gradient through A)."""
+    _, qw, fp = quantized
+    lc = LoRAConfig(rank=4)
+    adapters = init_lora_params(jax.random.key(3), cfg, lc)
+    grads = jax.jit(jax.grad(
+        lambda a: qlora.loss_fn(qw, fp, a, batch, cfg, lc)[0]))(adapters)
+    for leaf in jax.tree.leaves(grads):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert float(jnp.abs(grads["wq"]["b"]).sum()) > 0
+
+
+def test_random_quantized_params_device_side(cfg):
+    """The 8B bench's weight builder: no host numpy arrays, leaves live
+    on device, engine-compatible structure."""
+    fp, qw = kvcache.random_quantized_params(cfg, seed=1)
+    assert qw["blocks"]["wq"]["w"].dtype == jnp.int8
+    assert fp["embed"].dtype == jnp.bfloat16
+    lc = LoRAConfig(rank=4)
+    adapters = init_lora_params(jax.random.key(1), cfg, lc)
+    loss, _ = jax.jit(lambda a: qlora.loss_fn(
+        qw, fp, a, {"tokens": jnp.ones((1, 16), jnp.int32)}, cfg,
+        lc))(adapters)
+    assert np.isfinite(float(loss))
