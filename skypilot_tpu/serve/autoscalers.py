@@ -17,6 +17,14 @@ from skypilot_tpu.serve.service_spec import SkyServiceSpec
 @dataclasses.dataclass
 class ScalingDecision:
     target: int
+    # Mixed-fleet split (spot + on-demand sum may exceed ``target``
+    # while dynamic fallback is backfilling). None = unmixed.
+    spot_target: Optional[int] = None
+    ondemand_target: Optional[int] = None
+
+    @property
+    def mixed(self) -> bool:
+        return self.spot_target is not None
 
 
 class Autoscaler:
@@ -25,6 +33,8 @@ class Autoscaler:
 
     @classmethod
     def from_spec(cls, spec: SkyServiceSpec) -> "Autoscaler":
+        if spec.use_ondemand_fallback:
+            return FallbackRequestRateAutoscaler(spec)
         if spec.target_qps_per_replica is not None:
             return RequestRateAutoscaler(spec)
         return FixedAutoscaler(spec)
@@ -69,3 +79,54 @@ class RequestRateAutoscaler(Autoscaler):
             self._proposal_since = None
             return ScalingDecision(desired)
         return ScalingDecision(num_total)
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot fleet with an on-demand floor + preemption-aware backfill.
+
+    Reference parity: sky/serve/autoscalers.py
+    FallbackRequestRateAutoscaler:546 — ``base`` on-demand replicas are
+    always kept (availability floor); with ``dynamic_ondemand_fallback``
+    every spot replica that is wanted-but-not-READY (preempted, spot
+    stockout, still provisioning) is covered by an extra on-demand
+    replica, drained again once the spot fleet recovers. Serving cost
+    approaches all-spot while availability approaches all-on-demand.
+
+    Works over fixed-count specs too (no target_qps -> the request-rate
+    parent degrades to min_replicas, which equals the fixed count).
+    """
+
+    def split(self, overall: int, replicas) -> ScalingDecision:
+        """Split an overall target into (spot, on-demand) sub-targets.
+
+        ``overall`` is clamped to [min, max] replicas FIRST: the
+        hysteresis parent echoes the live count while a proposal
+        settles, and the live count includes backfill overage — an
+        unclamped echo would feed the overage back into the spot
+        target, a geometric launch runaway until the downscale delay
+        elapsed.
+        """
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        overall = min(max(overall, self.spec.min_replicas),
+                      self.spec.max_replicas)
+        base = self.spec.base_ondemand_fallback_replicas or 0
+        base = min(base, overall)
+        spot_target = overall - base
+        ready_spot = sum(1 for r in replicas if r["is_spot"]
+                         and r["status"] == ReplicaStatus.READY)
+        ondemand_target = base
+        if self.spec.dynamic_ondemand_fallback:
+            ondemand_target += max(spot_target - ready_spot, 0)
+        return ScalingDecision(overall, spot_target=spot_target,
+                               ondemand_target=ondemand_target)
+
+    def decide_mixed(self, current_qps: float,
+                     replicas) -> ScalingDecision:
+        """``replicas``: current-version live replica rows (dicts with
+        "status" and "is_spot")."""
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        num_ready = sum(1 for r in replicas
+                        if r["status"] == ReplicaStatus.READY)
+        overall = self.decide(current_qps, num_ready,
+                              len(replicas)).target
+        return self.split(overall, replicas)

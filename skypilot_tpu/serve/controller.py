@@ -44,8 +44,30 @@ def run(service_name: str) -> int:
             stdout=f, stderr=subprocess.STDOUT,
             env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
 
+    def apply_scaling(autoscaler, manager, qps, ready, alive,
+                      cur_version_live):
+        """One scaling tick; returns the overall target (for draining).
+        Mixed-fleet autoscalers own preemption replacement, so the
+        probe loop's auto-replace is off for them."""
+        if isinstance(autoscaler, autoscalers.FallbackRequestRateAutoscaler):
+            manager.auto_replace = False
+            d = autoscaler.decide_mixed(qps, cur_version_live)
+            manager.scale_mixed(d.spot_target, d.ondemand_target)
+            return d.target
+        manager.auto_replace = True
+        d = autoscaler.decide(qps, ready, alive)
+        manager.scale_to(d.target)
+        return d.target
+
     serve_state.set_service_status(service_name, ServiceStatus.REPLICA_INIT)
-    manager.scale_to(spec.target_num_replicas)
+    # Initial provision bypasses hysteresis (decide() at t=0 would
+    # propose-and-wait, delaying the first launch by upscale_delay).
+    if isinstance(autoscaler, autoscalers.FallbackRequestRateAutoscaler):
+        manager.auto_replace = False
+        d0 = autoscaler.split(spec.target_num_replicas, [])
+        manager.scale_mixed(d0.spot_target, d0.ondemand_target)
+    else:
+        manager.scale_to(spec.target_num_replicas)
     try:
         while True:
             time.sleep(POLL_SECONDS)
@@ -76,10 +98,16 @@ def run(service_name: str) -> int:
             serve_state.set_service_status(service_name, status)
             if status == ServiceStatus.FAILED:
                 break
-            decision = autoscaler.decide(serve_state.qps(service_name),
-                                         len(ready), len(alive))
-            manager.scale_to(decision.target)
-            manager.drain_old_versions(decision.target)
+            cur_live = [r for r in replicas
+                        if r.get("version", 1) == manager.version
+                        and r["status"] not in (ReplicaStatus.FAILED,
+                                                ReplicaStatus.SHUTDOWN,
+                                                ReplicaStatus.PREEMPTED,
+                                                ReplicaStatus.SHUTTING_DOWN)]
+            target = apply_scaling(autoscaler, manager,
+                                   serve_state.qps(service_name),
+                                   len(ready), len(alive), cur_live)
+            manager.drain_old_versions(target)
     finally:
         lb.terminate()
         manager.terminate_all()

@@ -39,8 +39,12 @@ class ReplicaManager:
             or [0])
         self._probe_failures: Dict[int, int] = {}
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
-        self._launching: set = set()
+        self._launching: Dict[int, bool] = {}   # rid -> is_spot
         self._lock = threading.Lock()
+        # With a mixed-fleet autoscaler the controller owns replacement
+        # decisions (preempted spot may come back as on-demand); the
+        # probe loop then only marks/terminates, never relaunches.
+        self.auto_replace = True
 
     # -- rolling updates ---------------------------------------------------
     def apply_update(self, spec: SkyServiceSpec, task_config: dict,
@@ -87,47 +91,93 @@ class ReplicaManager:
             for _ in range(target - n_current):
                 self._launch_replica()
         elif target < len(cur):
-            # Scale down the newest non-ready first, then newest ready.
-            order = sorted(
-                cur,
-                key=lambda r: (r["status"] == ReplicaStatus.READY,
-                               -r["replica_id"]))
-            for r in order[:len(cur) - target]:
+            for r in self._scale_down_order(cur)[:len(cur) - target]:
                 self._terminate_replica(r["replica_id"])
 
-    def _launch_replica(self) -> None:
+    @staticmethod
+    def _scale_down_order(replicas):
+        """Newest non-ready first, then newest ready."""
+        return sorted(replicas,
+                      key=lambda r: (r["status"] == ReplicaStatus.READY,
+                                     -r["replica_id"]))
+
+    def scale_mixed(self, spot_target: int, ondemand_target: int) -> None:
+        """Reconcile the spot and on-demand sub-fleets independently
+        (reference: FallbackRequestRateAutoscaler's per-type decisions,
+        sky/serve/autoscalers.py:640-700)."""
+        cur = [r for r in self._live_replicas()
+               if r.get("version", 1) == self.version]
+        for is_spot, target in ((True, spot_target),
+                                (False, ondemand_target)):
+            sub = [r for r in cur if bool(r.get("is_spot")) == is_spot]
+            with self._lock:
+                n = len(sub) + sum(1 for s in self._launching.values()
+                                   if s == is_spot)
+            if target > n:
+                for _ in range(target - n):
+                    self._launch_replica(use_spot=is_spot)
+            elif target < len(sub):
+                for r in self._scale_down_order(sub)[:len(sub) - target]:
+                    self._terminate_replica(r["replica_id"])
+
+    def _launch_replica(self, use_spot: Optional[bool] = None) -> None:
         with self._lock:
             rid = self._next_replica_id
             self._next_replica_id += 1
-            self._launching.add(rid)
+            self._launching[rid] = bool(use_spot)
         cluster = f"sky-serve-{self.service}-{rid}"
         version = self.version
         serve_state.upsert_replica(self.service, rid, cluster,
                                    ReplicaStatus.PROVISIONING, None,
-                                   version=version)
+                                   version=version,
+                                   is_spot=bool(use_spot))
         self._pool.submit(self._launch_replica_blocking, rid, cluster,
-                          version, dict(self.task_config))
+                          version, dict(self.task_config), use_spot)
 
     def _launch_replica_blocking(self, rid: int, cluster: str,
-                                 version: int, task_config: dict) -> None:
+                                 version: int, task_config: dict,
+                                 use_spot: Optional[bool] = None) -> None:
         try:
+            if use_spot is not None:
+                task_config = dict(task_config)
+                res = task_config.get("resources") or {}
+                if isinstance(res, list):
+                    res = [dict(r, use_spot=use_spot) for r in res]
+                else:
+                    res = dict(res, use_spot=use_spot)
+                task_config["resources"] = res
             task = Task.from_yaml_config(task_config)
             task.update_envs({"SKYTPU_REPLICA_ID": str(rid),
                               "SKYTPU_REPLICA_PORT": str(self._port(rid))})
             job_id, handle = execution.launch(task, cluster_name=cluster,
                                               retry_until_up=True)
+            # The controller may have terminated this replica while the
+            # launch was in flight (mixed-fleet backfill drains as soon
+            # as spot recovers): an unconditional STARTING upsert would
+            # resurrect the deleted row and leak the cluster.
+            row = [r for r in serve_state.list_replicas(self.service)
+                   if r["replica_id"] == rid]
+            if not row or row[0]["status"] in (ReplicaStatus.SHUTTING_DOWN,
+                                               ReplicaStatus.SHUTDOWN):
+                try:
+                    self.backend.teardown(handle)
+                except exceptions.SkyTpuError:
+                    cluster_state.remove_cluster(cluster)
+                return
             url = self._replica_url(handle, rid)
             serve_state.upsert_replica(self.service, rid, cluster,
                                        ReplicaStatus.STARTING, url,
-                                       version=version)
+                                       version=version,
+                                       is_spot=bool(use_spot))
         except Exception as e:  # noqa: BLE001 — replica failure is a state
             print(f"replica {rid} launch failed: {e}", flush=True)
             serve_state.upsert_replica(self.service, rid, cluster,
                                        ReplicaStatus.FAILED, None,
-                                       version=version)
+                                       version=version,
+                                       is_spot=bool(use_spot))
         finally:
             with self._lock:
-                self._launching.discard(rid)
+                self._launching.pop(rid, None)
 
     def _port(self, rid: int) -> int:
         # Local replicas share one machine: unique port per replica.
@@ -176,11 +226,15 @@ class ReplicaManager:
                 continue
             rid = r["replica_id"]
             if self._cluster_gone(r["cluster_name"]):
-                # Slice preempted: replace the replica entirely.
+                # Slice preempted: replace the replica entirely. Under
+                # a mixed-fleet autoscaler the controller decides the
+                # replacement's type instead (on-demand backfill).
                 serve_state.set_replica_status(self.service, rid,
                                                ReplicaStatus.PREEMPTED)
                 self._terminate_replica(rid)
-                self._launch_replica()
+                if self.auto_replace:
+                    self._launch_replica(
+                        use_spot=r.get("is_spot") or None)
                 continue
             ok = self._probe_one(r)
             if ok:

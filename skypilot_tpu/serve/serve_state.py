@@ -61,6 +61,7 @@ CREATE TABLE IF NOT EXISTS replicas (
     url TEXT,
     launched_at REAL,
     version INTEGER DEFAULT 1,
+    is_spot INTEGER DEFAULT 0,
     PRIMARY KEY (service, replica_id)
 );
 CREATE TABLE IF NOT EXISTS lb_requests (
@@ -79,6 +80,7 @@ def _db_path() -> str:
 _MIGRATIONS = (
     "ALTER TABLE services ADD COLUMN version INTEGER DEFAULT 1",
     "ALTER TABLE replicas ADD COLUMN version INTEGER DEFAULT 1",
+    "ALTER TABLE replicas ADD COLUMN is_spot INTEGER DEFAULT 0",
 )
 
 
@@ -169,16 +171,18 @@ def remove_service(name: str) -> None:
 
 def upsert_replica(service: str, replica_id: int, cluster_name: str,
                    status: ReplicaStatus, url: Optional[str],
-                   version: int = 1) -> None:
+                   version: int = 1, is_spot: bool = False) -> None:
     with _db() as c:
         c.execute(
             "INSERT INTO replicas (service, replica_id, cluster_name,"
-            " status, url, launched_at, version) VALUES (?,?,?,?,?,?,?)"
+            " status, url, launched_at, version, is_spot)"
+            " VALUES (?,?,?,?,?,?,?,?)"
             " ON CONFLICT(service, replica_id) DO UPDATE SET"
             " cluster_name=excluded.cluster_name, status=excluded.status,"
-            " url=excluded.url, version=excluded.version",
+            " url=excluded.url, version=excluded.version,"
+            " is_spot=excluded.is_spot",
             (service, replica_id, cluster_name, status.value, url,
-             time.time(), version))
+             time.time(), version, int(is_spot)))
 
 
 def set_replica_status(service: str, replica_id: int,
@@ -198,11 +202,13 @@ def list_replicas(service: str) -> List[Dict[str, Any]]:
     with _db() as c:
         rows = c.execute(
             "SELECT replica_id, cluster_name, status, url, launched_at,"
-            " version FROM replicas WHERE service=? ORDER BY replica_id",
+            " version, is_spot FROM replicas WHERE service=?"
+            " ORDER BY replica_id",
             (service,)).fetchall()
     return [{"replica_id": r[0], "cluster_name": r[1],
              "status": ReplicaStatus(r[2]), "url": r[3],
-             "launched_at": r[4], "version": r[5]} for r in rows]
+             "launched_at": r[4], "version": r[5],
+             "is_spot": bool(r[6])} for r in rows]
 
 
 def ready_urls(service: str) -> List[str]:

@@ -25,6 +25,18 @@ class SkyServiceSpec:
     upscale_delay_seconds: float = 30.0
     downscale_delay_seconds: float = 60.0
     post_data: Optional[str] = None
+    # Spot/on-demand mixed fleet (reference: sky/serve/autoscalers.py
+    # FallbackRequestRateAutoscaler:546): keep this many always-on
+    # on-demand replicas under the spot fleet...
+    base_ondemand_fallback_replicas: Optional[int] = None
+    # ...and/or dynamically backfill on-demand for every spot replica
+    # that is provisioned-but-not-READY (preempted or stockout).
+    dynamic_ondemand_fallback: Optional[bool] = None
+
+    @property
+    def use_ondemand_fallback(self) -> bool:
+        return (self.base_ondemand_fallback_replicas is not None
+                or bool(self.dynamic_ondemand_fallback))
 
     def __post_init__(self):
         if self.max_replicas is None:
@@ -39,6 +51,11 @@ class SkyServiceSpec:
                 f"need min <= target <= max replicas, got "
                 f"{self.min_replicas}/{self.target_num_replicas}/"
                 f"{self.max_replicas}")
+        base = self.base_ondemand_fallback_replicas
+        if base is not None and not 0 <= base <= self.max_replicas:
+            raise exceptions.ServeError(
+                f"need 0 <= base_ondemand_fallback_replicas <= "
+                f"max_replicas, got {base}/{self.max_replicas}")
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> "SkyServiceSpec":
@@ -63,14 +80,13 @@ class SkyServiceSpec:
             kwargs["min_replicas"] = kwargs["target_num_replicas"] = \
                 int(replicas)
             kwargs["max_replicas"] = int(replicas)
-        for src, dst in (("min_replicas", "min_replicas"),
-                         ("max_replicas", "max_replicas"),
-                         ("target_qps_per_replica", "target_qps_per_replica"),
-                         ("upscale_delay_seconds", "upscale_delay_seconds"),
-                         ("downscale_delay_seconds",
-                          "downscale_delay_seconds")):
-            if src in policy:
-                kwargs[dst] = policy[src]
+        for key in ("min_replicas", "max_replicas",
+                    "target_qps_per_replica", "upscale_delay_seconds",
+                    "downscale_delay_seconds",
+                    "base_ondemand_fallback_replicas",
+                    "dynamic_ondemand_fallback"):
+            if key in policy:
+                kwargs[key] = policy[key]
         if "port" in config:
             kwargs["replica_port"] = int(config.pop("port"))
         if config:
@@ -89,7 +105,8 @@ class SkyServiceSpec:
         if self.post_data:
             out["readiness_probe"]["post_data"] = self.post_data
         if self.min_replicas == self.max_replicas and \
-                self.target_qps_per_replica is None:
+                self.target_qps_per_replica is None and \
+                not self.use_ondemand_fallback:
             out["replicas"] = self.min_replicas
         else:
             out["replica_policy"] = {
@@ -99,4 +116,10 @@ class SkyServiceSpec:
                 "upscale_delay_seconds": self.upscale_delay_seconds,
                 "downscale_delay_seconds": self.downscale_delay_seconds,
             }
+            if self.base_ondemand_fallback_replicas is not None:
+                out["replica_policy"]["base_ondemand_fallback_replicas"] \
+                    = self.base_ondemand_fallback_replicas
+            if self.dynamic_ondemand_fallback is not None:
+                out["replica_policy"]["dynamic_ondemand_fallback"] \
+                    = self.dynamic_ondemand_fallback
         return out

@@ -65,6 +65,8 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
                 "target_qps_per_replica": {"type": ["number", "null"]},
                 "upscale_delay_seconds": {"type": "number"},
                 "downscale_delay_seconds": {"type": "number"},
+                "base_ondemand_fallback_replicas": {"type": "integer"},
+                "dynamic_ondemand_fallback": {"type": "boolean"},
             },
         },
         "replicas": {"type": "integer"},

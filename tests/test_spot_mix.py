@@ -1,0 +1,222 @@
+"""Spot/on-demand mixed-fleet serving: decision matrix + e2e backfill.
+
+Reference parity: sky/serve/autoscalers.py FallbackRequestRateAutoscaler
+(:546) — on-demand availability floor under a spot fleet, with
+preemption-aware dynamic backfill.
+"""
+
+import time
+
+import pytest
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+def _spec(**policy):
+    return SkyServiceSpec.from_yaml_config({
+        "readiness_probe": "/", "port": 18300,
+        "replica_policy": dict({"min_replicas": 3, "max_replicas": 3},
+                               **policy),
+    })
+
+
+def _rep(rid, is_spot, status=ReplicaStatus.READY):
+    return {"replica_id": rid, "is_spot": is_spot, "status": status}
+
+
+def test_from_spec_selects_fallback():
+    spec = _spec(base_ondemand_fallback_replicas=1)
+    a = autoscalers.Autoscaler.from_spec(spec)
+    assert isinstance(a, autoscalers.FallbackRequestRateAutoscaler)
+    assert spec.use_ondemand_fallback
+    # Round-trips through YAML (the controller re-parses the spec).
+    spec2 = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert spec2.base_ondemand_fallback_replicas == 1
+
+
+def test_startup_provisions_base_plus_dynamic_backfill():
+    """No replicas yet: spot fleet provisions AND on-demand covers the
+    whole not-yet-ready spot target (serves while spot comes up)."""
+    a = autoscalers.Autoscaler.from_spec(
+        _spec(base_ondemand_fallback_replicas=1,
+              dynamic_ondemand_fallback=True))
+    d = a.decide_mixed(0.0, [])
+    assert d.mixed
+    assert d.spot_target == 2
+    assert d.ondemand_target == 1 + 2
+
+
+def test_steady_state_drains_backfill():
+    """All spot READY: on-demand returns to the base floor."""
+    a = autoscalers.Autoscaler.from_spec(
+        _spec(base_ondemand_fallback_replicas=1,
+              dynamic_ondemand_fallback=True))
+    reps = [_rep(1, True), _rep(2, True), _rep(3, False)]
+    d = a.decide_mixed(0.0, reps)
+    assert d.spot_target == 2 and d.ondemand_target == 1
+
+
+def test_preemption_triggers_backfill():
+    """One of two spot replicas gone: one extra on-demand covers it."""
+    a = autoscalers.Autoscaler.from_spec(
+        _spec(base_ondemand_fallback_replicas=1,
+              dynamic_ondemand_fallback=True))
+    reps = [_rep(1, True), _rep(3, False)]
+    d = a.decide_mixed(0.0, reps)
+    assert d.spot_target == 2 and d.ondemand_target == 2
+
+
+def test_static_base_without_dynamic():
+    a = autoscalers.Autoscaler.from_spec(
+        _spec(base_ondemand_fallback_replicas=2))
+    d = a.decide_mixed(0.0, [])
+    assert d.spot_target == 1 and d.ondemand_target == 2
+    d = a.decide_mixed(0.0, [_rep(1, False)])
+    assert d.ondemand_target == 2  # never more than the base
+
+
+def test_all_spot_fleet_with_dynamic_only():
+    a = autoscalers.Autoscaler.from_spec(
+        _spec(dynamic_ondemand_fallback=True))
+    d = a.decide_mixed(0.0, [_rep(i, True) for i in (1, 2, 3)])
+    assert d.spot_target == 3 and d.ondemand_target == 0
+    d = a.decide_mixed(0.0, [_rep(1, True), _rep(2, True)])
+    assert d.ondemand_target == 1
+
+
+def test_base_capped_at_overall_target():
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.ServeError):
+        SkyServiceSpec.from_yaml_config({
+            "readiness_probe": "/", "port": 18300,
+            "replica_policy": {"min_replicas": 1, "max_replicas": 1,
+                               "base_ondemand_fallback_replicas": 5}})
+    # base == max is fine and fully on-demand.
+    spec = SkyServiceSpec.from_yaml_config({
+        "readiness_probe": "/", "port": 18300,
+        "replica_policy": {"min_replicas": 2, "max_replicas": 2,
+                           "base_ondemand_fallback_replicas": 2}})
+    a = autoscalers.Autoscaler.from_spec(spec)
+    d = a.decide_mixed(0.0, [])
+    assert d.spot_target == 0 and d.ondemand_target == 2
+
+
+def test_rate_scaling_composes_with_mix(monkeypatch):
+    """QPS pushes the overall target up; the split follows."""
+    spec = SkyServiceSpec.from_yaml_config({
+        "readiness_probe": "/", "port": 18300,
+        "replica_policy": {"min_replicas": 1, "max_replicas": 4,
+                           "target_qps_per_replica": 1.0,
+                           "upscale_delay_seconds": 0,
+                           "downscale_delay_seconds": 0,
+                           "base_ondemand_fallback_replicas": 1,
+                           "dynamic_ondemand_fallback": True}})
+    a = autoscalers.Autoscaler.from_spec(spec)
+    reps = [_rep(1, True), _rep(2, False)]
+    # decide() proposes 4 (qps 4 / 1 per replica); zero delays let it
+    # apply after two calls (proposal then confirm).
+    a.decide_mixed(4.0, reps)
+    d = a.decide_mixed(4.0, reps)
+    assert d.target == 4
+    assert d.spot_target == 3
+    assert d.ondemand_target == 1 + (3 - 1)
+
+
+def test_backfill_overage_never_feeds_back():
+    """Regression: the live count includes backfill overage; the
+    hysteresis echo of that count must be clamped to max_replicas or
+    the spot target inflates geometrically (launch runaway)."""
+    a = autoscalers.Autoscaler.from_spec(
+        _spec(base_ondemand_fallback_replicas=1,
+              dynamic_ondemand_fallback=True))  # min=max=3
+    # 7 live replicas (overage from repeated backfill), none ready.
+    reps = [_rep(i, i % 2 == 0, ReplicaStatus.STARTING)
+            for i in range(7)]
+    for _ in range(5):
+        d = a.decide_mixed(0.0, reps)
+        assert d.target == 3
+        assert d.spot_target == 2
+        assert d.ondemand_target <= 3  # base + full backfill
+
+
+# -- e2e: kill a spot replica, watch on-demand backfill ---------------------
+
+def test_spot_preemption_backfills_ondemand(tmp_path, monkeypatch):
+    """Local-provider e2e: a mixed service loses its spot replica; the
+    controller backfills with on-demand, then the spot fleet recovers."""
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    monkeypatch.setenv("SKYTPU_LOCAL_CLUSTERS_ROOT", str(tmp_path / "cloud"))
+    monkeypatch.setenv("SKYTPU_SERVE_POLL", "0.3")
+    from skypilot_tpu.provision import local as lp
+    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.task import Task
+    from tests.test_serve import REPLICA_RUN
+
+    cfg = {
+        "name": "svc",
+        "resources": {"cloud": "local"},
+        "run": REPLICA_RUN,
+        "service": {
+            "readiness_probe": {"path": "/", "initial_delay_seconds": 15},
+            "port": 18310,
+            "replica_policy": {
+                "min_replicas": 2, "max_replicas": 2,
+                "base_ondemand_fallback_replicas": 1,
+                "dynamic_ondemand_fallback": True,
+            },
+        },
+    }
+    serve_core.up(Task.from_yaml_config(cfg), "mixsvc")
+    try:
+        serve_core.wait_ready("mixsvc", timeout=300)
+
+        def replicas():
+            rows = serve_core.status("mixsvc")
+            return rows[0]["replicas"] if rows else []
+
+        # Converge to steady state: 1 spot + 1 on-demand, all READY
+        # (the startup backfill on-demand drains once spot is READY).
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            reps = [r for r in replicas()
+                    if r["status"] == ReplicaStatus.READY]
+            spot = [r for r in reps if r.get("is_spot")]
+            od = [r for r in reps if not r.get("is_spot")]
+            if len(spot) == 1 and len(od) == 1:
+                break
+            time.sleep(0.5)
+        assert len(spot) == 1 and len(od) == 1, replicas()
+
+        # Preempt the spot replica cloud-side.
+        lp.terminate_instances(spot[0]["cluster_name"], "local")
+
+        # Backfill: a NEW on-demand replica appears while spot is gone.
+        deadline = time.time() + 300
+        seen_backfill = False
+        while time.time() < deadline:
+            reps = replicas()
+            od_now = [r for r in reps if not r.get("is_spot")
+                      and r["status"] not in (ReplicaStatus.SHUTTING_DOWN,
+                                              ReplicaStatus.SHUTDOWN)]
+            if len(od_now) >= 2:
+                seen_backfill = True
+                break
+            time.sleep(0.3)
+        assert seen_backfill, replicas()
+
+        # And the fleet converges back: spot replacement READY, extra
+        # on-demand drained to the base floor.
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            reps = [r for r in replicas()
+                    if r["status"] == ReplicaStatus.READY]
+            spot = [r for r in reps if r.get("is_spot")]
+            od = [r for r in reps if not r.get("is_spot")]
+            if len(spot) == 1 and len(od) == 1:
+                break
+            time.sleep(0.5)
+        assert len(spot) == 1 and len(od) == 1, replicas()
+    finally:
+        serve_core.down("mixsvc")
