@@ -34,11 +34,21 @@ from skypilot_tpu.resources import Resources
 from skypilot_tpu.runtime import job_queue, topology
 from skypilot_tpu.runtime.rpc_client import ClusterRpc
 from skypilot_tpu.task import Task
-from skypilot_tpu.utils import paths
+from skypilot_tpu.utils import paths, timeline
 
 # Head-side location of the intra-cluster SSH key (pushed by
 # instance_setup for ssh-reachable hosts).
 _HEAD_SSH_KEY = "~/.skypilot_tpu/ssh/sky-key"
+
+
+def cluster_lock(cluster_name: str) -> timeline.FileLockEvent:
+    """Per-cluster lifecycle lock (reference:
+    sky/backends/cloud_vm_ray_backend.py:2846 locks every provision).
+    Two clients racing ``launch -c same`` must produce ONE cluster and
+    one provision; same for concurrent start/stop/teardown."""
+    return timeline.FileLockEvent(
+        os.path.join(paths.home(), "locks",
+                     f"cluster-{cluster_name}.lock"))
 
 
 class ClusterHandle(dict):
@@ -208,16 +218,21 @@ class TpuVmBackend:
     # -- provisioning ------------------------------------------------------
     def provision(self, task: Task, cluster_name: str,
                   retry_until_up: bool = False) -> ClusterHandle:
-        existing = state.get_cluster(cluster_name)
-        if existing is not None:
-            handle = ClusterHandle(existing["handle"])
-            if existing["status"] == state.ClusterStatus.UP:
-                self.check_resources_fit(task, handle)
-                return handle
-            if existing["status"] == state.ClusterStatus.STOPPED:
-                return self.start(cluster_name)
-        return RetryingProvisioner(retry_until_up).provision(
-            task, cluster_name)
+        # The existing-cluster check and the create must be atomic
+        # against other clients of this state DB, or a race provisions
+        # the same name twice (cloud-side duplicate or clobbered
+        # handle).
+        with cluster_lock(cluster_name):
+            existing = state.get_cluster(cluster_name)
+            if existing is not None:
+                handle = ClusterHandle(existing["handle"])
+                if existing["status"] == state.ClusterStatus.UP:
+                    self.check_resources_fit(task, handle)
+                    return handle
+                if existing["status"] == state.ClusterStatus.STOPPED:
+                    return self._start_locked(cluster_name)
+            return RetryingProvisioner(retry_until_up).provision(
+                task, cluster_name)
 
     def check_resources_fit(self, task: Task, handle: ClusterHandle) -> None:
         cluster_res = handle.resources
@@ -419,12 +434,17 @@ class TpuVmBackend:
             raise exceptions.NotSupportedError(
                 f"{handle.provider} instances cannot stop; use down "
                 f"(Feature.STOP)")
-        provision.stop_instances(handle.provider, handle.cluster_name,
-                                 handle.zone)
-        state.set_cluster_status(handle.cluster_name,
-                                 state.ClusterStatus.STOPPED)
+        with cluster_lock(handle.cluster_name):
+            provision.stop_instances(handle.provider, handle.cluster_name,
+                                     handle.zone)
+            state.set_cluster_status(handle.cluster_name,
+                                     state.ClusterStatus.STOPPED)
 
     def start(self, cluster_name: str) -> ClusterHandle:
+        with cluster_lock(cluster_name):
+            return self._start_locked(cluster_name)
+
+    def _start_locked(self, cluster_name: str) -> ClusterHandle:
         rec = state.get_cluster(cluster_name)
         if rec is None:
             raise exceptions.ClusterNotUpError(f"no cluster {cluster_name}")
@@ -444,6 +464,10 @@ class TpuVmBackend:
         return handle
 
     def teardown(self, handle: ClusterHandle) -> None:
+        with cluster_lock(handle.cluster_name):
+            self._teardown_locked(handle)
+
+    def _teardown_locked(self, handle: ClusterHandle) -> None:
         provision.terminate_instances(handle.provider, handle.cluster_name,
                                       handle.zone)
         # Ephemeral (persistent: false) buckets die with the cluster.

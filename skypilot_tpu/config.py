@@ -12,7 +12,9 @@ Example config.yaml:
 
     gcp:
       project: my-proj
-      specific_reservations: [res-1]
+      specific_reservations: [res-1]   # VM reservations: affinity +
+                                       # optimizer cost discount
+      use_reserved_tpu_capacity: true  # TPU QR guaranteed/reserved tier
     provisioner:
       ssh_timeout: 300
     admin_policy: mypkg.policy.MyPolicy

@@ -44,6 +44,28 @@ class Candidate:
     time_s: float        # estimated runtime on THIS candidate
 
 
+def _reserved_nodes_available(resources: Resources,
+                              cache: Dict[Tuple, int]) -> int:
+    """Unused reserved capacity usable by this candidate (0 unless the
+    config names ``gcp.specific_reservations``). Cached per zone for
+    one optimize call — the availability query is a cloud API hit.
+    Reference: sky/optimizer.py:345-355 treats reserved nodes as
+    already-paid-for (cost 0)."""
+    if resources.cloud != "gcp" or resources.use_spot:
+        return 0
+    from skypilot_tpu.provision import gcp
+    if not gcp.configured_reservations():
+        return 0
+    key = (resources.zone, resources.instance_type)
+    if key not in cache:
+        try:
+            cache[key] = sum(gcp.list_reservations_available(
+                resources.zone, resources.instance_type).values())
+        except Exception:  # noqa: BLE001 — availability is advisory
+            cache[key] = 0
+    return cache[key]
+
+
 def _candidates_for(task: Task, blocked: BlockedSet) -> List[Candidate]:
     """Launchable candidates with per-accelerator runtime scaling
     (reference: _estimate_nodes_cost_or_time, sky/optimizer.py:236).
@@ -57,6 +79,7 @@ def _candidates_for(task: Task, blocked: BlockedSet) -> List[Candidate]:
     from skypilot_tpu.catalog import catalog
     est = task.estimated_runtime_seconds
     out: List[Candidate] = []
+    reserved_cache: Dict[Tuple, int] = {}
     for r in task.resources:
         for launchable in r.launchables(blocked):
             if est is not None and est > 0:
@@ -66,7 +89,12 @@ def _candidates_for(task: Task, blocked: BlockedSet) -> List[Candidate]:
                 time_s = est / max(units, 1e-9)
             else:
                 time_s = DEFAULT_RUNTIME_ESTIMATE_S
-            cost = launchable.get_cost(time_s) * task.num_nodes
+            # Reserved capacity is already paid for: those nodes cost 0
+            # in the plan (reference: sky/optimizer.py:345-355).
+            n_reserved = _reserved_nodes_available(launchable,
+                                                   reserved_cache)
+            billable = max(task.num_nodes - n_reserved, 0)
+            cost = launchable.get_cost(time_s) * billable
             out.append(Candidate(launchable, cost, time_s))
     if not out:
         raise exceptions.ResourcesUnavailableError(

@@ -170,6 +170,50 @@ def _node_states(cluster_name: str, zone: str,
 
 # -- provision API ----------------------------------------------------------
 
+def configured_reservations() -> List[str]:
+    """Reservation names from config (``gcp.specific_reservations``).
+    Reference parity: sky/skypilot_config gcp.specific_reservations +
+    sky/clouds/gcp.py:1098 get_reservations_available_resources."""
+    from skypilot_tpu import config as config_lib
+    return list(config_lib.get_nested(("gcp", "specific_reservations"),
+                                      []) or [])
+
+
+def use_reserved_tpu_capacity() -> bool:
+    """``gcp.use_reserved_tpu_capacity``: request the queued-resource
+    guaranteed/reserved tier (the project holds a TPU reservation)."""
+    from skypilot_tpu import config as config_lib
+    return bool(config_lib.get_nested(
+        ("gcp", "use_reserved_tpu_capacity"), False))
+
+
+def list_reservations_available(zone: str,
+                                instance_type: Optional[str] = None
+                                ) -> Dict[str, int]:
+    """Unused capacity per configured SPECIFIC reservation in ``zone``:
+    {name: free_count}. Empty when none configured. With
+    ``instance_type``, only reservations whose machine type matches
+    count (a VM reservation must never discount a TPU candidate — TPU
+    reservations are consumed via the queued-resource guaranteed tier
+    instead, see run_instances)."""
+    names = set(configured_reservations())
+    if not names:
+        return {}
+    resp = _http("GET", f"{_compute_zone_url(zone)}/reservations")
+    out: Dict[str, int] = {}
+    for r in resp.get("items", []):
+        if r.get("name") not in names:
+            continue
+        sr = r.get("specificReservation", {})
+        mt = (sr.get("instanceProperties") or {}).get("machineType")
+        if instance_type is not None and mt != instance_type:
+            continue
+        total = int(sr.get("count", 0))
+        used = int(sr.get("inUseCount", 0))
+        out[r["name"]] = max(total - used, 0)
+    return out
+
+
 def _is_tpu_config(config: ProvisionConfig) -> bool:
     """TPU vs Compute Engine dispatch (reference: GCPNodeType selection
     at sky/provision/gcp/instance_utils.py:1658-1666)."""
@@ -228,6 +272,14 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
         if config.use_spot:
             body["spot"] = {}
             node_body.pop("schedulingConfig", None)
+        elif use_reserved_tpu_capacity():
+            # Consume the project's TPU reservation (QR guaranteed
+            # tier; reference: DWS/reserved capacity paths,
+            # sky/provision/gcp/mig_utils.py). Gated on its OWN config
+            # key: VM reservation names in specific_reservations must
+            # not force the tier — a project with only VM reservations
+            # would see every TPU QR go FAILED.
+            body["guaranteed"] = {"reserved": True}
         _http("POST",
               f"{TPU_API}/{_parent(config.zone)}/queuedResources"
               f"?queuedResourceId={_node_name(config.cluster_name)}", body)
@@ -506,6 +558,23 @@ def _run_compute_instances(config: ProvisionConfig) -> ProvisionRecord:
                 "onHostMaintenance": "TERMINATE",
                 "preemptible": bool(config.use_spot),
             }
+        if not config.use_spot:
+            # Name only reservations that exist in THIS zone with free
+            # capacity and a matching machine type: a blanket
+            # SPECIFIC_RESERVATION affinity is rejected by the API in
+            # every other zone, turning an advisory cost hint into a
+            # hard provisioning outage.
+            try:
+                usable = [n for n, free in list_reservations_available(
+                    config.zone, config.instance_type).items() if free > 0]
+            except Exception:  # noqa: BLE001 — advisory; create without
+                usable = []
+            if usable:
+                body["reservationAffinity"] = {
+                    "consumeReservationType": "SPECIFIC_RESERVATION",
+                    "key": "compute.googleapis.com/reservation-name",
+                    "values": usable,
+                }
         _http("POST", f"{_compute_zone_url(config.zone)}/instances", body)
         created.append(name)
     return ProvisionRecord("gcp", config.cluster_name, config.zone,

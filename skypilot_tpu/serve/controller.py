@@ -37,11 +37,14 @@ def run(service_name: str) -> int:
     # Start the LB as a child; it dies with us.
     lb_log = os.path.join(paths.logs_dir(),
                           f"serve-lb-{service_name}.log")
+    lb_argv = [sys.executable, "-m", "skypilot_tpu.serve.load_balancer",
+               "--service", service_name, "--port", str(rec["lb_port"])]
+    if spec.tls_certfile:
+        lb_argv += ["--tls-certfile", spec.tls_certfile,
+                    "--tls-keyfile", spec.tls_keyfile]
     with open(lb_log, "ab") as f:
         lb = subprocess.Popen(
-            [sys.executable, "-m", "skypilot_tpu.serve.load_balancer",
-             "--service", service_name, "--port", str(rec["lb_port"])],
-            stdout=f, stderr=subprocess.STDOUT,
+            lb_argv, stdout=f, stderr=subprocess.STDOUT,
             env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
 
     def apply_scaling(autoscaler, manager, qps, ready, alive,

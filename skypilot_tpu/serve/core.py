@@ -42,8 +42,9 @@ def up(task: Task, service_name: str,
         "serve_up", service_name=service_name, spec=spec_dict,
         task_config=task.to_yaml_config(), lb_port=lb_port)
     host = controller_utils.controller_endpoint_host(handle)
+    scheme = "https" if task.service.tls_certfile else "http"
     return {"name": service_name,
-            "endpoint": f"http://{host}:{result['lb_port']}",
+            "endpoint": f"{scheme}://{host}:{result['lb_port']}",
             "lb_port": result["lb_port"]}
 
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import http.server
 import itertools
+import os
 import socketserver
 import threading
 import urllib.error
@@ -177,12 +178,44 @@ class _ThreadingServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
     # Request bursts overflow the default listen backlog of 5 ->
     # connection resets before the handler ever runs.
     request_queue_size = 128
+    # TLS context (None = plaintext). Sockets are wrapped PER
+    # CONNECTION with a deferred handshake: wrapping the listening
+    # socket would run the handshake inside accept() on the single
+    # serve_forever thread, letting one silent client block the whole
+    # LB (a one-connection DoS).
+    ssl_context = None
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        if self.ssl_context is not None:
+            sock = self.ssl_context.wrap_socket(
+                sock, server_side=True, do_handshake_on_connect=False)
+        return sock, addr
+
+    def handle_error(self, request, client_address):
+        import ssl
+        import sys as _sys
+        e = _sys.exc_info()[1]
+        if isinstance(e, (ssl.SSLError, ConnectionError, TimeoutError)):
+            return  # failed handshake / dropped client: not our bug
+        super().handle_error(request, client_address)
 
 
-def serve(service: str, port: int, policy_name: str = "least_load"):
+def serve(service: str, port: int, policy_name: str = "least_load",
+          certfile: Optional[str] = None, keyfile: Optional[str] = None):
+    if bool(certfile) != bool(keyfile):
+        raise ValueError("TLS needs BOTH certfile and keyfile")
     policy = POLICIES[policy_name]()
     httpd = _ThreadingServer(("0.0.0.0", port),
                              make_handler(service, policy))
+    if certfile:
+        # TLS terminates here; LB -> replica stays plaintext on the
+        # cluster-internal network (reference: sky/serve TLS fields).
+        import ssl
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(os.path.expanduser(certfile),
+                            os.path.expanduser(keyfile))
+        httpd.ssl_context = ctx
     httpd.serve_forever()
 
 
@@ -192,8 +225,11 @@ def main() -> None:
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--policy", default="least_load",
                     choices=sorted(POLICIES))
+    ap.add_argument("--tls-certfile", default=None)
+    ap.add_argument("--tls-keyfile", default=None)
     args = ap.parse_args()
-    serve(args.service, args.port, args.policy)
+    serve(args.service, args.port, args.policy,
+          certfile=args.tls_certfile, keyfile=args.tls_keyfile)
 
 
 if __name__ == "__main__":

@@ -25,6 +25,11 @@ class SkyServiceSpec:
     upscale_delay_seconds: float = 30.0
     downscale_delay_seconds: float = 60.0
     post_data: Optional[str] = None
+    # TLS termination at the load balancer (reference:
+    # sky/serve/service_spec.py tls fields): PEM paths valid on the
+    # controller (push them via file_mounts for cloud controllers).
+    tls_keyfile: Optional[str] = None
+    tls_certfile: Optional[str] = None
     # Spot/on-demand mixed fleet (reference: sky/serve/autoscalers.py
     # FallbackRequestRateAutoscaler:546): keep this many always-on
     # on-demand replicas under the spot fleet...
@@ -56,6 +61,12 @@ class SkyServiceSpec:
             raise exceptions.ServeError(
                 f"need 0 <= base_ondemand_fallback_replicas <= "
                 f"max_replicas, got {base}/{self.max_replicas}")
+        # Enforced at the dataclass so every construction path (YAML,
+        # programmatic) agrees — the controller, core.up's endpoint
+        # scheme, and to_yaml_config all gate on tls_certfile.
+        if bool(self.tls_keyfile) != bool(self.tls_certfile):
+            raise exceptions.ServeError(
+                "service.tls needs both keyfile and certfile")
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> "SkyServiceSpec":
@@ -89,6 +100,13 @@ class SkyServiceSpec:
                 kwargs[key] = policy[key]
         if "port" in config:
             kwargs["replica_port"] = int(config.pop("port"))
+        tls = config.pop("tls", None) or {}
+        if tls:
+            if not (tls.get("keyfile") and tls.get("certfile")):
+                raise exceptions.ServeError(
+                    "service.tls needs both keyfile and certfile")
+            kwargs["tls_keyfile"] = tls["keyfile"]
+            kwargs["tls_certfile"] = tls["certfile"]
         if config:
             raise exceptions.ServeError(
                 f"unknown service fields: {sorted(config)}")
@@ -104,6 +122,9 @@ class SkyServiceSpec:
         }
         if self.post_data:
             out["readiness_probe"]["post_data"] = self.post_data
+        if self.tls_certfile:
+            out["tls"] = {"keyfile": self.tls_keyfile,
+                          "certfile": self.tls_certfile}
         if self.min_replicas == self.max_replicas and \
                 self.target_qps_per_replica is None and \
                 not self.use_ondemand_fallback:

@@ -72,6 +72,14 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         "replicas": {"type": "integer"},
         "port": {"type": "integer"},
         "load_balancing_policy": {"type": "string"},
+        "tls": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "keyfile": {"type": "string"},
+                "certfile": {"type": "string"},
+            },
+        },
     },
 }
 
@@ -123,6 +131,7 @@ CONFIG_SCHEMA: Dict[str, Any] = {
                 "project": {"type": "string"},
                 "specific_reservations": {"type": "array",
                                           "items": {"type": "string"}},
+                "use_reserved_tpu_capacity": {"type": "boolean"},
             },
         },
         "provisioner": {

@@ -103,21 +103,46 @@ def event(fn: Optional[Callable] = None, name: Optional[str] = None):
 
 
 class FileLockEvent:
-    """A filelock wrapped so acquisition waits show up on the trace."""
+    """An exclusive cross-process file lock (stdlib ``fcntl.flock``)
+    whose acquisition waits show up on the trace.
+
+    flock serializes distinct open-file-descriptions, so two THREADS of
+    one process exclude each other too (each acquire opens its own fd)
+    — the per-cluster launch lock needs both. ``timeout`` < 0 blocks
+    forever; otherwise TimeoutError after ~that many seconds.
+    """
 
     def __init__(self, lockfile: str, timeout: float = -1):
-        import filelock
-        self._lockfile = lockfile
-        os.makedirs(os.path.dirname(os.path.abspath(lockfile)),
-                    exist_ok=True)
-        self._lock = filelock.FileLock(lockfile, timeout=timeout)
+        self._lockfile = os.path.abspath(lockfile)
+        os.makedirs(os.path.dirname(self._lockfile), exist_ok=True)
+        self._timeout = timeout
+        self._fd = None
 
     def acquire(self):
+        import fcntl
         with Event(f"filelock.acquire:{self._lockfile}"):
-            return self._lock.acquire()
+            fd = os.open(self._lockfile, os.O_RDWR | os.O_CREAT, 0o644)
+            if self._timeout < 0:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+            else:
+                deadline = time.time() + self._timeout
+                while True:
+                    try:
+                        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                        break
+                    except OSError:
+                        if time.time() >= deadline:
+                            os.close(fd)
+                            raise TimeoutError(
+                                f"lock {self._lockfile} not acquired "
+                                f"within {self._timeout}s") from None
+                        time.sleep(0.05)
+            self._fd = fd
 
     def release(self):
-        return self._lock.release()
+        if self._fd is not None:
+            os.close(self._fd)  # closing drops the flock
+            self._fd = None
 
     def __enter__(self):
         self.acquire()

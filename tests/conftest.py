@@ -112,6 +112,7 @@ _SLOW_TESTS = {
     "tests/test_serve.py::test_serve_survives_client_death",
     "tests/test_serve.py::test_serve_up_ready_balance_down",
     "tests/test_serve.py::test_streaming_through_lb",
+    "tests/test_serve.py::test_tls_termination",
     "tests/test_spot_mix.py::test_spot_preemption_backfills_ondemand",
     "tests/test_qlora.py::test_zero_adapters_match_fp_model",
     "tests/test_qlora.py::test_qlora_adapters_learn",

@@ -382,3 +382,105 @@ def test_end_to_end_failover_across_zones(fake_api, tmp_path, monkeypatch):
     handle = RetryingProvisioner().provision(t, "tputest")
     assert handle.zone not in fake_api.stockout_zones
     assert handle.provider == "gcp"
+
+
+# -- reservations (gcp.specific_reservations) -------------------------------
+
+@pytest.fixture()
+def reservations_config():
+    from skypilot_tpu import config as config_lib
+    config_lib.set_nested(("gcp", "specific_reservations"), ["res-1"])
+    yield
+    config_lib.set_nested(("gcp", "specific_reservations"), None)
+
+
+def test_vm_create_carries_reservation_affinity(fake_api,
+                                                reservations_config,
+                                                monkeypatch):
+    # The zone holds res-1 with free capacity for this machine type.
+    monkeypatch.setattr(
+        gcp, "list_reservations_available",
+        lambda zone, itype=None: {"res-1": 2}
+        if zone == "us-central1-a" else {})
+    gcp.run_instances(_vm_config())
+    vm = fake_api.vms[("us-central1-a", "vmtest")]
+    aff = vm["reservationAffinity"]
+    assert aff["consumeReservationType"] == "SPECIFIC_RESERVATION"
+    assert aff["values"] == ["res-1"]
+
+
+def test_vm_affinity_skipped_where_reservation_absent(fake_api,
+                                                      reservations_config,
+                                                      monkeypatch):
+    """A reservation that lives in another zone (or is full) must NOT
+    be named in this zone's create — the API would reject it and turn
+    an advisory discount into a provisioning outage."""
+    monkeypatch.setattr(gcp, "list_reservations_available",
+                        lambda zone, itype=None: {"res-1": 0})
+    gcp.run_instances(_vm_config())
+    vm = fake_api.vms[("us-central1-a", "vmtest")]
+    assert "reservationAffinity" not in vm
+
+
+def test_spot_vm_never_consumes_reservation(fake_api,
+                                            reservations_config):
+    gcp.run_instances(_vm_config(use_spot=True))
+    vm = fake_api.vms[("us-central1-a", "vmtest")]
+    assert "reservationAffinity" not in vm
+
+
+def test_qr_reserved_tier_has_its_own_key(fake_api,
+                                          reservations_config):
+    """VM reservation names must NOT force the TPU guaranteed tier (a
+    project with only VM reservations would see every QR FAILED); the
+    tier has its own config key."""
+    gcp.run_instances(_config())
+    assert "guaranteed" not in fake_api.qrs[("us-west4-a",
+                                             "tputest")]["body"]
+    from skypilot_tpu import config as config_lib
+    config_lib.set_nested(("gcp", "use_reserved_tpu_capacity"), True)
+    try:
+        gcp.terminate_instances("tputest", "us-west4-a")
+        gcp.run_instances(_config())
+        qr = fake_api.qrs[("us-west4-a", "tputest")]
+        assert qr["body"]["guaranteed"] == {"reserved": True}
+    finally:
+        config_lib.set_nested(("gcp", "use_reserved_tpu_capacity"), None)
+
+
+def test_no_reservation_fields_without_config(fake_api):
+    gcp.run_instances(_vm_config())
+    vm = fake_api.vms[("us-central1-a", "vmtest")]
+    assert "reservationAffinity" not in vm
+    gcp.run_instances(_config())
+    assert "guaranteed" not in fake_api.qrs[("us-west4-a",
+                                             "tputest")]["body"]
+
+
+def test_list_reservations_available_parses_and_filters():
+    def transport(method, url, body):
+        assert method == "GET" and url.endswith("/reservations")
+        return {"items": [
+            {"name": "res-1", "specificReservation": {
+                "count": "4", "inUseCount": "1",
+                "instanceProperties": {"machineType": "n2-standard-8"}}},
+            {"name": "res-other", "specificReservation": {"count": "9"}},
+        ]}
+
+    from skypilot_tpu import config as config_lib
+    config_lib.set_nested(("gcp", "specific_reservations"), ["res-1"])
+    gcp.set_transport(transport)
+    try:
+        import os
+        os.environ.setdefault("GOOGLE_CLOUD_PROJECT", "test-proj")
+        # Unfiltered: 4 - 1 = 3 free; unconfigured names excluded.
+        assert gcp.list_reservations_available("us-central1-a") == \
+            {"res-1": 3}
+        # Machine-type filter: mismatch -> empty.
+        assert gcp.list_reservations_available(
+            "us-central1-a", "n2-standard-8") == {"res-1": 3}
+        assert gcp.list_reservations_available(
+            "us-central1-a", "a2-highgpu-8g") == {}
+    finally:
+        gcp.set_transport(None)
+        config_lib.set_nested(("gcp", "specific_reservations"), None)

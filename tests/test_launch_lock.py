@@ -1,0 +1,99 @@
+"""Per-cluster launch lock: racing clients produce one cluster.
+
+Reference parity: sky/backends/cloud_vm_ray_backend.py:2846 (every
+provision runs under a per-cluster file lock).
+"""
+
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.utils import timeline
+
+
+@pytest.fixture(autouse=True)
+def sky_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    monkeypatch.setenv("SKYTPU_LOCAL_CLUSTERS_ROOT", str(tmp_path / "cloud"))
+
+
+def test_filelock_mutual_exclusion(tmp_path):
+    """Two threads (distinct fds, same process) exclude each other —
+    the flock is per open-file-description, not per process."""
+    lockfile = str(tmp_path / "x.lock")
+    active = []
+    overlaps = []
+
+    def worker():
+        with timeline.FileLockEvent(lockfile):
+            active.append(1)
+            overlaps.append(len(active))
+            time.sleep(0.15)
+            active.pop()
+
+    threads = [threading.Thread(target=worker) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert max(overlaps) == 1
+
+
+def test_filelock_timeout(tmp_path):
+    lockfile = str(tmp_path / "y.lock")
+    held = timeline.FileLockEvent(lockfile)
+    held.acquire()
+    try:
+        with pytest.raises(TimeoutError):
+            timeline.FileLockEvent(lockfile, timeout=0.3).acquire()
+    finally:
+        held.release()
+    # Released: a timed acquire now succeeds.
+    with timeline.FileLockEvent(lockfile, timeout=1.0):
+        pass
+
+
+def test_concurrent_launch_one_cluster_one_provision():
+    """Two clients racing `launch -c same` -> ONE cluster, ONE
+    provision call (the second sees the first's UP record and reuses
+    it)."""
+    from skypilot_tpu import state
+    from skypilot_tpu.backend import TpuVmBackend
+    from skypilot_tpu.provision import local as lp
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    calls = []
+    real_run = lp.run_instances
+
+    def counting_run(config):
+        calls.append(config.cluster_name)
+        return real_run(config)
+
+    lp.run_instances = counting_run
+    try:
+        task = Task(run="true")
+        task.set_resources(Resources(cloud="local"))
+        backend = TpuVmBackend()
+        results, errors = [], []
+
+        def one():
+            try:
+                results.append(backend.provision(task, "race"))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=one) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert len(results) == 2
+        assert all(h.cluster_name == "race" for h in results)
+        assert calls == ["race"], calls  # exactly one provision
+        assert state.get_cluster("race") is not None
+        backend.teardown(results[0])
+    finally:
+        lp.run_instances = real_run

@@ -42,6 +42,35 @@ def test_optimizer_spot_cheaper():
     assert spot.price < on_demand.price
 
 
+def test_optimizer_reserved_capacity_wins(monkeypatch):
+    """Reserved nodes are already paid for: a zone holding a matching
+    reservation costs 0 there and beats the nominally cheapest zone
+    (reference: sky/optimizer.py:345-355)."""
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu.provision import gcp
+    baseline = optimizer.optimize_task(_task(instance_type="n2-standard-8"))
+    assert baseline.region.startswith("us")
+
+    config_lib.set_nested(("gcp", "specific_reservations"), ["res-eu"])
+    try:
+        def fake_avail(zone, instance_type=None):
+            if zone == "europe-west4-a" and \
+                    instance_type == "n2-standard-8":
+                return {"res-eu": 4}
+            return {}
+
+        monkeypatch.setattr(gcp, "list_reservations_available",
+                            fake_avail)
+        chosen = optimizer.optimize_task(_task(instance_type="n2-standard-8"))
+        assert chosen.zone == "europe-west4-a"
+        # Spot candidates never consume reservations.
+        spot = optimizer.optimize_task(
+            _task(instance_type="n2-standard-8", use_spot=True))
+        assert spot.region.startswith("us")
+    finally:
+        config_lib.set_nested(("gcp", "specific_reservations"), None)
+
+
 def test_optimizer_blocklist_failover():
     first = optimizer.optimize_task(_task("tpu-v5e-8"))
     blocked = {("gcp", first.region, first.zone)}
