@@ -82,3 +82,11 @@ def get_cluster_info(provider: str, cluster_name: str,
 
 def get_command_runners(info: ClusterInfo) -> list:
     return _impl(info.provider).get_command_runners(info)
+
+
+def query_ports(provider: str, cluster_name: str) -> dict:
+    """{service port: "host:port"} for providers with explicit port
+    exposure (kubernetes NodePort Services); {} elsewhere — VM/local
+    providers serve directly on the host address."""
+    fn = getattr(_impl(provider), "query_ports", None)
+    return fn(cluster_name) if fn else {}

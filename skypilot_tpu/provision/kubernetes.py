@@ -163,10 +163,110 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
                     f"kubectl apply failed for "
                     f"{manifest['metadata']['name']}: {out.strip()}")
             created.append(manifest["metadata"]["name"])
+    if config.ports:
+        open_ports(config.cluster_name, config.ports)
     return ProvisionRecord(provider="kubernetes",
                            cluster_name=config.cluster_name,
                            zone=config.zone,
                            created_instance_ids=created)
+
+
+# -- networking --------------------------------------------------------------
+#
+# Reference parity: sky/provision/kubernetes/network.py (open_ports /
+# query_ports / cleanup_ports; LoadBalancer vs ingress modes). Here a
+# NodePort Service on the HEAD pod is the portable default (works on
+# GKE and kind alike, no ingress controller prerequisite);
+# port_forward_command covers clusters whose nodes have no reachable
+# address.
+
+def _service_name(cluster_name: str) -> str:
+    return f"{cluster_name}-skytpu-svc"
+
+
+def service_manifest(cluster_name: str, ports: List[int]) -> Dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": _service_name(cluster_name),
+                     "labels": {LABEL: cluster_name}},
+        "spec": {
+            "type": "NodePort",
+            "selector": {LABEL: cluster_name,
+                         NODE_LABEL: "0", WORKER_LABEL: "0"},
+            "ports": [{"name": f"p{p}", "port": int(p),
+                       "targetPort": int(p), "protocol": "TCP"}
+                      for p in ports],
+        },
+    }
+
+
+def open_ports(cluster_name: str, ports: List[int]) -> None:
+    manifest = service_manifest(cluster_name, ports)
+    rc, out = _run(["apply", "-f", "-"], stdin=json.dumps(manifest))
+    if rc != 0:
+        raise exceptions.ProvisionError(
+            f"kubectl apply (service) failed: {out.strip()}")
+
+
+def cleanup_ports(cluster_name: str) -> None:
+    _run(["delete", "service", _service_name(cluster_name),
+          "--ignore-not-found", "--wait=false"])
+
+
+def _json_from(out: str) -> Optional[Dict]:
+    start = out.find("{")
+    return json.loads(out[start:]) if start >= 0 else None
+
+
+def _get_service(cluster_name: str) -> Optional[Dict]:
+    rc, out = _run(["get", "service", _service_name(cluster_name),
+                    "-o", "json"])
+    return _json_from(out) if rc == 0 else None
+
+
+def _node_address() -> Optional[str]:
+    """A reachable node address: any node's ExternalIP first (NodePorts
+    open on EVERY node, and the first-listed node — often a
+    control-plane or private-pool node — may have none), else any
+    InternalIP."""
+    rc, out = _run(["get", "nodes", "-o", "json"])
+    doc = _json_from(out) if rc == 0 else None
+    if not doc or not doc.get("items"):
+        return None
+    internal = None
+    for node in doc["items"]:
+        for a in node.get("status", {}).get("addresses", []):
+            if a.get("type") == "ExternalIP" and a.get("address"):
+                return a["address"]
+            if a.get("type") == "InternalIP" and a.get("address"):
+                internal = internal or a["address"]
+    return internal
+
+
+def query_ports(cluster_name: str) -> Dict[int, str]:
+    """{service port: "host:node_port"} for the cluster's Service."""
+    svc = _get_service(cluster_name)
+    if svc is None:
+        return {}
+    host = _node_address()
+    if host is None:
+        return {}
+    out: Dict[int, str] = {}
+    for p in svc.get("spec", {}).get("ports", []):
+        node_port = p.get("nodePort")
+        if node_port:
+            out[int(p["port"])] = f"{host}:{node_port}"
+    return out
+
+
+def port_forward_command(cluster_name: str, port: int,
+                         local_port: Optional[int] = None) -> str:
+    """For clusters whose nodes have no reachable address (laptops,
+    private GKE): the kubectl tunnel that exposes the Service port."""
+    return (f"{_kubectl()} port-forward service/"
+            f"{_service_name(cluster_name)} "
+            f"{local_port or port}:{port}")
 
 
 def stop_instances(cluster_name: str, zone: str) -> None:
@@ -175,6 +275,7 @@ def stop_instances(cluster_name: str, zone: str) -> None:
 
 
 def terminate_instances(cluster_name: str, zone: str) -> None:
+    cleanup_ports(cluster_name)
     rc, out = _run(["delete", "pods", "-l", f"{LABEL}={cluster_name}",
                     "--ignore-not-found", "--wait=false"])
     if rc != 0:
@@ -188,10 +289,10 @@ def _get_pods(cluster_name: str) -> List[Dict]:
     if rc != 0:
         raise exceptions.ProvisionError(
             f"kubectl get pods failed: {out.strip()}")
-    # kubectl may append warnings after the JSON on stderr; find the
-    # JSON object in the combined stream.
-    start = out.find("{")
-    return json.loads(out[start:])["items"] if start >= 0 else []
+    # kubectl may append warnings after the JSON on stderr; _json_from
+    # finds the JSON object in the combined stream.
+    doc = _json_from(out)
+    return doc["items"] if doc else []
 
 
 def query_instances(cluster_name: str, zone: str) -> str:
@@ -234,6 +335,10 @@ def get_cluster_info(cluster_name: str, zone: str) -> ClusterInfo:
     info = ClusterInfo(cluster_name=cluster_name, provider="kubernetes",
                        zone=zone, hosts=hosts)
     info.metadata["pod_names"] = [p["metadata"]["name"] for p in pods]
+    # Port endpoints are NOT resolved here: get_cluster_info runs on
+    # every exec/setup path and most callers don't need them — the
+    # dispatcher-level provision.query_ports serves the consumers that
+    # do (serve's replica URLs).
     return info
 
 

@@ -26,6 +26,36 @@ from skypilot_tpu.task import Task
 PROBE_FAILURES_BEFORE_NOT_READY = 3
 
 
+def _apply_resource_overrides(task_config: dict,
+                              use_spot: Optional[bool],
+                              port: int) -> dict:
+    """Per-replica resource rewrites: the mixed-fleet spot override and
+    the replica port (so providers with explicit port exposure —
+    kubernetes NodePort Services — open it at provision time). The
+    schema allows scalar/string port forms; normalize to ints before
+    merging or sorted() raises mid-launch and the replica FAILs."""
+    task_config = dict(task_config)
+    res = task_config.get("resources") or {}
+
+    def override(r: dict) -> dict:
+        r = dict(r)
+        if use_spot is not None:
+            r["use_spot"] = use_spot
+        raw = r.get("ports")
+        if raw is None:
+            raw = []
+        elif not isinstance(raw, (list, tuple)):
+            raw = [raw]
+        ports = {int(p) for p in raw}
+        ports.add(int(port))
+        r["ports"] = sorted(ports)
+        return r
+
+    task_config["resources"] = ([override(r) for r in res]
+                                if isinstance(res, list) else override(res))
+    return task_config
+
+
 class ReplicaManager:
     def __init__(self, service_name: str, spec: SkyServiceSpec,
                  task_config: dict, version: int = 1):
@@ -138,14 +168,8 @@ class ReplicaManager:
                                  version: int, task_config: dict,
                                  use_spot: Optional[bool] = None) -> None:
         try:
-            if use_spot is not None:
-                task_config = dict(task_config)
-                res = task_config.get("resources") or {}
-                if isinstance(res, list):
-                    res = [dict(r, use_spot=use_spot) for r in res]
-                else:
-                    res = dict(res, use_spot=use_spot)
-                task_config["resources"] = res
+            task_config = _apply_resource_overrides(
+                task_config, use_spot, self._port(rid))
             task = Task.from_yaml_config(task_config)
             task.update_envs({"SKYTPU_REPLICA_ID": str(rid),
                               "SKYTPU_REPLICA_PORT": str(self._port(rid))})
@@ -190,10 +214,18 @@ class ReplicaManager:
 
     def _replica_url(self, handle: ClusterHandle, rid: int) -> str:
         from skypilot_tpu import provision
+        port = self._port(rid)
+        # Providers with explicit port exposure (kubernetes NodePort
+        # Service) publish remapped endpoints; pod/VM addresses
+        # otherwise.
+        ep = provision.query_ports(handle.provider,
+                                   handle.cluster_name).get(port)
+        if ep:
+            return f"http://{ep}"
         info = provision.get_cluster_info(handle.provider,
                                           handle.cluster_name, handle.zone)
         ip = info.head.external_ip or info.head.internal_ip
-        return f"http://{ip}:{self._port(rid)}"
+        return f"http://{ip}:{port}"
 
     def _terminate_replica(self, rid: int) -> None:
         serve_state.set_replica_status(self.service, rid,

@@ -22,16 +22,41 @@ def fake_kubectl(tmp_path, monkeypatch):
     record = tmp_path / "calls.jsonl"
     pods_file = tmp_path / "pods.json"
     pods_file.write_text(json.dumps({"items": []}))
+    svc_file = tmp_path / "svc.json"
+    nodes_file = tmp_path / "nodes.json"
+    nodes_file.write_text(json.dumps({"items": [
+        {"status": {"addresses": [
+            {"type": "InternalIP", "address": "10.9.0.1"},
+            {"type": "ExternalIP", "address": "34.9.0.1"}]}}]}))
     shim = tmp_path / "kubectl"
     shim.write_text(textwrap.dedent(f"""\
         #!/usr/bin/env python3
-        import json, sys
+        import json, os, sys
         stdin = sys.stdin.read() if not sys.stdin.isatty() else ""
         with open({str(record)!r}, "a") as f:
             f.write(json.dumps({{"argv": sys.argv[1:], "stdin": stdin}})
                     + "\\n")
-        if sys.argv[1:3] == ["get", "pods"]:
+        argv = sys.argv[1:]
+        if argv[:2] == ["get", "pods"]:
             print(open({str(pods_file)!r}).read())
+        elif argv[:2] == ["get", "nodes"]:
+            print(open({str(nodes_file)!r}).read())
+        elif argv[:2] == ["get", "service"]:
+            if not os.path.exists({str(svc_file)!r}):
+                print("not found", file=sys.stderr)
+                sys.exit(1)
+            print(open({str(svc_file)!r}).read())
+        elif argv[0] == "apply" and '"kind": "Service"' in stdin:
+            # A minimal API server: applying a NodePort Service
+            # allocates node ports.
+            svc = json.loads(stdin)
+            for i, p in enumerate(svc["spec"]["ports"]):
+                p.setdefault("nodePort", 30000 + i)
+            with open({str(svc_file)!r}, "w") as f:
+                json.dump(svc, f)
+        elif argv[:2] == ["delete", "service"]:
+            if os.path.exists({str(svc_file)!r}):
+                os.unlink({str(svc_file)!r})
         """))
     shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
     monkeypatch.setenv("SKYTPU_KUBECTL", str(shim))
@@ -44,6 +69,10 @@ def fake_kubectl(tmp_path, monkeypatch):
 
         def set_pods(self, items):
             pods_file.write_text(json.dumps({"items": items}))
+
+        def service(self):
+            return (json.loads(svc_file.read_text())
+                    if svc_file.exists() else None)
 
     return Ctl()
 
@@ -123,7 +152,9 @@ def test_terminate_and_stop(fake_kubectl):
     k8s.terminate_instances("kt", "z")
     deletes = [c for c in fake_kubectl.calls()
                if c["argv"][0] == "delete"]
-    assert deletes and f"{k8s.LABEL}=kt" in deletes[0]["argv"]
+    # terminate removes the Service (port cleanup) AND the pods.
+    assert any(f"{k8s.LABEL}=kt" in c["argv"] for c in deletes)
+    assert any("service" in c["argv"] for c in deletes)
     with pytest.raises(exceptions.NotSupportedError):
         k8s.stop_instances("kt", "z")
 
@@ -139,3 +170,98 @@ def test_feature_negotiation_registry():
                               Feature.HOST_CONTROLLERS)
     assert provision.supports("gcp", Feature.MULTI_NODE_EXEC)
     assert provision.supports("local", Feature.STOP)
+
+
+# -- networking: NodePort Service exposure ----------------------------------
+
+def test_ports_create_nodeport_service(fake_kubectl):
+    k8s.run_instances(_cfg(ports=[8080, 9000]))
+    svc = fake_kubectl.service()
+    assert svc is not None
+    assert svc["spec"]["type"] == "NodePort"
+    assert svc["spec"]["selector"] == {
+        k8s.LABEL: "kt", k8s.NODE_LABEL: "0", k8s.WORKER_LABEL: "0"}
+    assert [p["port"] for p in svc["spec"]["ports"]] == [8080, 9000]
+
+
+def test_query_ports_maps_node_address(fake_kubectl):
+    k8s.run_instances(_cfg(ports=[8080]))
+    eps = k8s.query_ports("kt")
+    # The fake API allocates nodePort 30000; node ExternalIP preferred.
+    assert eps == {8080: "34.9.0.1:30000"}
+
+
+def test_dispatcher_query_ports(fake_kubectl):
+    """provision.query_ports routes to the k8s provider; providers
+    without port exposure answer {} without a provider call."""
+    from skypilot_tpu import provision
+    k8s.run_instances(_cfg(ports=[8080]))
+    assert provision.query_ports("kubernetes", "kt") == \
+        {8080: "34.9.0.1:30000"}
+    assert provision.query_ports("local", "whatever") == {}
+
+
+def test_terminate_cleans_up_service(fake_kubectl):
+    k8s.run_instances(_cfg(ports=[8080]))
+    assert fake_kubectl.service() is not None
+    k8s.terminate_instances("kt", "us-central2-b")
+    assert fake_kubectl.service() is None
+    assert k8s.query_ports("kt") == {}
+
+
+def test_no_service_without_ports(fake_kubectl):
+    k8s.run_instances(_cfg())
+    assert fake_kubectl.service() is None
+    fake_kubectl.set_pods([_pod_item("kt-0-0", 0, 0)])
+    info = k8s.get_cluster_info("kt", "us-central2-b")
+    assert "port_endpoints" not in info.metadata
+
+
+def test_port_forward_command(fake_kubectl):
+    cmd = k8s.port_forward_command("kt", 8080, local_port=18080)
+    assert "port-forward" in cmd
+    assert "service/kt-skytpu-svc" in cmd
+    assert "18080:8080" in cmd
+
+
+def test_replica_url_prefers_port_endpoints(monkeypatch, tmp_path):
+    """serve's replica URL uses the NodePort endpoint when the provider
+    publishes one (pod IPs are cluster-internal)."""
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "h"))
+    from skypilot_tpu import provision
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+    monkeypatch.setattr(
+        provision, "query_ports",
+        lambda provider, name: {8080: "34.9.0.1:30123"}
+        if provider == "kubernetes" else {})
+    spec = SkyServiceSpec.from_yaml_config({"readiness_probe": "/",
+                                            "port": 8080, "replicas": 1})
+    mgr = replica_managers.ReplicaManager(
+        "s", spec, {"resources": {"cloud": "kubernetes"}})
+    from skypilot_tpu.backend import ClusterHandle
+    handle = ClusterHandle({"cluster_name": "c", "provider": "kubernetes",
+                            "zone": "z"})
+    assert mgr._replica_url(handle, 1) == "http://34.9.0.1:30123"
+
+
+def test_replica_port_override_normalizes_forms():
+    """The schema allows ports as string/scalar forms; the replica
+    override must not crash on them (a TypeError here silently FAILs
+    every replica)."""
+    from skypilot_tpu.serve.replica_managers import \
+        _apply_resource_overrides
+    for raw in (["8080"], "8080", 8080, None, [8080, "8081"]):
+        cfg = _apply_resource_overrides(
+            {"resources": {"cloud": "local", "ports": raw}},
+            use_spot=None, port=9001)
+        ports = cfg["resources"]["ports"]
+        assert 9001 in ports
+        assert all(isinstance(p, int) for p in ports)
+    # List-of-resources form + spot override compose.
+    cfg = _apply_resource_overrides(
+        {"resources": [{"cloud": "local"}, {"cloud": "gcp"}]},
+        use_spot=True, port=8080)
+    assert all(r["use_spot"] and r["ports"] == [8080]
+               for r in cfg["resources"])
