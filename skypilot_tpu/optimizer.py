@@ -3,9 +3,10 @@ re-optimization for the failover loop.
 
 Reference parity: sky/optimizer.py (Optimizer:68 — optimize:106, chain DP
 :408, candidate fill :1252). The reference also carries a PuLP ILP for
-general DAGs (:469); since only chains are executable end-to-end there
-(execution.py:188), this build implements the chain DP exactly and keeps
-the general-DAG hook as a TODO rather than an unused ILP dependency.
+general DAGs (:469); this build plans general DAGs natively — exact DP
+on trees/forests, monotone coordinate descent on multi-parent graphs —
+with COST as total spend and TIME as end-to-end makespan (only chains
+are executable end-to-end in the reference, execution.py:188).
 """
 
 from __future__ import annotations
@@ -127,61 +128,138 @@ def optimize(dag: dag_lib.Dag,
              minimize: OptimizeTarget = OptimizeTarget.COST,
              blocked_resources: Optional[BlockedSet] = None,
              quiet: bool = True) -> Dict[Task, Resources]:
-    """Pick one launchable Resources per task, minimizing total cost/time.
+    """Pick one launchable Resources per task.
 
-    Chain DAGs get an exact DP over (task, candidate) states with egress
-    terms on the edges; a bare task set degenerates to per-task argmin.
+    COST minimizes total spend (node costs + egress); TIME minimizes
+    end-to-end makespan (longest node+edge path; for a chain that is
+    the plain sum). Exact on chains/trees/forests via DP; multi-parent
+    DAGs refine by coordinate descent (see :func:`optimize_dag` — the
+    reference reaches for a PuLP ILP there, sky/optimizer.py:469, but
+    only ever executes chains, execution.py:188).
     """
-    blocked = blocked_resources or set()
-    if not dag.is_chain():
-        raise exceptions.InvalidTaskError(
-            "only chain DAGs are supported (matches the reference's "
-            "executable surface, sky/execution.py:188)")
+    return optimize_dag(dag, minimize, blocked_resources, quiet)
 
+
+def optimize_dag(dag: dag_lib.Dag,
+                 minimize: OptimizeTarget = OptimizeTarget.COST,
+                 blocked: Optional[BlockedSet] = None,
+                 quiet: bool = True) -> Dict[Task, Resources]:
+    """General-DAG planner.
+
+    Objectives: COST = sum of node costs + egress edges; TIME =
+    MAKESPAN — the longest node+edge path, i.e. when the pipeline's
+    last task finishes with branches running in parallel (matching the
+    reference ILP's per-node finish-time model, sky/optimizer.py:469;
+    a naive branch-time SUM would prefer plans that finish later).
+
+    Exactness tiers:
+
+    1. Forest (every task has <= 1 parent): exact bottom-up tree DP —
+       best[v][j] = node(v,j) COMBINE over children c of
+       min_k(edge(v_j, c_k) + best[c][k]), where COMBINE is sum for
+       COST and max for TIME. Covers chains and fan-out pipelines.
+    2. Multi-parent DAGs: per-task argmin init, then topological
+       coordinate-descent sweeps re-choosing each task against the
+       full objective until no sweep improves (monotone, converges;
+       exact on the overwhelming egress-free case, a documented
+       heuristic otherwise).
+    """
+    import networkx as nx
+    blocked = blocked or set()
+    g = dag.graph
+    if not nx.is_directed_acyclic_graph(g):
+        raise exceptions.InvalidTaskError("task graph has a cycle")
     order = dag.topological_order()
     if not order:
         return {}
-
     per_task = {t: _candidates_for(t, blocked) for t in order}
-    if minimize is OptimizeTarget.COST:
+    is_cost = minimize is OptimizeTarget.COST
+    if is_cost:
         key = lambda c: c.cost
         edge_fn = _egress_cost
     else:
         key = lambda c: c.time_s
         edge_fn = _egress_time
 
-    # DP over the chain: best[i][j] = min objective ending at task i using
-    # candidate j, including egress from the chosen parent candidate.
-    best: List[List[float]] = []
-    back: List[List[int]] = []
-    for i, t in enumerate(order):
-        cands = per_task[t]
-        row, brow = [], []
-        for j, c in enumerate(cands):
-            if i == 0:
-                row.append(key(c))
-                brow.append(-1)
-                continue
-            prev_cands = per_task[order[i - 1]]
-            edge_gb = _edge_gigabytes(order[i - 1])
-            best_val, best_k = None, -1
-            for k, pc in enumerate(prev_cands):
-                egress = edge_fn(pc.resources, c.resources, edge_gb)
-                v = best[i - 1][k] + key(c) + egress
-                if best_val is None or v < best_val:
-                    best_val, best_k = v, k
-            row.append(best_val)
-            brow.append(best_k)
-        best.append(row)
-        back.append(brow)
+    def edge(u, cu, v, cv):
+        return edge_fn(cu.resources, cv.resources, _edge_gigabytes(u))
 
-    # Trace back the argmin path.
-    plan: Dict[Task, Resources] = {}
-    j = min(range(len(best[-1])), key=lambda j: best[-1][j])
-    for i in range(len(order) - 1, -1, -1):
-        plan[order[i]] = per_task[order[i]][j].resources
-        j = back[i][j]
+    if all(g.in_degree(v) <= 1 for v in order):
+        # Exact tree DP, leaves up. Children combine by SUM for COST
+        # (spend adds across branches) and MAX for TIME (branches run
+        # in parallel; the slowest one sets the finish).
+        best: Dict[Task, List[float]] = {}
+        pick: Dict[Task, List[Dict[Task, int]]] = {}
+        for v in reversed(order):
+            cands = per_task[v]
+            best[v] = []
+            pick[v] = []
+            for j, c in enumerate(cands):
+                node = key(c)
+                branch = 0.0
+                child_pick: Dict[Task, int] = {}
+                for w in g.successors(v):
+                    k = min(range(len(per_task[w])),
+                            key=lambda k: edge(v, c, w, per_task[w][k])
+                            + best[w][k])
+                    val = edge(v, c, w, per_task[w][k]) + best[w][k]
+                    branch = branch + val if is_cost else max(branch, val)
+                    child_pick[w] = k
+                best[v].append(node + branch)
+                pick[v].append(child_pick)
+        plan_idx: Dict[Task, int] = {}
+        for r in order:
+            if g.in_degree(r) == 0:
+                # Roots are independent: per-root argmin minimizes both
+                # the sum (COST) and the max (TIME) across roots.
+                plan_idx[r] = min(range(len(per_task[r])),
+                                  key=lambda j: best[r][j])
+        for v in order:  # propagate picks down the tree
+            for w, k in pick[v][plan_idx[v]].items():
+                plan_idx[w] = k
+    else:
+        # Coordinate descent from the per-task argmin, re-choosing each
+        # task against the FULL objective (required for makespan, whose
+        # value is not a local sum; graphs here are small).
+        plan_idx = {t: min(range(len(per_task[t])),
+                           key=lambda j: key(per_task[t][j]))
+                    for t in order}
 
+        def objective(idx):
+            if is_cost:
+                total = sum(key(per_task[t][idx[t]]) for t in order)
+                for u, v in g.edges:
+                    total += edge(u, per_task[u][idx[u]],
+                                  v, per_task[v][idx[v]])
+                return total
+            finish: Dict[Task, float] = {}
+            for t in order:   # topological
+                start = 0.0
+                for u in g.predecessors(t):
+                    start = max(start, finish[u]
+                                + edge(u, per_task[u][idx[u]],
+                                       t, per_task[t][idx[t]]))
+                finish[t] = start + key(per_task[t][idx[t]])
+            return max(finish.values())
+
+        for _ in range(len(order) + 2):   # each sweep is monotone
+            improved = False
+            for t in order:
+                cur = objective(plan_idx)
+                for j in range(len(per_task[t])):
+                    if j == plan_idx[t]:
+                        continue
+                    trial = dict(plan_idx)
+                    trial[t] = j
+                    val = objective(trial)
+                    if val < cur - 1e-12:
+                        plan_idx[t] = j
+                        cur = val
+                        improved = True
+            if not improved:
+                break
+
+    plan = {t: per_task[t][plan_idx[t]].resources for t in order}
     if not quiet:
         _print_plan(order, per_task, plan)
     return plan

@@ -106,6 +106,134 @@ def test_chain_dag_prefers_same_region():
     assert plan[a].cloud == plan[b].cloud == "gcp"
 
 
+def _plan_cost(d, plan):
+    """Objective value of a plan (node costs + egress edges), using the
+    optimizer's own terms."""
+    total = 0.0
+    for t in d.tasks:
+        total += plan[t].get_cost(optimizer.DEFAULT_RUNTIME_ESTIMATE_S)
+    for u, v in d.graph.edges:
+        total += optimizer._egress_cost(plan[u], plan[v],
+                                        u.estimated_outputs_gb or 0.0)
+    return total
+
+
+def _brute_force(d, blocked=None):
+    """Exact reference: enumerate every candidate assignment."""
+    import itertools
+    order = d.topological_order()
+    per = {t: [c.resources for c in
+               optimizer._candidates_for(t, blocked or set())]
+           for t in order}
+    best, best_plan = None, None
+    for combo in itertools.product(*(per[t] for t in order)):
+        plan = dict(zip(order, combo))
+        cost = _plan_cost(d, plan)
+        if best is None or cost < best:
+            best, best_plan = cost, plan
+    return best, best_plan
+
+
+def _cpu_task(name, outputs_gb=None):
+    t = Task(name=name)
+    t.set_resources(Resources(instance_type="n2-standard-8"))
+    if outputs_gb:
+        t.estimated_outputs_gb = outputs_gb
+    return t
+
+
+def test_fanout_tree_dag_is_exact():
+    """Fan-out (1 root -> 2 children) is no longer rejected; the tree
+    DP matches the brute-force optimum, co-locating children with the
+    root when egress dominates."""
+    root = _cpu_task("root", outputs_gb=500.0)
+    kids = [_cpu_task("k1"), _cpu_task("k2")]
+    d = dag_lib.Dag()
+    for k in kids:
+        d.add_edge(root, k)
+    plan = optimizer.optimize(d)
+    want_cost, _ = _brute_force(d)
+    assert abs(_plan_cost(d, plan) - want_cost) < 1e-9
+    assert plan[kids[0]].region == plan[root].region
+    assert plan[kids[1]].region == plan[root].region
+
+
+def test_diamond_dag_refines_to_optimum():
+    """Multi-parent diamond (A -> B,C -> D): coordinate descent finds
+    the brute-force optimum on this instance."""
+    a = _cpu_task("a", outputs_gb=200.0)
+    b = _cpu_task("b", outputs_gb=200.0)
+    c = _cpu_task("c", outputs_gb=200.0)
+    dd = _cpu_task("d")
+    d = dag_lib.Dag()
+    d.add_edge(a, b)
+    d.add_edge(a, c)
+    d.add_edge(b, dd)
+    d.add_edge(c, dd)
+    plan = optimizer.optimize(d)
+    want_cost, _ = _brute_force(d)
+    assert abs(_plan_cost(d, plan) - want_cost) < 1e-9
+    regions = {plan[t].region for t in (a, b, c, dd)}
+    assert len(regions) == 1  # egress dominates -> co-located
+
+
+def test_general_dag_without_egress_is_per_task_argmin():
+    a, b, c = _cpu_task("a"), _cpu_task("b"), _cpu_task("c")
+    d = dag_lib.Dag()
+    d.add_edge(a, c)
+    d.add_edge(b, c)
+    plan = optimizer.optimize(d)
+    for t in (a, b, c):
+        solo = optimizer.optimize_task(t)
+        assert plan[t].price == solo.price
+
+
+def test_time_target_minimizes_makespan_not_sum():
+    """Fan-out under TIME: branches run in parallel, so the plan must
+    minimize the longest branch (makespan), not the branch-time sum.
+    Cross-region edges are prohibitive, so children follow the root:
+    root@r1 gives branch times (10, 300) — sum 310, makespan 300;
+    root@r2 gives (155, 160) — sum 315, makespan 160. A sum objective
+    picks r1 and finishes 140s later."""
+    import unittest.mock as mock
+    root, a, b = _cpu_task("root"), _cpu_task("a"), _cpu_task("b")
+    d = dag_lib.Dag()
+    d.add_edge(root, a)
+    d.add_edge(root, b)
+
+    times = {("root", "r1"): 1.0, ("root", "r2"): 1.0,
+             ("a", "r1"): 10.0, ("a", "r2"): 155.0,
+             ("b", "r1"): 300.0, ("b", "r2"): 160.0}
+
+    def fake_cands(t, blocked):
+        out = []
+        for region in ("r1", "r2"):
+            res = Resources(instance_type="n2-standard-8")
+            object.__setattr__(res, "region", region)
+            object.__setattr__(res, "zone", region + "-a")
+            out.append(optimizer.Candidate(
+                res, cost=1.0, time_s=times[(t.name, region)]))
+        return out
+
+    def cross_region_edge(ra, rb, gb):
+        return 0.0 if ra.region == rb.region else 1e6
+
+    with mock.patch.object(optimizer, "_candidates_for",
+                           side_effect=fake_cands), \
+         mock.patch.object(optimizer, "_egress_time",
+                           cross_region_edge):
+        plan = optimizer.optimize(
+            d, minimize=optimizer.OptimizeTarget.TIME)
+    assert plan[root].region == "r2"
+    assert plan[a].region == "r2" and plan[b].region == "r2"
+    a, b = _cpu_task("a"), _cpu_task("b")
+    d = dag_lib.Dag()
+    d.add_edge(a, b)
+    d.add_edge(b, a)
+    with pytest.raises(exceptions.InvalidTaskError):
+        optimizer.optimize(d)
+
+
 def test_resources_yaml_roundtrip():
     r = Resources.from_yaml_config({
         "accelerators": "tpu-v5p-16", "use_spot": True,
