@@ -31,7 +31,7 @@ REF_TOK_S = 2147.98
 
 def run(config=None, requests=16, slots=16, prompt_len=96,
         new_tokens=64, max_burst=32, kv_int8=False,
-        weights_int8=False) -> dict:
+        weights_int8=False, admit_wave=None) -> dict:
     """Run the serving benchmark; returns the metrics dict (also usable
     by the repo-root bench.py to fold serving numbers into its single
     JSON artifact)."""
@@ -42,7 +42,7 @@ def run(config=None, requests=16, slots=16, prompt_len=96,
     if config is None:
         config = "llama3-tiny" if on_cpu else "llama3-400m"
     cfg, e = _build_engine(config, slots, prompt_len, new_tokens,
-                           kv_int8, weights_int8)
+                           kv_int8, weights_int8, max_wave=admit_wave)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
                for _ in range(requests)]
@@ -83,7 +83,7 @@ def run(config=None, requests=16, slots=16, prompt_len=96,
 
 
 def _build_engine(config, slots, prompt_len, new_tokens, kv_int8,
-                  weights_int8):
+                  weights_int8, max_wave=None):
     import jax
 
     from skypilot_tpu.infer import engine as eng
@@ -99,16 +99,18 @@ def _build_engine(config, slots, prompt_len, new_tokens, kv_int8,
         params, qw = kvcache.random_quantized_params(cfg)
         return cfg, eng.InferenceEngine(
             params, cfg, n_slots=slots, max_len=max_len,
-            prompt_buckets=(prompt_len,), kv_int8=kv_int8, qweights=qw)
+            prompt_buckets=(prompt_len,), kv_int8=kv_int8, qweights=qw,
+            max_wave=max_wave)
     params = llama.init_params(jax.random.key(0), cfg)
     return cfg, eng.InferenceEngine(
         params, cfg, n_slots=slots, max_len=max_len,
-        prompt_buckets=(prompt_len,), kv_int8=kv_int8)
+        prompt_buckets=(prompt_len,), kv_int8=kv_int8,
+        max_wave=max_wave)
 
 
 def run_http(config=None, requests=16, slots=16, prompt_len=96,
              new_tokens=64, max_burst=8, kv_int8=False,
-             weights_int8=False) -> dict:
+             weights_int8=False, admit_wave=None) -> dict:
     """End-to-end streaming bench: requests go over HTTP through a REAL
     load balancer to the model server, and TTFT is the wall time to the
     FIRST STREAMED BYTE of each response — the JetStream comparison
@@ -136,7 +138,8 @@ def run_http(config=None, requests=16, slots=16, prompt_len=96,
     from skypilot_tpu.serve.serve_state import ReplicaStatus
 
     cfg, engine = _build_engine(config, slots, prompt_len, new_tokens,
-                                kv_int8, weights_int8)
+                                kv_int8, weights_int8,
+                                max_wave=admit_wave)
 
     def free_port():
         with socket.socket() as s:
@@ -247,6 +250,10 @@ def main() -> None:
     ap.add_argument("--max-burst", type=int, default=32)
     ap.add_argument("--kv-int8", action="store_true")
     ap.add_argument("--weights-int8", action="store_true")
+    ap.add_argument("--admit-wave", type=int, default=None,
+                    help="cap admission waves: early waves' first "
+                         "tokens stream (HTTP) / stamp TTFT (engine) "
+                         "while later waves prefill")
     ap.add_argument("--engine-only", action="store_true",
                     help="bench the engine directly (no HTTP/LB; "
                          "engine-internal TTFT)")
@@ -255,13 +262,15 @@ def main() -> None:
         r = run(config=args.config, requests=args.requests,
                 slots=args.slots, prompt_len=args.prompt_len,
                 new_tokens=args.new_tokens, max_burst=args.max_burst,
-                kv_int8=args.kv_int8, weights_int8=args.weights_int8)
+                kv_int8=args.kv_int8, weights_int8=args.weights_int8,
+                admit_wave=args.admit_wave)
     else:
         r = run_http(config=args.config, requests=args.requests,
                      slots=args.slots, prompt_len=args.prompt_len,
                      new_tokens=args.new_tokens,
                      max_burst=args.max_burst, kv_int8=args.kv_int8,
-                     weights_int8=args.weights_int8)
+                     weights_int8=args.weights_int8,
+                     admit_wave=args.admit_wave)
     out = {
         "metric": "serve_median_ttft",
         "value": r["median_ttft_ms"],

@@ -61,12 +61,20 @@ class InferenceEngine:
                  sampling_params: sampling.SamplingParams = sampling.SamplingParams(),
                  eos_id: Optional[int] = None, seed: int = 0,
                  kv_int8: bool = False, weights_int8: bool = False,
-                 qweights=None):
+                 qweights=None, max_wave: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
         self.max_len = max_len
         self.buckets = tuple(b for b in prompt_buckets if b <= max_len)
+        # Admission wave cap: a burst of N requests prefills as
+        # ceil(N/max_wave) device calls instead of one. Each wave's
+        # first tokens can then stream out (step_burst's on_wave hook)
+        # while later waves are still prefilling — early requests'
+        # TTFT stops paying for the whole burst's prefill.
+        # <= 0 means uncapped (a 0 cap would otherwise spin _admit
+        # forever on empty waves).
+        self.max_wave = max_wave if max_wave and max_wave > 0 else None
         self.sampling_params = sampling_params
         self.eos_id = eos_id
         # One hidden spare slot (index n_slots): batched admission pads
@@ -170,18 +178,21 @@ class InferenceEngine:
         self.waiting.append(req)
         return req.rid
 
-    def _admit(self) -> None:
+    def _admit(self, on_wave=None) -> None:
         # Waves are grouped by prompt bucket (prefill is O(S^2): one
         # long prompt must not drag every co-admitted short prompt up
-        # to its bucket), then padded to the next power-of-two row
-        # count (dummy rows -> spare slot) so each (bucket, rows) pair
-        # compiles exactly once.
+        # to its bucket) and capped at max_wave, then padded to the
+        # next power-of-two row count (dummy rows -> spare slot) so
+        # each (bucket, rows) pair compiles exactly once. ``on_wave``
+        # fires after each wave lands — the server streams that wave's
+        # first tokens before the next wave's prefill.
         while self.waiting and self.free_slots:
             bucket = _bucket(len(self.waiting[0].prompt), self.buckets)
             wave: List[Request] = []
             slots: List[int] = []
             rest: List[Request] = []
-            while self.waiting and self.free_slots:
+            while self.waiting and self.free_slots and \
+                    (self.max_wave is None or len(wave) < self.max_wave):
                 req = self.waiting.pop(0)
                 if _bucket(len(req.prompt), self.buckets) == bucket:
                     wave.append(req)
@@ -190,6 +201,8 @@ class InferenceEngine:
                     rest.append(req)
             self.waiting = rest + self.waiting
             self._admit_wave(wave, slots, bucket)
+            if on_wave is not None:
+                on_wave()
 
     def _admit_wave(self, wave: List["Request"], slots: List[int],
                     bucket: int) -> None:
@@ -245,12 +258,14 @@ class InferenceEngine:
         self._admit()
         return self.step_decode_once()
 
-    def step_burst(self, max_burst: int = 8) -> Dict[int, List[int]]:
+    def step_burst(self, max_burst: int = 8,
+                   on_wave=None) -> Dict[int, List[int]]:
         """Admit, then decode up to ``max_burst`` tokens per slot in one
         device call. Tokens past a request's EOS/limit are discarded
         host-side (their cache rows die with the slot). Returns
-        {rid: [tokens...]} emitted this call."""
-        self._admit()
+        {rid: [tokens...]} emitted this call. ``on_wave`` fires after
+        each admission wave (streaming flush hook)."""
+        self._admit(on_wave)
         if not self.slot_req:
             return {}
         # Cap the burst so no active slot's cache can overflow, then

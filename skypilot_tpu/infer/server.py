@@ -164,7 +164,8 @@ class ModelServer:
         self._drain_inbox()
         if not (self.engine.waiting or self.engine.slot_req):
             return False
-        self.engine.step_burst(max_burst=self.max_burst)
+        self.engine.step_burst(max_burst=self.max_burst,
+                               on_wave=self._flush_streams)
         self._flush_streams()
         for req in self.engine.finished:
             p = self._pending.pop(req.rid, None)
@@ -290,6 +291,10 @@ def main() -> None:
     ap.add_argument("--max-burst", type=int, default=8,
                     help="decode tokens per device call (streaming "
                          "granularity vs dispatch amortization)")
+    ap.add_argument("--admit-wave", type=int, default=8,
+                    help="admission wave cap: early waves' first "
+                         "tokens stream while later waves prefill "
+                         "(0 = uncapped)")
     args = ap.parse_args()
 
     import jax
@@ -307,7 +312,8 @@ def main() -> None:
                         args.max_len),
         sampling_params=sampling.SamplingParams(
             temperature=args.temperature),
-        kv_int8=args.kv_int8, weights_int8=args.weights_int8)
+        kv_int8=args.kv_int8, weights_int8=args.weights_int8,
+        max_wave=args.admit_wave)
     # The engine slims its own tree under weights_int8; drop main()'s
     # reference too or the fp block weights stay resident for the whole
     # server lifetime and the memory halving never happens.

@@ -72,6 +72,7 @@ _SLOW_TESTS = {
     "tests/test_infer.py::test_engine_with_tp_sharded_params",
     "tests/test_infer.py::test_incremental_decode_matches_full_forward",
     "tests/test_infer.py::test_mixed_bucket_admission",
+    "tests/test_infer.py::test_max_wave_splits_admission",
     "tests/test_infer.py::test_moe_engine_serves",
     "tests/test_infer.py::test_sampling_temperature_valid",
     "tests/test_infer.py::test_weights_int8_composes_with_kv_int8",
