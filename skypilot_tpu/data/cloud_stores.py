@@ -97,6 +97,42 @@ class S3CloudStorage(CloudStorage):
             self.make_sync_dir_command(source, destination))
 
 
+class R2CloudStorage(S3CloudStorage):
+    """r2:// via the aws CLI against the Cloudflare R2 endpoint.
+
+    The endpoint/profile are baked into the generated command (built
+    client-side from config); the executing host needs the same aws
+    credentials profile. URLs are rewritten r2:// -> s3:// for the CLI.
+    """
+
+    def _aws(self) -> str:
+        from skypilot_tpu.data import storage as storage_lib
+        return storage_lib.r2_aws_prefix()
+
+    @staticmethod
+    def _s3_url(url: str) -> str:
+        return "s3://" + url.removeprefix("r2://")
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p {dst} && {self._aws()} s3 sync "
+                f"{shlex.quote(self._s3_url(source))} {dst}")
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p $(dirname {dst}) && {self._aws()} s3 cp "
+                f"{shlex.quote(self._s3_url(source))} {dst}")
+
+    def make_sync_auto_command(self, source: str, destination: str) -> str:
+        bucket, _, key = source[len("r2://"):].partition("/")
+        return _probe_then_dispatch(
+            f"{self._aws()} s3api head-object "
+            f"--bucket {shlex.quote(bucket)} --key {shlex.quote(key)}",
+            "not found|404",
+            self.make_sync_file_command(source, destination),
+            self.make_sync_dir_command(source, destination))
+
+
 class HttpCloudStorage(CloudStorage):
     """https:// single-file fetch via curl."""
 
@@ -112,6 +148,7 @@ class HttpCloudStorage(CloudStorage):
 _REGISTRY: Dict[str, CloudStorage] = {
     "gs": GcsCloudStorage(),
     "s3": S3CloudStorage(),
+    "r2": R2CloudStorage(),
     "https": HttpCloudStorage(),
     "http": HttpCloudStorage(),
 }

@@ -68,10 +68,20 @@ GOOFYS_INSTALL_CMD = (
 
 
 def get_s3_mount_cmd(bucket: str, mount_path: str,
-                     only_dir: str | None = None) -> str:
-    """Mount an S3 bucket with goofys (install if missing)."""
+                     only_dir: str | None = None,
+                     endpoint: str | None = None,
+                     profile: str | None = None) -> str:
+    """Mount an S3-API bucket with goofys (install if missing).
+    ``endpoint``/``profile`` cover S3-compatible stores (Cloudflare
+    R2)."""
     bucket = bucket.removeprefix("s3://").split("/", 1)[0]
     target = f"{bucket}:{only_dir}" if only_dir else bucket
+    flags = ""
+    if endpoint:
+        flags += f" --endpoint {shlex.quote(endpoint)}"
+    if profile:
+        flags += f" --profile {shlex.quote(profile)}"
     return (f"({GOOFYS_INSTALL_CMD}) && "
             f"mkdir -p {shlex.quote(mount_path)} && "
-            f"goofys {shlex.quote(target)} {shlex.quote(mount_path)}")
+            f"goofys{flags} {shlex.quote(target)} "
+            f"{shlex.quote(mount_path)}")

@@ -144,8 +144,20 @@ class S3Store(AbstractStore):
 
     SCHEME = "s3"
 
+    def _aws(self) -> str:
+        """The aws CLI invocation prefix (R2 adds endpoint/profile)."""
+        return "aws"
+
+    @property
+    def cli_url(self) -> str:
+        """The URL the aws CLI understands (always s3:// — the r2://
+        scheme is this framework's naming, not the CLI's)."""
+        base = f"s3://{self.name}"
+        return f"{base}/{self.subpath}" if self.subpath else base
+
     def exists(self) -> bool:
-        rc, _ = self._run(f"aws s3api head-bucket --bucket {self.name}")
+        rc, _ = self._run(
+            f"{self._aws()} s3api head-bucket --bucket {self.name}")
         return rc == 0
 
     def create(self, region: Optional[str] = None) -> None:
@@ -153,11 +165,11 @@ class S3Store(AbstractStore):
                f"LocationConstraint={shlex.quote(region)}"
                if region and region != "us-east-1" else "")
         rc, out = self._run(
-            f"aws s3api create-bucket --bucket {self.name}{loc}")
+            f"{self._aws()} s3api create-bucket --bucket {self.name}{loc}")
         if rc != 0 and "alreadyownedbyyou" not in out.lower().replace(
                 " ", ""):
             raise exceptions.StorageError(
-                f"creating s3://{self.name} failed: {out.strip()}")
+                f"creating {self.url} failed: {out.strip()}")
 
     def upload(self, source: str, subpath: str = "") -> None:
         dst = (f"s3://{self.name}/{subpath}" if subpath
@@ -165,20 +177,21 @@ class S3Store(AbstractStore):
         if os.path.isfile(os.path.expanduser(source)):
             # s3 sync requires directory sources (see GcsStore.upload).
             rc, out = self._run(
-                f"aws s3 cp {shlex.quote(source)} {dst}/")
+                f"{self._aws()} s3 cp {shlex.quote(source)} {dst}/")
         else:
             excl = storage_utils.aws_exclude_args(source)
             rc, out = self._run(
-                f"aws s3 sync {excl}{shlex.quote(source)} {dst}")
+                f"{self._aws()} s3 sync {excl}{shlex.quote(source)} {dst}")
         if rc != 0:
             raise exceptions.StorageError(
                 f"upload {source} -> {dst} failed: {out.strip()}")
 
     def delete(self) -> None:
-        rc, out = self._run(f"aws s3 rb s3://{self.name} --force")
+        rc, out = self._run(
+            f"{self._aws()} s3 rb s3://{self.name} --force")
         if rc != 0 and "nosuchbucket" not in out.lower().replace(" ", ""):
             raise exceptions.StorageError(
-                f"deleting s3://{self.name} failed: {out.strip()}")
+                f"deleting {self.url} failed: {out.strip()}")
 
     def mount_command(self, mount_path: str) -> str:
         return mounting_utils.get_s3_mount_cmd(
@@ -187,10 +200,54 @@ class S3Store(AbstractStore):
     def copy_down_command(self, destination: str) -> str:
         dst = shlex.quote(destination)
         return (f"mkdir -p {dst} && "
-                f"aws s3 sync {self.url} {dst}")
+                f"{self._aws()} s3 sync {self.cli_url} {dst}")
 
 
-_STORE_TYPES: Dict[str, type] = {"gs": GcsStore, "s3": S3Store}
+def r2_endpoint() -> str:
+    """Cloudflare R2 endpoint from env R2_ENDPOINT or config
+    ``r2.endpoint`` (https://<account_id>.r2.cloudflarestorage.com)."""
+    from skypilot_tpu import config as config_lib
+    ep = (os.environ.get("R2_ENDPOINT")
+          or config_lib.get_nested(("r2", "endpoint")))
+    if not ep:
+        raise exceptions.StorageError(
+            "r2 storage needs the account endpoint: set R2_ENDPOINT or "
+            "`r2.endpoint` in config "
+            "(https://<account_id>.r2.cloudflarestorage.com)")
+    return ep
+
+
+def r2_profile() -> str:
+    from skypilot_tpu import config as config_lib
+    return config_lib.get_nested(("r2", "profile"), "r2")
+
+
+def r2_aws_prefix() -> str:
+    """The aws-CLI invocation prefix for R2 — single definition shared
+    by the store lifecycle and the host-side fetch command builders."""
+    return (f"aws --endpoint-url {shlex.quote(r2_endpoint())} "
+            f"--profile {shlex.quote(r2_profile())}")
+
+
+class R2Store(S3Store):
+    """Cloudflare R2 bucket: the S3 API behind an account endpoint
+    (reference: R2Store, sky/data/storage.py:3584 — aws CLI with
+    --endpoint-url + a dedicated credentials profile). Hosts that pull
+    r2:// sources need the same profile configured."""
+
+    SCHEME = "r2"
+
+    def _aws(self) -> str:
+        return r2_aws_prefix()
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.get_s3_mount_cmd(
+            self.name, mount_path, only_dir=self.subpath or None,
+            endpoint=r2_endpoint(), profile=r2_profile())
+
+
+_STORE_TYPES: Dict[str, type] = {"gs": GcsStore, "s3": S3Store,
+                                 "r2": R2Store}
 
 
 class Storage:

@@ -237,3 +237,56 @@ def test_storage_yaml_preserves_s3_scheme():
     assert cfg["store"] == "s3"
     st2 = storage.Storage.from_yaml_config(cfg, run=run)
     assert st2.store.SCHEME == "s3"
+
+
+# -- Cloudflare R2 (S3 API + account endpoint) ------------------------------
+
+@pytest.fixture()
+def r2_config(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "h"))
+    monkeypatch.setenv("R2_ENDPOINT",
+                       "https://acct.r2.cloudflarestorage.com")
+
+
+def test_r2_store_lifecycle_commands(r2_config):
+    run = FakeRun()
+    st = storage.R2Store("r2bucket", run=run)
+    st.exists()
+    st.create()
+    st.delete()
+    for cmd in run.cmds:
+        assert "--endpoint-url https://acct.r2.cloudflarestorage.com" \
+            in cmd
+        assert "--profile r2" in cmd
+    # The CLI speaks s3://, never r2://.
+    assert any("s3 rb s3://r2bucket" in c for c in run.cmds)
+
+
+def test_r2_storage_from_url(r2_config, tmp_path):
+    run = FakeRun()
+    st = storage.Storage(source="r2://r2bucket/data", run=run)
+    assert st.store.SCHEME == "r2"
+    assert st.store.url == "r2://r2bucket/data"
+    cmd = st.store.copy_down_command("/dst")
+    assert "s3://r2bucket/data" in cmd and "--endpoint-url" in cmd
+    mount = st.store.mount_command("/mnt")
+    assert "goofys" in mount
+    assert "--endpoint https://acct.r2.cloudflarestorage.com" in mount
+    assert "--profile r2" in mount
+
+
+def test_r2_requires_endpoint(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "h"))
+    monkeypatch.delenv("R2_ENDPOINT", raising=False)
+    st = storage.R2Store("b", run=FakeRun())
+    with pytest.raises(exceptions.StorageError, match="endpoint"):
+        st.exists()
+
+
+def test_r2_cloud_store_commands(r2_config):
+    cs = cloud_stores.get_storage_from_path("r2://bkt/sub/f.txt")
+    f = cs.make_sync_file_command("r2://bkt/sub/f.txt", "/d/f.txt")
+    assert "s3://bkt/sub/f.txt" in f and "--endpoint-url" in f
+    auto = cs.make_sync_auto_command("r2://bkt/sub/name", "/d/name")
+    assert "head-object --bucket bkt --key sub/name" in auto
+    assert "--endpoint-url" in auto
