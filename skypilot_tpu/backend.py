@@ -264,7 +264,7 @@ class TpuVmBackend:
                                           handle.cluster_name, handle.zone)
         runners = provision.get_command_runners(info)
         for dst, src in file_mounts.items():
-            if src.startswith(("gs://", "s3://", "r2://")):
+            if src.startswith(("gs://", "s3://", "r2://", "az://")):
                 from skypilot_tpu.data import storage as storage_lib
                 storage_lib.mount_or_copy(handle, dst, src)
                 continue

@@ -133,6 +133,49 @@ class R2CloudStorage(S3CloudStorage):
             self.make_sync_dir_command(source, destination))
 
 
+class AzureCloudStorage(CloudStorage):
+    """az:// (container-centric) via the az CLI. The storage account is
+    baked into the generated command from config; executing hosts need
+    an authenticated az CLI (managed identity or az login)."""
+
+    @staticmethod
+    def _split(url: str):
+        container, _, key = url[len("az://"):].partition("/")
+        return container, key
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        from skypilot_tpu.data import storage as storage_lib
+        container, key = self._split(source)
+        return storage_lib.az_download_prefix_command(
+            container, key or None, destination)
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        from skypilot_tpu.data import storage as storage_lib
+        container, key = self._split(source)
+        dst = shlex.quote(destination)
+        return (f"mkdir -p $(dirname {dst}) && "
+                + storage_lib.az_storage_prefix("blob download")
+                + f" --container-name {shlex.quote(container)} "
+                  f"--name {shlex.quote(key)} --file {dst}")
+
+    def make_sync_auto_command(self, source: str, destination: str) -> str:
+        # `az storage blob exists` exits 0 either way and answers on
+        # stdout — the dispatch reads the answer, and any CLI failure
+        # (auth, network) stays a loud non-zero exit.
+        from skypilot_tpu.data import storage as storage_lib
+        container, key = self._split(source)
+        probe = (storage_lib.az_storage_prefix("blob exists")
+                 + f" --container-name {shlex.quote(container)} "
+                   f"--name {shlex.quote(key)} --query exists -o tsv")
+        return (f"skytpu_probe=$({probe} 2>&1); skytpu_rc=$?; "
+                f"if [ $skytpu_rc -ne 0 ]; then "
+                f"printf %s \"$skytpu_probe\" >&2; exit 1; "
+                f"elif printf %s \"$skytpu_probe\" | grep -qi true; then "
+                f"{self.make_sync_file_command(source, destination)}; "
+                f"else {self.make_sync_dir_command(source, destination)};"
+                f" fi")
+
+
 class HttpCloudStorage(CloudStorage):
     """https:// single-file fetch via curl."""
 
@@ -149,6 +192,7 @@ _REGISTRY: Dict[str, CloudStorage] = {
     "gs": GcsCloudStorage(),
     "s3": S3CloudStorage(),
     "r2": R2CloudStorage(),
+    "az": AzureCloudStorage(),
     "https": HttpCloudStorage(),
     "http": HttpCloudStorage(),
 }

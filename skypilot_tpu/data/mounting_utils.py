@@ -85,3 +85,28 @@ def get_s3_mount_cmd(bucket: str, mount_path: str,
             f"mkdir -p {shlex.quote(mount_path)} && "
             f"goofys{flags} {shlex.quote(target)} "
             f"{shlex.quote(mount_path)}")
+
+
+BLOBFUSE2_INSTALL_CMD = (
+    "which blobfuse2 >/dev/null 2>&1 || ("
+    "sudo apt-get update -qq && sudo apt-get install -y blobfuse2 "
+    "2>/dev/null) || ("
+    "sudo wget -q https://github.com/Azure/azure-storage-"
+    "fuse/releases/download/blobfuse2-2.3.2/blobfuse2-2.3.2-Debian-11.0."
+    "x86_64.deb -O /tmp/blobfuse2.deb && sudo dpkg -i /tmp/blobfuse2.deb)")
+
+
+def get_az_mount_cmd(account: str, container: str, mount_path: str,
+                     only_dir: str | None = None) -> str:
+    """Mount an Azure Blob container with blobfuse2 (reference:
+    sky/data/mounting_utils.py blobfuse2 builders). Auth rides the
+    host's managed identity / az login (AZURE_STORAGE_ACCOUNT env)."""
+    sub = (f" --subdirectory={shlex.quote(only_dir.rstrip('/') + '/')}"
+           if only_dir else "")
+    return (f"({BLOBFUSE2_INSTALL_CMD}) && "
+            f"mkdir -p {shlex.quote(mount_path)} && "
+            f"AZURE_STORAGE_ACCOUNT={shlex.quote(account)} "
+            f"AZURE_STORAGE_AUTH_TYPE=azcli "
+            f"blobfuse2 mount {shlex.quote(mount_path)} "
+            f"--container-name={shlex.quote(container)}{sub} "
+            f"--tmp-path=/tmp/blobfuse2-{shlex.quote(container)}")

@@ -246,8 +246,125 @@ class R2Store(S3Store):
             endpoint=r2_endpoint(), profile=r2_profile())
 
 
+def az_storage_prefix(sub: str, auth: bool = True) -> str:
+    """``az storage <sub>`` invocation prefix — single definition
+    shared by the store lifecycle and the host-side fetch builders.
+    ``auth=False`` for the azcopy-backed data-plane commands (``blob
+    sync``) that reject --auth-mode."""
+    base = (f"az storage {sub} --account-name "
+            f"{shlex.quote(azure_storage_account())}")
+    return base + (" --auth-mode login" if auth else "")
+
+
+def az_download_prefix_command(container: str, subpath: Optional[str],
+                               destination: str) -> str:
+    """Materialize a container (or a prefix of it) at ``destination``.
+
+    ``blob download-batch`` recreates the blob's FULL virtual path
+    under --destination (unlike gcloud/aws prefix syncs, which copy
+    the prefix's contents) — so a subpath downloads into a temp dir
+    and its contents move to the destination, keeping az:// COPY
+    semantics identical to gs://s3://r2:// and to the blobfuse2
+    --subdirectory MOUNT of the same URL.
+    """
+    dst = shlex.quote(destination)
+    if not subpath:
+        return (f"mkdir -p {dst} && "
+                + az_storage_prefix("blob download-batch")
+                + f" --source {shlex.quote(container)}"
+                  f" --destination {dst}")
+    sub = subpath.rstrip("/")
+    return ("skytpu_tmp=$(mktemp -d) && "
+            + az_storage_prefix("blob download-batch")
+            + f" --source {shlex.quote(container)}"
+              f" --destination \"$skytpu_tmp\""
+              f" --pattern {shlex.quote(sub + '/*')} && "
+              f"mkdir -p {dst} && "
+              f"cp -a \"$skytpu_tmp\"/{shlex.quote(sub)}/. {dst}/ && "
+              f"rm -rf \"$skytpu_tmp\"")
+
+
+def azure_storage_account() -> str:
+    """Azure storage account from env AZURE_STORAGE_ACCOUNT or config
+    ``azure.storage_account`` (account names are globally unique; the
+    reference derives one per user+region+subscription hash,
+    sky/data/storage.py:2302 — here it is explicit config, like R2's
+    endpoint)."""
+    from skypilot_tpu import config as config_lib
+    acct = (os.environ.get("AZURE_STORAGE_ACCOUNT")
+            or config_lib.get_nested(("azure", "storage_account")))
+    if not acct:
+        raise exceptions.StorageError(
+            "az:// storage needs the storage account: set "
+            "AZURE_STORAGE_ACCOUNT or `azure.storage_account` in config")
+    return acct
+
+
+class AzureBlobStore(AbstractStore):
+    """Azure Blob container via the az CLI (reference: AzureBlobStore,
+    sky/data/storage.py:2293 — az/azcopy + blobfuse2 MOUNT). URLs are
+    container-centric (``az://<container>[/subpath]``); the storage
+    account comes from config. Hosts pulling az:// sources need an
+    authenticated az CLI."""
+
+    SCHEME = "az"
+
+    def _cmd(self, sub: str, rest: str, auth: bool = True) -> str:
+        return az_storage_prefix(sub, auth) + " " + rest
+
+    def exists(self) -> bool:
+        rc, out = self._run(self._cmd(
+            "container exists", f"--name {self.name} -o tsv "
+            f"--query exists"))
+        return rc == 0 and "true" in out.lower()
+
+    def create(self, region: Optional[str] = None) -> None:
+        # Containers live in the (pre-existing) storage account; the
+        # account pins the region, so ``region`` is advisory here.
+        rc, out = self._run(self._cmd("container create",
+                                      f"--name {self.name}"))
+        if rc != 0 and "alreadyexists" not in out.lower().replace(" ", ""):
+            raise exceptions.StorageError(
+                f"creating {self.url} failed: {out.strip()}")
+
+    def upload(self, source: str, subpath: str = "") -> None:
+        if os.path.isfile(os.path.expanduser(source)):
+            blob = (f"{subpath}/{os.path.basename(source.rstrip('/'))}"
+                    if subpath else os.path.basename(source.rstrip("/")))
+            rc, out = self._run(self._cmd(
+                "blob upload", f"--container-name {self.name} "
+                f"--file {shlex.quote(source)} "
+                f"--name {shlex.quote(blob)} --overwrite"))
+        else:
+            # azcopy-backed `blob sync`: destination flag is -d, and
+            # --auth-mode is not accepted.
+            dest = (f" -d {shlex.quote(subpath)}" if subpath else "")
+            rc, out = self._run(self._cmd(
+                "blob sync", f"--container {self.name} "
+                f"--source {shlex.quote(source)}{dest}", auth=False))
+        if rc != 0:
+            raise exceptions.StorageError(
+                f"upload {source} -> {self.url} failed: {out.strip()}")
+
+    def delete(self) -> None:
+        rc, out = self._run(self._cmd("container delete",
+                                      f"--name {self.name}"))
+        if rc != 0 and "notfound" not in out.lower().replace(" ", ""):
+            raise exceptions.StorageError(
+                f"deleting {self.url} failed: {out.strip()}")
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.get_az_mount_cmd(
+            azure_storage_account(), self.name, mount_path,
+            only_dir=self.subpath or None)
+
+    def copy_down_command(self, destination: str) -> str:
+        return az_download_prefix_command(self.name, self.subpath,
+                                          destination)
+
+
 _STORE_TYPES: Dict[str, type] = {"gs": GcsStore, "s3": S3Store,
-                                 "r2": R2Store}
+                                 "r2": R2Store, "az": AzureBlobStore}
 
 
 class Storage:
