@@ -75,23 +75,33 @@ class GcsCloudStorage(CloudStorage):
 
 class S3CloudStorage(CloudStorage):
     """s3:// via the aws CLI (file_mounts with S3 sources pull directly
-    on the host; reference: sky/cloud_stores.py S3CloudStorage)."""
+    on the host; reference: sky/cloud_stores.py S3CloudStorage).
+    S3-compatible stores (R2) override the two hooks below."""
+
+    SCHEME = "s3"
+
+    def _aws(self) -> str:
+        return "aws"
+
+    def _cli_url(self, url: str) -> str:
+        """The URL the aws CLI understands (s3:// always)."""
+        return "s3://" + url.removeprefix(f"{self.SCHEME}://")
 
     def make_sync_dir_command(self, source: str, destination: str) -> str:
         dst = shlex.quote(destination)
-        return (f"mkdir -p {dst} && "
-                f"aws s3 sync {shlex.quote(source)} {dst}")
+        return (f"mkdir -p {dst} && {self._aws()} s3 sync "
+                f"{shlex.quote(self._cli_url(source))} {dst}")
 
     def make_sync_file_command(self, source: str, destination: str) -> str:
         dst = shlex.quote(destination)
-        return (f"mkdir -p $(dirname {dst}) && "
-                f"aws s3 cp {shlex.quote(source)} {dst}")
+        return (f"mkdir -p $(dirname {dst}) && {self._aws()} s3 cp "
+                f"{shlex.quote(self._cli_url(source))} {dst}")
 
     def make_sync_auto_command(self, source: str, destination: str) -> str:
-        bucket, _, key = source[len("s3://"):].partition("/")
+        bucket, _, key = source[len(f"{self.SCHEME}://"):].partition("/")
         return _probe_then_dispatch(
-            f"aws s3api head-object --bucket {shlex.quote(bucket)} "
-            f"--key {shlex.quote(key)}",
+            f"{self._aws()} s3api head-object "
+            f"--bucket {shlex.quote(bucket)} --key {shlex.quote(key)}",
             "not found|404",
             self.make_sync_file_command(source, destination),
             self.make_sync_dir_command(source, destination))
@@ -102,35 +112,15 @@ class R2CloudStorage(S3CloudStorage):
 
     The endpoint/profile are baked into the generated command (built
     client-side from config); the executing host needs the same aws
-    credentials profile. URLs are rewritten r2:// -> s3:// for the CLI.
+    credentials profile. URLs are rewritten r2:// -> s3:// for the CLI
+    (the _cli_url hook); everything else is the S3 builders.
     """
+
+    SCHEME = "r2"
 
     def _aws(self) -> str:
         from skypilot_tpu.data import storage as storage_lib
         return storage_lib.r2_aws_prefix()
-
-    @staticmethod
-    def _s3_url(url: str) -> str:
-        return "s3://" + url.removeprefix("r2://")
-
-    def make_sync_dir_command(self, source: str, destination: str) -> str:
-        dst = shlex.quote(destination)
-        return (f"mkdir -p {dst} && {self._aws()} s3 sync "
-                f"{shlex.quote(self._s3_url(source))} {dst}")
-
-    def make_sync_file_command(self, source: str, destination: str) -> str:
-        dst = shlex.quote(destination)
-        return (f"mkdir -p $(dirname {dst}) && {self._aws()} s3 cp "
-                f"{shlex.quote(self._s3_url(source))} {dst}")
-
-    def make_sync_auto_command(self, source: str, destination: str) -> str:
-        bucket, _, key = source[len("r2://"):].partition("/")
-        return _probe_then_dispatch(
-            f"{self._aws()} s3api head-object "
-            f"--bucket {shlex.quote(bucket)} --key {shlex.quote(key)}",
-            "not found|404",
-            self.make_sync_file_command(source, destination),
-            self.make_sync_dir_command(source, destination))
 
 
 class AzureCloudStorage(CloudStorage):

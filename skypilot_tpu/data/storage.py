@@ -183,8 +183,11 @@ class S3Store(AbstractStore):
             rc, out = self._run(
                 f"{self._aws()} s3 sync {excl}{shlex.quote(source)} {dst}")
         if rc != 0:
+            # Report the store's own scheme (r2:// for R2) even though
+            # the CLI destination is the s3:// form.
+            shown = dst.replace("s3://", f"{self.SCHEME}://", 1)
             raise exceptions.StorageError(
-                f"upload {source} -> {dst} failed: {out.strip()}")
+                f"upload {source} -> {shown} failed: {out.strip()}")
 
     def delete(self) -> None:
         rc, out = self._run(
@@ -274,7 +277,11 @@ def az_download_prefix_command(container: str, subpath: Optional[str],
                 + f" --source {shlex.quote(container)}"
                   f" --destination {dst}")
     sub = subpath.rstrip("/")
+    # The pre-created $tmp/<sub> keeps an empty/missing prefix an empty
+    # destination dir (gs/s3/r2 semantics) instead of a cp error —
+    # download-batch exits 0 having matched nothing.
     return ("skytpu_tmp=$(mktemp -d) && "
+            f"mkdir -p \"$skytpu_tmp\"/{shlex.quote(sub)} && "
             + az_storage_prefix("blob download-batch")
             + f" --source {shlex.quote(container)}"
               f" --destination \"$skytpu_tmp\""

@@ -29,8 +29,10 @@ def fake_kubectl(tmp_path, monkeypatch):
             {"type": "InternalIP", "address": "10.9.0.1"},
             {"type": "ExternalIP", "address": "34.9.0.1"}]}}]}))
     shim = tmp_path / "kubectl"
+    # -S skips sitecustomize (which imports the axon JAX plugin, ~2s
+    # per kubectl invocation; the shim is stdlib-only).
     shim.write_text(textwrap.dedent(f"""\
-        #!/usr/bin/env python3
+        #!/usr/bin/env -S python3 -S
         import json, os, sys
         stdin = sys.stdin.read() if not sys.stdin.isatty() else ""
         with open({str(record)!r}, "a") as f:
