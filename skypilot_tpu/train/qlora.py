@@ -33,7 +33,7 @@ from jax import lax
 
 from skypilot_tpu.models import llama
 from skypilot_tpu.train import trainer
-from skypilot_tpu.train.lora import LoRAConfig, init_lora_params
+from skypilot_tpu.train.lora import LoRAConfig
 
 Params = Dict[str, Any]
 
@@ -191,11 +191,8 @@ def make_qlora_train_step(cfg: llama.LlamaConfig, lc: LoRAConfig,
 
 def create_qlora_state(cfg: llama.LlamaConfig, lc: LoRAConfig,
                        tc: trainer.TrainConfig, seed: int = 0):
-    opt = trainer.make_optimizer(tc)
-
-    def init_fn(rng):
-        adapters = init_lora_params(rng, cfg, lc)
-        return {"params": adapters, "opt_state": opt.init(adapters),
-                "step": jnp.zeros((), jnp.int32)}
-
-    return jax.jit(init_fn)(jax.random.key(seed))
+    """The adapter train state IS lora's (params/opt_state/step over
+    A/B) — one definition, so `--qlora --resume` restore targets can
+    never diverge from fresh init (see lora._state_init_fn)."""
+    from skypilot_tpu.train import lora as lora_lib
+    return lora_lib.create_lora_state(cfg, lc, tc, mesh=None, seed=seed)

@@ -43,6 +43,10 @@ def main() -> None:
                     help="global batch (0 = 4 x data-parallel degree)")
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--xent-chunk", type=int, default=None,
+                    help="chunked cross-entropy: the [B,S,vocab] logits "
+                         "never materialize (512 is the measured v5e "
+                         "sweet spot; 0 = unchunked)")
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--sp", type=int, default=1)
@@ -57,6 +61,15 @@ def main() -> None:
     ap.add_argument("--lora", type=int, default=0, metavar="RANK",
                     help="LoRA finetune: train rank-RANK adapters over "
                          "frozen base weights (llama only)")
+    ap.add_argument("--qlora", type=int, default=0, metavar="RANK",
+                    help="QLoRA finetune: int8-quantized frozen base + "
+                         "rank-RANK adapters — 8B-class on one 16 GB "
+                         "chip (llama only, single chip; use --lora "
+                         "for sharded multi-chip)")
+    ap.add_argument("--qlora-random-base", action="store_true",
+                    help="random int8 base generated ON device (bench/"
+                         "smoke: skips the fp init an 8B config can't "
+                         "fit)")
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--ckpt-every", type=int, default=100)
     ap.add_argument("--resume", action="store_true")
@@ -68,6 +81,13 @@ def main() -> None:
         ap.error("--lora currently supports --model llama only")
     if args.lora < 0:
         ap.error("--lora rank must be positive")
+    if args.qlora:
+        if args.model != "llama":
+            ap.error("--qlora currently supports --model llama only")
+        if args.lora:
+            ap.error("--lora and --qlora are mutually exclusive")
+        if args.qlora < 0:
+            ap.error("--qlora rank must be positive")
     if args.zigzag and args.model not in ("llama", "moe"):
         # Only llama's and moe's forwards apply the zigzag permute;
         # letting the rule reach another model would silently mis-mask
@@ -97,6 +117,11 @@ def main() -> None:
         from skypilot_tpu.parallel import pipeline as model
         default_cfg = "pp-tiny"
     cfg = model.CONFIGS[args.config or default_cfg]
+    if args.xent_chunk is not None:
+        import dataclasses
+        if not hasattr(cfg, "xent_chunk"):
+            ap.error(f"--xent-chunk is not supported by {args.model}")
+        cfg = dataclasses.replace(cfg, xent_chunk=args.xent_chunk)
     args.seq = min(args.seq, cfg.max_seq_len)
 
     n = jax.device_count()
@@ -129,7 +154,47 @@ def main() -> None:
         from skypilot_tpu.train import checkpoints
         mgr = checkpoints.CheckpointManager(args.ckpt_dir)
 
-    if args.lora:
+    if args.qlora:
+        if n > 1:
+            # The int8 base is unsharded: pin everything to one chip
+            # (the whole point is one-16GB-chip finetuning) rather
+            # than dying on multi-chip hosts like v5e-8. Sharded
+            # multi-chip finetuning is --lora.
+            log(f"--qlora is single-chip: using 1 of {n} devices "
+                f"(use --lora for sharded multi-chip finetuning)")
+            jax.config.update("jax_default_device", jax.devices()[0])
+            n = 1
+            batch = args.batch or 4
+        from skypilot_tpu.infer import kvcache
+        from skypilot_tpu.train import lora as lora_lib
+        from skypilot_tpu.train import qlora as qlora_lib
+        lc = lora_lib.LoRAConfig(rank=args.qlora)
+        if args.qlora_random_base:
+            fp_params, qweights = kvcache.random_quantized_params(cfg)
+        else:
+            base = jax.jit(
+                lambda r: model.init_params(r, cfg))(jax.random.key(1))
+            qweights = {
+                "blocks": jax.jit(kvcache.quantize_block_weights)(base),
+                "head": jax.jit(
+                    lambda p: kvcache.quantize_head(p, cfg))(base),
+            }
+            fp_params = kvcache.slim_params(base)
+            del base   # the int8 copy replaces the fp block weights
+        log(f"QLoRA rank {args.qlora}: "
+            f"{lora_lib.num_trainable_params(cfg, lc):,} trainable over "
+            f"an int8 base of {cfg.num_params():,} params")
+        if mgr and args.resume and mgr.latest_step() is not None:
+            # The adapter state tree is identical to --lora's.
+            state = mgr.restore(
+                lora_lib.abstract_lora_state(cfg, lc, tc, mesh=None))
+            start_step = int(mgr.latest_step())
+            log(f"resumed from step {start_step}")
+        else:
+            state = qlora_lib.create_qlora_state(cfg, lc, tc)
+        raw_step = qlora_lib.make_qlora_train_step(cfg, lc, tc)
+        step_fn = lambda s, b: raw_step(s, qweights, fp_params, b)
+    elif args.lora:
         from skypilot_tpu.train import lora as lora_lib
         lc = lora_lib.LoRAConfig(rank=args.lora)
         base_sh = lora_lib.base_param_shardings(cfg, mesh, model)

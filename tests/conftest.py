@@ -99,6 +99,7 @@ _SLOW_TESTS = {
     "tests/test_pipeline.py::test_train_step_on_pp_mesh",
     "tests/test_recipes.py::test_evaluate_cli_smoke",
     "tests/test_recipes.py::test_train_run_cli_smoke",
+    "tests/test_recipes.py::test_train_run_qlora_cli_smoke",
     "tests/test_ring_attention.py::test_packed_model_with_sp",
     "tests/test_ring_attention.py::test_ring_gqa_gradients",
     "tests/test_ring_attention.py::test_ring_gradients_match",

@@ -98,3 +98,23 @@ def test_evaluate_cli_smoke(tmp_path):
     assert out["batches"] == 2
     assert out["perplexity"] > 1.0
     assert "restored step 2" in proc.stderr
+
+
+def test_train_run_qlora_cli_smoke(tmp_path):
+    """--qlora: int8-quantized base + adapters via the CLI, single
+    virtual device (the flag is the single-chip path)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1",
+               SKYTPU_CALLBACK_LOG_DIR=str(tmp_path),
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "skypilot_tpu.train.run",
+         "--config", "llama3-tiny", "--qlora", "4", "--steps", "3",
+         "--seq", "64", "--log-every", "1"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "QLoRA rank 4" in proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["steps"] == 3
