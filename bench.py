@@ -212,25 +212,44 @@ def main() -> None:
             serve_cfg = args.serve_config or (
                 "llama3-tiny" if on_cpu else "llama3-8b")
             big = "8b" in serve_cfg
-            # 16 slots so no request waits for a previous generation;
-            # admission split into waves of 8 — the first wave's tokens
-            # stream while the second prefills (measured best of
-            # {none, 8, 4, 2} on median AND p99 AND tok/s); burst 16
-            # amortizes per-call dispatch latency (decisive on a
-            # relayed chip).
+            # Realistic prompts (512-1024 token mix), 5 timed runs on
+            # the warm server, worst run reported: the r3 driver
+            # artifact showed 5x run-to-run TTFT variance, so a single
+            # lucky run proves nothing. 16 slots cover the request
+            # wave; admission waves of 4 (padded -> one compiled
+            # program per bucket); decode bursts stay short
+            # (open_burst) while free slots remain so a late arrival
+            # never waits out a full burst, and go long (max_burst 16,
+            # amortizing relay dispatch) only once every slot is busy.
             serve = bench_serve.run_http(
                 config=serve_cfg, requests=16, slots=16,
-                prompt_len=96, new_tokens=64, max_burst=16,
-                admit_wave=8, weights_int8=big, kv_int8=big)
+                new_tokens=192, max_burst=16, open_burst=4,
+                admit_wave=4, repeats=5,
+                weights_int8=big, kv_int8=big)
             out.update({
                 "serve_median_ttft_ms": serve["median_ttft_ms"],
+                "serve_worst_run_median_ttft_ms":
+                    serve["worst_run_median_ttft_ms"],
                 "serve_p99_ttft_ms": serve["p99_ttft_ms"],
                 "serve_out_tok_s": serve["out_tok_s"],
                 "serve_vs_baseline_ttft": serve["vs_baseline_ttft"],
+                "serve_worst_run_vs_baseline_ttft":
+                    serve["worst_run_vs_baseline_ttft"],
+                "serve_regressed": serve["regressed"],
+                "serve_runs": serve["runs"],
+                "serve_prompt_mean_len": serve["prompt_mean_len"],
+                "serve_prompt_max_len": serve["prompt_max_len"],
+                "serve_new_tokens": serve["new_tokens"],
                 "serve_config": serve["config"],
                 "serve_transport": serve["transport"],
                 "serve_weights_int8": serve["weights_int8"],
             })
+            if serve["regressed"]:
+                # Loud regression guard (VERDICT r3): a serve TTFT
+                # worse than the anchor must not ship silently.
+                log("SERVE REGRESSION: worst-run median TTFT "
+                    f"{serve['worst_run_median_ttft_ms']}ms >= anchor "
+                    f"{bench_serve.REF_TTFT_MS}ms")
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"serve bench failed: {e}")
             out["serve_error"] = str(e)[:200]
@@ -252,7 +271,7 @@ def _qlora_bench(args, dev, n_chips, on_cpu) -> dict:
     seq = args.qlora_seq if not on_cpu else 128
     cfg = dataclasses.replace(
         llama.CONFIGS[config], remat_policy="none",
-        xent_chunk=args.xent_chunk or 512)
+        xent_chunk=(512 if args.xent_chunk is None else args.xent_chunk))
     seq = min(seq, cfg.max_seq_len)
     lc = LoRAConfig(rank=args.qlora_rank)
     tc = trainer.TrainConfig(warmup_steps=10, total_steps=1000)
@@ -282,23 +301,33 @@ def _qlora_bench(args, dev, n_chips, on_cpu) -> dict:
 
     tok_s_chip = batch_size * seq / dt / max(n_chips, 1)
     n_params = cfg.num_params()
-    # Frozen base: fwd (2N) + activation-grad bwd (2N) per token — no
-    # weight-gradient pass — plus causal attention fwd+bwd.
-    flops_per_token = 4 * n_params + 4 * cfg.n_layers * seq * cfg.d_model
-    mfu = tok_s_chip * flops_per_token / peak_for(dev)
+    # Two FLOP bases, both reported (VERDICT r3: mixing bases makes the
+    # ratio unimpeachable-proof):
+    #  - 4N: the work this step actually does — frozen base runs fwd
+    #    (2N) + activation-grad bwd (2N), no weight-grad pass. The
+    #    honest hardware-utilization number.
+    #  - 6N: the anchor's basis (full-train FLOPs). On this basis the
+    #    ratio reduces to peak-normalized tokens/s vs the anchor's
+    #    finetune tokens/s — the apples-to-apples throughput ratio.
+    attn = cfg.n_layers * seq * cfg.d_model
+    mfu_4n = tok_s_chip * (4 * n_params + 4 * attn) / peak_for(dev)
+    mfu_6n = tok_s_chip * (6 * n_params + 6 * attn) / peak_for(dev)
     return {
         "qlora_8b_tokens_per_sec_per_chip": round(tok_s_chip, 2),
-        "qlora_8b_mfu": round(mfu, 4),
-        "qlora_8b_vs_baseline": round(mfu / REF_MFU, 3),
+        "qlora_8b_mfu_4n": round(mfu_4n, 4),
+        "qlora_8b_mfu_6n_basis": round(mfu_6n, 4),
+        "qlora_8b_vs_baseline": round(mfu_6n / REF_MFU, 3),
+        "qlora_8b_vs_baseline_4n": round(mfu_4n / REF_MFU, 3),
         "qlora_8b_config": config,
         "qlora_8b_n_params": n_params,
         "qlora_8b_batch": batch_size,
         "qlora_8b_seq": seq,
         "qlora_8b_rank": args.qlora_rank,
         "qlora_8b_step_time_s": round(dt, 4),
-        "qlora_8b_note": "int8 frozen base + LoRA; FLOPs counted 4N "
-                         "(no weight-grad pass) vs the anchor's 6N "
-                         "full train",
+        "qlora_8b_note": "int8 frozen base + LoRA. vs_baseline uses "
+                         "the anchor's own 6N FLOP basis (= chip-peak-"
+                         "normalized tokens/s ratio); mfu_4n is the "
+                         "actual work done (no weight-grad pass)",
     }
 
 

@@ -61,7 +61,8 @@ class InferenceEngine:
                  sampling_params: sampling.SamplingParams = sampling.SamplingParams(),
                  eos_id: Optional[int] = None, seed: int = 0,
                  kv_int8: bool = False, weights_int8: bool = False,
-                 qweights=None, max_wave: Optional[int] = None):
+                 qweights=None, max_wave: Optional[int] = None,
+                 pad_waves: bool = False):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -75,6 +76,13 @@ class InferenceEngine:
         # <= 0 means uncapped (a 0 cap would otherwise spin _admit
         # forever on empty waves).
         self.max_wave = max_wave if max_wave and max_wave > 0 else None
+        # pad_waves: every admission wave is padded to exactly max_wave
+        # rows (dummy rows -> spare slot), so ONE compiled program per
+        # bucket serves every wave. A straggler wave pays dummy prefill
+        # compute; in exchange no mid-traffic XLA compile can ever
+        # stall a request (a fresh (bucket, rows) pair otherwise
+        # compiles on first sight — tens of seconds on an 8B model).
+        self.pad_waves = bool(pad_waves and self.max_wave)
         self.sampling_params = sampling_params
         self.eos_id = eos_id
         # One hidden spare slot (index n_slots): batched admission pads
@@ -206,7 +214,10 @@ class InferenceEngine:
 
     def _admit_wave(self, wave: List["Request"], slots: List[int],
                     bucket: int) -> None:
-        n = 1 << (len(wave) - 1).bit_length() if len(wave) > 1 else 1
+        if self.pad_waves:
+            n = self.max_wave
+        else:
+            n = 1 << (len(wave) - 1).bit_length() if len(wave) > 1 else 1
         tokens_b = np.zeros((n, bucket), np.int32)
         true_lens = np.ones((n,), np.int32)
         slot_ids = np.full((n,), self.n_slots, np.int32)  # spare
@@ -258,6 +269,24 @@ class InferenceEngine:
         self._admit()
         return self.step_decode_once()
 
+    def admit(self, on_wave=None) -> None:
+        """Prefill+insert every admissible waiting request (public
+        wrapper: the server calls this separately from decode so it can
+        size decode bursts AFTER admission — full bursts only when the
+        slots are full and admission is impossible anyway)."""
+        self._admit(on_wave)
+
+    def reset(self) -> None:
+        """Drop every queued and in-flight request and zero the slot
+        state. After an engine failure the server must not re-drive
+        poisoned slots — stale waiting/slot_req would re-raise the same
+        error for every future request (advisor r3)."""
+        self.waiting.clear()
+        self.finished.clear()
+        self.slot_req.clear()
+        self.free_slots = list(range(self.n_slots))
+        self.cache["length"] = jnp.zeros_like(self.cache["length"])
+
     def step_burst(self, max_burst: int = 8,
                    on_wave=None) -> Dict[int, List[int]]:
         """Admit, then decode up to ``max_burst`` tokens per slot in one
@@ -266,6 +295,12 @@ class InferenceEngine:
         {rid: [tokens...]} emitted this call. ``on_wave`` fires after
         each admission wave (streaming flush hook)."""
         self._admit(on_wave)
+        return self.decode_burst(max_burst)
+
+    def decode_burst(self, max_burst: int = 8) -> Dict[int, List[int]]:
+        """Decode up to ``max_burst`` tokens per active slot in one
+        device call — NO admission (callers that interleave admission
+        and decode use :meth:`admit` + this)."""
         if not self.slot_req:
             return {}
         # Cap the burst so no active slot's cache can overflow, then

@@ -58,9 +58,19 @@ class ModelServer:
     on a single core — concurrent TTFTs collapsed to full-batch wall.)
     """
 
-    def __init__(self, engine, max_burst: int = 8):
+    def __init__(self, engine, max_burst: int = 8,
+                 open_burst: int = 4):
         self.engine = engine
         self.max_burst = max_burst
+        # Burst size while the admission window is OPEN (free slots
+        # exist, so a request could arrive any moment): a late HTTP
+        # arrival waits at most one short burst before its prefill,
+        # instead of a full max_burst decode (JetStream's
+        # prefill-over-generate priority; r3 driver bench showed 5x
+        # TTFT variance from arrivals stranded behind full bursts).
+        # Full bursts run only when every slot is busy — admission is
+        # impossible then, so the long device call costs nothing.
+        self.open_burst = min(open_burst, max_burst)
         self._inbox_lock = threading.Lock()
         self._inbox: list = []
         self._pending: Dict[int, _Pending] = {}   # loop-thread only
@@ -129,6 +139,18 @@ class ModelServer:
                         p.chunks.put({"error": p.result["error"]})
                     p.event.set()
                 self._pending.clear()
+                # The engine's waiting/slot_req still hold the poisoned
+                # requests — left in place, every subsequent step would
+                # re-drive them and fail all future traffic with the
+                # same error (advisor r3). Reset the slot state; if even
+                # that fails the device is gone: flip /health to 503 so
+                # the LB stops routing here.
+                try:
+                    self.engine.reset()
+                except Exception as e2:  # noqa: BLE001
+                    print(f"engine reset failed, marking unhealthy: "
+                          f"{e2}", file=sys.stderr)
+                    self._ready.clear()
                 busy = False
             if not busy:
                 time.sleep(0.002)
@@ -160,13 +182,28 @@ class ModelServer:
                 p.cursor += len(new)
                 p.chunks.put({"tokens": list(new)})
 
+    def _on_wave(self) -> None:
+        # After each admission wave: stream its first tokens, then pull
+        # any requests that arrived DURING the wave's prefill into this
+        # same admission pass (engine._admit keeps looping while
+        # waiting+free slots exist) — they'd otherwise sit through a
+        # decode burst first.
+        self._flush_streams()
+        self._drain_inbox()
+
     def _step(self) -> bool:
         self._drain_inbox()
-        if not (self.engine.waiting or self.engine.slot_req):
+        eng = self.engine
+        if not (eng.waiting or eng.slot_req):
             return False
-        self.engine.step_burst(max_burst=self.max_burst,
-                               on_wave=self._flush_streams)
+        # Admission has strict priority over decode.
+        eng.admit(on_wave=self._on_wave)
         self._flush_streams()
+        if eng.slot_req:
+            k = (self.max_burst if not eng.free_slots
+                 else self.open_burst)
+            eng.decode_burst(max_burst=k)
+            self._flush_streams()
         for req in self.engine.finished:
             p = self._pending.pop(req.rid, None)
             if p is None:
@@ -271,8 +308,9 @@ def make_handler(model: ModelServer):
 
 
 def serve(engine, host: str = "0.0.0.0", port: int = 8080,
-          max_burst: int = 8):
-    model = ModelServer(engine, max_burst=max_burst)
+          max_burst: int = 8, open_burst: int = 4):
+    model = ModelServer(engine, max_burst=max_burst,
+                        open_burst=open_burst)
     httpd = _Threading((host, port), make_handler(model))
     return model, httpd
 
@@ -291,6 +329,10 @@ def main() -> None:
     ap.add_argument("--max-burst", type=int, default=8,
                     help="decode tokens per device call (streaming "
                          "granularity vs dispatch amortization)")
+    ap.add_argument("--open-burst", type=int, default=4,
+                    help="decode burst while free slots remain — keeps "
+                         "late arrivals from waiting out a full burst "
+                         "before their prefill")
     ap.add_argument("--admit-wave", type=int, default=8,
                     help="admission wave cap: early waves' first "
                          "tokens stream while later waves prefill "
@@ -319,7 +361,8 @@ def main() -> None:
     # server lifetime and the memory halving never happens.
     del params
     model, httpd = serve(engine, port=args.port,
-                         max_burst=args.max_burst)
+                         max_burst=args.max_burst,
+                         open_burst=args.open_burst)
     print(f"serving on :{args.port}", file=sys.stderr, flush=True)
     try:
         httpd.serve_forever()

@@ -129,13 +129,16 @@ def set_status(job_id: int, status: ManagedJobStatus,
     the job — it would then run to completion despite a successful
     cancel reply. So every forward write applies only when the job is
     not already CANCELLING/terminal, and CANCELLING itself never
-    overwrites a terminal state. Terminal writes are unconditional.
+    overwrites a terminal state. Terminal writes are first-wins: a late
+    SUCCEEDED/FAILED from _monitor must not overwrite a CANCELLED that
+    the cancel path already recorded (CANCELLED still applies over
+    CANCELLING, which is non-terminal).
     Returns False when the write did not apply — the caller should take
     the cancellation path.
     """
     terminal = [s.value for s in ManagedJobStatus if s.is_terminal()]
     if status.is_terminal():
-        blocked: list = []
+        blocked = terminal
     elif status == ManagedJobStatus.CANCELLING:
         blocked = terminal
     else:
@@ -151,8 +154,9 @@ def set_status(job_id: int, status: ManagedJobStatus,
         elif status.is_terminal():
             cur = c.execute(
                 "UPDATE managed_jobs SET status=?, ended_at=?,"
-                " last_error=COALESCE(?, last_error) WHERE job_id=?",
-                (status.value, time.time(), error, job_id))
+                " last_error=COALESCE(?, last_error)"
+                f" WHERE job_id=?{guard}",
+                (status.value, time.time(), error, job_id, *blocked))
         else:
             cur = c.execute(
                 "UPDATE managed_jobs SET status=?, last_error="

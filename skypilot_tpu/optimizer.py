@@ -45,6 +45,15 @@ class Candidate:
     time_s: float        # estimated runtime on THIS candidate
 
 
+def _reserved_key(resources: Resources) -> Optional[Tuple]:
+    if resources.cloud != "gcp" or resources.use_spot:
+        return None
+    from skypilot_tpu.provision import gcp
+    if not gcp.configured_reservations():
+        return None
+    return (resources.zone, resources.instance_type)
+
+
 def _reserved_nodes_available(resources: Resources,
                               cache: Dict[Tuple, int]) -> int:
     """Unused reserved capacity usable by this candidate (0 unless the
@@ -52,22 +61,26 @@ def _reserved_nodes_available(resources: Resources,
     one optimize call — the availability query is a cloud API hit.
     Reference: sky/optimizer.py:345-355 treats reserved nodes as
     already-paid-for (cost 0)."""
-    if resources.cloud != "gcp" or resources.use_spot:
+    key = _reserved_key(resources)
+    if key is None:
         return 0
-    from skypilot_tpu.provision import gcp
-    if not gcp.configured_reservations():
-        return 0
-    key = (resources.zone, resources.instance_type)
     if key not in cache:
         try:
-            cache[key] = sum(gcp.list_reservations_available(
-                resources.zone, resources.instance_type).values())
+            cache[key] = sum(gcp_list_reservations(resources))
         except Exception:  # noqa: BLE001 — availability is advisory
             cache[key] = 0
     return cache[key]
 
 
-def _candidates_for(task: Task, blocked: BlockedSet) -> List[Candidate]:
+def gcp_list_reservations(resources: Resources):
+    from skypilot_tpu.provision import gcp
+    return gcp.list_reservations_available(
+        resources.zone, resources.instance_type).values()
+
+
+def _candidates_for(task: Task, blocked: BlockedSet,
+                    reserved_cache: Optional[Dict[Tuple, int]] = None,
+                    ) -> List[Candidate]:
     """Launchable candidates with per-accelerator runtime scaling
     (reference: _estimate_nodes_cost_or_time, sky/optimizer.py:236).
 
@@ -80,7 +93,9 @@ def _candidates_for(task: Task, blocked: BlockedSet) -> List[Candidate]:
     from skypilot_tpu.catalog import catalog
     est = task.estimated_runtime_seconds
     out: List[Candidate] = []
-    reserved_cache: Dict[Tuple, int] = {}
+    if reserved_cache is None:
+        reserved_cache = {}
+    consumed: Dict[Tuple, int] = {}
     for r in task.resources:
         for launchable in r.launchables(blocked):
             if est is not None and est > 0:
@@ -96,7 +111,18 @@ def _candidates_for(task: Task, blocked: BlockedSet) -> List[Candidate]:
                                                    reserved_cache)
             billable = max(task.num_nodes - n_reserved, 0)
             cost = launchable.get_cost(time_s) * billable
+            if n_reserved > 0:
+                key = _reserved_key(launchable)
+                consumed[key] = max(consumed.get(key, 0),
+                                    min(task.num_nodes, n_reserved))
             out.append(Candidate(launchable, cost, time_s))
+    # Greedy capacity consumption: a multi-task DAG planned in one call
+    # must not discount the SAME unused reservation capacity once per
+    # task. Conservative — this task is charged as if it takes the
+    # reservation in every zone it considered, so later tasks may see
+    # less capacity than runtime reality; never less cost.
+    for key, used in consumed.items():
+        reserved_cache[key] = max(reserved_cache[key] - used, 0)
     if not out:
         raise exceptions.ResourcesUnavailableError(
             f"no feasible resources for {task} "
@@ -172,7 +198,9 @@ def optimize_dag(dag: dag_lib.Dag,
     order = dag.topological_order()
     if not order:
         return {}
-    per_task = {t: _candidates_for(t, blocked) for t in order}
+    reserved_cache: Dict[Tuple, int] = {}
+    per_task = {t: _candidates_for(t, blocked, reserved_cache)
+                for t in order}
     is_cost = minimize is OptimizeTarget.COST
     if is_cost:
         key = lambda c: c.cost

@@ -139,3 +139,166 @@ def test_streaming_oversized_prompt_clean_400(model_server):
                       {"tokens": list(range(99)), "max_new_tokens": 2,
                        "stream": True})
     assert code == 400 and "error" in out
+
+
+class _FakeEngine:
+    """Minimal engine double recording decode burst sizes."""
+
+    def __init__(self, n_slots=4, fail_steps=0):
+        self.n_slots = n_slots
+        self.waiting = []
+        self.slot_req = {}
+        self.finished = []
+        self.free_slots = list(range(n_slots))
+        self.buckets = (16,)
+        self.bursts = []
+        self.fail_steps = fail_steps
+        self._rid = 0
+        self.reset_calls = 0
+
+    def add_request(self, tokens, max_new):
+        r = eng.Request(rid=self._rid, prompt=list(tokens),
+                        max_new_tokens=max_new)
+        self._rid += 1
+        self.waiting.append(r)
+        return r.rid
+
+    def admit(self, on_wave=None):
+        if self.fail_steps > 0:
+            self.fail_steps -= 1
+            raise RuntimeError("boom")
+        while self.waiting and self.free_slots:
+            r = self.waiting.pop(0)
+            r.slot = self.free_slots.pop(0)
+            r.tokens.append(7)
+            import time as _t
+            r.first_token_s = _t.time()
+            self.slot_req[r.slot] = r
+            if on_wave:
+                on_wave()
+
+    def decode_burst(self, max_burst=8):
+        self.bursts.append(max_burst)
+        for slot, r in list(self.slot_req.items()):
+            r.tokens.append(8)
+            if len(r.tokens) >= r.max_new_tokens:
+                self.slot_req.pop(slot)
+                self.free_slots.append(slot)
+                self.finished.append(r)
+        return {}
+
+    def generate(self, prompts, max_new_tokens=2):
+        return [[1] * max_new_tokens for _ in prompts]
+
+    def reset(self):
+        self.reset_calls += 1
+        self.waiting.clear()
+        self.slot_req.clear()
+        self.finished.clear()
+        self.free_slots = list(range(self.n_slots))
+
+
+def test_adaptive_burst_short_while_slots_free():
+    """Decode bursts stay short while free slots remain (a late arrival
+    must not wait out a full max_burst decode before its prefill) and
+    go long only once every slot is busy."""
+    fake = _FakeEngine(n_slots=2)
+    model = srv.ModelServer(fake, max_burst=16, open_burst=2)
+    try:
+        p1 = model._add([1, 2], 64)
+        p2 = model._add([3], 64)      # fills both slots
+        p3 = model._add([4], 4)       # waits -> slots stay full
+        import time
+        deadline = time.time() + 30
+        while len(fake.bursts) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert fake.bursts, "no decode bursts ran"
+        # Slots were full from the first decode on -> full bursts.
+        assert fake.bursts[0] == 16
+        p3.event.wait(timeout=30)
+        del p1, p2
+    finally:
+        model.shutdown()
+
+
+def test_adaptive_burst_open_window():
+    """With free slots remaining, the server uses open_burst."""
+    fake = _FakeEngine(n_slots=8)
+    model = srv.ModelServer(fake, max_burst=16, open_burst=2)
+    try:
+        p = model._add([1, 2], 6)
+        assert p.event.wait(timeout=30)
+        assert fake.bursts and all(b == 2 for b in fake.bursts)
+    finally:
+        model.shutdown()
+
+
+def test_engine_failure_resets_and_recovers():
+    """An engine exception fails in-flight requests AND resets the
+    engine's queue/slot state so later requests succeed (advisor r3:
+    stale waiting entries re-poisoned every subsequent step)."""
+    fake = _FakeEngine(n_slots=2, fail_steps=1)
+    model = srv.ModelServer(fake, max_burst=4, open_burst=4)
+    try:
+        p = model._add([1], 4)
+        assert p.event.wait(timeout=30)
+        assert "error" in (p.result or {})
+        assert fake.reset_calls == 1
+        assert model._ready.is_set()      # engine reset ok -> healthy
+        p2 = model._add([2], 3)
+        assert p2.event.wait(timeout=30)
+        assert p2.result and "error" not in p2.result
+    finally:
+        model.shutdown()
+
+
+def test_engine_reset_failure_flips_health():
+    fake = _FakeEngine(n_slots=2, fail_steps=1)
+
+    def bad_reset():
+        raise RuntimeError("device gone")
+
+    fake.reset = bad_reset
+    model = srv.ModelServer(fake, max_burst=4)
+    try:
+        p = model._add([1], 4)
+        assert p.event.wait(timeout=30)
+        assert not model._ready.is_set()  # /health now 503
+    finally:
+        model.shutdown()
+
+
+def test_engine_reset_clears_slots():
+    cfg = llama.CONFIGS["llama3-tiny"]
+    params = llama.init_params(jax.random.key(0), cfg)
+    e = eng.InferenceEngine(params, cfg, n_slots=2, max_len=32,
+                            prompt_buckets=(8,))
+    e.add_request([1, 2, 3], max_new_tokens=64)   # stays active
+    e.add_request([4, 5], max_new_tokens=64)
+    e.add_request([6], max_new_tokens=2)          # queued (no slot)
+    e.step()
+    assert e.slot_req and e.waiting
+    e.reset()
+    assert not e.slot_req and not e.waiting and not e.finished
+    assert sorted(e.free_slots) == [0, 1]
+    assert int(e.cache["length"].sum()) == 0
+    # The engine still serves fresh requests after a reset.
+    out = e.generate([[9, 8]], max_new_tokens=3)
+    assert len(out[0]) == 3
+
+
+def test_pad_waves_single_program_per_bucket():
+    """pad_waves pads every admission wave to max_wave rows, so results
+    are identical to the unpadded engine and odd wave sizes cannot
+    trigger fresh prefill compiles mid-traffic."""
+    cfg = llama.CONFIGS["llama3-tiny"]
+    params = llama.init_params(jax.random.key(0), cfg)
+    plain = eng.InferenceEngine(params, cfg, n_slots=8, max_len=32,
+                                prompt_buckets=(8,))
+    padded = eng.InferenceEngine(params, cfg, n_slots=8, max_len=32,
+                                 prompt_buckets=(8,), max_wave=4,
+                                 pad_waves=True)
+    prompts = [[3, 1, 4], [1, 5], [9, 2, 6, 5], [3, 5, 8], [9, 7]]
+    want = plain.generate(prompts, max_new_tokens=4)
+    got = padded.generate(prompts, max_new_tokens=4)
+    assert got == want

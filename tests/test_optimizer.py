@@ -205,7 +205,7 @@ def test_time_target_minimizes_makespan_not_sum():
              ("a", "r1"): 10.0, ("a", "r2"): 155.0,
              ("b", "r1"): 300.0, ("b", "r2"): 160.0}
 
-    def fake_cands(t, blocked):
+    def fake_cands(t, blocked, reserved_cache=None):
         out = []
         for region in ("r1", "r2"):
             res = Resources(instance_type="n2-standard-8")

@@ -64,7 +64,7 @@ def test_dp_matches_brute_force(seed):
 
     import unittest.mock as mock
     with mock.patch.object(optimizer, "_candidates_for",
-                           side_effect=lambda t, b: per_task[t]):
+                           side_effect=lambda t, b, rc=None: per_task[t]):
         plan = optimizer.optimize(d)
     got = sum(
         next(c.cost for c in per_task[t]
@@ -128,7 +128,7 @@ def test_tree_dag_matches_brute_force(seed):
     want = _dag_brute_force(d, tasks, per_task)
     import unittest.mock as mock
     with mock.patch.object(optimizer, "_candidates_for",
-                           side_effect=lambda t, b: per_task[t]):
+                           side_effect=lambda t, b, rc=None: per_task[t]):
         plan = optimizer.optimize(d)
     assert _dag_objective(d, tasks, per_task, plan) == \
         pytest.approx(want, rel=1e-9)
@@ -145,7 +145,7 @@ def test_general_dag_never_worse_than_argmin(seed):
                 for t in tasks}
     import unittest.mock as mock
     with mock.patch.object(optimizer, "_candidates_for",
-                           side_effect=lambda t, b: per_task[t]):
+                           side_effect=lambda t, b, rc=None: per_task[t]):
         plan = optimizer.optimize(d)
     got = _dag_objective(d, tasks, per_task, plan)
     argmin_plan = {t: min(per_task[t], key=lambda c: c.cost).resources
