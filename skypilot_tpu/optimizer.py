@@ -65,17 +65,13 @@ def _reserved_nodes_available(resources: Resources,
     if key is None:
         return 0
     if key not in cache:
+        from skypilot_tpu.provision import gcp
         try:
-            cache[key] = sum(gcp_list_reservations(resources))
+            cache[key] = sum(gcp.list_reservations_available(
+                resources.zone, resources.instance_type).values())
         except Exception:  # noqa: BLE001 — availability is advisory
             cache[key] = 0
     return cache[key]
-
-
-def gcp_list_reservations(resources: Resources):
-    from skypilot_tpu.provision import gcp
-    return gcp.list_reservations_available(
-        resources.zone, resources.instance_type).values()
 
 
 def _candidates_for(task: Task, blocked: BlockedSet,
@@ -246,13 +242,6 @@ def optimize_dag(dag: dag_lib.Dag,
             for w, k in pick[v][plan_idx[v]].items():
                 plan_idx[w] = k
     else:
-        # Coordinate descent from the per-task argmin, re-choosing each
-        # task against the FULL objective (required for makespan, whose
-        # value is not a local sum; graphs here are small).
-        plan_idx = {t: min(range(len(per_task[t])),
-                           key=lambda j: key(per_task[t][j]))
-                    for t in order}
-
         def objective(idx):
             if is_cost:
                 total = sum(key(per_task[t][idx[t]]) for t in order)
@@ -270,27 +259,92 @@ def optimize_dag(dag: dag_lib.Dag,
                 finish[t] = start + key(per_task[t][idx[t]])
             return max(finish.values())
 
-        for _ in range(len(order) + 2):   # each sweep is monotone
-            improved = False
-            for t in order:
-                cur = objective(plan_idx)
-                for j in range(len(per_task[t])):
-                    if j == plan_idx[t]:
-                        continue
-                    trial = dict(plan_idx)
-                    trial[t] = j
-                    val = objective(trial)
-                    if val < cur - 1e-12:
-                        plan_idx[t] = j
-                        cur = val
-                        improved = True
-            if not improved:
+        n_combos = 1
+        for t in order:
+            n_combos *= max(len(per_task[t]), 1)
+            if n_combos > _EXACT_COMBO_CAP:
                 break
+        if n_combos <= _EXACT_COMBO_CAP:
+            # Small graph (the common case): exhaustive enumeration is
+            # EXACT — this is the role of the reference's PuLP ILP
+            # (sky/optimizer.py:469) without the solver dependency.
+            plan_idx = _exact_small_dag(order, per_task, g, key,
+                                        edge, is_cost)
+        else:
+            plan_idx = _coordinate_descent(order, per_task, key,
+                                           objective)
 
     plan = {t: per_task[t][plan_idx[t]].resources for t in order}
     if not quiet:
         _print_plan(order, per_task, plan)
     return plan
+
+
+_EXACT_COMBO_CAP = 100_000
+
+
+def _exact_small_dag(order, per_task, g, key, edge, is_cost):
+    """Exhaustive exact optimizer for multi-parent DAGs whose joint
+    candidate space fits under _EXACT_COMBO_CAP. Node values and edge
+    value matrices are precomputed so each combination evaluates in
+    microseconds."""
+    import itertools
+
+    node_val = {t: [key(c) for c in per_task[t]] for t in order}
+    edge_mat = {}
+    for u, v in g.edges:
+        edge_mat[(u, v)] = [[edge(u, cu, v, cv) for cv in per_task[v]]
+                            for cu in per_task[u]]
+    preds = {t: list(g.predecessors(t)) for t in order}
+    best_val, best_combo = float("inf"), None
+    for combo in itertools.product(
+            *(range(len(per_task[t])) for t in order)):
+        idx = dict(zip(order, combo))
+        if is_cost:
+            val = sum(node_val[t][idx[t]] for t in order)
+            for (u, v), mat in edge_mat.items():
+                val += mat[idx[u]][idx[v]]
+        else:
+            finish = {}
+            for t in order:
+                start = 0.0
+                for u in preds[t]:
+                    s = finish[u] + edge_mat[(u, t)][idx[u]][idx[t]]
+                    if s > start:
+                        start = s
+                finish[t] = start + node_val[t][idx[t]]
+            val = max(finish.values())
+        if val < best_val:
+            best_val, best_combo = val, idx
+    return best_combo
+
+
+def _coordinate_descent(order, per_task, key, objective):
+    """Fallback above the exact cap: per-task argmin init, then
+    topological sweeps re-choosing each task against the full objective
+    until no sweep improves (monotone, converges; a documented
+    heuristic — no optimality bound)."""
+    plan_idx = {t: min(range(len(per_task[t])),
+                       key=lambda j, t=t: key(per_task[t][j]))
+                for t in order}
+
+    for _ in range(len(order) + 2):   # each sweep is monotone
+        improved = False
+        for t in order:
+            cur = objective(plan_idx)
+            for j in range(len(per_task[t])):
+                if j == plan_idx[t]:
+                    continue
+                trial = dict(plan_idx)
+                trial[t] = j
+                val = objective(trial)
+                if val < cur - 1e-12:
+                    plan_idx[t] = j
+                    cur = val
+                    improved = True
+        if not improved:
+            break
+    return plan_idx
 
 
 def optimize_task(task: Task,

@@ -134,15 +134,67 @@ def test_tree_dag_matches_brute_force(seed):
         pytest.approx(want, rel=1e-9)
 
 
-@pytest.mark.parametrize("seed", range(8))
-def test_general_dag_never_worse_than_argmin(seed):
-    """Random multi-parent DAGs: coordinate descent is documented as a
-    heuristic — assert it never does worse than the no-egress argmin
-    start (monotone sweeps), and matches brute force on most seeds."""
+@pytest.mark.parametrize("seed", range(12))
+def test_general_dag_exact_under_cap(seed):
+    """Random multi-parent DAGs up to 8 tasks: below _EXACT_COMBO_CAP
+    the optimizer enumerates exhaustively, so the plan must EQUAL the
+    brute-force optimum (VERDICT r3 #7 — the role of the reference's
+    PuLP ILP, sky/optimizer.py:469)."""
     rng = random.Random(2000 + seed)
-    d, tasks = _random_dag(rng.randint(3, 5), rng, tree_only=False)
+    d, tasks = _random_dag(rng.randint(3, 8), rng, tree_only=False)
     per_task = {t: optimizer._candidates_for(t, set())[:4]
                 for t in tasks}
+    assert all(len(c) >= 1 for c in per_task.values())
+    import unittest.mock as mock
+    with mock.patch.object(optimizer, "_candidates_for",
+                           side_effect=lambda t, b, rc=None: per_task[t]):
+        plan = optimizer.optimize(d)
+    got = _dag_objective(d, tasks, per_task, plan)
+    want = _dag_brute_force(d, tasks, per_task)
+    assert got == pytest.approx(want, rel=1e-9)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_general_dag_makespan_exact_under_cap(seed):
+    """TIME target on multi-parent DAGs: exhaustive path minimizes the
+    true makespan (longest node+edge path)."""
+    rng = random.Random(3000 + seed)
+    d, tasks = _random_dag(rng.randint(3, 6), rng, tree_only=False)
+    for t in tasks:
+        t.estimated_runtime_seconds = rng.choice([600.0, 3600.0, 7200.0])
+    per_task = {t: optimizer._candidates_for(t, set())[:4]
+                for t in tasks}
+
+    def makespan(plan):
+        finish = {}
+        for t in tasks:   # insertion order is topological
+            start = 0.0
+            for u in d.graph.predecessors(t):
+                start = max(start, finish[u] + optimizer._egress_time(
+                    plan[u], plan[t], optimizer._edge_gigabytes(u)))
+            finish[t] = start + next(
+                c.time_s for c in per_task[t] if c.resources is plan[t])
+        return max(finish.values())
+
+    best = min(makespan({t: c.resources for t, c in zip(tasks, combo)})
+               for combo in itertools.product(
+                   *(per_task[t] for t in tasks)))
+    import unittest.mock as mock
+    with mock.patch.object(optimizer, "_candidates_for",
+                           side_effect=lambda t, b, rc=None: per_task[t]):
+        plan = optimizer.optimize(
+            d, minimize=optimizer.OptimizeTarget.TIME)
+    assert makespan(plan) == pytest.approx(best, rel=1e-9)
+
+
+def test_above_cap_falls_back_to_heuristic(monkeypatch):
+    """Above the cap the coordinate-descent fallback still returns a
+    plan no worse than the per-task argmin."""
+    rng = random.Random(7)
+    d, tasks = _random_dag(6, rng, tree_only=False)
+    per_task = {t: optimizer._candidates_for(t, set())[:4]
+                for t in tasks}
+    monkeypatch.setattr(optimizer, "_EXACT_COMBO_CAP", 1)
     import unittest.mock as mock
     with mock.patch.object(optimizer, "_candidates_for",
                            side_effect=lambda t, b, rc=None: per_task[t]):
@@ -150,7 +202,4 @@ def test_general_dag_never_worse_than_argmin(seed):
     got = _dag_objective(d, tasks, per_task, plan)
     argmin_plan = {t: min(per_task[t], key=lambda c: c.cost).resources
                    for t in tasks}
-    argmin_cost = _dag_objective(d, tasks, per_task, argmin_plan)
-    assert got <= argmin_cost + 1e-9
-    want = _dag_brute_force(d, tasks, per_task)
-    assert got >= want - 1e-9  # sanity: never "beats" the optimum
+    assert got <= _dag_objective(d, tasks, per_task, argmin_plan) + 1e-9
