@@ -170,14 +170,17 @@ class RetryingProvisioner:
         )
         provision.run_instances(handle.provider, config)
         provision.wait_instances(handle.provider, cluster_name, handle.zone)
-        _setup_and_init_runtime(handle.provider, cluster_name, handle.zone)
+        _setup_and_init_runtime(handle.provider, cluster_name, handle.zone,
+                                docker_image=launchable.docker_image)
         state.set_cluster(cluster_name, dict(handle), state.ClusterStatus.UP,
                           handle["price_per_hour"])
         return handle
 
 
 def _setup_and_init_runtime(provider: str, cluster_name: str,
-                            zone: str) -> ClusterInfo:
+                            zone: str,
+                            docker_image: Optional[str] = None
+                            ) -> ClusterInfo:
     """Post-provision: wait for hosts, push the framework + cluster key,
     and write the head-side cluster.json through the RPC so the cluster
     runtime (driver/skylet/job DB) is self-sufficient from here on."""
@@ -185,6 +188,15 @@ def _setup_and_init_runtime(provider: str, cluster_name: str,
     info = provision.get_cluster_info(provider, cluster_name, zone)
     instance_setup.wait_for_ssh(info)
     instance_setup.setup_runtime_on_cluster(info)
+    if docker_image and any(h.runner_kind == "k8s" for h in info.hosts):
+        # On kubernetes the POD already runs this image (pod_manifest
+        # uses it as the pod image): no docker-in-pod setup, and the
+        # gang driver must NOT wrap jobs in docker exec.
+        docker_image = None
+    if docker_image:
+        # image_id: docker:<img> — pull + start the task container on
+        # every host; the gang driver will exec jobs inside it.
+        instance_setup.setup_docker_on_cluster(info, docker_image)
     uses_ssh = any(h.runner_kind == "ssh" for h in info.hosts)
     agent_token = None
     if any(h.runner_kind == "k8s" for h in info.hosts):
@@ -201,7 +213,8 @@ def _setup_and_init_runtime(provider: str, cluster_name: str,
         provider_env=info.metadata.get("provider_env"),
         ssh_key_path=_HEAD_SSH_KEY if uses_ssh else None,
         launched_at=time.time(),
-        agent_token=agent_token)
+        agent_token=agent_token,
+        docker_image=docker_image)
     _rpc_for_info(info, cluster_name).init_cluster(meta)
     return info
 
@@ -449,17 +462,31 @@ class TpuVmBackend:
         if rec is None:
             raise exceptions.ClusterNotUpError(f"no cluster {cluster_name}")
         handle = ClusterHandle(rec["handle"])
+        # Rebuild the FULL config: run_instances dispatches TPU-vs-
+        # Compute on the accelerator field, and the resume paths read
+        # ports/image/runtime_version — a bare config here would send a
+        # stopped TPU node down the Compute Engine path.
+        res = handle.resources
         config = ProvisionConfig(
             cluster_name=cluster_name,
             num_nodes=handle["num_nodes"],
             hosts_per_node=handle["hosts_per_node"],
-            zone=handle.zone, region=handle["region"])
+            zone=handle.zone, region=handle["region"],
+            accelerator=res.accelerator_name,
+            accelerator_count=res.accelerator_count,
+            instance_type=res.instance_type,
+            use_spot=res.use_spot,
+            runtime_version=res.runtime_version,
+            disk_size=res.disk_size,
+            image_id=res.image_id,
+            ports=list(res.ports) if res.ports else None)
         provision.run_instances(handle.provider, config)
         provision.wait_instances(handle.provider, cluster_name, handle.zone)
         # Re-run runtime init: restarted VMs may have new IPs, and the
         # head needs a fresh cluster.json (autostop config and job
         # history persist on the head's disk across stop/start).
-        _setup_and_init_runtime(handle.provider, cluster_name, handle.zone)
+        _setup_and_init_runtime(handle.provider, cluster_name, handle.zone,
+                                docker_image=res.docker_image)
         state.set_cluster_status(cluster_name, state.ClusterStatus.UP)
         return handle
 

@@ -90,3 +90,20 @@ def query_ports(provider: str, cluster_name: str) -> dict:
     providers serve directly on the host address."""
     fn = getattr(_impl(provider), "query_ports", None)
     return fn(cluster_name) if fn else {}
+
+
+def open_ports(provider: str, cluster_name: str, ports: list) -> None:
+    """Expose ``ports`` on the cluster (GCP: firewall rule targeting
+    the cluster's network tag; kubernetes: NodePort Service). Providers
+    also call this themselves at provision time when the config carries
+    ports; the dispatcher form serves post-hoc exposure (reference:
+    sky/provision/__init__.py open_ports)."""
+    fn = getattr(_impl(provider), "open_ports", None)
+    if fn:
+        fn(cluster_name, ports)
+
+
+def cleanup_ports(provider: str, cluster_name: str) -> None:
+    fn = getattr(_impl(provider), "cleanup_ports", None)
+    if fn:
+        fn(cluster_name)

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import gcp_auth
+from skypilot_tpu.resources import extract_docker_image
 from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
                                            ProvisionConfig, ProvisionRecord)
 from skypilot_tpu.utils import command_runner
@@ -83,13 +84,17 @@ def _map_http_error(code: int, body: str) -> Exception:
     low = body.lower()
     if code == 429 or "resource_exhausted" in low or "stockout" in low \
             or "no more capacity" in low or "out of capacity" in low:
-        return exceptions.CapacityError(f"TPU capacity error ({code}): {body}")
-    if code == 403 and "quota" in low:
-        return exceptions.QuotaExceededError(f"TPU quota error: {body}")
-    if code == 404:
-        return exceptions.ClusterNotUpError(f"TPU not found: {body}")
-    return exceptions.ResourcesUnavailableError(
-        f"TPU API error ({code}): {body}")
+        err: Exception = exceptions.CapacityError(
+            f"TPU capacity error ({code}): {body}")
+    elif code == 403 and "quota" in low:
+        err = exceptions.QuotaExceededError(f"TPU quota error: {body}")
+    elif code == 404:
+        err = exceptions.ClusterNotUpError(f"TPU not found: {body}")
+    else:
+        err = exceptions.ResourcesUnavailableError(
+            f"TPU API error ({code}): {body}")
+    err.http_code = code   # callers branch on 404/409 without parsing
+    return err
 
 
 # -- naming -----------------------------------------------------------------
@@ -214,6 +219,62 @@ def list_reservations_available(zone: str,
     return out
 
 
+# -- firewall / port exposure -----------------------------------------------
+#
+# Reference parity: sky/provision/gcp/instance.py:573 (open_ports),
+# :628 (cleanup_ports) and the firewall-rule machinery in
+# sky/provision/gcp/config.py:392-460. Design delta: instances are
+# network-tagged with the cluster name AT CREATE (both the TPU node and
+# Compute VM bodies carry the tag), so exposure is one idempotent
+# firewall-rule upsert — no retrofit tag-patching pass per instance.
+
+def _firewall_rule_name(cluster_name: str) -> str:
+    return f"skytpu-{cluster_name}-ports"
+
+
+def open_ports(cluster_name: str, ports: List[int],
+               zone: str = None) -> None:
+    """Create/update the cluster's ingress allow rule (tcp ``ports``
+    from anywhere, scoped by target tag to this cluster's instances).
+    Without it a default-VPC deployment silently blackholes every
+    serve LB/replica and task ``ports:`` endpoint."""
+    del zone  # firewall rules are global compute resources
+    if not ports:
+        return
+    project = gcp_auth.get_project()
+    name = _firewall_rule_name(cluster_name)
+    body = {
+        "name": name,
+        "description": f"skypilot-tpu ports for cluster {cluster_name}",
+        "network": "global/networks/default",
+        "direction": "INGRESS",
+        "allowed": [{"IPProtocol": "tcp",
+                     "ports": [str(p) for p in sorted(set(ports))]}],
+        "sourceRanges": ["0.0.0.0/0"],
+        "targetTags": [cluster_name],
+    }
+    url = f"{COMPUTE_API}/projects/{project}/global/firewalls"
+    try:
+        _http("POST", url, body)
+    except exceptions.ResourcesUnavailableError as e:
+        if getattr(e, "http_code", None) != 409:
+            raise
+        # Rule exists (resume / replica count change): converge it.
+        _http("PATCH", f"{url}/{name}", body)
+
+
+def cleanup_ports(cluster_name: str, zone: str = None) -> None:
+    """Delete the cluster's firewall rule; absent rule is fine (the
+    cluster may never have exposed ports)."""
+    del zone
+    project = gcp_auth.get_project()
+    try:
+        _http("DELETE", f"{COMPUTE_API}/projects/{project}/global/"
+                        f"firewalls/{_firewall_rule_name(cluster_name)}")
+    except exceptions.ClusterNotUpError:
+        pass
+
+
 def _is_tpu_config(config: ProvisionConfig) -> bool:
     """TPU vs Compute Engine dispatch (reference: GCPNodeType selection
     at sky/provision/gcp/instance_utils.py:1658-1666)."""
@@ -233,10 +294,12 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
     # Resume path: node(s) already exist?
     status = query_instances(config.cluster_name, config.zone)
     if status == "UP":
+        open_ports(config.cluster_name, config.ports)
         return ProvisionRecord("gcp", config.cluster_name, config.zone,
                                resumed=True)
     if status == "STOPPED":
         _http("POST", _node_url(config.cluster_name, config.zone) + ":start")
+        open_ports(config.cluster_name, config.ports)
         return ProvisionRecord("gcp", config.cluster_name, config.zone,
                                resumed=True)
 
@@ -244,6 +307,10 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
         "acceleratorType": to_gcp_accelerator_type(accel),
         "runtimeVersion": config.runtime_version,
         "networkConfig": {"enableExternalIps": True},
+        # The cluster network tag is attached at create so the port
+        # firewall rule (targetTags) applies to every node with no
+        # retrofit pass.
+        "tags": [config.cluster_name],
         "labels": dict(config.labels, **{"skypilot-tpu-cluster":
                                          config.cluster_name}),
         "metadata": {},
@@ -291,6 +358,7 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
               f"{TPU_API}/{_parent(config.zone)}/nodes"
               f"?nodeId={_node_name(config.cluster_name)}", node_body)
         ids = [config.cluster_name]
+    open_ports(config.cluster_name, config.ports)
     return ProvisionRecord("gcp", config.cluster_name, config.zone,
                            created_instance_ids=ids)
 
@@ -386,6 +454,9 @@ def terminate_instances(cluster_name: str, zone: str) -> None:
                   f"{_compute_zone_url(zone)}/instances/{vm['name']}")
         except exceptions.ClusterNotUpError:
             continue
+    # The port firewall rule dies with the cluster (reference:
+    # sky/provision/gcp/instance.py:628 cleanup_ports).
+    cleanup_ports(cluster_name)
 
 
 def query_instances(cluster_name: str, zone: str) -> str:
@@ -510,6 +581,7 @@ def _run_compute_instances(config: ProvisionConfig) -> ProvisionRecord:
     # create must top up the missing VMs, not silently under-provision.
     missing = [n for n in expected if n not in existing]
     if not missing:
+        open_ports(config.cluster_name, config.ports)
         return ProvisionRecord("gcp", config.cluster_name, config.zone,
                                resumed=True)
 
@@ -539,13 +611,19 @@ def _run_compute_instances(config: ProvisionConfig) -> ProvisionRecord:
                             f"{config.instance_type}"),
             "disks": [{"boot": True, "autoDelete": True,
                        "initializeParams": {
-                           "sourceImage": config.image_id
-                           or DEFAULT_VM_IMAGE,
+                           # docker:<img> is a CONTAINER image — the VM
+                           # still boots the stock image; the container
+                           # is set up post-provision.
+                           "sourceImage": (
+                               DEFAULT_VM_IMAGE
+                               if extract_docker_image(config.image_id)
+                               else config.image_id or DEFAULT_VM_IMAGE),
                            "diskSizeGb": str(config.disk_size)}}],
             "networkInterfaces": [{
                 "network": "global/networks/default",
                 "accessConfigs": [{"type": "ONE_TO_ONE_NAT",
                                    "name": "External NAT"}]}],
+            "tags": {"items": [config.cluster_name]},
             "labels": dict(config.labels,
                            **{"skypilot-tpu-cluster": config.cluster_name}),
             "metadata": {"items": _ssh_pubkey_metadata()},
@@ -577,6 +655,7 @@ def _run_compute_instances(config: ProvisionConfig) -> ProvisionRecord:
                 }
         _http("POST", f"{_compute_zone_url(config.zone)}/instances", body)
         created.append(name)
+    open_ports(config.cluster_name, config.ports)
     return ProvisionRecord("gcp", config.cluster_name, config.zone,
                            created_instance_ids=created,
                            resumed=bool(existing))

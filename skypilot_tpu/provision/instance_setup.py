@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import os
+import shlex
 import time
 from typing import List, Optional
 
@@ -85,6 +86,64 @@ def setup_runtime_on_cluster(info: ClusterInfo,
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=min(max_workers, max(len(runners), 1))) as ex:
         list(ex.map(setup_one, runners))
+
+
+DOCKER_CONTAINER = "skytpu-container"
+
+# `docker` without the daemon group needs sudo; probe once per command.
+_DOCKER_PREFIX = ('d=docker; docker info >/dev/null 2>&1 || '
+                  'd="sudo docker"; ')
+
+
+def docker_exec_command(inner: str, env: dict = None) -> str:
+    """Wrap a shell script to run inside the cluster's task container
+    with ``env`` injected (docker exec does not inherit the host
+    process env the way a plain detached job does)."""
+    flags = "".join(f"-e {shlex.quote(f'{k}={v}')} "
+                    for k, v in (env or {}).items())
+    return (f"{_DOCKER_PREFIX}$d exec {flags}{DOCKER_CONTAINER} "
+            f"bash -c {shlex.quote(inner)}")
+
+
+def setup_docker_on_cluster(info: ClusterInfo, image: str,
+                            max_workers: int = 32) -> None:
+    """Pull ``image`` and (re)start the task container on every host.
+
+    Reference parity: sky/provision/docker_utils.py (initialize: pull,
+    docker run with host networking, then exec user commands inside).
+    Design delta: the host's $HOME — including the synced framework pkg
+    and ~/sky_workdir — is bind-mounted at /root, so the container sees
+    exactly the files the plain-VM path uses; --net=host --privileged
+    keeps TPU device access and the gang rank/coordinator ports
+    identical inside and outside."""
+    runners = _runners(info)
+    q = shlex.quote(image)
+    cmds = [
+        # Stock VM images (the boot image under a docker: task) ship
+        # without docker — install it first (reference:
+        # docker_utils.py initialize checks/installs the daemon).
+        "command -v docker >/dev/null 2>&1 || "
+        "(sudo apt-get update -qq >/dev/null 2>&1 && "
+        "sudo apt-get install -y -qq docker.io >/dev/null) || "
+        "command -v docker",
+        f"{_DOCKER_PREFIX}$d pull {q}",
+        f"{_DOCKER_PREFIX}$d rm -f {DOCKER_CONTAINER} >/dev/null 2>&1; "
+        # --entrypoint: ML images commonly set ENTRYPOINT (python3,
+        # conda run, ...) which would turn a bare `sleep infinity` CMD
+        # into `<entrypoint> sleep infinity` and exit at once.
+        f'$d run -d --name {DOCKER_CONTAINER} --net=host --privileged '
+        f'-v "$HOME:/root" --entrypoint sleep {q} infinity',
+    ]
+
+    def docker_one(runner: command_runner.CommandRunner) -> None:
+        for cmd in cmds:
+            rc, out, err = runner.run(cmd, timeout=600)
+            if rc != 0:
+                raise exceptions.CommandError(rc, cmd, out + err)
+
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(max_workers, max(len(runners), 1))) as ex:
+        list(ex.map(docker_one, runners))
 
 
 def start_host_agents(info: ClusterInfo, token: str,

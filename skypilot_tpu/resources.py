@@ -69,6 +69,14 @@ class Resources:
         return catalog.is_tpu(self.accelerators)
 
     @property
+    def docker_image(self) -> Optional[str]:
+        """Container image when ``image_id: docker:<image>`` — the task
+        runs inside that container on the VM/TPU-VM (reference:
+        sky/resources.py:885 extract_docker_image; provisioning still
+        boots the stock VM image underneath)."""
+        return extract_docker_image(self.image_id)
+
+    @property
     def accelerator_name(self) -> Optional[str]:
         if self.accelerators is None:
             return None
@@ -194,6 +202,18 @@ class Resources:
         if isinstance(accel, dict):  # {"A100": 8} form
             (name, cnt), = accel.items()
             accel = f"{name}:{cnt}"
+        # Reference-YAML compat: accelerator_args: {runtime_version: X}
+        # (sky/resources.py:605-629) maps onto the first-class
+        # runtime_version field; other args have no TPU-VM meaning.
+        args = config.pop("accelerator_args", None)
+        if args:
+            extra = set(args) - {"runtime_version"}
+            if extra:
+                raise exceptions.InvalidTaskError(
+                    f"unsupported accelerator_args: {sorted(extra)} "
+                    f"(TPU-VM supports runtime_version)")
+            config.setdefault("runtime_version",
+                              args["runtime_version"])
         known = {f.name for f in dataclasses.fields(cls) if f.name != "_price"}
         unknown = set(config) - known
         if unknown:
@@ -223,6 +243,14 @@ class Resources:
         if self._price is not None:
             bits.append(f"${self._price:.2f}/h")
         return f"Resources({', '.join(bits)})"
+
+
+def extract_docker_image(image_id: Optional[str]) -> Optional[str]:
+    """The single owner of the ``docker:`` image_id scheme: returns the
+    container image, or None for VM images / unset."""
+    if image_id and image_id.startswith("docker:"):
+        return image_id[len("docker:"):]
+    return None
 
 
 def _is_blocked(cloud: str, region: str, zone: str, blocked: set) -> bool:

@@ -72,14 +72,32 @@ def build_job_env(meta: dict, job_id: int, host: dict) -> Dict[str, str]:
     return env
 
 
-def _wrap_script(run_cmd: str, rc_file: str, runner, workdir: bool) -> str:
+def _wrap_script(run_cmd: str, rc_file: str, runner, workdir: bool,
+                 docker_image: str = None,
+                 env: Dict[str, str] = None) -> str:
     """Wrap the job command: make the framework importable on this host,
     optionally enter the synced workdir, and record the exit code
-    atomically (tmp+mv) so the poll loop never reads a partial write."""
+    atomically (tmp+mv) so the poll loop never reads a partial write.
+
+    With ``docker_image`` the command itself runs inside the cluster's
+    task container (docker exec propagating the rank env — the
+    container does not inherit the detached process env); the rc file
+    is still written HOST-side so the poll loop and gang-kill work
+    unchanged. The container bind-mounts the host $HOME at /root, so
+    the synced pkg and sky_workdir resolve at the same relative paths."""
     if runner.is_local:
         pythonpath = (f"export PYTHONPATH="
                       f"{shlex.quote(command_runner.PKG_PARENT)}"
                       f":$PYTHONPATH; ")
+        if docker_image:
+            # The head's PKG_PARENT is a host-absolute path that may
+            # not exist inside the container; the synced pkg dir under
+            # $HOME does (the container bind-mounts host $HOME at
+            # /root) — export both so head-rank docker jobs can import
+            # the framework like the SSH ranks do.
+            pythonpath += (f'export PYTHONPATH="$HOME/'
+                           f'{command_runner.REMOTE_PKG_DIR}'
+                           f':$PYTHONPATH"; ')
     else:
         pythonpath = (f'export PYTHONPATH="$HOME/'
                       f'{command_runner.REMOTE_PKG_DIR}:$PYTHONPATH"; ')
@@ -87,7 +105,12 @@ def _wrap_script(run_cmd: str, rc_file: str, runner, workdir: bool) -> str:
     # in the rank log), not silently run the job in $HOME.
     cd = "cd sky_workdir && " if workdir else ""
     q = shlex.quote
-    return (f"{pythonpath}{cd}{run_cmd}; rc=$?; "
+    body = f"{pythonpath}{cd}{run_cmd}"
+    if docker_image:
+        from skypilot_tpu.provision import instance_setup
+        body = instance_setup.docker_exec_command(
+            f"cd \"$HOME\" && {body}", env=env)
+    return (f"{body}; rc=$?; "
             f"echo $rc > {q(rc_file + '.tmp')} && "
             f"mv {q(rc_file + '.tmp')} {q(rc_file)}; exit $rc")
 
@@ -150,7 +173,9 @@ def run_job(cluster_name: str, job_id: int,
                 runner.run(f"mkdir -p {scratch}")
                 rc_file = f"{scratch}/rc"
                 log_path = f"{scratch}/out.log"
-            wrapped = _wrap_script(job["run_cmd"], rc_file, runner, workdir)
+            wrapped = _wrap_script(job["run_cmd"], rc_file, runner, workdir,
+                                   docker_image=meta.get("docker_image"),
+                                   env=env)
             pid = runner.run_detached(wrapped, env=env,
                                       cwd=host.get("workspace"),
                                       log_path=log_path)

@@ -119,7 +119,8 @@ def from_cluster_info(info, provider_env: Dict[str, str] | None = None,
                       ssh_key_path: str | None = None,
                       launched_at: float | None = None,
                       agent_token: str | None = None,
-                      agent_port: int | None = None) -> Dict[str, Any]:
+                      agent_port: int | None = None,
+                      docker_image: str | None = None) -> Dict[str, Any]:
     """Client-side: build the cluster.json payload from a provision
     ClusterInfo (each HostInfo carries its runner kind)."""
     hosts = []
@@ -147,6 +148,7 @@ def from_cluster_info(info, provider_env: Dict[str, str] | None = None,
         "ssh_key_path": ssh_key_path,
         "agent_token": agent_token,
         "agent_port": agent_port,
+        "docker_image": docker_image,
         "provider_env": provider_env or {},
         "hosts": hosts,
     }

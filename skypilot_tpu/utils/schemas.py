@@ -23,6 +23,7 @@ _RESOURCES_SCHEMA: Dict[str, Any] = {
         "zone": {"type": ["string", "null"]},
         "accelerators": {"type": ["string", "object", "null"]},
         "runtime_version": {"type": ["string", "null"]},
+        "accelerator_args": {"type": ["object", "null"]},
         "job_recovery": {"type": ["string", "object", "null"]},
         "cpus": {"type": ["string", "number", "null"]},
         "memory": {"type": ["string", "number", "null"]},

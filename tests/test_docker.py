@@ -1,0 +1,114 @@
+"""Docker image tasks (``image_id: docker:<img>``) against a fake
+docker CLI: container setup at launch, job exec inside the container
+with the rank env propagated, logs flowing back. Offline — the fake
+`docker` executable records every invocation and emulates `exec` by
+running the inner command directly (VERDICT r3 #4).
+"""
+
+import os
+import stat
+import textwrap
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu.backend import TpuVmBackend
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.runtime.job_queue import JobStatus
+from skypilot_tpu.task import Task
+
+FAKE_DOCKER = textwrap.dedent("""\
+    #!/usr/bin/env -S python3 -S
+    import os, subprocess, sys
+    args = sys.argv[1:]
+    log = os.environ.get("FAKE_DOCKER_LOG")
+    if log and args and args[0] != "info":
+        with open(log, "a") as f:
+            f.write(" ".join(args) + chr(10))
+    if not args:
+        sys.exit(2)
+    cmd = args[0]
+    if cmd == "exec":
+        i = 1
+        env = {}
+        while args[i] == "-e":
+            k, _, v = args[i + 1].partition("=")
+            env[k] = v
+            i += 2
+        container, rest = args[i], args[i + 1:]
+        os.environ.update(env)
+        os.environ["IN_FAKE_CONTAINER"] = container
+        sys.exit(subprocess.call(rest))
+    sys.exit(0)
+""")
+
+
+@pytest.fixture()
+def fake_docker(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    exe = bindir / "docker"
+    exe.write_text(FAKE_DOCKER)
+    exe.chmod(exe.stat().st_mode | stat.S_IEXEC)
+    log = tmp_path / "docker_calls.log"
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_DOCKER_LOG", str(log))
+    yield log
+
+
+def _docker_task(run, image="myorg/task-env:1.2", name="d"):
+    t = Task(name=name, run=run)
+    t.set_resources(Resources(cloud="local",
+                              image_id=f"docker:{image}"))
+    return t
+
+
+def test_docker_image_property():
+    r = Resources(cloud="local", image_id="docker:ubuntu:22.04")
+    assert r.docker_image == "ubuntu:22.04"
+    assert Resources(cloud="local").docker_image is None
+    assert Resources(cloud="gcp",
+                     image_id="projects/x/global/images/y"
+                     ).docker_image is None
+
+
+def test_docker_setup_exec_logs(fake_docker):
+    t = _docker_task('echo "inside=$IN_FAKE_CONTAINER '
+                     'rank=$SKYTPU_NODE_RANK"')
+    job_id, handle = sky.launch(t, cluster_name="cdock")
+    status = TpuVmBackend().wait_job(handle, job_id, timeout=60)
+    assert status == JobStatus.SUCCEEDED
+
+    calls = fake_docker.read_text().splitlines()
+    # Launch-time container setup: pull then (re)create.
+    assert any(c.startswith("pull myorg/task-env:1.2") for c in calls)
+    runs = [c for c in calls if c.startswith("run ")]
+    assert runs and "--net=host" in runs[0] and \
+        "--name skytpu-container" in runs[0] and \
+        "myorg/task-env:1.2" in runs[0]
+    # The job ran through docker exec with the rank env as -e flags.
+    execs = [c for c in calls if c.startswith("exec ")]
+    assert execs and "SKYTPU_NODE_RANK=0" in execs[0]
+    # ...and the command really ran "inside" the container, seeing the
+    # injected env.
+    log_path = TpuVmBackend().job_log_paths(handle, job_id)[0]
+    content = open(log_path).read()
+    assert "inside=skytpu-container rank=0" in content
+    sky.down("cdock")
+
+
+def test_docker_exec_on_existing_cluster(fake_docker):
+    t = _docker_task("echo first")
+    job1, handle = sky.launch(t, cluster_name="cdock2")
+    TpuVmBackend().wait_job(handle, job1, timeout=60)
+    t2 = _docker_task('echo "second-in=$IN_FAKE_CONTAINER"',
+                      name="second")
+    job2, _ = sky.exec(t2, cluster_name="cdock2")
+    assert TpuVmBackend().wait_job(handle, job2,
+                                   timeout=60) == JobStatus.SUCCEEDED
+    content = open(
+        TpuVmBackend().job_log_paths(handle, job2)[0]).read()
+    assert "second-in=skytpu-container" in content
+    sky.down("cdock2")
