@@ -25,6 +25,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.resources import extract_docker_image
 from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
                                            ProvisionConfig, ProvisionRecord)
 from skypilot_tpu.utils.command_runner import CommandRunner
@@ -46,6 +47,19 @@ _GKE_TPU_ACCEL = {
     "v5e": "tpu-v5-lite-podslice",
     "v5p": "tpu-v5p-slice",
     "v6e": "tpu-v6e-slice",
+}
+
+# GPU accelerator name -> GKE node label value
+# (cloud.google.com/gke-accelerator). Reference:
+# sky/provision/kubernetes/utils.py GKELabelFormatter.
+_GKE_GPU_ACCEL = {
+    "T4": "nvidia-tesla-t4",
+    "L4": "nvidia-l4",
+    "V100": "nvidia-tesla-v100",
+    "P100": "nvidia-tesla-p100",
+    "A100": "nvidia-tesla-a100",
+    "A100-80GB": "nvidia-a100-80gb",
+    "H100": "nvidia-h100-80gb",
 }
 
 # (version, total chips) -> topology label. 8 chips/host for v5e/v6e
@@ -101,7 +115,13 @@ def pod_manifest(config: ProvisionConfig, node_id: int,
             "restartPolicy": "Never",
             "containers": [{
                 "name": "task",
-                "image": config.image_id or DEFAULT_IMAGE,
+                # On k8s the pod IS the container: a docker:<img>
+                # image_id becomes the pod image directly (the VM
+                # providers instead boot a stock image and run the
+                # container inside; backend skips that path for k8s
+                # hosts).
+                "image": (extract_docker_image(config.image_id)
+                          or config.image_id or DEFAULT_IMAGE),
                 "command": ["/bin/sh", "-c",
                             "sleep infinity"],
                 "resources": {"requests": {}, "limits": {}},
@@ -131,6 +151,33 @@ def pod_manifest(config: ProvisionConfig, node_id: int,
                 "key": "cloud.google.com/gke-spot",
                 "operator": "Equal", "value": "true",
                 "effect": "NoSchedule"}]
+            spec["spec"]["nodeSelector"][
+                "cloud.google.com/gke-spot"] = "true"
+    elif config.accelerator:
+        # GPU-on-k8s (LIVE-UNTESTED like the rest of this provider —
+        # modeled on GKE's device-plugin contract: nvidia.com/gpu
+        # requests + gke-accelerator node label; reference:
+        # sky/provision/kubernetes/utils.py GKELabelFormatter).
+        gke_label = _GKE_GPU_ACCEL.get(config.accelerator.upper())
+        if gke_label is None:
+            raise exceptions.ProvisionError(
+                f"no GKE accelerator mapping for "
+                f"{config.accelerator!r} (known: "
+                f"{sorted(_GKE_GPU_ACCEL)})")
+        n = config.accelerator_count or 1
+        spec["spec"].setdefault("nodeSelector", {})[
+            "cloud.google.com/gke-accelerator"] = gke_label
+        res = spec["spec"]["containers"][0]["resources"]
+        res["requests"]["nvidia.com/gpu"] = str(n)
+        res["limits"]["nvidia.com/gpu"] = str(n)
+        spec["spec"]["tolerations"] = [{
+            "key": "nvidia.com/gpu", "operator": "Exists",
+            "effect": "NoSchedule"}]
+        if config.use_spot:
+            spec["spec"]["tolerations"].append({
+                "key": "cloud.google.com/gke-spot",
+                "operator": "Equal", "value": "true",
+                "effect": "NoSchedule"})
             spec["spec"]["nodeSelector"][
                 "cloud.google.com/gke-spot"] = "true"
     return spec
@@ -184,14 +231,34 @@ def _service_name(cluster_name: str) -> str:
     return f"{cluster_name}-skytpu-svc"
 
 
-def service_manifest(cluster_name: str, ports: List[int]) -> Dict:
+def _ingress_name(cluster_name: str) -> str:
+    return f"{cluster_name}-skytpu-ingress"
+
+
+def ports_mode() -> str:
+    """'nodeport' (default — works on GKE and kind with no
+    prerequisite) | 'loadbalancer' | 'ingress' (requires an nginx
+    ingress controller). Reference parity:
+    sky/provision/kubernetes/network.py port modes."""
+    from skypilot_tpu import config as config_lib
+    mode = (config_lib.get_nested(("kubernetes", "ports"),
+                                  "nodeport") or "nodeport").lower()
+    if mode not in ("nodeport", "loadbalancer", "ingress"):
+        raise exceptions.ProvisionError(
+            f"kubernetes.ports must be nodeport|loadbalancer|ingress, "
+            f"got {mode!r}")
+    return mode
+
+
+def service_manifest(cluster_name: str, ports: List[int],
+                     svc_type: str = "NodePort") -> Dict:
     return {
         "apiVersion": "v1",
         "kind": "Service",
         "metadata": {"name": _service_name(cluster_name),
                      "labels": {LABEL: cluster_name}},
         "spec": {
-            "type": "NodePort",
+            "type": svc_type,
             "selector": {LABEL: cluster_name,
                          NODE_LABEL: "0", WORKER_LABEL: "0"},
             "ports": [{"name": f"p{p}", "port": int(p),
@@ -201,16 +268,63 @@ def service_manifest(cluster_name: str, ports: List[int]) -> Dict:
     }
 
 
+def ingress_manifest(cluster_name: str, ports: List[int]) -> Dict:
+    """One nginx Ingress fronting the cluster Service: port p is
+    reachable at path /skytpu/{cluster}/{p}/ (rewritten to / for the
+    backend). Reference: sky/provision/kubernetes/network.py ingress
+    mode with the same per-port path layout."""
+    return {
+        "apiVersion": "networking.k8s.io/v1",
+        "kind": "Ingress",
+        "metadata": {
+            "name": _ingress_name(cluster_name),
+            "labels": {LABEL: cluster_name},
+            "annotations": {
+                "nginx.ingress.kubernetes.io/rewrite-target": "/$2",
+                "nginx.ingress.kubernetes.io/use-regex": "true",
+            },
+        },
+        "spec": {
+            "ingressClassName": "nginx",
+            "rules": [{
+                "http": {
+                    "paths": [{
+                        "path": (f"/skytpu/{cluster_name}/{p}"
+                                 f"(/|$)(.*)"),
+                        "pathType": "ImplementationSpecific",
+                        "backend": {"service": {
+                            "name": _service_name(cluster_name),
+                            "port": {"number": int(p)}}},
+                    } for p in ports],
+                },
+            }],
+        },
+    }
+
+
 def open_ports(cluster_name: str, ports: List[int]) -> None:
-    manifest = service_manifest(cluster_name, ports)
+    mode = ports_mode()
+    svc_type = {"nodeport": "NodePort",
+                "loadbalancer": "LoadBalancer",
+                "ingress": "ClusterIP"}[mode]
+    manifest = service_manifest(cluster_name, ports, svc_type)
     rc, out = _run(["apply", "-f", "-"], stdin=json.dumps(manifest))
     if rc != 0:
         raise exceptions.ProvisionError(
             f"kubectl apply (service) failed: {out.strip()}")
+    if mode == "ingress":
+        rc, out = _run(["apply", "-f", "-"],
+                       stdin=json.dumps(
+                           ingress_manifest(cluster_name, ports)))
+        if rc != 0:
+            raise exceptions.ProvisionError(
+                f"kubectl apply (ingress) failed: {out.strip()}")
 
 
 def cleanup_ports(cluster_name: str) -> None:
     _run(["delete", "service", _service_name(cluster_name),
+          "--ignore-not-found", "--wait=false"])
+    _run(["delete", "ingress", _ingress_name(cluster_name),
           "--ignore-not-found", "--wait=false"])
 
 
@@ -245,10 +359,27 @@ def _node_address() -> Optional[str]:
 
 
 def query_ports(cluster_name: str) -> Dict[int, str]:
-    """{service port: "host:node_port"} for the cluster's Service."""
+    """{service port: endpoint} for the cluster's exposure objects.
+    Endpoint shapes per mode (all usable as ``http://{endpoint}``):
+    nodeport ``node_addr:node_port``, loadbalancer ``lb_addr:port``,
+    ingress ``ingress_addr/skytpu/{cluster}/{port}``."""
     svc = _get_service(cluster_name)
     if svc is None:
         return {}
+    ports = [int(p["port"])
+             for p in svc.get("spec", {}).get("ports", [])]
+    svc_type = svc.get("spec", {}).get("type", "NodePort")
+    if svc_type == "LoadBalancer":
+        addr = _lb_address(svc.get("status", {}))
+        return ({p: f"{addr}:{p}" for p in ports} if addr else {})
+    if svc_type == "ClusterIP":      # ingress mode
+        rc, out = _run(["get", "ingress", _ingress_name(cluster_name),
+                        "-o", "json"])
+        ing = _json_from(out) if rc == 0 else None
+        addr = _lb_address(ing.get("status", {})) if ing else None
+        if not addr:
+            return {}
+        return {p: f"{addr}/skytpu/{cluster_name}/{p}" for p in ports}
     host = _node_address()
     if host is None:
         return {}
@@ -258,6 +389,15 @@ def query_ports(cluster_name: str) -> Dict[int, str]:
         if node_port:
             out[int(p["port"])] = f"{host}:{node_port}"
     return out
+
+
+def _lb_address(status: Dict) -> Optional[str]:
+    for ing in status.get("loadBalancer", {}).get("ingress", []):
+        if ing.get("ip"):
+            return ing["ip"]
+        if ing.get("hostname"):
+            return ing["hostname"]
+    return None
 
 
 def port_forward_command(cluster_name: str, port: int,
