@@ -1,0 +1,123 @@
+"""Live-cloud smoke-test DSL (reference:
+tests/smoke_tests/smoke_tests_utils.py — Test NamedTuple + run_one_test
+shell runner). A smoke test is an ordered list of shell commands run
+serially against REAL cloud credentials; any nonzero exit fails the
+test and the teardown always runs.
+
+These tests are skipped unless GCP credentials and a project are
+configured (`gcloud auth` + project) — the first user with a project
+can validate provisioning end-to-end with:
+
+    SKYTPU_SMOKE=1 pytest tests/smoke/ -v
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import pytest
+
+DEFAULT_TIMEOUT_S = 25 * 60
+
+# Suffix every cluster name so two smoke runs (or two users in one
+# project) never collide.
+_RUN_ID = uuid.uuid4().hex[:4]
+
+SKYTPU = f"{sys.executable} -m skypilot_tpu.client.cli"
+
+
+def has_gcp_credentials() -> bool:
+    """Credentials + project present AND smoke explicitly requested —
+    a `pytest tests/` in CI must never bill a cloud account by
+    accident."""
+    if not os.environ.get("SKYTPU_SMOKE"):
+        return False
+    if shutil.which("gcloud") is None:
+        return False
+    try:
+        from skypilot_tpu.provision import gcp_auth
+        return bool(gcp_auth.get_project()) and \
+            bool(gcp_auth.get_access_token())
+    except Exception:  # noqa: BLE001
+        return False
+
+
+requires_gcp = pytest.mark.skipif(
+    not has_gcp_credentials(),
+    reason="live-GCP smoke test: set SKYTPU_SMOKE=1 with gcloud "
+           "credentials and a project configured")
+
+
+def smoke_name(prefix: str) -> str:
+    return f"smk-{prefix}-{_RUN_ID}"
+
+
+@dataclasses.dataclass
+class SmokeTest:
+    name: str
+    commands: List[str]          # serial; first failure stops the test
+    teardown: Optional[str] = None   # always runs
+    timeout: int = DEFAULT_TIMEOUT_S
+    env: Optional[Dict[str, str]] = None
+
+
+def wait_cluster_status(cluster: str, statuses: List[str],
+                        timeout_s: int = 900, poll_s: int = 15) -> str:
+    """Shell snippet: poll `skytpu status` until the cluster shows one
+    of ``statuses`` (reference: smoke_tests_utils.py
+    get_cmd_wait_until_cluster_status_contains)."""
+    pat = r"\|".join(statuses)
+    return (
+        f"end=$(( $(date +%s) + {timeout_s} )); "
+        f"while [ $(date +%s) -lt $end ]; do "
+        f"s=$({SKYTPU} status {cluster} 2>/dev/null); echo \"$s\"; "
+        f"echo \"$s\" | grep -E '{pat}' && exit 0; "
+        f"sleep {poll_s}; done; "
+        f"echo 'TIMEOUT waiting for {'/'.join(statuses)}'; exit 1")
+
+
+def wait_job_status(cluster: str, job_id: int, statuses: List[str],
+                    timeout_s: int = 900, poll_s: int = 10) -> str:
+    pat = r"\|".join(statuses)
+    return (
+        f"end=$(( $(date +%s) + {timeout_s} )); "
+        f"while [ $(date +%s) -lt $end ]; do "
+        f"q=$({SKYTPU} queue {cluster} 2>/dev/null); echo \"$q\"; "
+        f"echo \"$q\" | grep -E '^ *{job_id} .*({pat})' && exit 0; "
+        f"sleep {poll_s}; done; "
+        f"echo 'TIMEOUT waiting for job {job_id}'; exit 1")
+
+
+def run_one_test(test: SmokeTest) -> None:
+    """Run the commands serially through bash, streaming output; the
+    teardown runs regardless of pass/fail (billable resources must not
+    outlive a red test)."""
+    env = dict(os.environ, **(test.env or {}))
+    failed_cmd = None
+    try:
+        for cmd in test.commands:
+            print(f"[{test.name}] $ {cmd}", file=sys.stderr, flush=True)
+            t0 = time.time()
+            proc = subprocess.run(["bash", "-c", cmd], env=env,
+                                  timeout=test.timeout)
+            print(f"[{test.name}] rc={proc.returncode} "
+                  f"({time.time() - t0:.0f}s)", file=sys.stderr,
+                  flush=True)
+            if proc.returncode != 0:
+                failed_cmd = cmd
+                break
+    finally:
+        if test.teardown:
+            print(f"[{test.name}] teardown: {test.teardown}",
+                  file=sys.stderr, flush=True)
+            subprocess.run(["bash", "-c", test.teardown], env=env,
+                           timeout=test.timeout)
+    assert failed_cmd is None, \
+        f"smoke test {test.name} failed at: {failed_cmd}"
