@@ -108,9 +108,33 @@ def exec_cmd(cluster, yaml_or_command, name, detach_run):
 
 @cli.command()
 @click.option("--refresh", "-r", is_flag=True, default=False)
+@click.option("--ip", "show_ip", is_flag=True, default=False,
+              help="Print only the head host IP of ONE cluster "
+                   "(external when it has one), for scripting.")
 @click.argument("clusters", nargs=-1)
-def status(refresh, clusters):
+def status(refresh, show_ip, clusters):
     """Show clusters."""
+    if show_ip:
+        # Reference parity: `sky status --ip` (sky/cli.py status).
+        if len(clusters) != 1:
+            raise click.UsageError("--ip requires exactly one cluster")
+        from skypilot_tpu import provision
+        records = sky.status(list(clusters), refresh=refresh)
+        if not records:
+            raise click.ClickException(f"no cluster {clusters[0]!r}")
+        if records[0]["status"].value != "UP":
+            raise click.ClickException(
+                f"cluster {clusters[0]!r} is "
+                f"{records[0]['status'].value}, not UP")
+        h = records[0]["handle"]
+        info = provision.get_cluster_info(h["provider"], clusters[0],
+                                          h.get("zone"))
+        if not info.hosts:
+            raise click.ClickException(
+                f"cluster {clusters[0]!r} has no reachable hosts")
+        head = info.hosts[0]
+        click.echo(head.external_ip or head.internal_ip)
+        return
     records = sky.status(list(clusters) or None, refresh=refresh)
     if not records:
         click.echo("No existing clusters.")

@@ -125,11 +125,15 @@ def _mixed_prompts(rng, vocab, requests, lo=512, hi=1024):
     return prompts, (lo, hi)
 
 
-def _client_wave(host, port, payloads, timeout=600.0):
+def _client_wave(host, port, payloads, timeout=600.0, stagger_s=0.0):
     """Fire every payload concurrently from ONE thread (raw sockets +
     a selector). A thread-per-request client adds GIL scheduling jitter
     that rivals the TTFTs being measured on a single-core host — the
     r3 driver artifact showed 5x run-to-run TTFT variance.
+
+    ``stagger_s`` paces arrivals: request i is sent at i*stagger_s —
+    an open-ish workload instead of one instantaneous burst, so
+    admission overlaps decode the way production traffic does.
 
     Returns [(ttft_s, n_tokens, total_s)] aligned with payloads.
     TTFT is wall time from request send to the first BODY byte (the
@@ -141,22 +145,36 @@ def _client_wave(host, port, payloads, timeout=600.0):
 
     sel = selectors.DefaultSelector()
     conns = []
-    for body in payloads:
+    t_start = time.time()
+    unsent = []
+    for i, body in enumerate(payloads):
         s = socket.create_connection((host, port))
         head = (f"POST /generate HTTP/1.1\r\nHost: {host}\r\n"
                 "Content-Type: application/json\r\n"
                 f"Content-Length: {len(body)}\r\n\r\n").encode()
-        s.sendall(head + body)
-        st = {"sock": s, "t0": time.time(), "buf": b"", "first": None,
+        st = {"sock": s, "t0": None, "buf": b"", "first": None,
               "hdr_end": None, "done": None}
-        s.setblocking(False)
-        sel.register(s, selectors.EVENT_READ, st)
         conns.append(st)
+        unsent.append((t_start + i * stagger_s, s, head + body, st))
 
+    def send_due():
+        while unsent and time.time() >= unsent[0][0]:
+            _, s, data, st = unsent.pop(0)
+            s.sendall(data)            # still blocking: full send
+            st["t0"] = time.time()
+            s.setblocking(False)
+            sel.register(s, selectors.EVENT_READ, st)
+
+    send_due()
     deadline = time.time() + timeout
     live = len(conns)
     while live and time.time() < deadline:
-        for key, _ in sel.select(timeout=1.0):
+        wait = 1.0
+        if unsent:
+            wait = max(min(wait, unsent[0][0] - time.time()), 0.0)
+        events = sel.select(timeout=wait)
+        send_due()
+        for key, _ in events:
             st = key.data
             try:
                 piece = st["sock"].recv(1 << 16)
@@ -173,11 +191,24 @@ def _client_wave(host, port, payloads, timeout=600.0):
                 pos = st["buf"].find(b"\r\n\r\n")
                 if pos >= 0:
                     st["hdr_end"] = pos + 4
+                    hdrs = st["buf"][:pos].lower()
+                    # Error paths (400/500, LB 503) respond with
+                    # Content-Length over the same keep-alive socket —
+                    # no chunked terminator, no close; completion must
+                    # come from the framed length.
+                    m = re.search(rb"content-length:\s*(\d+)", hdrs)
+                    if m:
+                        st["clen"] = int(m.group(1))
             if (st["first"] is None and st["hdr_end"] is not None
                     and len(st["buf"]) > st["hdr_end"]):
                 st["first"] = now
+            done = False
+            if st["hdr_end"] is not None and st.get("clen") is not None:
+                done = (len(st["buf"]) - st["hdr_end"] >= st["clen"])
             # Chunked body ends with the zero-length chunk.
-            if st["buf"].endswith(b"0\r\n\r\n"):
+            elif st["buf"].endswith(b"0\r\n\r\n"):
+                done = True
+            if done:
                 sel.unregister(st["sock"])
                 st["done"] = now
                 live -= 1
@@ -192,6 +223,13 @@ def _client_wave(host, port, payloads, timeout=600.0):
         if b" 200 " not in status + b" ":
             raise AssertionError(f"non-200 response: {status!r} "
                                  f"{st['buf'][:300]!r}")
+        body = st["buf"][st["hdr_end"]:]
+        if re.search(rb'"error"\s*:', body):
+            # A mid-stream engine failure ends the 200 stream with an
+            # {"error": ...} line — counting it as a 0-token success
+            # would silently corrupt the bench numbers.
+            raise AssertionError(f"engine error mid-stream: "
+                                 f"{body[:300]!r}")
         m = re.search(rb'"n_tokens":\s*(\d+)', st["buf"])
         n_tok = int(m.group(1)) if m else 0
         out.append((st["first"] - st["t0"], n_tok,
@@ -202,7 +240,8 @@ def _client_wave(host, port, payloads, timeout=600.0):
 def run_http(config=None, requests=16, slots=16, prompt_len=None,
              new_tokens=64, max_burst=8, kv_int8=False,
              weights_int8=False, admit_wave=None, open_burst=4,
-             repeats=1, prompt_lo=512, prompt_hi=1024) -> dict:
+             repeats=1, prompt_lo=512, prompt_hi=1024,
+             stagger_s=0.0) -> dict:
     """End-to-end streaming bench: requests go over HTTP through a REAL
     load balancer to the model server, and TTFT is the wall time to the
     FIRST STREAMED BYTE of each response — the JetStream comparison
@@ -228,6 +267,11 @@ def run_http(config=None, requests=16, slots=16, prompt_len=None,
     on_cpu = jax.default_backend() == "cpu"
     if config is None:
         config = "llama3-tiny" if on_cpu else "llama3-400m"
+    if admit_wave is None:
+        # pad_waves below needs a wave cap: without one the engine
+        # silently falls back to power-of-two padding and a novel
+        # (bucket, rows) pair can hit a mid-measurement XLA compile.
+        admit_wave = 4
 
     home = tempfile.mkdtemp(prefix="skytpu-bench-serve-")
     os.environ["SKYPILOT_TPU_HOME"] = home
@@ -296,7 +340,8 @@ def run_http(config=None, requests=16, slots=16, prompt_len=None,
     all_ttfts = []
     for rep in range(max(repeats, 1)):
         t0 = time.time()
-        res = _client_wave("127.0.0.1", lb_port, payloads)
+        res = _client_wave("127.0.0.1", lb_port, payloads,
+                           stagger_s=stagger_s)
         wall = time.time() - t0
         ttfts = sorted(r[0] * 1e3 for r in res)
         all_ttfts.extend(ttfts)
@@ -343,6 +388,7 @@ def run_http(config=None, requests=16, slots=16, prompt_len=None,
         "prompt_mean_len": round(mean_len, 1),
         "prompt_max_len": max(len(p) for p in prompts),
         "new_tokens": new_tokens,
+        "stagger_s": stagger_s,
         "config": config,
         "kv_int8": kv_int8,
         "weights_int8": weights_int8,
@@ -365,6 +411,9 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=1,
                     help="timed runs on the warm server; the summary "
                          "reports median-of-runs and the worst run")
+    ap.add_argument("--stagger", type=float, default=0.0,
+                    help="seconds between request arrivals (0 = one "
+                         "instantaneous burst)")
     ap.add_argument("--kv-int8", action="store_true")
     ap.add_argument("--weights-int8", action="store_true")
     ap.add_argument("--admit-wave", type=int, default=None,
@@ -389,7 +438,7 @@ def main() -> None:
                      weights_int8=args.weights_int8,
                      admit_wave=args.admit_wave,
                      open_burst=args.open_burst,
-                     repeats=args.repeats)
+                     repeats=args.repeats, stagger_s=args.stagger)
     out = {
         "metric": "serve_median_ttft",
         "value": r["median_ttft_ms"],

@@ -355,7 +355,10 @@ def main() -> None:
         sampling_params=sampling.SamplingParams(
             temperature=args.temperature),
         kv_int8=args.kv_int8, weights_int8=args.weights_int8,
-        max_wave=args.admit_wave)
+        max_wave=args.admit_wave,
+        # One compiled prefill program per bucket: an odd wave size
+        # must never hit a mid-traffic XLA compile on a live replica.
+        pad_waves=True)
     # The engine slims its own tree under weights_int8; drop main()'s
     # reference too or the fp block weights stay resident for the whole
     # server lifetime and the memory halving never happens.

@@ -47,6 +47,21 @@ def test_launch_local_roundtrip(runner):
     assert "clic" not in res.output
 
 
+def test_status_ip(runner):
+    res = runner.invoke(cli_mod.cli, [
+        "launch", "echo up", "--cloud", "local", "-c", "clip"])
+    assert res.exit_code == 0, res.output
+    try:
+        res = runner.invoke(cli_mod.cli, ["status", "clip", "--ip"])
+        assert res.exit_code == 0, res.output
+        assert res.output.strip()  # one bare address line
+        assert "\n" not in res.output.strip()
+        res = runner.invoke(cli_mod.cli, ["status", "--ip"])
+        assert res.exit_code != 0  # exactly one cluster required
+    finally:
+        runner.invoke(cli_mod.cli, ["down", "clip"])
+
+
 def test_launch_from_yaml(runner, tmp_path):
     yaml_file = tmp_path / "task.yaml"
     yaml_file.write_text(
