@@ -74,10 +74,17 @@ def init_cache(cfg: llama.LlamaConfig, n_slots: int,
     if kv_int8:
         cache["k"] = jnp.zeros((L, n_slots, max_len, G, hd), jnp.int8)
         cache["v"] = jnp.zeros((L, n_slots, max_len, G, hd), jnp.int8)
-        cache["k_scale"] = jnp.zeros((L, n_slots, max_len, G),
-                                     jnp.float32)
-        cache["v_scale"] = jnp.zeros((L, n_slots, max_len, G),
-                                     jnp.float32)
+        # Scales: [..., G, max_len] (row dim last) in BF16. Both choices
+        # fight TPU tile padding: XLA lays the G=8 dim minormost
+        # whatever the logical order, and an f32 minormost dim of 8
+        # pads 8->128 — a 16x expansion that was 2x730 MB of HBM at 32
+        # slots (per the XLA OOM allocation dump). bf16 tiles (16,128)
+        # cap the waste at 2x, and scale precision is irrelevant at
+        # absmax/127 granularity.
+        cache["k_scale"] = jnp.zeros((L, n_slots, G, max_len),
+                                     jnp.bfloat16)
+        cache["v_scale"] = jnp.zeros((L, n_slots, G, max_len),
+                                     jnp.bfloat16)
     else:
         cache["k"] = jnp.zeros((L, n_slots, max_len, G, hd), cfg.dtype)
         cache["v"] = jnp.zeros((L, n_slots, max_len, G, hd), cfg.dtype)
@@ -244,8 +251,8 @@ def cache_logical_axes(cache: Cache | None = None) -> Dict[str, Tuple]:
         "last_token": ("batch",),
     }
     if cache is not None and "k_scale" in cache:
-        axes["k_scale"] = ("layer", "batch", "seq_cache", "kv_heads")
-        axes["v_scale"] = ("layer", "batch", "seq_cache", "kv_heads")
+        axes["k_scale"] = ("layer", "batch", "kv_heads", "seq_cache")
+        axes["v_scale"] = ("layer", "batch", "kv_heads", "seq_cache")
     return axes
 
 
@@ -256,18 +263,32 @@ def cache_logical_axes(cache: Cache | None = None) -> Dict[str, Tuple]:
 def prefill(params: llama.Params, tokens: jax.Array, true_len: jax.Array,
             cfg: llama.LlamaConfig,
             constrain=None, qweights=None) -> Tuple[Cache, jax.Array]:
-    """Causal forward over a right-padded prompt.
+    """Causal forward over ONE right-padded prompt ([S_bucket] int32);
+    see :func:`prefill_batch` for the batched core. Returns
+    ({"k","v"}: [L, S_bucket, G, hd], logits [vocab] fp32)."""
+    prefix, logits = prefill_batch(params, tokens[None], true_len[None],
+                                   cfg, constrain=constrain,
+                                   qweights=qweights)
+    return {"k": prefix["k"][:, 0], "v": prefix["v"][:, 0]}, logits[0]
 
-    tokens: [S_bucket] int32 (single request), true_len: scalar int32.
-    Returns ({"k","v"}: [L, S_bucket, G, hd] post-rope rows, logits at
-    the last real position [vocab] fp32). With ``qweights`` the block
+
+def prefill_batch(params: llama.Params, tokens: jax.Array,
+                  true_lens: jax.Array, cfg: llama.LlamaConfig,
+                  constrain=None, qweights=None) -> Tuple[Cache, jax.Array]:
+    """Causal forward over a WAVE of right-padded prompts.
+
+    tokens: [W, S_bucket] int32, true_lens: [W] int32.
+    Returns ({"k","v"}: [L, W, S_bucket, G, hd] post-rope rows, logits
+    at each request's last real position [W, vocab] fp32). One batched
+    program per wave: the W requests share every weight read and the
+    matmuls run at W x S rows — admission cost per request drops vs a
+    scan of W single-request prefills. With ``qweights`` the block
     matmuls + head run w8a8 int8, so params may omit the fp matrices
     entirely (slim tree: embed + norms only).
     """
     if constrain is None:
         constrain = lambda x, axes: x
     wq8 = qweights is not None
-    tokens = tokens[None]                                     # [1, S]
     S = tokens.shape[1]
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = jnp.arange(S)
@@ -299,15 +320,16 @@ def prefill(params: llama.Params, tokens: jax.Array, true_len: jax.Array,
                          qlayer, "w_down", 1, cfg.dtype)
         else:
             x = x + _ffn(cfg, h, layer)
-        return x, (k[0], v[0])
+        return x, (k, v)
 
     xs = ((params["blocks"], qweights["blocks"]) if wq8
           else params["blocks"])
-    x, (ks, vs) = lax.scan(body, x, xs)
+    x, (ks, vs) = lax.scan(body, x, xs)        # ks: [L, W, S, G, hd]
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
-    last = x[0, true_len - 1]                                  # [D]
+    last = jnp.take_along_axis(
+        x, (true_lens - 1)[:, None, None], axis=1)[:, 0]       # [W, D]
     if wq8:
-        logits = qeinsum("d,dv->v", last, qweights["head"], 1,
+        logits = qeinsum("wd,dv->wv", last, qweights["head"], 1,
                          jnp.float32)
     else:
         head = (params["embed"].T if cfg.tie_embeddings
@@ -326,12 +348,15 @@ def insert(cache: Cache, prefix: Cache, slot: jax.Array,
     out = dict(cache)
     pk, pv = prefix["k"], prefix["v"]
     if "k_scale" in cache:
-        pk, ks = quantize_rows(pk)
+        pk, ks = quantize_rows(pk)          # ks/vs: [L, S, G]
         pv, vs = quantize_rows(pv)
+        sdt = cache["k_scale"].dtype
         out["k_scale"] = lax.dynamic_update_slice(
-            cache["k_scale"], ks[:, None], (0, slot, 0, 0))
+            cache["k_scale"], ks.transpose(0, 2, 1)[:, None].astype(sdt),
+            (0, slot, 0, 0))
         out["v_scale"] = lax.dynamic_update_slice(
-            cache["v_scale"], vs[:, None], (0, slot, 0, 0))
+            cache["v_scale"], vs.transpose(0, 2, 1)[:, None].astype(sdt),
+            (0, slot, 0, 0))
     out["k"] = lax.dynamic_update_slice(
         cache["k"], pk[:, None], (0, slot, 0, 0, 0))
     out["v"] = lax.dynamic_update_slice(
@@ -377,17 +402,23 @@ def decode_step(params: llama.Params, cache: Cache,
     quant = "k_scale" in cache
     wq8 = qweights is not None
 
-    def body(carry, layer_kv):
-        x = carry
+    # The cache rides in the scan CARRY and is updated per layer with
+    # dynamic_update_slice — XLA's in-place while-loop pattern. Passing
+    # it through xs/ys instead allocates a fresh stacked-ys copy of the
+    # whole cache (2 x 1.4 GB HLO temps in the OOM dump at 32 slots):
+    # a while carry aliases input to output, scan ys cannot.
+    def body(carry, layer_q):
+        x, i, ak, av, aks, avs = carry
         if wq8:
-            layer, qlayer, *kv = layer_kv
+            layer, qlayer = layer_q
         else:
-            layer, *kv = layer_kv
-            qlayer = None
+            layer, qlayer = layer_q, None
+        ck = lax.dynamic_index_in_dim(ak, i, 0, keepdims=False)
+        cv = lax.dynamic_index_in_dim(av, i, 0, keepdims=False)
         if quant:
-            ck, cv, cks, cvs = kv                           # ck int8
+            cks = lax.dynamic_index_in_dim(aks, i, 0, keepdims=False)
+            cvs = lax.dynamic_index_in_dim(avs, i, 0, keepdims=False)
         else:
-            ck, cv = kv                                     # ck [B,M,G,hd]
             cks = cvs = None
         h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
         q = proj("bsd,dhk->bshk", h, layer, qlayer, "wq", 1, cfg.dtype)
@@ -396,25 +427,32 @@ def decode_step(params: llama.Params, cache: Cache,
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         if quant:
-            kq, ks = quantize_rows(k[:, 0])
+            kq, ks = quantize_rows(k[:, 0])     # ks/vs: [B, G]
             vq, vs = quantize_rows(v[:, 0])
             ck = ck.at[batch_ix, pos].set(kq)
             cv = cv.at[batch_ix, pos].set(vq)
-            cks = cks.at[batch_ix, pos].set(ks)
-            cvs = cvs.at[batch_ix, pos].set(vs)
-            # Dequant fuses into the einsums: HBM reads stay int8.
-            ck_f = dequantize_rows(ck, cks)
-            cv_f = dequantize_rows(cv, cvs)
+            sdt = cks.dtype
+            cks = cks.at[batch_ix, :, pos].set(ks.astype(sdt))
+            cvs = cvs.at[batch_ix, :, pos].set(vs.astype(sdt))
         else:
             ck = ck.at[batch_ix, pos].set(k[:, 0])
             cv = cv.at[batch_ix, pos].set(v[:, 0])
-            ck_f = ck.astype(jnp.float32)
-            cv_f = cv.astype(jnp.float32)
+        # The dots read the cache at its stored dtype (int8 converts
+        # inline); per-row scales are linear in the contraction, so
+        # K's scale applies to the SCORES and V's folds into the
+        # softmax weights — no [B, M, G, hd]-shaped dequantized
+        # intermediate to materialize.
+        ck_f = ck.astype(jnp.float32)
+        cv_f = cv.astype(jnp.float32)
         qh = q[:, 0].reshape(B, G, rep, hd)
         s = jnp.einsum("bgrk,bmgk->bgrm", qh.astype(jnp.float32),
                        ck_f) * scale
+        if quant:
+            s = s * cks[:, :, None, :]
         s = jnp.where(valid[:, None, None, :], s, neg)
         w = jax.nn.softmax(s, axis=-1)
+        if quant:
+            w = w * cvs[:, :, None, :]
         o = jnp.einsum("bgrm,bmgk->bgrk", w, cv_f)
         o = o.reshape(B, 1, cfg.n_heads, hd).astype(cfg.dtype)
         o = proj("bshk,hkd->bsd", o, layer, qlayer, "wo", 2, cfg.dtype)
@@ -430,16 +468,19 @@ def decode_step(params: llama.Params, cache: Cache,
             x = x + m
         else:
             x = x + _ffn(cfg, h, layer)
-        out_kv = (ck, cv, cks, cvs) if quant else (ck, cv)
-        return x, out_kv
+        ak = lax.dynamic_update_index_in_dim(ak, ck, i, 0)
+        av = lax.dynamic_update_index_in_dim(av, cv, i, 0)
+        if quant:
+            aks = lax.dynamic_update_index_in_dim(aks, cks, i, 0)
+            avs = lax.dynamic_update_index_in_dim(avs, cvs, i, 0)
+        return (x, i + 1, ak, av, aks, avs), None
 
-    xs = [params["blocks"]]
-    if wq8:
-        xs.append(qweights["blocks"])
-    xs += [cache["k"], cache["v"]]
-    if quant:
-        xs += [cache["k_scale"], cache["v_scale"]]
-    x, new_kv = lax.scan(body, x, tuple(xs))
+    xs = ((params["blocks"], qweights["blocks"]) if wq8
+          else params["blocks"])
+    init = (x, jnp.int32(0), cache["k"], cache["v"],
+            cache.get("k_scale", jnp.zeros((), jnp.bfloat16)),
+            cache.get("v_scale", jnp.zeros((), jnp.bfloat16)))
+    (x, _, nk, nv, nks, nvs), _ = lax.scan(body, init, xs)
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     if wq8:
         logits = qeinsum("bsd,dv->bsv", x, qweights["head"], 1,
@@ -450,10 +491,9 @@ def decode_step(params: llama.Params, cache: Cache,
         logits = jnp.einsum("bsd,dv->bsv", x,
                             head.astype(cfg.dtype))[:, 0].astype(jnp.float32)
     out = dict(cache)
+    out["k"], out["v"] = nk, nv
     if quant:
-        out["k"], out["v"], out["k_scale"], out["v_scale"] = new_kv
-    else:
-        out["k"], out["v"] = new_kv
+        out["k_scale"], out["v_scale"] = nks, nvs
     return out, logits
 
 

@@ -219,7 +219,8 @@ def test_kv_int8_quantize_roundtrip():
 def test_kv_int8_cache_shapes(cfg):
     c = kvcache.init_cache(cfg, 3, 16, kv_int8=True)
     assert c["k"].dtype == jnp.int8
-    assert c["k_scale"].shape == (cfg.n_layers, 3, 16, cfg.n_kv_heads)
+    # Row dim minormost: [..., G] minor would tile-pad 8->128 (16x).
+    assert c["k_scale"].shape == (cfg.n_layers, 3, cfg.n_kv_heads, 16)
     axes = kvcache.cache_logical_axes(c)
     assert "k_scale" in axes
     assert "k_scale" not in kvcache.cache_logical_axes()

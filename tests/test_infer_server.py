@@ -222,13 +222,33 @@ def test_adaptive_burst_short_while_slots_free():
 
 
 def test_adaptive_burst_open_window():
-    """With free slots remaining, the server uses open_burst."""
+    """With free slots remaining and traffic recent, the server uses
+    open_burst. open_window_s pinned huge: a loop-thread stall on a
+    loaded CI host must not flip the quiet fallback mid-test."""
     fake = _FakeEngine(n_slots=8)
-    model = srv.ModelServer(fake, max_burst=16, open_burst=2)
+    model = srv.ModelServer(fake, max_burst=16, open_burst=2,
+                            open_window_s=1e9)
     try:
         p = model._add([1, 2], 6)
         assert p.event.wait(timeout=30)
         assert fake.bursts and all(b == 2 for b in fake.bursts)
+    finally:
+        model.shutdown()
+
+
+def test_adaptive_burst_long_when_quiet():
+    """Free slots alone must not pin bursts short: once no request has
+    arrived for open_window_s, bursts go long (a partially loaded
+    server would otherwise pay per-burst dispatch forever)."""
+    fake = _FakeEngine(n_slots=8)
+    model = srv.ModelServer(fake, max_burst=16, open_burst=2,
+                            open_window_s=0.0)
+    try:
+        p = model._add([1, 2], 6)
+        assert p.event.wait(timeout=30)
+        # Every arrival is instantly "quiet" at window 0 -> full bursts
+        # despite 7 free slots.
+        assert fake.bursts and all(b == 16 for b in fake.bursts)
     finally:
         model.shutdown()
 
