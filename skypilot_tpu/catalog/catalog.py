@@ -18,13 +18,27 @@ import pandas as pd
 
 _DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
 
+# Clouds with a priced offerings catalog. 'kubernetes' and 'local' have
+# none by design: their capacity is whatever the cluster/machine has, so
+# they take the synthetic-candidate path in Resources.launchables.
+CATALOG_CLOUDS = ("gcp", "aws")
+
 
 @functools.lru_cache(maxsize=None)
 def _df(cloud: str = "gcp") -> pd.DataFrame:
+    if cloud is None or cloud == "all":
+        return pd.concat([_df(c) for c in CATALOG_CLOUDS],
+                         ignore_index=True)
     path = os.path.join(_DATA_DIR, f"{cloud}.csv")
     if not os.path.exists(path):
-        from skypilot_tpu.catalog.fetchers import generate_static
-        generate_static.main(path)
+        if cloud == "gcp":
+            from skypilot_tpu.catalog.fetchers import generate_static
+            generate_static.main(path)
+        elif cloud == "aws":
+            from skypilot_tpu.catalog.fetchers import generate_static_aws
+            generate_static_aws.main(path)
+        else:
+            raise ValueError(f"no catalog for cloud {cloud!r}")
     df = pd.read_csv(path, keep_default_na=False)
     return df
 
@@ -51,7 +65,7 @@ def parse_accelerator(spec: str) -> tuple[str, int]:
 
 
 def list_accelerators(name_filter: Optional[str] = None,
-                      cloud: str = "gcp") -> pd.DataFrame:
+                      cloud: Optional[str] = None) -> pd.DataFrame:
     df = _df(cloud)
     df = df[df["accelerator"] != ""]
     if name_filter:
@@ -65,7 +79,7 @@ def offerings(accelerator: Optional[str] = None,
               instance_type: Optional[str] = None,
               region: Optional[str] = None,
               zone: Optional[str] = None,
-              cloud: str = "gcp") -> pd.DataFrame:
+              cloud: Optional[str] = None) -> pd.DataFrame:
     """All catalog rows matching the partial spec (case-insensitive)."""
     df = _df(cloud)
     if accelerator is not None:
@@ -83,7 +97,7 @@ def offerings(accelerator: Optional[str] = None,
 
 def get_hourly_cost(accelerator: str, use_spot: bool = False,
                     region: Optional[str] = None, zone: Optional[str] = None,
-                    cloud: str = "gcp") -> float:
+                    cloud: Optional[str] = None) -> float:
     """Cheapest matching offering's whole-slice/VM hourly price."""
     df = offerings(accelerator, region=region, zone=zone, cloud=cloud)
     if df.empty:
@@ -108,7 +122,7 @@ _PEAK_TFLOPS = {
     "tpu-v2": 45, "tpu-v3": 123, "tpu-v4": 275, "tpu-v5e": 197,
     "tpu-v5p": 459, "tpu-v6e": 918,
     "A100": 312, "A100-80GB": 312, "H100": 989, "L4": 121,
-    "T4": 65, "V100": 125, "P100": 21,
+    "T4": 65, "V100": 125, "P100": 21, "A10G": 70,
 }
 _V5E_TFLOPS = 197.0
 
@@ -143,7 +157,7 @@ def compute_units(accelerator: Optional[str],
 
 
 def cpu_instance_types(min_cpus: float = 0, min_memory_gb: float = 0,
-                       cloud: str = "gcp") -> pd.DataFrame:
+                       cloud: Optional[str] = None) -> pd.DataFrame:
     df = _df(cloud)
     df = df[(df["accelerator"] == "")
             & (df["vcpus"] >= min_cpus)

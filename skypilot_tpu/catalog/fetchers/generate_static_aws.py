@@ -1,0 +1,108 @@
+"""Generate the static AWS GPU/CPU catalog CSV.
+
+Counterpart of ``generate_static.py`` (GCP) for the AWS cloud, mirroring
+the reference's per-cloud data-fetcher pattern (reference:
+sky/clouds/service_catalog/data_fetchers/fetch_aws.py — enumerates EC2
+instance offerings + pricing into CSVs consumed by one pandas query
+layer). Zero-egress environment: emits a checked-in snapshot of public
+EC2 on-demand pricing (approximate, 2025) rather than calling the
+Pricing API; the query layer is identical either way.
+
+AWS has no TPUs — its catalog rows are GPU and CPU instances, which is
+exactly what makes the cross-cloud optimizer story real: a GPU task can
+be arbitraged between GCP A100s and EC2 p4d, while TPU tasks stay on
+GCP.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.generate_static_aws
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+from skypilot_tpu.catalog.fetchers.generate_static import HEADER
+
+# accel, count/VM, instance type, us-east-1 $/hr, vcpus, mem GB, regions
+GPU_VMS = [
+    ("A100", 8, "p4d.24xlarge", 32.77, 96, 1152,
+     ["us-east-1", "us-west-2", "eu-west-1", "ap-northeast-1"]),
+    ("A100-80GB", 8, "p4de.24xlarge", 40.97, 96, 1152,
+     ["us-east-1", "us-west-2"]),
+    ("H100", 8, "p5.48xlarge", 98.32, 192, 2048,
+     ["us-east-1", "us-west-2", "eu-north-1"]),
+    ("V100", 8, "p3.16xlarge", 24.48, 64, 488,
+     ["us-east-1", "us-west-2", "eu-west-1"]),
+    ("V100", 1, "p3.2xlarge", 3.06, 8, 61,
+     ["us-east-1", "us-west-2", "eu-west-1", "ap-northeast-1"]),
+    ("A10G", 1, "g5.xlarge", 1.006, 4, 16,
+     ["us-east-1", "us-west-2", "eu-west-1", "ap-northeast-1"]),
+    ("A10G", 4, "g5.12xlarge", 5.672, 48, 192,
+     ["us-east-1", "us-west-2", "eu-west-1"]),
+    ("A10G", 8, "g5.48xlarge", 16.288, 192, 768,
+     ["us-east-1", "us-west-2"]),
+    ("L4", 1, "g6.xlarge", 0.805, 4, 16,
+     ["us-east-1", "us-west-2", "eu-west-1"]),
+    ("T4", 1, "g4dn.xlarge", 0.526, 4, 16,
+     ["us-east-1", "us-west-2", "eu-west-1", "ap-northeast-1"]),
+    ("T4", 4, "g4dn.12xlarge", 3.912, 48, 192,
+     ["us-east-1", "us-west-2"]),
+]
+
+# CPU-only (controllers, data prep) — m6i family.
+CPU_VMS = [
+    ("m6i.large", 0.096, 2, 8),
+    ("m6i.xlarge", 0.192, 4, 16),
+    ("m6i.2xlarge", 0.384, 8, 32),
+    ("m6i.4xlarge", 0.768, 16, 64),
+    ("m6i.8xlarge", 1.536, 32, 128),
+    ("r6i.2xlarge", 0.504, 8, 64),
+]
+CPU_REGIONS = ["us-east-1", "us-west-2", "eu-west-1", "ap-northeast-1"]
+
+# us-east-1 is the price anchor; other regions carry a flat multiplier
+# (same approximation style as the GCP fetcher's REGION_MULT).
+REGION_MULT = {"us-east-1": 1.0, "us-west-2": 1.0, "eu-west-1": 1.06,
+               "eu-north-1": 1.02, "ap-northeast-1": 1.20}
+
+# Default AZ suffixes emitted per region. Real AZ ids are
+# account-specific mappings; two suffixes give failover tests a
+# same-region second zone, matching the reference's checked-in AZ
+# mapping approach (tests/default_aws_az_mappings.csv).
+AZ_SUFFIXES = ("a", "b")
+
+SPOT_DISCOUNT = 0.32  # EC2 spot discounts run deeper than GCP's
+
+
+def rows():
+    for accel, count, itype, base, vcpus, mem, regions in GPU_VMS:
+        for region in regions:
+            price = base * REGION_MULT.get(region, 1.1)
+            for suffix in AZ_SUFFIXES:
+                yield [accel, count, "aws", itype, 0, 1, region,
+                       f"{region}{suffix}", round(price, 3),
+                       round(price * SPOT_DISCOUNT, 3), vcpus, mem]
+    for itype, base, vcpus, mem in CPU_VMS:
+        for region in CPU_REGIONS:
+            price = base * REGION_MULT.get(region, 1.1)
+            for suffix in AZ_SUFFIXES:
+                yield ["", 0, "aws", itype, 0, 1, region,
+                       f"{region}{suffix}", round(price, 3),
+                       round(price * SPOT_DISCOUNT, 3), vcpus, mem]
+
+
+def main(out_path: str | None = None) -> str:
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "data", "aws.csv")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(HEADER)
+        for r in rows():
+            w.writerow(r)
+    return out_path
+
+
+if __name__ == "__main__":
+    print(main())

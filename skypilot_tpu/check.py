@@ -19,7 +19,7 @@ from skypilot_tpu.utils import paths
 
 # Known providers, in display order. 'local' is the in-process fake
 # cloud used by tests and demos; it is always credentialed.
-CLOUDS = ("gcp", "kubernetes", "local")
+CLOUDS = ("gcp", "aws", "kubernetes", "local")
 
 
 def _cache_path() -> str:
@@ -32,6 +32,9 @@ def _check_one(cloud: str) -> Tuple[bool, str]:
     if cloud == "gcp":
         from skypilot_tpu.provision import gcp_auth
         return gcp_auth.check_credentials()
+    if cloud == "aws":
+        from skypilot_tpu.provision import aws_auth
+        return aws_auth.check_credentials()
     if cloud == "kubernetes":
         try:
             from skypilot_tpu.provision import kubernetes as k8s

@@ -92,18 +92,21 @@ def query_ports(provider: str, cluster_name: str) -> dict:
     return fn(cluster_name) if fn else {}
 
 
-def open_ports(provider: str, cluster_name: str, ports: list) -> None:
+def open_ports(provider: str, cluster_name: str, ports: list,
+               zone: str = None) -> None:
     """Expose ``ports`` on the cluster (GCP: firewall rule targeting
-    the cluster's network tag; kubernetes: NodePort Service). Providers
-    also call this themselves at provision time when the config carries
-    ports; the dispatcher form serves post-hoc exposure (reference:
-    sky/provision/__init__.py open_ports)."""
+    the cluster's network tag; kubernetes: NodePort Service; AWS:
+    security-group ingress — NEEDS ``zone`` to locate the region).
+    Providers also call this themselves at provision time when the
+    config carries ports; the dispatcher form serves post-hoc exposure
+    (reference: sky/provision/__init__.py open_ports)."""
     fn = getattr(_impl(provider), "open_ports", None)
     if fn:
-        fn(cluster_name, ports)
+        fn(cluster_name, ports, zone)
 
 
-def cleanup_ports(provider: str, cluster_name: str) -> None:
+def cleanup_ports(provider: str, cluster_name: str,
+                  zone: str = None) -> None:
     fn = getattr(_impl(provider), "cleanup_ports", None)
     if fn:
-        fn(cluster_name)
+        fn(cluster_name, zone)

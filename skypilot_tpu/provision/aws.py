@@ -1,0 +1,497 @@
+"""AWS EC2 provisioning over the Query API, zero-SDK.
+
+Reference parity: sky/provision/aws/instance.py (run/stop/terminate/
+query instances, security-group port exposure at sky/provision/aws/
+instance.py open_ports) — redesigned in the same style as this repo's
+GCP provider: plain signed HTTPS (SigV4, see aws_auth.py) instead of
+boto3, an injectable transport so the whole module is unit-testable
+offline against canned XML (tests/test_aws_provision.py), and the
+uniform functional provision API.
+
+AWS carries the GPU/CPU side of the cross-cloud story (no TPUs): the
+optimizer arbitrates p4d/p5/g5 against GCP A100/H100/L4 rows, and the
+failover loop can block one cloud wholesale and land on the other.
+
+Cluster model: instances are tagged ``skypilot-cluster=<name>`` (the
+idempotency key — run_instances reuses/restarts whatever already
+carries the tag), one self-referencing security group per cluster
+carries SSH + user port exposure, and the cluster keypair is the same
+``~/.ssh/sky-key`` every other provider uses.
+"""
+
+from __future__ import annotations
+
+import base64
+import re
+import time
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+from typing import Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import aws_auth
+from skypilot_tpu.provision import Feature as _F
+from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
+                                           ProvisionConfig, ProvisionRecord)
+from skypilot_tpu.resources import extract_docker_image
+from skypilot_tpu.utils import command_runner
+
+API_VERSION = "2016-11-15"
+CLUSTER_TAG = "skypilot-cluster"
+SSH_USER = "ubuntu"
+KEYPAIR_PREFIX = "sky-key"
+
+# Canonical Ubuntu 22.04 LTS amd64 server images, resolved at call time
+# via DescribeImages (owner = Canonical's account) so the catalog never
+# hardcodes per-region AMI ids (reference: the aws catalog ships an
+# image table; a DescribeImages lookup is the zero-catalog equivalent).
+UBUNTU_OWNER = "099720109477"
+UBUNTU_NAME_FILTER = \
+    "ubuntu/images/hvm-ssd/ubuntu-jammy-22.04-amd64-server-*"
+
+FEATURES = frozenset(_F)
+
+# transport(action, params, region) -> raw XML response text.
+Transport = Callable[[str, Dict[str, str], str], str]
+_transport: Optional[Transport] = None
+
+
+def set_transport(fn: Optional[Transport]) -> None:
+    """Inject a fake EC2 API (tests) or reset to real HTTPS (None)."""
+    global _transport
+    _transport = fn
+
+
+def _api(action: str, params: Dict[str, str], region: str) -> ET.Element:
+    """One signed EC2 Query API call; returns the parsed XML root with
+    namespaces stripped (EC2 stamps every element with the doc ns)."""
+    full = {"Action": action, "Version": API_VERSION, **params}
+    if _transport is not None:
+        text = _transport(action, full, region)
+    else:
+        creds = aws_auth.load_credentials()
+        if creds is None:
+            raise exceptions.NoCloudAccessError(
+                "no AWS credentials (set AWS_ACCESS_KEY_ID/"
+                "AWS_SECRET_ACCESS_KEY or ~/.aws/credentials)")
+        host = f"ec2.{region}.amazonaws.com"
+        url, headers, body = aws_auth.sign_request(
+            creds, "POST", host, "/", full, region=region, service="ec2")
+        req = urllib.request.Request(url, data=body, headers=headers,
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                text = resp.read().decode()
+        except urllib.error.HTTPError as e:
+            raise _map_api_error(e.code, e.read().decode(errors="replace"))
+    root = ET.fromstring(text)
+    _strip_ns(root)
+    if root.tag == "Response":           # error document (fake transport
+        err = root.find(".//Error")      # may return it with HTTP 200)
+        code = err.findtext("Code", "") if err is not None else ""
+        msg = err.findtext("Message", "") if err is not None else text
+        raise _map_error_code(code, msg)
+    return root
+
+
+def _strip_ns(elem: ET.Element) -> None:
+    for e in elem.iter():
+        if "}" in e.tag:
+            e.tag = e.tag.split("}", 1)[1]
+
+
+def _map_api_error(http_code: int, body: str) -> Exception:
+    try:
+        root = ET.fromstring(body)
+        _strip_ns(root)
+        err = root.find(".//Error")
+        if err is not None:
+            return _map_error_code(err.findtext("Code", ""),
+                                   err.findtext("Message", body))
+    except ET.ParseError:
+        pass
+    return exceptions.ResourcesUnavailableError(
+        f"EC2 API error ({http_code}): {body[:500]}")
+
+
+def _map_error_code(code: str, message: str) -> Exception:
+    """EC2 error code -> the failover taxonomy (scopes drive the
+    blocklist: capacity = zone-blockable, quota = region-blockable)."""
+    err: Exception
+    if code in ("InsufficientInstanceCapacity", "InsufficientHostCapacity",
+                "SpotMaxPriceTooLow", "InsufficientCapacity",
+                "Unsupported"):
+        err = exceptions.CapacityError(f"EC2 capacity: {code}: {message}")
+    elif code in ("InstanceLimitExceeded", "VcpuLimitExceeded",
+                  "MaxSpotInstanceCountExceeded", "RequestLimitExceeded"):
+        err = exceptions.QuotaExceededError(
+            f"EC2 quota: {code}: {message}")
+    elif code in ("AuthFailure", "UnauthorizedOperation",
+                  "OptInRequired"):
+        err = exceptions.NoCloudAccessError(
+            f"EC2 auth: {code}: {message}")
+    elif code.endswith(".Duplicate"):
+        err = DuplicateError(f"EC2: {code}: {message}")
+    elif code.startswith("InvalidGroup") or code.startswith(
+            "InvalidKeyPair") or code.endswith(".NotFound"):
+        err = exceptions.ClusterNotUpError(f"EC2: {code}: {message}")
+    else:
+        err = exceptions.ResourcesUnavailableError(
+            f"EC2: {code}: {message}")
+    err.ec2_code = code
+    return err
+
+
+class DuplicateError(Exception):
+    """Resource already exists — the idempotent paths treat this as
+    success (CreateSecurityGroup / ImportKeyPair on re-launch)."""
+
+
+_ZONE_RE = re.compile(r"^([a-z]+-[a-z]+-\d+)")
+
+
+def _region_of_zone(zone: str) -> str:
+    """'us-east-1a' -> 'us-east-1'. Regex, not rstrip: Local/Wavelength
+    zone names ('us-west-2-lax-1a') carry extra dashed segments that a
+    letter-strip would fold into a bogus region."""
+    m = _ZONE_RE.match(zone)
+    if not m:
+        raise ValueError(f"unparseable AWS zone {zone!r}")
+    return m.group(1)
+
+
+def _numbered(prefix: str, values) -> Dict[str, str]:
+    return {f"{prefix}.{i + 1}": v for i, v in enumerate(values)}
+
+
+# -- instance listing -------------------------------------------------------
+
+def _list_instances(cluster_name: str, region: str,
+                    states=("pending", "running", "stopping", "stopped")
+                    ) -> List[Dict]:
+    params = {
+        "Filter.1.Name": f"tag:{CLUSTER_TAG}",
+        "Filter.1.Value.1": cluster_name,
+        **_numbered("Filter.2.Value", states),
+    }
+    params["Filter.2.Name"] = "instance-state-name"
+    root = _api("DescribeInstances", params, region)
+    out = []
+    for inst in root.iter("instancesSet"):
+        for item in inst.findall("item"):
+            if item.find("instanceId") is None:
+                continue
+            out.append({
+                "id": item.findtext("instanceId"),
+                "state": item.findtext("instanceState/name"),
+                "internal_ip": item.findtext("privateIpAddress") or "",
+                "external_ip": item.findtext("ipAddress"),
+                "launch_index": int(item.findtext("amiLaunchIndex", "0")),
+                "sg_id": item.findtext(
+                    "groupSet/item/groupId"),
+            })
+    return out
+
+
+# -- security group / ports -------------------------------------------------
+
+def _sg_name(cluster_name: str) -> str:
+    return f"sky-sg-{cluster_name}"
+
+
+def _ensure_security_group(cluster_name: str, region: str) -> str:
+    """Per-cluster SG: SSH from anywhere + all traffic within the group
+    (gang hosts reach each other on every port, like the GCP intra-
+    cluster allowance). Idempotent via .Duplicate."""
+    try:
+        root = _api("CreateSecurityGroup", {
+            "GroupName": _sg_name(cluster_name),
+            "GroupDescription": f"skypilot_tpu cluster {cluster_name}",
+        }, region)
+        sg_id = root.findtext("groupId")
+    except DuplicateError:
+        sg_id = _find_security_group(cluster_name, region)
+    if sg_id is None:
+        raise exceptions.ResourcesUnavailableError(
+            f"security group for {cluster_name} neither created nor found")
+    for rule in (
+            {"IpPermissions.1.IpProtocol": "tcp",
+             "IpPermissions.1.FromPort": "22",
+             "IpPermissions.1.ToPort": "22",
+             "IpPermissions.1.IpRanges.1.CidrIp": "0.0.0.0/0"},
+            {"IpPermissions.1.IpProtocol": "-1",
+             "IpPermissions.1.UserIdGroupPairs.1.GroupId": sg_id}):
+        try:
+            _api("AuthorizeSecurityGroupIngress",
+                 {"GroupId": sg_id, **rule}, region)
+        except DuplicateError:
+            pass
+    return sg_id
+
+
+def _find_security_group(cluster_name: str, region: str) -> Optional[str]:
+    try:
+        root = _api("DescribeSecurityGroups", {
+            "Filter.1.Name": "group-name",
+            "Filter.1.Value.1": _sg_name(cluster_name),
+        }, region)
+    except exceptions.ClusterNotUpError:
+        return None
+    return root.findtext(".//securityGroupInfo/item/groupId") or \
+        root.findtext(".//item/groupId")
+
+
+def open_ports(cluster_name: str, ports: List[int],
+               zone: Optional[str] = None) -> None:
+    """Expose task/serve ports: one tcp ingress rule per port on the
+    cluster SG (reference: sky/provision/aws/instance.py open_ports).
+    Idempotent — re-authorizing an existing rule is a .Duplicate.
+
+    ``zone`` is REQUIRED: security groups are regional, and guessing
+    the region from AWS_DEFAULT_REGION would authorize ports on (or
+    create!) a wrong-region SG while the cluster's real ports stay
+    closed."""
+    if not zone:
+        raise ValueError(
+            "aws.open_ports needs the cluster's zone to locate its "
+            "regional security group")
+    region = _region_of_zone(zone)
+    sg_id = _find_security_group(cluster_name, region)
+    if sg_id is None:
+        # Creating a fresh SG here would attach to NOTHING — the call
+        # would "succeed" while the cluster's real ports stay closed
+        # (wrong zone passed, or cluster already gone). Fail instead.
+        raise exceptions.ClusterNotUpError(
+            f"no security group for cluster {cluster_name!r} in "
+            f"{region} — wrong zone, or the cluster is terminated")
+    for port in ports:
+        try:
+            _api("AuthorizeSecurityGroupIngress", {
+                "GroupId": sg_id,
+                "IpPermissions.1.IpProtocol": "tcp",
+                "IpPermissions.1.FromPort": str(port),
+                "IpPermissions.1.ToPort": str(port),
+                "IpPermissions.1.IpRanges.1.CidrIp": "0.0.0.0/0",
+            }, region)
+        except DuplicateError:
+            pass
+
+
+def cleanup_ports(cluster_name: str, zone: Optional[str] = None) -> None:
+    """The SG is deleted with the cluster (terminate_instances); nothing
+    to do for port-only cleanup — rules die with the group."""
+
+
+# -- keypair ----------------------------------------------------------------
+
+def _ensure_keypair(region: str) -> str:
+    """Import the local public key; the keypair NAME embeds a hash of
+    the key material, so a regenerated or different-machine key gets a
+    fresh name instead of silently colliding with a stale 'sky-key'
+    import (whose instances the local private key could never reach —
+    the reference hashes material into the name for the same reason)."""
+    import hashlib
+
+    from skypilot_tpu import authentication
+    _, pub = authentication.get_or_generate_keys()
+    with open(pub) as f:
+        content = f.read().strip()
+    digest = hashlib.sha256(content.encode()).hexdigest()[:10]
+    name = f"{KEYPAIR_PREFIX}-{digest}"
+    material = base64.b64encode(content.encode()).decode()
+    try:
+        _api("ImportKeyPair", {
+            "KeyName": name,
+            "PublicKeyMaterial": material,
+        }, region)
+    except DuplicateError:
+        pass
+    return name
+
+
+# -- AMI --------------------------------------------------------------------
+
+def _resolve_image(config: ProvisionConfig, region: str) -> str:
+    if config.image_id and not extract_docker_image(config.image_id):
+        return config.image_id
+    root = _api("DescribeImages", {
+        "Owner.1": UBUNTU_OWNER,
+        "Filter.1.Name": "name",
+        "Filter.1.Value.1": UBUNTU_NAME_FILTER,
+        "Filter.2.Name": "state",
+        "Filter.2.Value.1": "available",
+    }, region)
+    images = []
+    for item in root.iter("item"):
+        ami = item.findtext("imageId")
+        if ami:
+            images.append((item.findtext("creationDate", ""), ami))
+    if not images:
+        raise exceptions.ResourcesUnavailableError(
+            f"no Ubuntu 22.04 AMI found in {region}")
+    return max(images)[1]   # latest creationDate
+
+
+# -- provision API ----------------------------------------------------------
+
+def run_instances(config: ProvisionConfig) -> ProvisionRecord:
+    """Create or resume the cluster's instances. Idempotent by the
+    cluster tag: stopped instances restart, missing ones are created,
+    running ones are left alone."""
+    zone = config.zone
+    region = _region_of_zone(zone)
+    want = config.num_nodes * config.hosts_per_node
+    existing = _list_instances(config.cluster_name, region)
+    record = ProvisionRecord(provider="aws",
+                             cluster_name=config.cluster_name, zone=zone)
+
+    # An instance in 'stopping' (autostop just fired, user relaunches
+    # immediately) rejects StartInstances with IncorrectInstanceState —
+    # which would read as a zone failure and split the cluster across
+    # a failover. Wait out the transition first.
+    deadline = time.monotonic() + 300
+    while any(i["state"] == "stopping" for i in existing):
+        if time.monotonic() >= deadline:
+            raise exceptions.ResourcesUnavailableError(
+                f"instances of {config.cluster_name} stuck in 'stopping'")
+        time.sleep(3 if _transport is None else 0)
+        existing = _list_instances(config.cluster_name, region)
+    stopped = [i for i in existing if i["state"] == "stopped"]
+    alive = [i for i in existing
+             if i["state"] in ("pending", "running")]
+    if stopped:
+        _api("StartInstances",
+             _numbered("InstanceId", [i["id"] for i in stopped]), region)
+        record.resumed = True
+        alive += stopped
+    missing = want - len(alive)
+    if missing > 0:
+        key_name = _ensure_keypair(region)
+        sg_id = _ensure_security_group(config.cluster_name, region)
+        params = {
+            "ImageId": _resolve_image(config, region),
+            "InstanceType": config.instance_type,
+            "MinCount": str(missing),   # gang semantics: all-or-nothing
+            "MaxCount": str(missing),
+            "KeyName": key_name,
+            "Placement.AvailabilityZone": zone,
+            "SecurityGroupId.1": sg_id,
+            "BlockDeviceMapping.1.DeviceName": "/dev/sda1",
+            "BlockDeviceMapping.1.Ebs.VolumeSize": str(config.disk_size),
+            "BlockDeviceMapping.1.Ebs.VolumeType": "gp3",
+            "TagSpecification.1.ResourceType": "instance",
+            "TagSpecification.1.Tag.1.Key": CLUSTER_TAG,
+            "TagSpecification.1.Tag.1.Value": config.cluster_name,
+        }
+        if config.use_spot:
+            params["InstanceMarketOptions.MarketType"] = "spot"
+            params["InstanceMarketOptions.SpotOptions."
+                   "InstanceInterruptionBehavior"] = "terminate"
+        for i, (k, v) in enumerate(sorted(config.labels.items())):
+            params[f"TagSpecification.1.Tag.{i + 2}.Key"] = k
+            params[f"TagSpecification.1.Tag.{i + 2}.Value"] = v
+        root = _api("RunInstances", params, region)
+        for item in root.iter("item"):
+            iid = item.findtext("instanceId")
+            if iid:
+                record.created_instance_ids.append(iid)
+    if config.ports:
+        open_ports(config.cluster_name, list(config.ports), zone)
+    return record
+
+
+def wait_instances(cluster_name: str, zone: str,
+                   timeout: float = 600) -> None:
+    region = _region_of_zone(zone)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        insts = _list_instances(cluster_name, region)
+        if insts and all(i["state"] == "running" for i in insts):
+            return
+        time.sleep(2 if _transport is None else 0)
+    raise exceptions.ResourcesUnavailableError(
+        f"instances of {cluster_name} not running after {timeout}s")
+
+
+def stop_instances(cluster_name: str, zone: str) -> None:
+    region = _region_of_zone(zone)
+    ids = [i["id"] for i in _list_instances(cluster_name, region)
+           if i["state"] in ("pending", "running")]
+    if ids:
+        _api("StopInstances", _numbered("InstanceId", ids), region)
+
+
+def terminate_instances(cluster_name: str, zone: str) -> None:
+    region = _region_of_zone(zone)
+    insts = _list_instances(cluster_name, region)
+    if insts:
+        _api("TerminateInstances",
+             _numbered("InstanceId", [i["id"] for i in insts]), region)
+    # The SG can only delete once its instances are gone; EC2 keeps a
+    # terminating instance attached for a while, so retry briefly and
+    # leave an orphan SG (free, reused on relaunch) rather than fail
+    # the teardown. The attached group id rides the instance listing;
+    # fall back to the name lookup when no instance remained.
+    sg_id = next((i["sg_id"] for i in insts if i["sg_id"]), None) \
+        or _find_security_group(cluster_name, region)
+    if sg_id is None:
+        return
+    for _ in range(30):
+        try:
+            _api("DeleteSecurityGroup", {"GroupId": sg_id}, region)
+            return
+        except Exception:  # noqa: BLE001 — DependencyViolation until gone
+            if _transport is not None:
+                return
+            time.sleep(5)
+
+
+def query_instances(cluster_name: str, zone: str) -> str:
+    region = _region_of_zone(zone)
+    insts = _list_instances(cluster_name, region)
+    if not insts:
+        return "NOT_FOUND"
+    states = {i["state"] for i in insts}
+    if states <= {"pending", "running"}:
+        return "UP"
+    if states <= {"stopped", "stopping"}:
+        return "STOPPED"
+    return "PARTIAL"
+
+
+def get_cluster_info(cluster_name: str, zone: str) -> ClusterInfo:
+    region = _region_of_zone(zone)
+    insts = [i for i in _list_instances(cluster_name, region)
+             if i["state"] in ("pending", "running")]
+    if not insts:
+        raise exceptions.ClusterNotUpError(
+            f"no running instances for {cluster_name}")
+    # Stable host order: launch index, then instance id (fresh launches
+    # share one reservation; resumes fall back to id order).
+    insts.sort(key=lambda i: (i["launch_index"], i["id"]))
+    hosts = [HostInfo(host_id=n, node_id=n, worker_id=0,
+                      internal_ip=i["internal_ip"],
+                      external_ip=i["external_ip"],
+                      ssh_user=SSH_USER, ssh_port=22)
+             for n, i in enumerate(insts)]
+    return ClusterInfo(cluster_name=cluster_name, provider="aws",
+                       zone=zone, hosts=hosts,
+                       ssh_key_path="~/.ssh/sky-key",
+                       metadata={"instance_ids": [i["id"] for i in insts]})
+
+
+def get_command_runners(info: ClusterInfo
+                        ) -> List[command_runner.CommandRunner]:
+    runners = []
+    for h in info.hosts:
+        ip = h.external_ip or h.internal_ip
+        runners.append(command_runner.SSHRunner(
+            ip=ip, user=h.ssh_user or SSH_USER,
+            key_path=info.ssh_key_path or "~/.ssh/sky-key",
+            host_id=h.host_id, port=h.ssh_port))
+    return runners
+
+
+def check_credentials():
+    return aws_auth.check_credentials()

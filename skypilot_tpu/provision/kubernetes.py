@@ -302,7 +302,8 @@ def ingress_manifest(cluster_name: str, ports: List[int]) -> Dict:
     }
 
 
-def open_ports(cluster_name: str, ports: List[int]) -> None:
+def open_ports(cluster_name: str, ports: List[int],
+               zone: str = None) -> None:
     mode = ports_mode()
     svc_type = {"nodeport": "NodePort",
                 "loadbalancer": "LoadBalancer",
@@ -321,7 +322,7 @@ def open_ports(cluster_name: str, ports: List[int]) -> None:
                 f"kubectl apply (ingress) failed: {out.strip()}")
 
 
-def cleanup_ports(cluster_name: str) -> None:
+def cleanup_ports(cluster_name: str, zone: str = None) -> None:
     _run(["delete", "service", _service_name(cluster_name),
           "--ignore-not-found", "--wait=false"])
     _run(["delete", "ingress", _ingress_name(cluster_name),

@@ -58,7 +58,7 @@ class Resources:
             catalog.parse_accelerator(self.accelerators)  # validate
         parse_count(self.cpus, "cpus")
         parse_count(self.memory, "memory")
-        if self.cloud not in (None, "gcp", "kubernetes", "local"):
+        if self.cloud not in (None, "gcp", "aws", "kubernetes", "local"):
             raise ValueError(f"unknown cloud {self.cloud!r}")
         if self.is_tpu() and self.runtime_version is None:
             object.__setattr__(self, "runtime_version",
@@ -114,19 +114,30 @@ class Resources:
         or region may be None = whole region/cloud blocked).
         """
         blocked = blocked or set()
-        if self.cloud == "local":
-            r = self.copy(region="local", zone="local", _price=0.0)
-            return [] if _is_blocked("local", "local", "local", blocked) else [r]
+        if self.cloud in ("local", "kubernetes"):
+            # Catalog-less clouds: capacity is whatever the machine/
+            # cluster has, so the sole candidate is the spec itself
+            # (price 0 — kubernetes nodes are owned capacity; the
+            # reference prices k8s at 0 too).
+            zone = "local" if self.cloud == "local" else "default"
+            r = self.copy(region=zone, zone=zone, _price=0.0)
+            return ([] if _is_blocked(self.cloud, zone, zone, blocked)
+                    else [r])
         out = []
         min_cpus, cpus_plus = parse_count(self.cpus, "cpus")
         min_mem, mem_plus = parse_count(self.memory, "memory")
+        # None = arbitrage across every catalog cloud (the reference's
+        # core value prop: sky/optimizer.py candidates span all enabled
+        # clouds); a set cloud restricts the search to it.
+        cloud = self.cloud if self.cloud in catalog.CATALOG_CLOUDS else None
         if self.accelerators is None and self.instance_type is None:
-            df = catalog.cpu_instance_types(min_cpus or 0, min_mem or 0)
+            df = catalog.cpu_instance_types(min_cpus or 0, min_mem or 0,
+                                            cloud=cloud)
         else:
             name, count = (catalog.parse_accelerator(self.accelerators)
                            if self.accelerators else (None, None))
             df = catalog.offerings(name, count, self.instance_type,
-                                   self.region, self.zone)
+                                   self.region, self.zone, cloud=cloud)
             if min_cpus is not None:
                 df = df[df["vcpus"] >= min_cpus] if cpus_plus else \
                     df[df["vcpus"] == min_cpus]
@@ -138,10 +149,11 @@ class Resources:
             df = df[df["zone"] == self.zone]
         price_col = "spot_price" if self.use_spot else "price"
         for _, row in df.sort_values(price_col).iterrows():
-            if _is_blocked("gcp", row["region"], row["zone"], blocked):
+            if _is_blocked(row["cloud"], row["region"], row["zone"],
+                           blocked):
                 continue
             out.append(self.copy(
-                cloud="gcp", region=row["region"], zone=row["zone"],
+                cloud=row["cloud"], region=row["region"], zone=row["zone"],
                 instance_type=row["instance_type"],
                 _price=float(row[price_col])))
         return out

@@ -1,0 +1,416 @@
+"""AWS EC2 provisioning against a fake Query API (offline).
+
+Same seam as the GCP fake (tests/test_gcp_provision.py): a stateful
+fake transport models the instance/SG/keypair state machine and returns
+real EC2 XML, so the provider's parsing, idempotency, and error mapping
+run exactly as they would against the live API (reference tests the
+analogous layer in tests/unit_tests with moto-style stubs)."""
+
+import datetime
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import aws, aws_auth
+from skypilot_tpu.provision.common import ProvisionConfig
+
+
+# -- SigV4 ------------------------------------------------------------------
+
+def test_sigv4_derived_key_matches_documented_vector():
+    """The AWS General Reference publishes this exact derivation
+    example (secret/date/region/service -> signing key)."""
+    key = aws_auth.derive_signing_key(
+        "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+        "20120215", "us-east-1", "iam")
+    assert key.hex() == ("f4780e2d9f65fa895f9c67b32ce1baf0"
+                         "b0d8a43505a000a1a9e090d414db404d")
+
+
+def test_sigv4_request_shape():
+    creds = aws_auth.AwsCredentials("AKIDEXAMPLE", "secret",
+                                    session_token="tok")
+    url, headers, body = aws_auth.sign_request(
+        creds, "POST", "ec2.us-east-1.amazonaws.com", "/",
+        {"Action": "DescribeInstances", "Version": "2016-11-15"},
+        region="us-east-1", service="ec2",
+        now=datetime.datetime(2026, 1, 2, 3, 4, 5,
+                              tzinfo=datetime.timezone.utc))
+    assert url == "https://ec2.us-east-1.amazonaws.com/"
+    auth = headers["Authorization"]
+    assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/"
+                           "20260102/us-east-1/ec2/aws4_request")
+    # The session token must be part of the signed header set — STS
+    # creds fail with an unsigned token.
+    assert "x-amz-security-token" in auth
+    assert headers["X-Amz-Date"] == "20260102T030405Z"
+    assert b"Action=DescribeInstances" in body
+
+
+def test_credentials_from_ini(tmp_path, monkeypatch):
+    for var in ("AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY",
+                "AWS_SESSION_TOKEN", "AWS_PROFILE"):
+        monkeypatch.delenv(var, raising=False)
+    ini = tmp_path / "credentials"
+    ini.write_text("[default]\naws_access_key_id = AK1\n"
+                   "aws_secret_access_key = SK1\n"
+                   "[other]\naws_access_key_id = AK2\n"
+                   "aws_secret_access_key = SK2\n")
+    monkeypatch.setenv("AWS_SHARED_CREDENTIALS_FILE", str(ini))
+    creds = aws_auth.load_credentials()
+    assert (creds.access_key, creds.secret_key) == ("AK1", "SK1")
+    assert aws_auth.load_credentials("other").access_key == "AK2"
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "ENVK")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "ENVS")
+    assert aws_auth.load_credentials().access_key == "ENVK"
+
+
+# -- fake EC2 ---------------------------------------------------------------
+
+class FakeEc2:
+    """Stateful fake: instances keyed by id, one SG per group name.
+    Returns genuine EC2 response XML (namespaced, like the real API)."""
+
+    NS = 'xmlns="http://ec2.amazonaws.com/doc/2016-11-15/"'
+
+    def __init__(self, capacity_errors=0, quota_error=False):
+        self.instances = {}           # id -> dict
+        self.sgs = {}                 # name -> {id, rules: set}
+        self.keypairs = set()
+        self.calls = []               # (action, params)
+        self._next = 0
+        self.capacity_errors = capacity_errors
+        self.quota_error = quota_error
+
+    def _error(self, code, msg):
+        return (f'<Response {self.NS}><Errors><Error><Code>{code}</Code>'
+                f"<Message>{msg}</Message></Error></Errors>"
+                "<RequestID>x</RequestID></Response>")
+
+    def __call__(self, action, params, region):
+        self.calls.append((action, dict(params)))
+        return getattr(self, "_" + action)(params, region)
+
+    # -- instances --
+    def _RunInstances(self, params, region):
+        if self.quota_error:
+            return self._error("VcpuLimitExceeded", "vCPU limit")
+        if self.capacity_errors > 0:
+            self.capacity_errors -= 1
+            return self._error("InsufficientInstanceCapacity",
+                               "no capacity in AZ")
+        n = int(params["MinCount"])
+        tags = {}
+        i = 1
+        while f"TagSpecification.1.Tag.{i}.Key" in params:
+            tags[params[f"TagSpecification.1.Tag.{i}.Key"]] = \
+                params[f"TagSpecification.1.Tag.{i}.Value"]
+            i += 1
+        items = []
+        for idx in range(n):
+            iid = f"i-{self._next:08x}"
+            self._next += 1
+            self.instances[iid] = {
+                "id": iid, "state": "pending", "tags": tags,
+                "launch_index": idx,
+                "private_ip": f"10.0.0.{len(self.instances) + 10}",
+                "public_ip": f"54.1.2.{len(self.instances) + 10}",
+                "spot": "InstanceMarketOptions.MarketType" in params,
+                "type": params["InstanceType"],
+                "image": params["ImageId"],
+                "sg": params.get("SecurityGroupId.1"),
+                "key": params.get("KeyName"),
+            }
+            items.append(f"<item><instanceId>{iid}</instanceId>"
+                         f"<amiLaunchIndex>{idx}</amiLaunchIndex>"
+                         "<instanceState><code>0</code>"
+                         "<name>pending</name></instanceState></item>")
+        return (f'<RunInstancesResponse {self.NS}><instancesSet>'
+                f"{''.join(items)}</instancesSet></RunInstancesResponse>")
+
+    def _DescribeInstances(self, params, region):
+        want_cluster = None
+        states = set()
+        for k, v in params.items():
+            if k.startswith("Filter") and k.endswith("Name"):
+                base = k[:-len("Name")]
+                vals = [params[p] for p in params
+                        if p.startswith(base + "Value")]
+                if v == "tag:" + aws.CLUSTER_TAG:
+                    want_cluster = vals[0]
+                elif v == "instance-state-name":
+                    states = set(vals)
+        items = []
+        for inst in self.instances.values():
+            # Instances auto-progress pending->running (and a scripted
+            # stopping->stopped after _stopping_gets observations).
+            if inst["state"] == "pending":
+                inst["state"] = "running"
+            elif inst["state"] == "stopping":
+                left = inst.get("_stopping_gets", 0)
+                if left <= 0:
+                    inst["state"] = "stopped"
+                else:
+                    inst["_stopping_gets"] = left - 1
+            if want_cluster is not None and \
+                    inst["tags"].get(aws.CLUSTER_TAG) != want_cluster:
+                continue
+            if states and inst["state"] not in states:
+                continue
+            pub = (f"<ipAddress>{inst['public_ip']}</ipAddress>"
+                   if inst["state"] == "running" else "")
+            items.append(
+                "<item>"
+                f"<instanceId>{inst['id']}</instanceId>"
+                f"<amiLaunchIndex>{inst['launch_index']}</amiLaunchIndex>"
+                "<instanceState><code>16</code>"
+                f"<name>{inst['state']}</name></instanceState>"
+                f"<privateIpAddress>{inst['private_ip']}</privateIpAddress>"
+                f"{pub}"
+                f"<groupSet><item><groupId>{inst['sg']}</groupId>"
+                "</item></groupSet>"
+                "</item>")
+        return (f'<DescribeInstancesResponse {self.NS}><reservationSet>'
+                f"<item><instancesSet>{''.join(items)}</instancesSet>"
+                "</item></reservationSet></DescribeInstancesResponse>")
+
+    def _set_state(self, params, state):
+        ids = [v for k, v in params.items()
+               if k.startswith("InstanceId.")]
+        for iid in ids:
+            self.instances[iid]["state"] = state
+        return (f'<Response {self.NS}><return>true</return></Response>'
+                .replace("Response", "OkResponse"))
+
+    def _StartInstances(self, params, region):
+        return self._set_state(params, "pending")
+
+    def _StopInstances(self, params, region):
+        return self._set_state(params, "stopped")
+
+    def _TerminateInstances(self, params, region):
+        ids = [v for k, v in params.items()
+               if k.startswith("InstanceId.")]
+        for iid in ids:
+            del self.instances[iid]
+        return f'<TerminateInstancesResponse {self.NS}/>'
+
+    # -- security groups --
+    def _CreateSecurityGroup(self, params, region):
+        name = params["GroupName"]
+        if name in self.sgs:
+            return self._error("InvalidGroup.Duplicate", "exists")
+        sg_id = f"sg-{len(self.sgs):04x}"
+        self.sgs[name] = {"id": sg_id, "rules": set()}
+        return (f'<CreateSecurityGroupResponse {self.NS}>'
+                f"<groupId>{sg_id}</groupId>"
+                "</CreateSecurityGroupResponse>")
+
+    def _DescribeSecurityGroups(self, params, region):
+        name = params.get("Filter.1.Value.1")
+        sg = self.sgs.get(name)
+        inner = (f"<item><groupId>{sg['id']}</groupId></item>"
+                 if sg else "")
+        return (f'<DescribeSecurityGroupsResponse {self.NS}>'
+                f"<securityGroupInfo>{inner}</securityGroupInfo>"
+                "</DescribeSecurityGroupsResponse>")
+
+    def _AuthorizeSecurityGroupIngress(self, params, region):
+        sg = next((s for s in self.sgs.values()
+                   if s["id"] == params["GroupId"]), None)
+        assert sg is not None, "authorize on unknown SG"
+        rule = (params.get("IpPermissions.1.IpProtocol"),
+                params.get("IpPermissions.1.FromPort"),
+                params.get("IpPermissions.1.ToPort"),
+                params.get("IpPermissions.1.IpRanges.1.CidrIp")
+                or params.get("IpPermissions.1.UserIdGroupPairs.1.GroupId"))
+        if rule in sg["rules"]:
+            return self._error("InvalidPermission.Duplicate", "exists")
+        sg["rules"].add(rule)
+        return f'<AuthorizeSecurityGroupIngressResponse {self.NS}/>'
+
+    def _DeleteSecurityGroup(self, params, region):
+        for name, sg in list(self.sgs.items()):
+            if sg["id"] == params["GroupId"]:
+                del self.sgs[name]
+        return f'<DeleteSecurityGroupResponse {self.NS}/>'
+
+    # -- keypair / images --
+    def _ImportKeyPair(self, params, region):
+        if params["KeyName"] in self.keypairs:
+            return self._error("InvalidKeyPair.Duplicate", "exists")
+        self.keypairs.add(params["KeyName"])
+        return f'<ImportKeyPairResponse {self.NS}/>'
+
+    def _DescribeImages(self, params, region):
+        return (f'<DescribeImagesResponse {self.NS}><imagesSet>'
+                "<item><imageId>ami-old</imageId>"
+                "<creationDate>2024-01-01T00:00:00Z</creationDate></item>"
+                "<item><imageId>ami-jammy</imageId>"
+                "<creationDate>2025-06-01T00:00:00Z</creationDate></item>"
+                "</imagesSet></DescribeImagesResponse>")
+
+
+@pytest.fixture
+def fake(monkeypatch, tmp_path):
+    f = FakeEc2()
+    aws.set_transport(f)
+    # Keypair material comes from a scratch key, not the user's (and no
+    # ssh-keygen in this image: write the pair directly).
+    priv = tmp_path / "sky-key"
+    priv.write_text("fake private key\n")
+    (tmp_path / "sky-key.pub").write_text("ssh-ed25519 AAAAfake test\n")
+    monkeypatch.setenv("SKYPILOT_TPU_SSH_KEY", str(priv))
+    from skypilot_tpu import authentication
+    authentication.get_or_generate_keys.cache_clear()
+    yield f
+    aws.set_transport(None)
+    authentication.get_or_generate_keys.cache_clear()
+
+
+def _config(**kw):
+    defaults = dict(cluster_name="c1", num_nodes=2, hosts_per_node=1,
+                    zone="us-east-1a", region="us-east-1",
+                    instance_type="p4d.24xlarge", accelerator="A100",
+                    accelerator_count=8)
+    defaults.update(kw)
+    return ProvisionConfig(**defaults)
+
+
+def test_create_cluster(fake):
+    record = aws.run_instances(_config())
+    assert len(record.created_instance_ids) == 2
+    assert not record.resumed
+    # Gang semantics: one RunInstances with MinCount == MaxCount == 2.
+    run = next(p for a, p in fake.calls if a == "RunInstances")
+    assert (run["MinCount"], run["MaxCount"]) == ("2", "2")
+    assert run["Placement.AvailabilityZone"] == "us-east-1a"
+    assert run["TagSpecification.1.Tag.1.Key"] == aws.CLUSTER_TAG
+    assert run["TagSpecification.1.Tag.1.Value"] == "c1"
+    assert run["ImageId"] == "ami-jammy"       # latest by creationDate
+    # Keypair name embeds the key-material hash: a regenerated local
+    # key can never silently collide with a stale imported 'sky-key'.
+    assert run["KeyName"].startswith(aws.KEYPAIR_PREFIX + "-")
+    assert run["KeyName"] in fake.keypairs
+    # The cluster SG exists with ssh + intra-group rules.
+    sg = fake.sgs[aws._sg_name("c1")]
+    assert ("tcp", "22", "22", "0.0.0.0/0") in sg["rules"]
+    assert ("-1", None, None, sg["id"]) in sg["rules"]
+
+    aws.wait_instances("c1", "us-east-1a")
+    assert aws.query_instances("c1", "us-east-1a") == "UP"
+
+
+def test_run_is_idempotent_and_resumes(fake):
+    aws.run_instances(_config())
+    aws.wait_instances("c1", "us-east-1a")
+    n_created = len(fake.instances)
+    # Second run: nothing new.
+    record = aws.run_instances(_config())
+    assert not record.created_instance_ids
+    assert len(fake.instances) == n_created
+    # Stop, then run again -> StartInstances, resumed=True.
+    aws.stop_instances("c1", "us-east-1a")
+    assert aws.query_instances("c1", "us-east-1a") == "STOPPED"
+    record = aws.run_instances(_config())
+    assert record.resumed
+    assert any(a == "StartInstances" for a, _ in fake.calls)
+    assert aws.query_instances("c1", "us-east-1a") == "UP"
+
+
+def test_spot_and_custom_image_and_labels(fake):
+    aws.run_instances(_config(use_spot=True, image_id="ami-custom",
+                              labels={"team": "ml"}))
+    run = next(p for a, p in fake.calls if a == "RunInstances")
+    assert run["InstanceMarketOptions.MarketType"] == "spot"
+    assert run["ImageId"] == "ami-custom"
+    assert run["TagSpecification.1.Tag.2.Key"] == "team"
+
+
+def test_ports_open_as_sg_rules(fake):
+    aws.run_instances(_config(ports=[8080, 443]))
+    sg = fake.sgs[aws._sg_name("c1")]
+    assert ("tcp", "8080", "8080", "0.0.0.0/0") in sg["rules"]
+    assert ("tcp", "443", "443", "0.0.0.0/0") in sg["rules"]
+    # Idempotent re-open.
+    aws.open_ports("c1", [8080], "us-east-1a")
+
+
+def test_relaunch_waits_out_stopping_state(fake):
+    """StartInstances on a 'stopping' instance is IncorrectInstanceState
+    — run_instances must wait for 'stopped' first, or the failover loop
+    misreads a healthy cluster as a zone failure and splits it."""
+    aws.run_instances(_config())
+    aws.wait_instances("c1", "us-east-1a")
+    # Model the transition: instances are mid-stop, one Describe later
+    # they are stopped (the fake's auto-progression hook).
+    for inst in fake.instances.values():
+        inst["state"] = "stopping"
+        inst["_stopping_gets"] = 1
+    record = aws.run_instances(_config())
+    assert record.resumed
+    start = next(p for a, p in fake.calls if a == "StartInstances")
+    assert len([k for k in start if k.startswith("InstanceId.")]) == 2
+
+
+def test_open_ports_requires_zone(fake):
+    aws.run_instances(_config())
+    with pytest.raises(ValueError):
+        aws.open_ports("c1", [8080])
+
+
+def test_capacity_error_maps_to_failover_taxonomy(fake):
+    fake.capacity_errors = 1
+    with pytest.raises(exceptions.CapacityError):
+        aws.run_instances(_config())
+    fake.quota_error = True
+    with pytest.raises(exceptions.QuotaExceededError):
+        aws.run_instances(_config(cluster_name="c2"))
+
+
+def test_cluster_info_and_runners(fake):
+    aws.run_instances(_config())
+    aws.wait_instances("c1", "us-east-1a")
+    info = aws.get_cluster_info("c1", "us-east-1a")
+    assert len(info.hosts) == 2
+    assert [h.host_id for h in info.hosts] == [0, 1]
+    # Stable rank order = launch index.
+    assert [h.worker_id for h in info.hosts] == [0, 0]
+    assert info.hosts[0].ssh_user == "ubuntu"
+    assert info.hosts[0].external_ip.startswith("54.")
+    runners = aws.get_command_runners(info)
+    assert len(runners) == 2
+
+
+def test_terminate_removes_instances_and_sg(fake):
+    aws.run_instances(_config())
+    aws.terminate_instances("c1", "us-east-1a")
+    assert not fake.instances
+    assert aws._sg_name("c1") not in fake.sgs
+    assert aws.query_instances("c1", "us-east-1a") == "NOT_FOUND"
+
+
+def test_provision_dispatcher_routes_aws(fake):
+    from skypilot_tpu import provision
+    assert provision.supports("aws", provision.Feature.STOP)
+    record = provision.run_instances("aws", _config())
+    assert record.provider == "aws"
+    assert provision.query_instances("aws", "c1", "us-east-1a") == "UP"
+
+
+def test_region_of_zone():
+    assert aws._region_of_zone("us-east-1a") == "us-east-1"
+    assert aws._region_of_zone("ap-northeast-1b") == "ap-northeast-1"
+    assert aws._region_of_zone("eu-west-1") == "eu-west-1"
+    # Local/Wavelength zones carry dashed suffixes beyond the letter.
+    assert aws._region_of_zone("us-west-2-lax-1a") == "us-west-2"
+    with pytest.raises(ValueError):
+        aws._region_of_zone("bogus")
+
+
+def test_open_ports_without_sg_fails_loudly(fake):
+    """A missing SG means wrong zone or dead cluster: creating a fresh
+    unattached SG would 'succeed' while the real ports stay closed."""
+    from skypilot_tpu import exceptions as exc
+    with pytest.raises(exc.ClusterNotUpError):
+        aws.open_ports("ghost", [8080], "us-east-1a")
