@@ -241,7 +241,7 @@ def run_http(config=None, requests=16, slots=16, prompt_len=None,
              new_tokens=64, max_burst=8, kv_int8=False,
              weights_int8=False, admit_wave=None, open_burst=4,
              repeats=1, prompt_lo=512, prompt_hi=1024,
-             stagger_s=0.0) -> dict:
+             stagger_s=0.0, coalesce_s=0.012) -> dict:
     """End-to-end streaming bench: requests go over HTTP through a REAL
     load balancer to the model server, and TTFT is the wall time to the
     FIRST STREAMED BYTE of each response — the JetStream comparison
@@ -312,7 +312,8 @@ def run_http(config=None, requests=16, slots=16, prompt_len=None,
     model_port, lb_port = free_port(), free_port()
     model, httpd = srv.serve(engine, host="127.0.0.1", port=model_port,
                              max_burst=max_burst,
-                             open_burst=open_burst)
+                             open_burst=open_burst,
+                             coalesce_s=coalesce_s)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     assert model._ready.wait(timeout=600), "model warmup timed out"
 
