@@ -83,6 +83,11 @@ class LlamaConfig:
 CONFIGS: Dict[str, LlamaConfig] = {
     "llama3-8b": LlamaConfig(vocab_size=128_256, d_model=4096, n_layers=32,
                              n_heads=32, n_kv_heads=8, d_ff=14_336),
+    # Llama-3.1-70B proportions (multi-slice scale: llm/
+    # llama3-70b-multislice.yaml shards it dp x fsdp x tp over v5p).
+    "llama3-70b": LlamaConfig(vocab_size=128_256, d_model=8192,
+                              n_layers=80, n_heads=64, n_kv_heads=8,
+                              d_ff=28_672, max_seq_len=8192),
     # 1B-class config at Llama-3.2-1B proportions, with head_dim 128
     # (16 heads instead of 32): identical parameter count and FLOPs, but
     # the head dim matches the MXU lane width / Mosaic tiling so the
