@@ -276,8 +276,9 @@ class TpuVmBackend:
         info = provision.get_cluster_info(handle.provider,
                                           handle.cluster_name, handle.zone)
         runners = provision.get_command_runners(info)
+        from skypilot_tpu.data import cloud_stores
         for dst, src in file_mounts.items():
-            if src.startswith(("gs://", "s3://", "r2://", "az://")):
+            if src.startswith(cloud_stores.BUCKET_URL_PREFIXES):
                 from skypilot_tpu.data import storage as storage_lib
                 storage_lib.mount_or_copy(handle, dst, src)
                 continue

@@ -125,8 +125,9 @@ def translate_local_file_mounts(task: Task, handle: ClusterHandle) -> Task:
     translation is a no-op there. For cloud controllers, local workdir/
     file_mounts are uploaded to a GCS bucket and the task is rewritten
     to gs:// sources."""
+    from skypilot_tpu.data import cloud_stores
     needs_translation = bool(task.workdir) or any(
-        not src.startswith(("gs://", "s3://", "r2://", "az://", "http://", "https://"))
+        not src.startswith(cloud_stores.REMOTE_URL_PREFIXES)
         for src in (task.file_mounts or {}).values())
     if handle.provider == "local" or not needs_translation:
         return task
@@ -146,7 +147,7 @@ def translate_local_file_mounts(task: Task, handle: ClusterHandle) -> Task:
         uploads[f"{run_prefix}/workdir"] = task.workdir
         cfg["workdir"] = None
     for dst, src in list(mounts.items()):
-        if not src.startswith(("gs://", "s3://", "r2://", "az://", "http://", "https://")):
+        if not src.startswith(cloud_stores.REMOTE_URL_PREFIXES):
             sub = f"{run_prefix}/mount{len(uploads)}"
             uploads[sub] = src
             if os.path.isfile(os.path.expanduser(src)):

@@ -99,6 +99,10 @@ class S3CloudStorage(CloudStorage):
 
     def make_sync_auto_command(self, source: str, destination: str) -> str:
         bucket, _, key = source[len(f"{self.SCHEME}://"):].partition("/")
+        if not key:
+            # A bucket root is always a directory; probing it would run
+            # head-object with an empty --key (parameter error).
+            return self.make_sync_dir_command(source, destination)
         return _probe_then_dispatch(
             f"{self._aws()} s3api head-object "
             f"--bucket {shlex.quote(bucket)} --key {shlex.quote(key)}",
@@ -178,14 +182,80 @@ class HttpCloudStorage(CloudStorage):
         raise ValueError(f"https source {source} must be a single file")
 
 
+class IbmCosCloudStorage(S3CloudStorage):
+    """cos://<region>/<bucket>/... via the aws CLI against the IBM COS
+    regional S3-compatibility endpoint (region rides in the URL)."""
+
+    SCHEME = "cos"
+
+    def _split(self, url: str):
+        rest = url.removeprefix("cos://")
+        region, _, bucket_path = rest.partition("/")
+        return region, bucket_path
+
+    def _aws_for(self, url: str) -> str:
+        from skypilot_tpu.data import storage as storage_lib
+        return storage_lib.cos_aws_prefix(self._split(url)[0])
+
+    # The region-qualified URL makes the prefix source-dependent, so
+    # the builders re-dispatch through _aws_for instead of _aws().
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p {dst} && {self._aws_for(source)} s3 sync "
+                f"{shlex.quote(self._cli_url(source))} {dst}")
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f"mkdir -p $(dirname {dst}) && {self._aws_for(source)} "
+                f"s3 cp {shlex.quote(self._cli_url(source))} {dst}")
+
+    def make_sync_auto_command(self, source: str, destination: str) -> str:
+        bucket, _, key = self._split(source)[1].partition("/")
+        if not key:   # cos://<region>/<bucket> — a bucket root is a dir
+            return self.make_sync_dir_command(source, destination)
+        return _probe_then_dispatch(
+            f"{self._aws_for(source)} s3api head-object "
+            f"--bucket {shlex.quote(bucket)} --key {shlex.quote(key)}",
+            "not found|404",
+            self.make_sync_file_command(source, destination),
+            self.make_sync_dir_command(source, destination))
+
+    def _cli_url(self, url: str) -> str:
+        return "s3://" + self._split(url)[1]
+
+
+class OciCloudStorage(S3CloudStorage):
+    """oci:// via the aws CLI against the OCI S3-compatibility endpoint
+    (namespace + region from config, like R2's account endpoint)."""
+
+    SCHEME = "oci"
+
+    def _aws(self) -> str:
+        from skypilot_tpu.data import storage as storage_lib
+        return storage_lib.oci_aws_prefix()
+
+
 _REGISTRY: Dict[str, CloudStorage] = {
     "gs": GcsCloudStorage(),
     "s3": S3CloudStorage(),
     "r2": R2CloudStorage(),
     "az": AzureCloudStorage(),
+    "cos": IbmCosCloudStorage(),
+    "oci": OciCloudStorage(),
     "https": HttpCloudStorage(),
     "http": HttpCloudStorage(),
 }
+
+
+# "<scheme>://" prefixes that mark a source as remote (vs a local path
+# to upload) — DERIVED from the registry, so a newly registered scheme
+# can never silently be treated as a local file by callers.
+REMOTE_URL_PREFIXES = tuple(f"{s}://" for s in _REGISTRY)
+
+# Bucket-store subset (excludes plain http(s) file fetches): what the
+# backend can FUSE-mount / prefix-sync.
+BUCKET_URL_PREFIXES = tuple(f"{s}://" for s in _REGISTRY
+                            if s not in ("http", "https"))
 
 
 def get_storage_from_path(url: str) -> CloudStorage:

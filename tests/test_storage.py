@@ -360,3 +360,110 @@ def test_az_cloud_store_commands(az_config):
     assert "blob exists" in auto and "--query exists" in auto
     # exit-code-0-with-answer-on-stdout: failure is loud, true -> file.
     assert "exit 1" in auto and "grep -qi true" in auto
+
+
+# -- IBM COS (region-qualified cos://) --------------------------------------
+
+@pytest.fixture()
+def cos_config(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "h"))
+
+
+def test_cos_storage_from_url(cos_config):
+    """cos URLs carry the region first (reference IBMCosStore URL form:
+    cos://<region>/<bucket>/path)."""
+    run = FakeRun()
+    st = storage.Storage(source="cos://us-south/cosbucket/data", run=run)
+    assert st.store.SCHEME == "cos"
+    assert st.store.name == "cosbucket"
+    assert st.store.region == "us-south"
+    assert st.store.url == "cos://us-south/cosbucket/data"
+    cmd = st.store.copy_down_command("/dst")
+    assert "s3://cosbucket/data" in cmd
+    assert ("--endpoint-url https://s3.us-south.cloud-object-storage"
+            ".appdomain.cloud" in cmd)
+    assert "--profile ibm" in cmd
+    mount = st.store.mount_command("/mnt")
+    assert "goofys" in mount and "us-south" in mount
+
+
+def test_cos_url_without_bucket_rejected(cos_config):
+    with pytest.raises(exceptions.StorageError, match="cos://<region>"):
+        storage.Storage(source="cos://us-south", run=FakeRun())
+
+
+def test_cos_lifecycle_commands(cos_config):
+    run = FakeRun()
+    st = storage.IbmCosStore("b", run=run, region="eu-de")
+    st.exists(); st.create(); st.delete()
+    for cmd in run.cmds:
+        assert "s3.eu-de.cloud-object-storage.appdomain.cloud" in cmd
+
+
+def test_cos_cloud_store_commands(cos_config):
+    cs = cloud_stores.get_storage_from_path("cos://us-south/bkt/sub/f")
+    f = cs.make_sync_file_command("cos://us-south/bkt/sub/f", "/d/f")
+    assert "s3://bkt/sub/f" in f and "s3.us-south" in f
+    auto = cs.make_sync_auto_command("cos://us-south/bkt/sub/n", "/d/n")
+    assert "head-object --bucket bkt --key sub/n" in auto
+
+
+# -- OCI Object Storage (S3-compat endpoint) --------------------------------
+
+@pytest.fixture()
+def oci_config(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "h"))
+    monkeypatch.setenv("OCI_NAMESPACE", "mytenancy")
+    monkeypatch.setenv("OCI_REGION", "us-ashburn-1")
+
+
+def test_oci_storage_from_url(oci_config):
+    run = FakeRun()
+    st = storage.Storage(source="oci://ocibucket/data", run=run)
+    assert st.store.SCHEME == "oci"
+    assert st.store.url == "oci://ocibucket/data"
+    cmd = st.store.copy_down_command("/dst")
+    assert "s3://ocibucket/data" in cmd
+    assert ("--endpoint-url https://mytenancy.compat.objectstorage"
+            ".us-ashburn-1.oraclecloud.com" in cmd)
+    assert "--profile oci" in cmd
+    mount = st.store.mount_command("/mnt")
+    assert "goofys" in mount and "mytenancy.compat" in mount
+
+
+def test_oci_requires_namespace(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "h"))
+    for v in ("OCI_NAMESPACE", "OCI_REGION"):
+        monkeypatch.delenv(v, raising=False)
+    st = storage.OciStore("b", run=FakeRun())
+    with pytest.raises(exceptions.StorageError, match="namespace"):
+        st.exists()
+
+
+def test_oci_cloud_store_commands(oci_config):
+    cs = cloud_stores.get_storage_from_path("oci://bkt/sub/f.txt")
+    f = cs.make_sync_file_command("oci://bkt/sub/f.txt", "/d/f.txt")
+    assert "s3://bkt/sub/f.txt" in f and "compat.objectstorage" in f
+
+
+def test_cos_bucket_root_syncs_as_directory(cos_config):
+    """cos://<region>/<bucket> (no subpath) must take the dir-sync path
+    — an auto probe would run head-object with an empty --key."""
+    cs = cloud_stores.get_storage_from_path("cos://us-south/bkt")
+    cmd = cs.make_sync_auto_command("cos://us-south/bkt", "/d")
+    assert "head-object" not in cmd
+    assert "s3 sync" in cmd and "s3://bkt" in cmd
+    # Same guard on the generic S3 family.
+    s3 = cloud_stores.get_storage_from_path("s3://bkt")
+    assert "head-object" not in s3.make_sync_auto_command("s3://bkt", "/d")
+
+
+def test_cos_named_store_create_repins_region(cos_config):
+    """sync_up(region=...) on a named cos store must move the ENDPOINT,
+    not send a mismatched LocationConstraint to the default region."""
+    run = FakeRun()
+    st = storage.IbmCosStore("b", run=run)
+    st.create(region="eu-de")
+    assert st.region == "eu-de"
+    assert any("s3.eu-de.cloud-object-storage" in c for c in run.cmds)
+    assert not any("LocationConstraint" in c for c in run.cmds)
