@@ -53,13 +53,7 @@ def check(quiet: bool = False,
     (reference behavior: sky/check.py merges subset results).
     """
     to_check = list(clouds) if clouds else list(CLOUDS)
-    prior: List[str] = []
-    if clouds and os.path.exists(_cache_path()):
-        try:
-            with open(_cache_path()) as f:
-                prior = json.load(f)["enabled"]
-        except (json.JSONDecodeError, KeyError):
-            prior = []
+    prior = (cached_enabled_clouds() or []) if clouds else []
     enabled = [c for c in prior if c not in to_check]
     reasons: Dict[str, str] = {}
     for cloud in to_check:
@@ -81,15 +75,43 @@ def check(quiet: bool = False,
     return enabled
 
 
-def get_cached_enabled_clouds_or_refresh(
-        raise_if_no_cloud_access: bool = False) -> List[str]:
+_cache_memo: dict = {}
+
+
+def cached_enabled_clouds() -> Optional[List[str]]:
+    """The enabled list IF a check has ever run, else None (no probe).
+
+    The optimizer consults this to restrict catalog candidates to
+    enabled clouds (reference: optimizer candidates come only from
+    enabled clouds, sky/optimizer.py via check.py:172) — but only once
+    the user has actually run a check; with no cache, every catalog
+    cloud stays a candidate so offline planning/dryruns work
+    credential-free. Memoized on file mtime: launchables() sits on the
+    optimizer's per-resource path and must not re-parse an unchanged
+    file every call."""
     path = _cache_path()
-    if os.path.exists(path):
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    key = (path, mtime)
+    if key not in _cache_memo:
         try:
             with open(path) as f:
-                return json.load(f)["enabled"]
-        except (json.JSONDecodeError, KeyError):
-            pass
+                value = list(json.load(f)["enabled"])
+        except (json.JSONDecodeError, KeyError, TypeError, OSError):
+            # Unreadable/malformed cache == "no check has run".
+            value = None
+        _cache_memo.clear()
+        _cache_memo[key] = value
+    return _cache_memo[key]
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access: bool = False) -> List[str]:
+    cached = cached_enabled_clouds()
+    if cached is not None:
+        return cached
     try:
         return check(quiet=True)
     except exceptions.NoCloudAccessError:

@@ -120,9 +120,21 @@ def _candidates_for(task: Task, blocked: BlockedSet,
     for key, used in consumed.items():
         reserved_cache[key] = max(reserved_cache[key] - used, 0)
     if not out:
+        from skypilot_tpu import check as check_lib
+        enabled = check_lib.cached_enabled_clouds()
+        hint = ""
+        if enabled is not None:
+            wanted = {r.cloud for r in task.resources if r.cloud}
+            disabled = sorted(wanted - set(enabled))
+            if disabled or not any(c in enabled
+                                   for c in catalog.CATALOG_CLOUDS):
+                hint = (f" — cloud(s) {disabled or 'gcp/aws'} not "
+                        f"enabled (enabled: {enabled}); run `skytpu "
+                        f"check` after configuring credentials")
         raise exceptions.ResourcesUnavailableError(
             f"no feasible resources for {task} "
-            f"(requested {task.resources}, {len(blocked)} blocked)")
+            f"(requested {task.resources}, {len(blocked)} blocked)"
+            f"{hint}")
     return out
 
 

@@ -128,8 +128,27 @@ class Resources:
         min_mem, mem_plus = parse_count(self.memory, "memory")
         # None = arbitrage across every catalog cloud (the reference's
         # core value prop: sky/optimizer.py candidates span all enabled
-        # clouds); a set cloud restricts the search to it.
+        # clouds); a set cloud restricts the search to it. Once a
+        # credential check has run, disabled clouds drop out of the
+        # candidate set (no cache -> no restriction: offline dryruns
+        # stay credential-free).
         cloud = self.cloud if self.cloud in catalog.CATALOG_CLOUDS else None
+        from skypilot_tpu import check as check_lib
+        enabled = check_lib.cached_enabled_clouds()
+        allowed = None
+        if enabled is not None:
+            if cloud is not None and cloud not in enabled:
+                # No candidates, not an exception: this Resources may be
+                # one option of an any-of list whose other entries are
+                # feasible (the optimizer's no-feasible-resources error
+                # carries the enabled-clouds hint when everything
+                # drops out).
+                return []
+            if cloud is None:
+                allowed = [c for c in catalog.CATALOG_CLOUDS
+                           if c in enabled]
+                if not allowed:
+                    return []
         if self.accelerators is None and self.instance_type is None:
             df = catalog.cpu_instance_types(min_cpus or 0, min_mem or 0,
                                             cloud=cloud)
@@ -143,6 +162,8 @@ class Resources:
                     df[df["vcpus"] == min_cpus]
             if min_mem is not None:
                 df = df[df["memory_gb"] >= min_mem] if mem_plus else df
+        if allowed is not None:
+            df = df[df["cloud"].isin(allowed)]
         if self.region is not None:
             df = df[df["region"] == self.region]
         if self.zone is not None:

@@ -121,3 +121,20 @@ def run_one_test(test: SmokeTest) -> None:
                            timeout=test.timeout)
     assert failed_cmd is None, \
         f"smoke test {test.name} failed at: {failed_cmd}"
+
+
+def has_aws_credentials() -> bool:
+    """AWS keys present AND smoke explicitly requested (same accident
+    guard as GCP: a bare `pytest tests/` must never bill an account)."""
+    if not os.environ.get("SKYTPU_SMOKE"):
+        return False
+    try:
+        from skypilot_tpu.provision import aws_auth
+        return aws_auth.load_credentials() is not None
+    except Exception:  # noqa: BLE001
+        return False
+
+
+requires_aws = pytest.mark.skipif(
+    not has_aws_credentials(),
+    reason="live AWS smoke needs SKYTPU_SMOKE=1 + AWS credentials")

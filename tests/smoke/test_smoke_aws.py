@@ -1,0 +1,75 @@
+"""Live-AWS smoke tests: the EC2 provider against real credentials
+(reference: tests/smoke_tests/test_cluster_job.py aws cases). Skipped
+without SKYTPU_SMOKE=1 + AWS keys — see smoke_utils.has_aws_credentials.
+
+Cost notes: the lifecycle test uses m6i.large (~$0.10/h); the spot test
+uses a g4dn.xlarge spot T4 (~$0.16/h). Every test tears its cluster
+down in a finally, pass or fail.
+"""
+
+from tests.smoke.smoke_utils import (SKYTPU, SmokeTest, requires_aws,
+                                     run_one_test, smoke_name,
+                                     wait_cluster_status,
+                                     wait_job_status)
+
+pytestmark = requires_aws
+
+
+def test_aws_vm_lifecycle():
+    """launch -> exec -> stop -> start -> down on the cheapest EC2 VM:
+    exercises RunInstances/Describe/Stop/Start/Terminate, the
+    hashed-name keypair import, and the cluster security group."""
+    name = smoke_name("awsvm")
+    run_one_test(SmokeTest(
+        name="aws_vm_lifecycle",
+        commands=[
+            f"{SKYTPU} launch -c {name} --cloud aws 'echo hello-aws' "
+            f"--detach-run",
+            wait_cluster_status(name, ["UP"]),
+            wait_job_status(name, 1, ["SUCCEEDED"]),
+            f"{SKYTPU} exec {name} 'hostname && echo exec-ok'",
+            f"{SKYTPU} logs {name} 1 --no-follow | grep hello-aws",
+            f"{SKYTPU} stop {name}",
+            wait_cluster_status(name, ["STOPPED"], timeout_s=600),
+            f"{SKYTPU} start {name}",
+            wait_cluster_status(name, ["UP"], timeout_s=900),
+        ],
+        teardown=f"{SKYTPU} down {name} || true",
+    ))
+
+
+def test_aws_ports_security_group():
+    """ports: must become SG ingress rules reachable from outside."""
+    name = smoke_name("awsports")
+    run_one_test(SmokeTest(
+        name="aws_ports_security_group",
+        commands=[
+            f"cat > /tmp/{name}.yaml <<'EOF'\n"
+            f"resources:\n  cloud: aws\n  ports: [8043]\n"
+            f"run: timeout 600 python3 -m http.server 8043\n"
+            f"EOF",
+            f"{SKYTPU} launch -c {name} /tmp/{name}.yaml --detach-run",
+            wait_cluster_status(name, ["UP"]),
+            wait_job_status(name, 1, ["RUNNING"]),
+            # External reachability through the SG rule.
+            f"ip=$({SKYTPU} status --ip {name}) && "
+            f"curl -sf --max-time 20 http://$ip:8043/ >/dev/null",
+        ],
+        teardown=f"{SKYTPU} down {name} || true",
+    ))
+
+
+def test_aws_spot_gpu():
+    """Spot T4 via InstanceMarketOptions; nvidia-smi sees the GPU."""
+    name = smoke_name("awsspot")
+    run_one_test(SmokeTest(
+        name="aws_spot_gpu",
+        commands=[
+            f"{SKYTPU} launch -c {name} --cloud aws "
+            f"--gpus T4 --use-spot 'nvidia-smi -L' --detach-run",
+            wait_cluster_status(name, ["UP"], timeout_s=1200),
+            wait_job_status(name, 1, ["SUCCEEDED"]),
+            f"{SKYTPU} logs {name} 1 --no-follow | grep -i tesla",
+        ],
+        teardown=f"{SKYTPU} down {name} || true",
+    ))

@@ -369,3 +369,42 @@ def test_cross_cloud_spot_arbitrage():
     spot = optimizer.optimize_task(_task("H100:8", use_spot=True))
     assert od.cloud == "gcp"        # a3-highgpu-8g undercuts p5 on-demand
     assert spot.cloud == "aws"      # p5 spot undercuts a3 spot
+
+
+def test_enabled_cloud_cache_gates_candidates(tmp_path, monkeypatch):
+    """Once a credential check has run, disabled clouds drop out of the
+    candidate set (reference: optimizer candidates come only from
+    enabled clouds); without a cache every catalog cloud stays in so
+    offline dryruns need no credentials."""
+    import json
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    free = optimizer.optimize_task(_task(None, cpus="8+"))
+    assert free.cloud == "aws"          # no cache: cheapest overall
+    with open(tmp_path / "enabled_clouds.json", "w") as f:
+        json.dump({"enabled": ["gcp", "local"]}, f)
+    gated = optimizer.optimize_task(_task(None, cpus="8+"))
+    assert gated.cloud == "gcp"
+    with pytest.raises(exceptions.ResourcesUnavailableError,
+                       match="not enabled"):
+        optimizer.optimize_task(_task(None, cpus="8+", cloud="aws"))
+    # Any-of lists FALL THROUGH a disabled pinned cloud to the next
+    # feasible option instead of aborting the whole optimize.
+    t = Task(name="anyof")
+    t.set_resources([Resources(cloud="aws", cpus="8+"),
+                     Resources(cloud="gcp", cpus="8+")])
+    assert optimizer.optimize_task(t).cloud == "gcp"
+    # Catalog clouds all disabled -> clear error, not empty plan.
+    with open(tmp_path / "enabled_clouds.json", "w") as f:
+        json.dump({"enabled": ["local"]}, f)
+    with pytest.raises(exceptions.ResourcesUnavailableError,
+                       match="skytpu check"):
+        optimizer.optimize_task(_task(None, cpus="8+"))
+    # A malformed cache degrades to "no check has run", not a crash.
+    with open(tmp_path / "enabled_clouds.json", "w") as f:
+        f.write('{"enabled": null}')
+    assert optimizer.optimize_task(_task(None, cpus="8+")).cloud == "aws"
+    # Local tasks stay unaffected by the gate.
+    with open(tmp_path / "enabled_clouds.json", "w") as f:
+        json.dump({"enabled": ["local"]}, f)
+    assert optimizer.optimize_task(
+        _task(None, cloud="local")).cloud == "local"
