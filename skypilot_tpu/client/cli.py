@@ -27,6 +27,23 @@ def cli():
     """skypilot_tpu: run tasks on TPU slices (and VMs) in the sky."""
 
 
+def _resource_overrides(accelerators: Optional[str],
+                        cloud: Optional[str], use_spot: bool,
+                        recovery: Optional[str] = None) -> dict:
+    """CLI flags -> Resources.copy overrides (shared by launch, exec,
+    and both jobs-launch forms)."""
+    overrides = {}
+    if accelerators:
+        overrides["accelerators"] = accelerators
+    if cloud:
+        overrides["cloud"] = cloud
+    if use_spot:
+        overrides["use_spot"] = True
+    if recovery:
+        overrides["job_recovery"] = recovery
+    return overrides
+
+
 def _load_task(yaml_path: Optional[str], command: Optional[str],
                accelerators: Optional[str], cloud: Optional[str],
                num_nodes: Optional[int], use_spot: bool,
@@ -39,13 +56,7 @@ def _load_task(yaml_path: Optional[str], command: Optional[str],
         task.name = name
     if num_nodes:
         task.num_nodes = num_nodes
-    overrides = {}
-    if accelerators:
-        overrides["accelerators"] = accelerators
-    if cloud:
-        overrides["cloud"] = cloud
-    if use_spot:
-        overrides["use_spot"] = True
+    overrides = _resource_overrides(accelerators, cloud, use_spot)
     if overrides:
         task.set_resources(task.resources[0].copy(**overrides))
     return task
@@ -345,11 +356,23 @@ def jobs_launch(yaml_or_command, name, accelerators, cloud, use_spot,
     from skypilot_tpu.jobs import core as jobs_core
     is_yaml = yaml_or_command.endswith((".yaml", ".yml")) or os.path.exists(
         yaml_or_command)
-    task = _load_task(yaml_or_command if is_yaml else None,
-                      None if is_yaml else yaml_or_command,
-                      accelerators, cloud, None, use_spot, name)
-    if recovery:
-        task.set_resources(task.resources[0].copy(job_recovery=recovery))
+    tasks = (Task.from_yaml_all(yaml_or_command) if is_yaml
+             else [Task(run=yaml_or_command)])
+    over = _resource_overrides(accelerators, cloud, use_spot, recovery)
+    # Flag overrides apply to EVERY task of a pipeline, same as the
+    # single-task path (the reference's behavior for job-level flags).
+    for t in tasks:
+        if over:
+            t.set_resources(t.resources[0].copy(**over))
+    if len(tasks) > 1:
+        job_id = jobs_core.launch(tasks, name=name)
+        click.echo(f"Managed pipeline {job_id} submitted "
+                   f"({len(tasks)} tasks; controller log: "
+                   f"jobs-controller-{job_id}.log).")
+        return
+    task = tasks[0]
+    if name:
+        task.name = name
     job_id = jobs_core.launch(task, name=name)
     click.echo(f"Managed job {job_id} submitted "
                f"(controller log: jobs-controller-{job_id}.log).")
@@ -360,11 +383,16 @@ def jobs_queue():
     """List managed jobs."""
     from skypilot_tpu.jobs import core as jobs_core
     rows = jobs_core.queue()
-    fmt = "{:<6}{:<16}{:<20}{:<10}{:<18}"
-    click.echo(fmt.format("ID", "NAME", "STATUS", "#RECOV", "CLUSTER"))
+    fmt = "{:<6}{:<16}{:<20}{:<7}{:<10}{:<18}"
+    click.echo(fmt.format("ID", "NAME", "STATUS", "TASK", "#RECOV",
+                          "CLUSTER"))
     for r in rows:
+        n = r.get("num_tasks", 1)
+        task_col = (f"{r.get('current_task', 0) + 1}/{n}" if n > 1
+                    else "-")
         click.echo(fmt.format(r["job_id"], r["name"] or "-",
-                              r["status"].value, r["recovery_count"],
+                              r["status"].value, task_col,
+                              r["recovery_count"],
                               r["cluster_name"] or "-"))
 
 

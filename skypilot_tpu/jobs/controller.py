@@ -33,19 +33,41 @@ class JobsController:
         rec = state.get(managed_job_id)
         if rec is None:
             raise exceptions.ManagedJobError(f"no managed job {managed_job_id}")
-        self.task = Task.from_yaml_config(rec["task_config"])
-        self.cluster_name = f"sky-jobs-{managed_job_id}"
-        self.strategy = recovery_strategy.StrategyExecutor.make(
-            rec["recovery_strategy"], self.task, self.cluster_name)
+        cfg = rec["task_config"]
+        # A pipeline ({"pipeline": [cfg, ...]}) runs its tasks
+        # SEQUENTIALLY under one managed job, each on its own cluster
+        # with its own recovery (reference: sky/jobs/controller.py:68
+        # iterates dag.tasks; task i+1 starts only after i SUCCEEDED).
+        configs = (cfg["pipeline"] if "pipeline" in cfg else [cfg])
+        self.tasks = [Task.from_yaml_config(c) for c in configs]
+        self.default_strategy = rec["recovery_strategy"]
         self.backend = TpuVmBackend()
+        # Current-task slots, (re)bound by _bind_task per pipeline step.
+        self.task = None
+        self.cluster_name = None
+        self.strategy = None
+
+    def _bind_task(self, index: int) -> None:
+        self.task = self.tasks[index]
+        suffix = f"-t{index}" if len(self.tasks) > 1 else ""
+        self.cluster_name = f"sky-jobs-{self.job_id}{suffix}"
+        strat = self.default_strategy
+        for r in self.task.resources:       # per-task override
+            strat = r.job_recovery or strat
+        self.strategy = recovery_strategy.StrategyExecutor.make(
+            strat, self.task, self.cluster_name)
+        # Recovery budget is PER TASK: step A burning its allowance on
+        # preemptions must not strand step B with zero attempts (the DB
+        # recovery_count stays cumulative for display).
+        self.task_recoveries = 0
+        state.set_current_task(self.job_id, index)
+        state.set_cluster(self.job_id, self.cluster_name)
 
     def _log(self, msg: str) -> None:
         print(f"[managed job {self.job_id}] {msg}", flush=True)
 
     def run(self) -> None:
         try:
-            self._log(f"starting; cluster {self.cluster_name}, "
-                      f"strategy {type(self.strategy).__name__}")
             if not state.set_status(self.job_id,
                                     state.ManagedJobStatus.STARTING):
                 # Cancel landed between submit and controller startup.
@@ -53,27 +75,10 @@ class JobsController:
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.CANCELLED)
                 return
-            state.set_cluster(self.job_id, self.cluster_name)
-            # Launching-parallelism gate (reference: sky/jobs/
-            # scheduler.py:72 — at most 4 concurrent launches per CPU).
-            state.acquire_launch_slot(self.job_id)
-            try:
-                job_id, handle = self.strategy.launch()
-            finally:
-                state.release_launch_slot(self.job_id)
-            self._log(f"cluster up; job {job_id} running")
-            if not state.transition_to_running(self.job_id):
-                # A cancel landed while we were provisioning — honor it
-                # instead of resurrecting the job (the cluster is torn
-                # down by _cleanup in the finally block).
-                self._log("cancelled during launch; tearing down")
-                state.set_status(self.job_id,
-                                 state.ManagedJobStatus.CANCELLED)
-                return
-            # _monitor returns the FINAL (job_id, handle) — recovery may
-            # have moved the job to a fresh cluster in another zone.
-            job_id, handle = self._monitor(job_id, handle)
-            self._snapshot_output(job_id, handle)
+            for i in range(len(self.tasks)):
+                self._bind_task(i)
+                if not self._run_one_task(i):
+                    return          # terminal status already recorded
             final = state.get(self.job_id)
             if final:
                 self._log(f"finished: {final['status'].value}")
@@ -88,39 +93,100 @@ class JobsController:
         finally:
             self._cleanup()
 
-    def _snapshot_output(self, job_id: int, handle: ClusterHandle) -> None:
+    def _run_one_task(self, index: int) -> bool:
+        """One pipeline step: launch, monitor to completion, snapshot
+        logs, tear the step's cluster down. True = task succeeded and
+        the pipeline may continue; False = a terminal status (FAILED /
+        CANCELLED / ...) was recorded (reference:
+        sky/jobs/controller.py:119 _run_one_task)."""
+        n = len(self.tasks)
+        step = f"task {index + 1}/{n}: " if n > 1 else ""
+        # A cancel that landed between steps (inter-step teardown takes
+        # minutes on real clusters) must be honored BEFORE the next
+        # slice is provisioned and billed — the pre-launch analog of
+        # the STARTING guard that protects task 0.
+        rec = state.get(self.job_id)
+        if rec and rec["status"] == state.ManagedJobStatus.CANCELLING:
+            self._log(f"{step}cancelled before launch")
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.CANCELLED)
+            return False
+        self._log(f"{step}starting; cluster {self.cluster_name}, "
+                  f"strategy {type(self.strategy).__name__}")
+        # Launching-parallelism gate (reference: sky/jobs/
+        # scheduler.py:72 — at most 4 concurrent launches per CPU).
+        state.acquire_launch_slot(self.job_id)
+        try:
+            job_id, handle = self.strategy.launch()
+        finally:
+            state.release_launch_slot(self.job_id)
+        self._log(f"{step}cluster up; job {job_id} running")
+        if not state.transition_to_running(self.job_id):
+            # A cancel landed while we were provisioning — honor it
+            # instead of resurrecting the job (the cluster is torn
+            # down by _cleanup in the finally block).
+            self._log("cancelled during launch; tearing down")
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.CANCELLED)
+            return False
+        # _monitor returns the FINAL (job_id, handle) — recovery may
+        # have moved the job to a fresh cluster in another zone.
+        ok, job_id, handle = self._monitor(job_id, handle)
+        if ok and index == n - 1:
+            # Record SUCCEEDED at DETECTION time — the log snapshot
+            # below can take minutes on real clusters, and a cancel
+            # acknowledged in that window must not be silently
+            # overwritten by a late terminal write.
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.SUCCEEDED)
+        self._snapshot_output(job_id, handle, task_index=index)
+        if ok and index < n - 1:
+            # Inter-step teardown: the next task gets its own cluster;
+            # this one must not keep billing under it.
+            self._cleanup()
+        return ok
+
+    def _snapshot_output(self, job_id: int, handle: ClusterHandle,
+                         task_index: int = 0) -> None:
         """Persist the job's output logs before the per-job cluster is
         torn down, so `jobs logs` works after completion (reference:
-        the controller's log download at sky/jobs/controller.py)."""
+        the controller's log download at sky/jobs/controller.py).
+        Pipeline steps append to one file, separated by headers."""
         from skypilot_tpu.utils import paths
         out_path = os.path.join(paths.logs_dir(),
                                 f"jobs-output-{self.job_id}.log")
+        mode = "w" if task_index == 0 else "a"
         try:
-            with open(out_path, "w") as f:
+            with open(out_path, mode) as f:
+                if len(self.tasks) > 1:
+                    f.write(f"===== task {task_index + 1}/"
+                            f"{len(self.tasks)}"
+                            f" ({self.task.name or 'unnamed'}) =====\n")
                 self.backend.tail_logs(handle, job_id, follow=False, out=f)
         except exceptions.SkyTpuError as e:
             self._log(f"output snapshot failed: {e}")
 
     # -- monitor loop ------------------------------------------------------
     def _monitor(self, job_id: int, handle: ClusterHandle):
-        """Returns the final (job_id, handle) — possibly a recovered
-        cluster, which is the one whose logs are worth snapshotting."""
+        """Returns (succeeded, job_id, handle) — possibly a recovered
+        cluster, which is the one whose logs are worth snapshotting.
+        Terminal FAILED/CANCELLED states are recorded here; SUCCEEDED
+        is NOT (the pipeline loop records it after the LAST task —
+        intermediate task successes leave the job RUNNING)."""
         while True:
             time.sleep(POLL_SECONDS)
             rec = state.get(self.job_id)
             if rec["status"] == state.ManagedJobStatus.CANCELLING:
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.CANCELLED)
-                return job_id, handle
+                return False, job_id, handle
             js = self._cluster_job_status(handle, job_id)
             if js == JobStatus.SUCCEEDED:
-                state.set_status(self.job_id,
-                                 state.ManagedJobStatus.SUCCEEDED)
-                return job_id, handle
+                return True, job_id, handle
             if js == JobStatus.CANCELLED:
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.CANCELLED)
-                return job_id, handle
+                return False, job_id, handle
             if js is None or js in (JobStatus.FAILED,
                                     JobStatus.FAILED_SETUP):
                 # Cluster gone (slice preempted) or job died with the
@@ -130,17 +196,18 @@ class JobsController:
                     state.set_status(self.job_id,
                                      state.ManagedJobStatus.FAILED,
                                      error="task failed on healthy cluster")
-                    return job_id, handle
+                    return False, job_id, handle
                 recovered = self._recover()
                 if recovered is None:
-                    return job_id, handle
+                    return False, job_id, handle
                 job_id, handle = recovered
 
     def _recover(self):
         """Recover the cluster+job; returns (job_id, handle) or None if
         the managed job reached a terminal state instead."""
-        n = state.bump_recovery(self.job_id)
-        if n > recovery_strategy.MAX_RECOVERY_ATTEMPTS:
+        state.bump_recovery(self.job_id)     # cumulative, for display
+        self.task_recoveries += 1            # per-task budget
+        if self.task_recoveries > recovery_strategy.MAX_RECOVERY_ATTEMPTS:
             state.set_status(self.job_id, state.ManagedJobStatus.FAILED,
                              error="max recovery attempts exceeded")
             return None
@@ -188,6 +255,8 @@ class JobsController:
         return None
 
     def _cleanup(self) -> None:
+        if self.cluster_name is None:     # cancelled before any task
+            return
         rec = cluster_state.get_cluster(self.cluster_name)
         if rec is not None:
             try:

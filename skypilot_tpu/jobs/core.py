@@ -31,17 +31,31 @@ def _rpc(handle: ClusterHandle):
     return controller_utils.controller_rpc(handle)
 
 
-def launch(task: Task, name: Optional[str] = None) -> int:
+def launch(task, name: Optional[str] = None) -> int:
     """Submit a managed job; a controller process on the jobs controller
-    cluster owns it end to end."""
-    handle = _controller_handle(create_for=task)
-    task = controller_utils.translate_local_file_mounts(task, handle)
+    cluster owns it end to end.
+
+    ``task`` may be a list of Tasks — a PIPELINE: the controller runs
+    them sequentially, each on its own cluster with its own recovery
+    (reference: multi-document job YAMLs, sky/jobs/controller.py:68)."""
+    tasks = task if isinstance(task, list) else [task]
+    handle = _controller_handle(create_for=tasks[0])
+    tasks = [controller_utils.translate_local_file_mounts(t, handle)
+             for t in tasks]
     strategy = None
-    for r in task.resources:
-        strategy = r.job_recovery or strategy
+    if len(tasks) == 1:
+        for r in tasks[0].resources:
+            strategy = r.job_recovery or strategy
+        task_config = tasks[0].to_yaml_config()
+    else:
+        # Pipelines: recovery is PER TASK — each step's job_recovery
+        # rides in its own config and controller._bind_task applies it;
+        # aggregating across steps would leak one task's choice into
+        # siblings that set none (they get the job default instead).
+        task_config = {"pipeline": [t.to_yaml_config() for t in tasks]}
     result = _rpc(handle).call(
-        "jobs_submit", name=name or task.name,
-        task_config=task.to_yaml_config(),
+        "jobs_submit", name=name or tasks[0].name,
+        task_config=task_config,
         strategy=strategy or "EAGER_NEXT_ZONE")
     return result["job_id"]
 

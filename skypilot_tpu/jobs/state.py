@@ -88,6 +88,8 @@ def _db_path() -> str:
 _MIGRATIONS = (
     "ALTER TABLE managed_jobs ADD COLUMN launch_started_at REAL",
     "ALTER TABLE managed_jobs ADD COLUMN launch_ended_at REAL",
+    # Pipeline position (multi-task managed jobs run sequentially).
+    "ALTER TABLE managed_jobs ADD COLUMN current_task INTEGER DEFAULT 0",
 )
 
 
@@ -177,6 +179,12 @@ def set_cluster(job_id: int, cluster_name: str) -> None:
                   (cluster_name, job_id))
 
 
+def set_current_task(job_id: int, task_index: int) -> None:
+    with _db() as c:
+        c.execute("UPDATE managed_jobs SET current_task = ? "
+                  "WHERE job_id = ?", (task_index, job_id))
+
+
 def set_controller_pid(job_id: int, pid: int) -> None:
     with _db() as c:
         c.execute("UPDATE managed_jobs SET controller_pid=? WHERE job_id=?",
@@ -201,6 +209,35 @@ def list_jobs() -> List[Dict[str, Any]]:
     with _db() as c:
         rows = c.execute(_SELECT + " ORDER BY job_id DESC").fetchall()
     return [_rec(r) for r in rows]
+
+
+def reap_dead_controllers() -> int:
+    """Mark non-terminal jobs whose controller PROCESS is gone as
+    FAILED_CONTROLLER — a controller that dies hard (crash at import,
+    OOM-kill) otherwise leaves its job non-terminal FOREVER (the
+    reference reconciles the same way in its scheduler sweep). Runs on
+    the controller head (same host as the PIDs), called from the
+    jobs_list/jobs_get RPC so stale rows self-heal on observation.
+    Returns the number of jobs reaped."""
+    non_terminal = [s.value for s in ManagedJobStatus
+                    if not s.is_terminal()]
+    with _db() as c:
+        rows = c.execute(
+            "SELECT job_id, controller_pid, status FROM managed_jobs"
+            f" WHERE status IN ({','.join('?' * len(non_terminal))})"
+            " AND controller_pid IS NOT NULL",
+            non_terminal).fetchall()
+    reaped = 0
+    for job_id, pid, _status in rows:
+        try:
+            os.kill(pid, 0)        # signal 0 = liveness probe
+        except ProcessLookupError:
+            if set_status(job_id, ManagedJobStatus.FAILED_CONTROLLER,
+                          error="controller process died"):
+                reaped += 1
+        except PermissionError:
+            pass                   # alive, different uid
+    return reaped
 
 
 def acquire_launch_slot(job_id: int, poll: float = 0.2,
@@ -298,18 +335,22 @@ def count_alive() -> int:
 _SELECT = ("SELECT job_id, name, task_config, status, submitted_at,"
            " started_at, ended_at, cluster_name, recovery_count,"
            " recovery_strategy, controller_pid, last_error,"
-           " launch_started_at, launch_ended_at FROM managed_jobs")
+           " launch_started_at, launch_ended_at, current_task"
+           " FROM managed_jobs")
 
 
 def _rec(row) -> Dict[str, Any]:
     (jid, name, cfg, status, sub, start, end, cluster, rec_n, strat, pid,
-     err, launch_start, launch_end) = row
+     err, launch_start, launch_end, cur_task) = row
+    cfg = json.loads(cfg)
+    num_tasks = len(cfg["pipeline"]) if "pipeline" in cfg else 1
     return {"job_id": jid, "name": name,
-            "task_config": json.loads(cfg),
+            "task_config": cfg,
             "status": ManagedJobStatus(status),
             "submitted_at": sub, "started_at": start, "ended_at": end,
             "cluster_name": cluster, "recovery_count": rec_n,
             "recovery_strategy": strat, "controller_pid": pid,
             "launch_started_at": launch_start,
             "launch_ended_at": launch_end,
+            "current_task": cur_task or 0, "num_tasks": num_tasks,
             "last_error": err}

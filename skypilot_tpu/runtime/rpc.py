@@ -270,11 +270,13 @@ def _m_jobs_submit(cluster_name, cdir, p):
 
 def _m_jobs_list(cluster_name, cdir, p):
     from skypilot_tpu.jobs import state as jstate
+    jstate.reap_dead_controllers()
     return [_serialize_enum_rec(r) for r in jstate.list_jobs()]
 
 
 def _m_jobs_get(cluster_name, cdir, p):
     from skypilot_tpu.jobs import state as jstate
+    jstate.reap_dead_controllers()
     rec = jstate.get(int(p["job_id"]))
     return _serialize_enum_rec(rec) if rec else None
 

@@ -128,6 +128,22 @@ class Task:
                 f"{path} did not parse to a task dict")
         return cls.from_yaml_config(config)
 
+    @classmethod
+    def from_yaml_all(cls, path: str) -> List["Task"]:
+        """Every task in a (possibly multi-document) YAML — the
+        reference's managed-job PIPELINE form: tasks separated by
+        ``---`` run sequentially under one job (reference:
+        sky/jobs/controller.py:68 iterates dag.tasks)."""
+        with open(os.path.expanduser(path)) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d is not None]
+        if not docs:
+            raise exceptions.InvalidTaskError(f"{path} is empty")
+        for d in docs:
+            if not isinstance(d, dict):
+                raise exceptions.InvalidTaskError(
+                    f"{path}: document {d!r} is not a task dict")
+        return [cls.from_yaml_config(d) for d in docs]
+
     def to_yaml_config(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         if self.name:

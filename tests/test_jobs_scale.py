@@ -107,7 +107,15 @@ def test_launch_slot_contention_64_claimants():
 def test_controller_burst_end_to_end(monkeypatch):
     """A burst of real managed jobs (controller processes + local
     clusters) through a launch gate: all succeed, the gate holds, and
-    `jobs queue` stays responsive mid-storm."""
+    `jobs queue` stays responsive mid-storm.
+
+    Observation goes through the CLIENT RPC (jobs_core.queue) — the
+    jobs DB lives on the CONTROLLER CLUSTER HEAD's home, not in the
+    test process's SKYPILOT_TPU_HOME; a direct jobs_state read here
+    sees an empty client-side DB and waits forever (the bug this test
+    shipped with)."""
+    import os
+
     from skypilot_tpu.jobs import core as jobs_core
     from skypilot_tpu.resources import Resources
     from skypilot_tpu.task import Task
@@ -124,13 +132,15 @@ def test_controller_burst_end_to_end(monkeypatch):
             for i in range(n)]
     assert len(set(jids)) == n
 
-    # Queue latency sampled while the storm runs.
+    # Queue latency sampled while the storm runs — through the RPC,
+    # like `skytpu jobs queue` (the responsiveness a user sees).
     latencies = []
     deadline = time.time() + 600
     pending = set(jids)
+    rows = {}
     while pending and time.time() < deadline:
         t0 = time.time()
-        rows = {r["job_id"]: r for r in jobs_state.list_jobs()}
+        rows = {r["job_id"]: r for r in jobs_core.queue()}
         latencies.append(time.time() - t0)
         for j in list(pending):
             st = rows.get(j, {}).get("status")
@@ -139,12 +149,17 @@ def test_controller_burst_end_to_end(monkeypatch):
         time.sleep(1.0)
     assert not pending, f"{len(pending)} jobs never finished"
     for j in jids:
-        assert jobs_state.get(j)["status"] == \
-            ManagedJobStatus.SUCCEEDED, jobs_state.get(j)
-    assert max(latencies) < 5.0, f"queue unresponsive: {max(latencies)}"
+        assert rows[j]["status"] == ManagedJobStatus.SUCCEEDED, rows[j]
+    assert max(latencies) < 10.0, f"queue unresponsive: {max(latencies)}"
 
     # The launch gate held: overlapping launch windows never exceeded
-    # the limit (sweep the window edges).
+    # the limit (sweep the window edges). Window timestamps live in
+    # the head-side DB: point this process's home at the head's.
+    head_home = os.path.join(os.environ["SKYTPU_LOCAL_CLUSTERS_ROOT"],
+                             "sky-jobs-controller", "host0",
+                             ".skypilot_tpu")
+    assert os.path.isdir(head_home), head_home
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", head_home)
     windows = []
     for j in jids:
         s, e = jobs_state.launch_window(j)

@@ -167,3 +167,80 @@ def test_jobs_survive_client_death(tmp_path, monkeypatch):
             return
         time.sleep(0.3)
     raise AssertionError("managed job did not finish after client death")
+
+
+# -- pipelines (reference: multi-document job YAMLs run sequentially) -------
+
+def test_pipeline_runs_tasks_sequentially():
+    """Two tasks under ONE managed job: each gets its own cluster, the
+    second starts only after the first succeeds, outputs of both are
+    snapshotted, and every cluster is gone at the end."""
+    import io
+
+    jid = jobs_core.launch([_task("echo step-one", name="a"),
+                            _task("echo step-two", name="b")],
+                           name="pipe1")
+    status = jobs_core.wait(jid, timeout=240)
+    assert status == ManagedJobStatus.SUCCEEDED
+    rec = jobs_core.get(jid)
+    assert rec["num_tasks"] == 2
+    assert rec["current_task"] == 1          # finished on the last task
+    out = io.StringIO()
+    jobs_core.tail_job_output(jid, out=out)
+    text = out.getvalue()
+    assert "step-one" in text and "step-two" in text
+    assert text.index("step-one") < text.index("step-two")
+    _wait_cluster_gone(f"sky-jobs-{jid}-t0")
+    _wait_cluster_gone(f"sky-jobs-{jid}-t1")
+
+
+def test_pipeline_failure_stops_chain():
+    """A failing step fails the WHOLE pipeline; later tasks never run."""
+    jid = jobs_core.launch([_task("exit 3", name="bad"),
+                            _task("echo never", name="after")],
+                           name="pipe2")
+    status = jobs_core.wait(jid, timeout=240)
+    assert status == ManagedJobStatus.FAILED
+    rec = jobs_core.get(jid)
+    assert rec["current_task"] == 0          # died on the first step
+    import io
+    out = io.StringIO()
+    jobs_core.tail_job_output(jid, out=out)
+    assert "never" not in out.getvalue()
+    _wait_cluster_gone(f"sky-jobs-{jid}-t0")
+
+
+def test_pipeline_yaml_multi_document(tmp_path):
+    """Task.from_yaml_all parses --- separated docs into a pipeline."""
+    p = tmp_path / "pipe.yaml"
+    p.write_text(
+        "name: prep\nresources: {cloud: local}\nrun: echo prep\n"
+        "---\n"
+        "name: train\nresources: {cloud: local}\nrun: echo train\n")
+    tasks = Task.from_yaml_all(str(p))
+    assert [t.name for t in tasks] == ["prep", "train"]
+    single = Task.from_yaml_all(__file__.replace(
+        "test_managed_jobs.py", "../examples/tpu_train_tiny.yaml"))
+    assert len(single) == 1
+
+
+def test_dead_controller_reaped_on_observation():
+    """A controller that dies hard (import crash, OOM-kill) must not
+    leave its job non-terminal forever: the jobs_list/jobs_get RPC
+    sweep marks it FAILED_CONTROLLER (reference: scheduler sweep)."""
+    import subprocess
+
+    from skypilot_tpu.jobs import state as jstate
+
+    jid = jstate.add("dead", {"run": "echo hi"}, "EAGER_NEXT_ZONE")
+    # A real, already-exited PID (not a made-up number: PID reuse
+    # semantics differ).
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    jstate.set_controller_pid(jid, proc.pid)
+    jstate.set_status(jid, jstate.ManagedJobStatus.STARTING)
+    assert jstate.reap_dead_controllers() == 1
+    assert jstate.get(jid)["status"] == \
+        jstate.ManagedJobStatus.FAILED_CONTROLLER
+    # Terminal jobs and NULL-pid rows are untouched on a second sweep.
+    assert jstate.reap_dead_controllers() == 0

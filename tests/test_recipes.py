@@ -18,11 +18,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     glob.glob(os.path.join(REPO, "examples", "*.yaml"))
     + glob.glob(os.path.join(REPO, "llm", "*.yaml"))))
 def test_recipe_yaml_parses(path):
-    with open(path) as f:
-        config = yaml.safe_load(f)
-    task = Task.from_yaml_config(config)
-    assert task.run
-    assert task.resources
+    # from_yaml_all handles single- and multi-document (pipeline) YAMLs.
+    tasks = Task.from_yaml_all(path)
+    assert tasks
+    for task in tasks:
+        assert task.run
+        assert task.resources
 
 
 def test_train_run_cli_smoke(tmp_path):
