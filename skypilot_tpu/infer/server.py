@@ -155,24 +155,26 @@ class ModelServer:
             except Exception as e:  # noqa: BLE001 — fail the in-flight
                 # requests loudly; never let the serving thread die
                 # while /health reports ok.
-                for p in self._pending.values():
-                    p.result = {"error": f"engine failure: {e}"}
-                    if p.stream:
-                        p.chunks.put({"error": p.result["error"]})
-                    p.event.set()
-                self._pending.clear()
                 # The engine's waiting/slot_req still hold the poisoned
                 # requests — left in place, every subsequent step would
                 # re-drive them and fail all future traffic with the
                 # same error (advisor r3). Reset the slot state; if even
                 # that fails the device is gone: flip /health to 503 so
-                # the LB stops routing here.
+                # the LB stops routing here. Health flips BEFORE the
+                # pending events fire: a client reacting to its failed
+                # request must not race a still-green /health.
                 try:
                     self.engine.reset()
                 except Exception as e2:  # noqa: BLE001
                     print(f"engine reset failed, marking unhealthy: "
                           f"{e2}", file=sys.stderr)
                     self._ready.clear()
+                for p in self._pending.values():
+                    p.result = {"error": f"engine failure: {e}"}
+                    if p.stream:
+                        p.chunks.put({"error": p.result["error"]})
+                    p.event.set()
+                self._pending.clear()
                 busy = False
             if not busy:
                 time.sleep(0.002)
