@@ -363,6 +363,9 @@ def run_http(config=None, requests=16, slots=16, prompt_len=None,
     # TTFT numbers use). Engine-only decode at 32 full slots measures
     # ~1.17k tok/s on v5e; this reports what survives HTTP + LB.
     full = None
+    if full_load and requests >= slots:
+        log(f"full-load phase skipped: requests ({requests}) already "
+            f">= slots ({slots}) — the headline phase IS full load")
     if full_load and requests < slots:
         if prompt_len is None:
             fl_prompts, _ = _mixed_prompts(rng, cfg.vocab_size, slots,

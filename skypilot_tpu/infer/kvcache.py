@@ -115,19 +115,27 @@ def quantize_weight(w: jax.Array, contract_ndim: int
     return {"w": q, "s": scale}
 
 
+# How many leading dims (after the layer dim) each block weight
+# contracts in its consuming einsum. SINGLE definition: quantization
+# and the sharding axes derive from the same map, so a new quantized
+# weight can never get int8 data without sharding axes (it would
+# silently replicate under --tp).
+QUANT_CONTRACT = {"wq": 1, "wk": 1, "wv": 1, "wo": 2,
+                  "w_gate": 1, "w_up": 1, "w_down": 1}
+
+
 def quantize_block_weights(params: llama.Params) -> Dict[str, Dict]:
     """int8 copies of the stacked per-layer matmul weights (norms and
     the embedding table stay fp)."""
     blocks = params["blocks"]
-    contract = {"wq": 1, "wk": 1, "wv": 1, "wo": 2,
-                "w_gate": 1, "w_up": 1, "w_down": 1}
 
     def per_layer(name, w):
-        nd = contract[name]
+        nd = QUANT_CONTRACT[name]
         # vmap over the leading layer dim.
         return jax.vmap(lambda x: quantize_weight(x, nd))(w)
 
-    return {name: per_layer(name, blocks[name]) for name in contract}
+    return {name: per_layer(name, blocks[name])
+            for name in QUANT_CONTRACT}
 
 
 def quantize_head(params: llama.Params,
@@ -135,6 +143,20 @@ def quantize_head(params: llama.Params,
     head = (params["embed"].T if cfg.tie_embeddings
             else params["lm_head"])
     return quantize_weight(head, 1)
+
+
+def qweight_logical_axes(cfg: llama.LlamaConfig) -> Dict[str, Dict]:
+    """Logical axes for the ``{"blocks": ..., "head": ...}`` qweights
+    tree (same names the fp params use, so one TP rule set shards
+    both): ``w`` mirrors its fp tensor; ``s`` (per-output-channel
+    scales) keeps ("layer",) + the NON-contracted output axes."""
+    full = llama.param_logical_axes(cfg)["blocks"]
+    blocks = {}
+    for name, nd in QUANT_CONTRACT.items():
+        axes = full[name]            # ("layer", <contracted...>, <out...>)
+        blocks[name] = {"w": axes, "s": ("layer",) + axes[1 + nd:]}
+    return {"blocks": blocks,
+            "head": {"w": ("embed", "vocab"), "s": ("vocab",)}}
 
 
 def _act_quant(x: jax.Array, n_contract: int

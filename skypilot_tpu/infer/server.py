@@ -391,6 +391,11 @@ def main() -> None:
                     help="seconds to wait for a filling admission wave "
                          "when the newest arrival is fresher than this "
                          "(prevents 1-row padded waves on bursts)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree: shard weights + KV "
+                         "cache over the first N local devices "
+                         "(Megatron head/mlp/vocab split — serves "
+                         "models bigger than one chip's HBM)")
     args = ap.parse_args()
 
     import jax
@@ -401,9 +406,25 @@ def main() -> None:
     on_cpu = jax.default_backend() == "cpu"
     cfg = llama.CONFIGS[args.config or
                         ("llama3-tiny" if on_cpu else "llama3-400m")]
-    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = None
+    if args.tp > 1:
+        import numpy as np
+        from jax.sharding import Mesh
+        devices = jax.devices()
+        if len(devices) < args.tp:
+            raise SystemExit(f"--tp {args.tp} needs {args.tp} devices, "
+                             f"found {len(devices)}")
+        mesh = Mesh(np.array(devices[:args.tp]), ("tp",))
+        # Sharded-at-init: each device materializes only its shards —
+        # a plain init_params would build the full fp tree on device 0
+        # and OOM exactly the bigger-than-one-chip models --tp exists
+        # for.
+        params = eng.InferenceEngine.sharded_init(cfg, mesh)
+    else:
+        params = llama.init_params(jax.random.key(0), cfg)
     engine = eng.InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.max_len,
+        mesh=mesh,
         prompt_buckets=(128, min(512, args.max_len),
                         args.max_len),
         sampling_params=sampling.SamplingParams(

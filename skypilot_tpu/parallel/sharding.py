@@ -96,6 +96,34 @@ def logical_to_sharding(logical_tree, mesh: Mesh, rules: Rules = DEFAULT_RULES,
         logical_tree, shapes, is_leaf=is_leaf)
 
 
+def shard_tree_subset(tree, logical_tree, mesh: Mesh, rules: Rules):
+    """device_put every array in ``tree`` per its axes in
+    ``logical_tree``, walking by DICT KEY so ``tree`` may be a subset
+    of the axes tree (e.g. w8a8 serving's slimmed params: embed + norms
+    only — a plain tree.map would fail on the structure mismatch).
+    Arrays without an axes entry are replicated."""
+    replicated = NamedSharding(mesh, P())
+    if isinstance(tree, dict):
+        sub = logical_tree if isinstance(logical_tree, dict) else {}
+        return {k: shard_tree_subset(v, sub.get(k), mesh, rules)
+                for k, v in tree.items()}
+    axes = logical_tree if isinstance(logical_tree, tuple) else None
+    if axes is None:
+        return jax.device_put(tree, replicated)
+    return jax.device_put(
+        tree, NamedSharding(mesh, spec_for(axes, rules, mesh,
+                                           tree.shape)))
+
+
+# Inference TP rules: Megatron-style heads/mlp/vocab over tp; no data/
+# fsdp axes (serving replicates activations' batch). The "embed"
+# logical axis has no rule, so NORMS replicate — but the embedding
+# table and LM head shard their "vocab" dim (the token gather and the
+# logits matmul run vocab-split, with XLA inserting the collectives).
+INFER_TP_RULES: Rules = {"heads": "tp", "kv_heads": "tp",
+                         "mlp": "tp", "vocab": "tp"}
+
+
 def make_constrain(mesh: Optional[Mesh], rules: Rules = ACT_RULES):
     """Return fn(x, logical_axes) applying with_sharding_constraint.
 
