@@ -32,7 +32,8 @@ DASHBOARD_HTML = """<!doctype html>
 </tr></thead><tbody></tbody></table>
 <h2>Managed jobs</h2>
 <table id="jobs"><thead><tr>
-  <th>ID</th><th>Name</th><th>Status</th><th>Recoveries</th><th>Cluster</th>
+  <th>ID</th><th>Name</th><th>Status</th><th>Task</th>
+  <th>Recoveries</th><th>Cluster</th>
 </tr></thead><tbody></tbody></table>
 <h2>API requests</h2>
 <table id="requests"><thead><tr>
@@ -67,8 +68,10 @@ async function refresh() {
       fetch("/api/status").then(r => r.json()),
     ]);
     fill("clusters", cs, ["name", "status", "resources", "autostop"]);
-    fill("jobs", js, ["job_id", "name", "status", "recovery_count",
-                      "cluster_name"]);
+    js.forEach(j => { j.task = (j.num_tasks > 1)
+        ? ((j.current_task || 0) + 1) + "/" + j.num_tasks : "-"; });
+    fill("jobs", js, ["job_id", "name", "status", "task",
+                      "recovery_count", "cluster_name"]);
     fill("requests", rs.slice(-30).reverse(),
          ["request_id", "name", "status"]);
     document.getElementById("updated").textContent =
