@@ -387,11 +387,8 @@ def jobs_queue():
     click.echo(fmt.format("ID", "NAME", "STATUS", "TASK", "#RECOV",
                           "CLUSTER"))
     for r in rows:
-        n = r.get("num_tasks", 1)
-        task_col = (f"{r.get('current_task', 0) + 1}/{n}" if n > 1
-                    else "-")
         click.echo(fmt.format(r["job_id"], r["name"] or "-",
-                              r["status"].value, task_col,
+                              r["status"].value, r.get("task", "-"),
                               r["recovery_count"],
                               r["cluster_name"] or "-"))
 

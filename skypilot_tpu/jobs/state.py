@@ -353,4 +353,8 @@ def _rec(row) -> Dict[str, Any]:
             "launch_started_at": launch_start,
             "launch_ended_at": launch_end,
             "current_task": cur_task or 0, "num_tasks": num_tasks,
+            # Single display form for "which pipeline step" — the CLI
+            # queue and the dashboard both render this field.
+            "task": (f"{(cur_task or 0) + 1}/{num_tasks}"
+                     if num_tasks > 1 else "-"),
             "last_error": err}

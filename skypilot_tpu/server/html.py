@@ -68,8 +68,6 @@ async function refresh() {
       fetch("/api/status").then(r => r.json()),
     ]);
     fill("clusters", cs, ["name", "status", "resources", "autostop"]);
-    js.forEach(j => { j.task = (j.num_tasks > 1)
-        ? ((j.current_task || 0) + 1) + "/" + j.num_tasks : "-"; });
     fill("jobs", js, ["job_id", "name", "status", "task",
                       "recovery_count", "cluster_name"]);
     fill("requests", rs.slice(-30).reverse(),
