@@ -244,3 +244,26 @@ def test_dead_controller_reaped_on_observation():
         jstate.ManagedJobStatus.FAILED_CONTROLLER
     # Terminal jobs and NULL-pid rows are untouched on a second sweep.
     assert jstate.reap_dead_controllers() == 0
+
+
+def test_pipeline_cancel_mid_run_stops_chain():
+    """Cancel during a pipeline's first (long) task: the job ends
+    CANCELLED, the second task NEVER launches a cluster, and the
+    first task's cluster is torn down (the inter-step pre-launch
+    cancel guard)."""
+    jid = jobs_core.launch([_task("sleep 60", name="long"),
+                            _task("echo never", name="after")],
+                           name="pipecancel")
+    deadline = time.time() + 120
+    while jobs_core.get(jid)["status"] != ManagedJobStatus.RUNNING:
+        assert time.time() < deadline
+        time.sleep(0.1)
+    jobs_core.cancel(jid)
+    status = jobs_core.wait(jid, timeout=120)
+    assert status == ManagedJobStatus.CANCELLED
+    rec = jobs_core.get(jid)
+    assert rec["current_task"] == 0           # never advanced
+    _wait_cluster_gone(f"sky-jobs-{jid}-t0")
+    from skypilot_tpu.provision import local as lp
+    assert lp.query_instances(f"sky-jobs-{jid}-t1",
+                              "local") == "NOT_FOUND"
