@@ -248,9 +248,9 @@ def test_dead_controller_reaped_on_observation():
 
 def test_pipeline_cancel_mid_run_stops_chain():
     """Cancel during a pipeline's first (long) task: the job ends
-    CANCELLED, the second task NEVER launches a cluster, and the
-    first task's cluster is torn down (the inter-step pre-launch
-    cancel guard)."""
+    CANCELLED (via the monitor's CANCELLING check), the second task
+    NEVER launches a cluster, and the first task's cluster is torn
+    down."""
     jid = jobs_core.launch([_task("sleep 60", name="long"),
                             _task("echo never", name="after")],
                            name="pipecancel")
@@ -264,6 +264,32 @@ def test_pipeline_cancel_mid_run_stops_chain():
     rec = jobs_core.get(jid)
     assert rec["current_task"] == 0           # never advanced
     _wait_cluster_gone(f"sky-jobs-{jid}-t0")
-    from skypilot_tpu.provision import local as lp
-    assert lp.query_instances(f"sky-jobs-{jid}-t1",
-                              "local") == "NOT_FOUND"
+    assert local_provider.query_instances(f"sky-jobs-{jid}-t1",
+                                          "local") == "NOT_FOUND"
+
+
+def test_pipeline_inter_step_cancel_guard(tmp_path, monkeypatch):
+    """The PRE-LAUNCH guard itself: a cancel landing BETWEEN task 0's
+    completion and task 1's launch (inter-step teardown takes minutes
+    on real clusters) must stop the chain before a new cluster is
+    provisioned — driven directly at the controller, since the window
+    is unhittable deterministically from outside."""
+    from skypilot_tpu.jobs import controller as ctl
+    from skypilot_tpu.jobs import state as jstate
+
+    cfg = {"pipeline": [
+        {"name": "a", "resources": {"cloud": "local"}, "run": "true"},
+        {"name": "b", "resources": {"cloud": "local"}, "run": "true"}]}
+    jid = jstate.add("guard", cfg, "EAGER_NEXT_ZONE")
+    jstate.set_status(jid, jstate.ManagedJobStatus.RUNNING)
+    c = ctl.JobsController(jid)
+    c._bind_task(1)
+    launched = []
+    monkeypatch.setattr(c.strategy, "launch",
+                        lambda *a, **k: launched.append(1))
+    # The cancel lands in the inter-step window.
+    jstate.set_status(jid, jstate.ManagedJobStatus.CANCELLING)
+    assert c._run_one_task(1) is False
+    assert not launched, "cancelled pipeline still provisioned a cluster"
+    assert jstate.get(jid)["status"] == \
+        jstate.ManagedJobStatus.CANCELLED
