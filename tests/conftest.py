@@ -95,6 +95,7 @@ _SLOW_TESTS = {
     "tests/test_managed_jobs.py::test_pipeline_runs_tasks_sequentially",
     "tests/test_managed_jobs.py::test_pipeline_failure_stops_chain",
     "tests/test_managed_jobs.py::test_pipeline_cancel_mid_run_stops_chain",
+    "tests/test_infer_tp.py::test_server_main_tp_end_to_end",
     "tests/test_moe.py::test_loss_decreases",
     "tests/test_moe.py::test_train_step_on_ep_mesh",
     "tests/test_observability.py::test_benchmark_launch_local",

@@ -109,3 +109,55 @@ def test_sharded_init_materializes_on_mesh():
                             prompt_buckets=(8,), mesh=mesh)
     base = _generate()
     assert e.generate(PROMPTS, max_new_tokens=6) == base
+
+
+@pytest.mark.slow
+def test_server_main_tp_end_to_end(tmp_path):
+    """`infer.server --tp 2` as a real subprocess: /health flips ready
+    and /generate streams tokens — the full CLI surface of TP serving,
+    not just the engine (the virtual CPU mesh stands in for chips)."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "skypilot_tpu.infer.server",
+         "--config", "llama3-tiny", "--port", str(port),
+         "--tp", "2", "--slots", "2", "--max-len", "64"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 300
+        while True:
+            assert time.time() < deadline, "server never became ready"
+            assert proc.poll() is None, "server process died"
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/health",
+                        timeout=5) as r:
+                    if r.status == 200:
+                        break
+            except OSError:
+                pass
+            time.sleep(1)
+        body = json.dumps({"tokens": [1, 2, 3],
+                           "max_new_tokens": 4}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        assert len(out["tokens"]) == 4
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
