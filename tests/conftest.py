@@ -96,6 +96,9 @@ _SLOW_TESTS = {
     "tests/test_managed_jobs.py::test_pipeline_failure_stops_chain",
     "tests/test_managed_jobs.py::test_pipeline_cancel_mid_run_stops_chain",
     "tests/test_infer_tp.py::test_server_main_tp_end_to_end",
+    "tests/test_infer_tp.py::test_tp_engine_matches_single_device",
+    "tests/test_infer_tp.py::test_sharded_init_materializes_on_mesh",
+    "tests/test_infer_tp.py::test_tp_engine_matches_w8a8_and_kv_int8",
     "tests/test_moe.py::test_loss_decreases",
     "tests/test_moe.py::test_train_step_on_ep_mesh",
     "tests/test_observability.py::test_benchmark_launch_local",
