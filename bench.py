@@ -224,8 +224,9 @@ def main() -> None:
             # waves' prefill); decode bursts stay short (open_burst)
             # while traffic is arriving and slots remain, and go long
             # (max_burst 32, amortizing relay dispatch) once slots are
-            # full or arrivals go quiet. At 32/32 the same build does
-            # ~820 tok/s at median TTFT ~1460 ms.
+            # full or arrivals go quiet. The full_load companion phase
+            # measures 32/32 on the same warm server (~740-790 tok/s
+            # median-of-3; engine-only decode is ~1.17k).
             serve = bench_serve.run_http(
                 config=serve_cfg, requests=24, slots=32,
                 new_tokens=192, max_burst=32, open_burst=4,
