@@ -232,18 +232,34 @@ def main() -> None:
                 new_tokens=192, max_burst=32, open_burst=4,
                 admit_wave=4, repeats=5, full_load=True,
                 weights_int8=big, kv_int8=big)
+            # Chip-normalized throughput: our tok/s per peak-TFLOP vs
+            # the anchor's tok/s per peak-TFLOP on ITS chip (v6e,
+            # 918 TF) — the serve analog of the train metric's MFU
+            # ratio, so a v5e result reads fairly against a v6e anchor.
+            from skypilot_tpu.infer.bench_serve import REF_TOK_S
+            ref_peak = PEAK_FLOPS["v6e"]
+            norm = ((serve["out_tok_s"] / peak_for(dev))
+                    / (REF_TOK_S / ref_peak))
             out.update({
                 "serve_median_ttft_ms": serve["median_ttft_ms"],
                 "serve_worst_run_median_ttft_ms":
                     serve["worst_run_median_ttft_ms"],
                 "serve_p99_ttft_ms": serve["p99_ttft_ms"],
                 "serve_out_tok_s": serve["out_tok_s"],
+                "serve_tpot_ms": serve["tpot_ms"],
+                "serve_vs_baseline_tpot": serve["vs_baseline_tpot"],
+                "serve_vs_baseline_tok_s_normalized": round(norm, 3),
+                "serve_tok_s_normalization": (
+                    f"(ours/{peak_for(dev)/1e12:.0f}TF) / "
+                    f"(anchor {REF_TOK_S}/{ref_peak/1e12:.0f}TF v6e)"),
                 "serve_vs_baseline_ttft": serve["vs_baseline_ttft"],
                 "serve_worst_run_vs_baseline_ttft":
                     serve["worst_run_vs_baseline_ttft"],
                 "serve_regressed": serve["regressed"],
                 "serve_worst_run_regressed":
                     serve["worst_run_regressed"],
+                "serve_worst_run_below_1p2x":
+                    serve["worst_run_below_1p2x"],
                 "serve_runs": serve["runs"],
                 "serve_prompt_mean_len": serve["prompt_mean_len"],
                 "serve_prompt_max_len": serve["prompt_max_len"],
@@ -256,12 +272,21 @@ def main() -> None:
                 # Throughput-optimal companion: every slot filled on
                 # the same warm server (the 24-request numbers above
                 # keep serving headroom for the TTFT metric).
-                out["serve_full_load_requests"] = \
-                    serve["full_load"]["requests"]
-                out["serve_full_load_out_tok_s"] = \
-                    serve["full_load"]["out_tok_s"]
+                fl = serve["full_load"]
+                out["serve_full_load_requests"] = fl["requests"]
+                out["serve_full_load_out_tok_s"] = fl["out_tok_s"]
                 out["serve_full_load_median_ttft_ms"] = \
-                    serve["full_load"]["median_ttft_ms"]
+                    fl["median_ttft_ms"]
+                out["serve_full_load_tpot_ms"] = fl.get("tpot_ms")
+                out["serve_full_load_regressed"] = fl["regressed"]
+                if fl["regressed"]:
+                    log("SERVE REGRESSION (full load): median TTFT "
+                        f"{fl['median_ttft_ms']}ms >= anchor "
+                        f"{bench_serve.REF_TTFT_MS}ms")
+            if serve["worst_run_below_1p2x"]:
+                log("serve worst-run margin below the 1.2x gate: "
+                    f"{serve['worst_run_median_ttft_ms']}ms vs anchor "
+                    f"{bench_serve.REF_TTFT_MS}ms")
             if serve["regressed"]:
                 # Loud regression guard (VERDICT r3): a serve TTFT
                 # worse than the anchor must not ship silently.

@@ -41,6 +41,15 @@ class Request:
     eos_id: Optional[int] = None
 
 
+@dataclasses.dataclass
+class BurstHandle:
+    """A dispatched-but-unfetched decode burst (see
+    :meth:`InferenceEngine.dispatch_decode_burst`)."""
+    toks: jax.Array                   # [k, slots+1] on device
+    k: int
+    slot_req: Dict[int, "Request"]    # slot->request snapshot at dispatch
+
+
 def _bucket(n: int, buckets) -> int:
     for b in buckets:
         if n <= b:
@@ -136,6 +145,10 @@ class InferenceEngine:
         self.waiting: List[Request] = []
         self.finished: List[Request] = []
         self._next_rid = 0
+        # Tokens dispatched to the device but not yet committed
+        # host-side (one outstanding async burst at a time is the
+        # expected pattern; the count caps the next burst).
+        self._inflight_tokens = 0
 
         sp = self.sampling_params
 
@@ -143,19 +156,28 @@ class InferenceEngine:
         # self.cache from the output every call, so XLA updates the
         # [L, slots, max_len, G, hd] buffers in place, never copying.
 
+        # RNG lives on device and every program splits it INTERNALLY,
+        # returning the successor key: a host-side jax.random.split per
+        # call would be an extra eagerly-dispatched device program on
+        # the hot path (per decode burst / admission wave) — material
+        # when dispatch rides a relayed TPU link.
+
         # Batched admission: ONE batched prefill for the whole wave (the
         # W requests share every weight read; matmuls run at W x S
         # rows), then a scan of per-request cache inserts (cheap
-        # scatters). Dummy rows target the spare slot.
-        @functools.partial(jax.jit, donate_argnums=(1,),
+        # scatters). Dummy rows target the spare slot; its length
+        # bookkeeping is zeroed HERE (last row of the length vector)
+        # rather than by a follow-up eager scatter per wave.
+        @functools.partial(jax.jit, donate_argnums=(1, 5),
                            static_argnames=("bucket",))
         def _admit_wave(params, cache, tokens_b, true_lens, slots, rng,
                         *, bucket, qweights=None):
             del bucket
             from jax import lax as _lax
+            rng, sub = jax.random.split(rng)
             prefix, logits = kvcache.prefill_batch(
                 params, tokens_b, true_lens, cfg, qweights=qweights)
-            first = sampling.sample(logits, rng, sp)      # [W]
+            first = sampling.sample(logits, sub, sp)      # [W]
 
             def ins(c, w):
                 pk = _lax.dynamic_index_in_dim(prefix["k"], w, 1,
@@ -168,24 +190,27 @@ class InferenceEngine:
 
             cache, _ = _lax.scan(ins, cache,
                                  jnp.arange(tokens_b.shape[0]))
-            return cache, first
+            cache["length"] = cache["length"].at[-1].set(0)  # spare
+            return cache, rng, first
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
         def _decode(params, cache, rng, active, qweights=None):
+            rng, sub = jax.random.split(rng)
             cache, logits = kvcache.decode_step(params, cache, cfg,
                                                 qweights=qweights)
-            toks = sampling.sample(logits, rng, sp)
+            toks = sampling.sample(logits, sub, sp)
             cache = kvcache.commit_tokens(cache, toks, active)
-            return cache, toks
+            return cache, rng, toks
 
         # Burst decode: k steps in one device program -> one host round
         # trip per k tokens. Crucial when dispatch latency rivals the
         # per-token compute (small models, remote/relayed TPUs).
-        @functools.partial(jax.jit, donate_argnums=(1,),
+        @functools.partial(jax.jit, donate_argnums=(1, 2),
                            static_argnames=("k",))
         def _decode_burst(params, cache, rng, active, *, k,
                           qweights=None):
             from jax import lax as _lax
+            rng, sub = jax.random.split(rng)
 
             def body(c, key):
                 c, logits = kvcache.decode_step(params, c, cfg,
@@ -195,8 +220,8 @@ class InferenceEngine:
                 return c, toks
 
             cache, toks = _lax.scan(body, cache,
-                                    jax.random.split(rng, k))
-            return cache, toks                     # [k, slots]
+                                    jax.random.split(sub, k))
+            return cache, rng, toks                # [k, slots]
 
         self._admit_wave_fn = _admit_wave
         self._decode_fn = _decode
@@ -295,13 +320,10 @@ class InferenceEngine:
             tokens_b[i, :len(req.prompt)] = req.prompt
             true_lens[i] = len(req.prompt)
             slot_ids[i] = slot
-        self.rng, sub = jax.random.split(self.rng)
-        self.cache, first = self._admit_wave_fn(
+        self.cache, self.rng, first = self._admit_wave_fn(
             self.params, self.cache, jnp.asarray(tokens_b),
-            jnp.asarray(true_lens), jnp.asarray(slot_ids), sub,
+            jnp.asarray(true_lens), jnp.asarray(slot_ids), self.rng,
             bucket=bucket, qweights=self.qweights)
-        # Spare-slot bookkeeping must not linger.
-        self.cache["length"] = self.cache["length"].at[self.n_slots].set(0)
         return first
 
     def _complete_wave(self, wave: List["Request"], slots: List[int],
@@ -328,12 +350,16 @@ class InferenceEngine:
         return len(req.prompt) + len(req.tokens) >= self.max_len
 
     def _retire(self, req: Request) -> None:
+        # No cache-length scrub: ``insert`` stamps the slot's length on
+        # reuse, decode's commit mask skips non-active slots, and a
+        # dead slot's attention output is never read — an eager
+        # per-retirement scatter here was pure hygiene at one device
+        # dispatch per finished request (reset() still zeroes all).
         req.done = True
         self.finished.append(req)
         if req.slot is not None:
             self.slot_req.pop(req.slot, None)
             self.free_slots.append(req.slot)
-            self.cache["length"] = self.cache["length"].at[req.slot].set(0)
             req.slot = None
 
     def step(self) -> Dict[int, int]:
@@ -360,6 +386,7 @@ class InferenceEngine:
         self.finished.clear()
         self.slot_req.clear()
         self.free_slots = list(range(self.n_slots))
+        self._inflight_tokens = 0
         self.cache["length"] = jnp.zeros_like(self.cache["length"])
 
     def step_burst(self, max_burst: int = 8,
@@ -376,33 +403,70 @@ class InferenceEngine:
         """Decode up to ``max_burst`` tokens per active slot in one
         device call — NO admission (callers that interleave admission
         and decode use :meth:`admit` + this)."""
-        if not self.slot_req:
+        handle = self.dispatch_decode_burst(max_burst)
+        if handle is None:
             return {}
-        # Cap the burst so no active slot's cache can overflow, then
-        # round down to a power of two: each distinct k compiles its own
-        # program, so the k-space must stay tiny. (Tokens a request
-        # doesn't need are discarded host-side — cheaper than a
-        # recompile.)
+        return self.complete_decode_burst(handle)
+
+    def dispatch_decode_burst(self, max_burst: int = 8
+                              ) -> Optional["BurstHandle"]:
+        """Enqueue one decode-burst program WITHOUT fetching its tokens;
+        pass the handle to :meth:`complete_decode_burst` later.
+
+        This is the TPU-idle killer for streaming servers: dispatch
+        burst k+1, THEN fetch/stream burst k's tokens — the device
+        chews on k+1 (programs chain on the donated cache) while the
+        host does JSON framing, socket writes and LB hops for k. The
+        burst cap accounts for tokens still in flight, and slots whose
+        request retires at k's completion simply waste rows in k+1
+        (their tokens are discarded; OOB cache writes clamp into the
+        dead slot's own rows).
+
+        Returns ``None`` when there is nothing to decode — no active
+        slot, or every active request's remaining budget is already
+        covered by in-flight tokens.
+        """
+        if not self.slot_req:
+            return None
+        # Cap the burst so no active slot's cache can overflow (counting
+        # dispatched-but-uncommitted tokens), then round down to a power
+        # of two: each distinct k compiles its own program, so the
+        # k-space must stay tiny. (Tokens a request doesn't need are
+        # discarded host-side — cheaper than a recompile.)
         k = max_burst
+        need = 0
         for req in self.slot_req.values():
-            rows = len(req.prompt) + len(req.tokens)
+            rows = (len(req.prompt) + len(req.tokens)
+                    + self._inflight_tokens)
             k = min(k, self.max_len - rows)
-        k = max(k, 1)
+            need = max(need, req.max_new_tokens - len(req.tokens)
+                       - self._inflight_tokens)
+        if k < 1 or need < 1:
+            return None
         k = 1 << (k.bit_length() - 1)
-        if k == 1:
-            return {r: [t] for r, t in self.step_decode_once().items()}
         active = np.zeros((self.n_slots + 1,), bool)
         for s in self.slot_req:
             active[s] = True
-        self.rng, sub = jax.random.split(self.rng)
-        self.cache, toks = self._decode_burst_fn(
-            self.params, self.cache, sub, jnp.asarray(active), k=k,
+        self.cache, self.rng, toks = self._decode_burst_fn(
+            self.params, self.cache, self.rng, jnp.asarray(active), k=k,
             qweights=self.qweights)
-        toks = np.asarray(toks)                    # [k, slots]
+        self._inflight_tokens += k
+        return BurstHandle(toks=toks, k=k, slot_req=dict(self.slot_req))
+
+    def complete_decode_burst(self, handle: "BurstHandle"
+                              ) -> Dict[int, List[int]]:
+        """Fetch a dispatched burst's tokens (host sync) and do the
+        bookkeeping: append/retire per request, using the slot->request
+        snapshot taken at dispatch. Requests retired by an earlier
+        completion are skipped (their surplus tokens are discarded)."""
+        toks = np.asarray(handle.toks)             # [k, slots]
+        self._inflight_tokens -= handle.k
         out: Dict[int, List[int]] = {}
-        for slot, req in list(self.slot_req.items()):
+        for slot, req in handle.slot_req.items():
+            if req.done:
+                continue
             emitted = []
-            for i in range(k):
+            for i in range(handle.k):
                 tok = int(toks[i, slot])
                 emitted.append(tok)
                 req.tokens.append(tok)
@@ -419,10 +483,9 @@ class InferenceEngine:
         active = np.zeros((self.n_slots + 1,), bool)
         for s in self.slot_req:
             active[s] = True
-        self.rng, sub = jax.random.split(self.rng)
-        self.cache, toks = self._decode_fn(self.params, self.cache, sub,
-                                           jnp.asarray(active),
-                                           qweights=self.qweights)
+        self.cache, self.rng, toks = self._decode_fn(
+            self.params, self.cache, self.rng, jnp.asarray(active),
+            qweights=self.qweights)
         toks = np.asarray(toks)
         out: Dict[int, int] = {}
         for slot, req in list(self.slot_req.items()):

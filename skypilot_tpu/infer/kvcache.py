@@ -415,33 +415,35 @@ def decode_step(params: llama.Params, cache: Cache,
     x = params["embed"].astype(cfg.dtype)[tokens]             # [B, 1, D]
     cos, sin = llama.rope_frequencies(cfg, pos[:, None])      # [B,1,hd/2]
 
-    # Rows <= length are valid (the just-written current row included).
-    valid = (jnp.arange(M)[None, :] <= cache["length"][:, None])  # [B, M]
+    # Stored rows are STRICTLY below ``length``; the pending token
+    # joins attention as an explicit SELF-TERM (one extra logit per
+    # head) and its K/V rows are scattered into the cache ONCE — for
+    # all layers together — after the layer scan. Keeping the cache a
+    # scan INVARIANT (read-only inside the loop) instead of a carry is
+    # what the decode-step's HBM budget lives on: the carried version
+    # round-tripped each layer's 82 MB K/V slice through
+    # dynamic-slice/row-update/dynamic-update (~330 MB of copy traffic
+    # per layer, ~12 ms of a 31 ms 8B step), and even the scatter-into-
+    # carry variant paid 4 serialized scatters x 32 layers of fixed op
+    # overhead. Self-term math is identical: the pending row's score
+    # uses the SAME quantized values a read-back would see, and the
+    # softmax simply sees that logit at the end of the row instead of
+    # at index ``length``.
+    valid = (jnp.arange(M)[None, :] < cache["length"][:, None])   # [B, M]
     neg = jnp.asarray(-1e30, jnp.float32)
     scale = hd ** -0.5
     batch_ix = jnp.arange(B)
 
     quant = "k_scale" in cache
     wq8 = qweights is not None
+    sdt = cache["k_scale"].dtype if quant else None
 
-    # The cache rides in the scan CARRY and is updated per layer with
-    # dynamic_update_slice — XLA's in-place while-loop pattern. Passing
-    # it through xs/ys instead allocates a fresh stacked-ys copy of the
-    # whole cache (2 x 1.4 GB HLO temps in the OOM dump at 32 slots):
-    # a while carry aliases input to output, scan ys cannot.
     def body(carry, layer_q):
-        x, i, ak, av, aks, avs = carry
+        x, i = carry
         if wq8:
             layer, qlayer = layer_q
         else:
             layer, qlayer = layer_q, None
-        ck = lax.dynamic_index_in_dim(ak, i, 0, keepdims=False)
-        cv = lax.dynamic_index_in_dim(av, i, 0, keepdims=False)
-        if quant:
-            cks = lax.dynamic_index_in_dim(aks, i, 0, keepdims=False)
-            cvs = lax.dynamic_index_in_dim(avs, i, 0, keepdims=False)
-        else:
-            cks = cvs = None
         h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
         q = proj("bsd,dhk->bshk", h, layer, qlayer, "wq", 1, cfg.dtype)
         k = proj("bsd,dhk->bshk", h, layer, qlayer, "wk", 1, cfg.dtype)
@@ -451,31 +453,49 @@ def decode_step(params: llama.Params, cache: Cache,
         if quant:
             kq, ks = quantize_rows(k[:, 0])     # ks/vs: [B, G]
             vq, vs = quantize_rows(v[:, 0])
-            ck = ck.at[batch_ix, pos].set(kq)
-            cv = cv.at[batch_ix, pos].set(vq)
-            sdt = cks.dtype
-            cks = cks.at[batch_ix, :, pos].set(ks.astype(sdt))
-            cvs = cvs.at[batch_ix, :, pos].set(vs.astype(sdt))
+            ks, vs = ks.astype(sdt), vs.astype(sdt)
+            k_new = kq.astype(jnp.bfloat16)     # exact: int8 fits bf16
+            v_new = vq.astype(jnp.float32) * vs.astype(jnp.float32)[..., None]
+            ys = (kq, vq, ks, vs)
         else:
-            ck = ck.at[batch_ix, pos].set(k[:, 0])
-            cv = cv.at[batch_ix, pos].set(v[:, 0])
-        # The dots read the cache at its stored dtype (int8 converts
-        # inline); per-row scales are linear in the contraction, so
+            kq, vq = k[:, 0], v[:, 0]
+            ks = vs = None
+            k_new = kq.astype(jnp.bfloat16)
+            v_new = vq.astype(jnp.float32)
+            ys = (kq, vq)
+        ck = lax.dynamic_index_in_dim(cache["k"], i, 0, keepdims=False)
+        cv = lax.dynamic_index_in_dim(cache["v"], i, 0, keepdims=False)
+        # The attention dots run in bf16 with fp32 ACCUMULATION. The
+        # int8 cache converts to bf16 EXACTLY (integers <= 127 carry no
+        # rounding in an 8-bit mantissa) and each bf16xbf16 product is
+        # exact in the fp32 accumulator, so the scores match a full
+        # fp32 dot while the materialized cache-sized intermediate is
+        # half the size. Per-row scales stay linear in the contraction:
         # K's scale applies to the SCORES and V's folds into the
-        # softmax weights — no [B, M, G, hd]-shaped dequantized
-        # intermediate to materialize.
-        ck_f = ck.astype(jnp.float32)
-        cv_f = cv.astype(jnp.float32)
-        qh = q[:, 0].reshape(B, G, rep, hd)
-        s = jnp.einsum("bgrk,bmgk->bgrm", qh.astype(jnp.float32),
-                       ck_f) * scale
+        # softmax weights — nothing dequantized at cache shape ever
+        # hits fp32.
+        qh = q[:, 0].reshape(B, G, rep, hd).astype(jnp.bfloat16)
+        s = jnp.einsum("bgrk,bmgk->bgrm", qh, ck.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32) * scale
+        s_self = jnp.einsum("bgrk,bgk->bgr", qh, k_new,
+                            preferred_element_type=jnp.float32) * scale
         if quant:
+            cks = lax.dynamic_index_in_dim(cache["k_scale"], i, 0,
+                                           keepdims=False)
+            cvs = lax.dynamic_index_in_dim(cache["v_scale"], i, 0,
+                                           keepdims=False)
             s = s * cks[:, :, None, :]
+            s_self = s_self * ks.astype(jnp.float32)[:, :, None]
         s = jnp.where(valid[:, None, None, :], s, neg)
-        w = jax.nn.softmax(s, axis=-1)
+        w = jax.nn.softmax(jnp.concatenate([s, s_self[..., None]], -1),
+                           axis=-1)
+        wm, w_self = w[..., :M], w[..., M]
         if quant:
-            w = w * cvs[:, :, None, :]
-        o = jnp.einsum("bgrm,bmgk->bgrk", w, cv_f)
+            wm = wm * cvs[:, :, None, :]
+        o = jnp.einsum("bgrm,bmgk->bgrk", wm.astype(jnp.bfloat16),
+                       cv.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+        o = o + w_self[..., None] * v_new[:, :, None, :]
         o = o.reshape(B, 1, cfg.n_heads, hd).astype(cfg.dtype)
         o = proj("bshk,hkd->bsd", o, layer, qlayer, "wo", 2, cfg.dtype)
         x = x + o
@@ -490,19 +510,11 @@ def decode_step(params: llama.Params, cache: Cache,
             x = x + m
         else:
             x = x + _ffn(cfg, h, layer)
-        ak = lax.dynamic_update_index_in_dim(ak, ck, i, 0)
-        av = lax.dynamic_update_index_in_dim(av, cv, i, 0)
-        if quant:
-            aks = lax.dynamic_update_index_in_dim(aks, cks, i, 0)
-            avs = lax.dynamic_update_index_in_dim(avs, cvs, i, 0)
-        return (x, i + 1, ak, av, aks, avs), None
+        return (x, i + 1), ys
 
     xs = ((params["blocks"], qweights["blocks"]) if wq8
           else params["blocks"])
-    init = (x, jnp.int32(0), cache["k"], cache["v"],
-            cache.get("k_scale", jnp.zeros((), jnp.bfloat16)),
-            cache.get("v_scale", jnp.zeros((), jnp.bfloat16)))
-    (x, _, nk, nv, nks, nvs), _ = lax.scan(body, init, xs)
+    (x, _), ys = lax.scan(body, (x, jnp.int32(0)), xs)
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     if wq8:
         logits = qeinsum("bsd,dv->bsv", x, qweights["head"], 1,
@@ -512,10 +524,24 @@ def decode_step(params: llama.Params, cache: Cache,
                 else params["lm_head"])
         logits = jnp.einsum("bsd,dv->bsv", x,
                             head.astype(cfg.dtype))[:, 0].astype(jnp.float32)
+    # One batched scatter per cache array: every layer's pending row
+    # lands at [l, b, pos[b]] (the ys stacks are megabyte-scale next to
+    # the gigabyte-scale cache, and the donated cache aliases through).
     out = dict(cache)
-    out["k"], out["v"] = nk, nv
     if quant:
-        out["k_scale"], out["v_scale"] = nks, nvs
+        kq_l, vq_l, ks_l, vs_l = ys           # [L,B,G,hd] / [L,B,G]
+        out["k"] = cache["k"].at[:, batch_ix, pos].set(kq_l)
+        out["v"] = cache["v"].at[:, batch_ix, pos].set(vq_l)
+        # Non-adjacent advanced indices put the broadcast dim first:
+        # update shape is [B, L, G].
+        out["k_scale"] = cache["k_scale"].at[:, batch_ix, :, pos].set(
+            ks_l.transpose(1, 0, 2))
+        out["v_scale"] = cache["v_scale"].at[:, batch_ix, :, pos].set(
+            vs_l.transpose(1, 0, 2))
+    else:
+        k_l, v_l = ys
+        out["k"] = cache["k"].at[:, batch_ix, pos].set(k_l)
+        out["v"] = cache["v"].at[:, batch_ix, pos].set(v_l)
     return out, logits
 
 

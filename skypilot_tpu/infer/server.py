@@ -92,6 +92,13 @@ class ModelServer:
         # Monotonic: an NTP step must not pin the window open (short
         # bursts forever) or spuriously slam it shut.
         self._last_arrival = 0.0
+        # Double-buffered decode (engines exposing the async pair):
+        # burst k+1 is dispatched BEFORE burst k's tokens are fetched
+        # and streamed, so the TPU decodes k+1 while this thread does
+        # k's JSON framing + socket writes + LB hop. Fake/simple
+        # engines without the pair fall back to sync decode_burst.
+        self._burst = None
+        self._async_decode = hasattr(engine, "dispatch_decode_burst")
         self._inbox_lock = threading.Lock()
         self._inbox: list = []
         self._pending: Dict[int, _Pending] = {}   # loop-thread only
@@ -163,6 +170,7 @@ class ModelServer:
                 # the LB stops routing here. Health flips BEFORE the
                 # pending events fire: a client reacting to its failed
                 # request must not race a still-green /health.
+                self._burst = None   # poisoned in-flight burst, if any
                 try:
                     self.engine.reset()
                 except Exception as e2:  # noqa: BLE001
@@ -215,10 +223,18 @@ class ModelServer:
         self._flush_streams()
         self._drain_inbox()
 
+    def _complete_burst(self) -> None:
+        """Land the outstanding async burst: fetch its tokens (host
+        sync), run retire bookkeeping, stream what it decoded."""
+        if self._burst is not None:
+            handle, self._burst = self._burst, None
+            self.engine.complete_decode_burst(handle)
+            self._flush_streams()
+
     def _step(self) -> bool:
         self._drain_inbox()
         eng = self.engine
-        if not (eng.waiting or eng.slot_req):
+        if not (eng.waiting or eng.slot_req or self._burst is not None):
             return False
         # Coalesce a filling wave: more arrivals are in flight when the
         # last one is only milliseconds old. Never waits when the wave
@@ -235,16 +251,30 @@ class ModelServer:
                        < self.coalesce_s):
                 time.sleep(0.002)
                 self._drain_inbox()
-        # Admission has strict priority over decode.
-        eng.admit(on_wave=self._on_wave)
-        self._flush_streams()
+        # Admission has strict priority over decode — but it needs
+        # accurate slot state, so the outstanding burst lands first
+        # (retirements there may free the very slots admission wants).
+        if eng.waiting:
+            self._complete_burst()
+            if eng.waiting and eng.free_slots:
+                eng.admit(on_wave=self._on_wave)
+                self._flush_streams()
         if eng.slot_req:
             quiet = (time.monotonic() - self._last_arrival
                      > self.open_window_s)
             k = (self.max_burst if not eng.free_slots or quiet
                  else self.open_burst)
-            eng.decode_burst(max_burst=k)
-            self._flush_streams()
+            if self._async_decode:
+                # Dispatch the NEXT burst before fetching the previous
+                # one: the device decodes while this thread streams.
+                nxt = eng.dispatch_decode_burst(max_burst=k)
+                self._complete_burst()
+                self._burst = nxt
+            else:
+                eng.decode_burst(max_burst=k)
+                self._flush_streams()
+        else:
+            self._complete_burst()
         for req in self.engine.finished:
             p = self._pending.pop(req.rid, None)
             if p is None:
@@ -302,9 +332,12 @@ def make_handler(model: ModelServer):
             self.end_headers()
 
             def write_chunk(data: bytes) -> None:
-                self.wfile.write(f"{len(data):x}\r\n".encode())
-                self.wfile.write(data + b"\r\n")
-                self.wfile.flush()
+                # ONE write per chunk: the handler's wfile is unbuffered
+                # (http.server wbufsize=0), so separate size/data/CRLF
+                # writes would be three syscalls — and three chances for
+                # the kernel to emit small segments — per streamed token
+                # batch.
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
 
             try:
                 for chunk in chunks:
@@ -313,7 +346,6 @@ def make_handler(model: ModelServer):
                 return  # client went away mid-stream
             try:
                 self.wfile.write(b"0\r\n\r\n")
-                self.wfile.flush()
             except BrokenPipeError:
                 pass
 

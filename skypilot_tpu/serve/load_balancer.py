@@ -13,17 +13,85 @@ import argparse
 import http.server
 import itertools
 import os
+import socket
 import socketserver
 import threading
-import urllib.error
-import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
 
 from skypilot_tpu.serve import serve_state
 
 _HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "host",
                 "proxy-authenticate", "proxy-authorization", "te",
                 "trailers", "upgrade"}
+
+
+class _UpstreamPool:
+    """Keep-alive sockets to replicas. A fresh TCP connect per proxied
+    request costs a handshake on the TTFT path and a TIME_WAIT per
+    request; streaming replicas speak HTTP/1.1 keep-alive, so sockets
+    whose previous response was fully consumed are reusable."""
+
+    def __init__(self):
+        self._idle: Dict[Tuple[str, int], List[socket.socket]] = {}
+        self._lock = threading.Lock()
+
+    def get(self, addr: Tuple[str, int]) -> Tuple[socket.socket, bool]:
+        """Returns (socket, reused). A reused socket may have gone
+        stale (replica closed it while idle) — callers retry once on a
+        fresh connect before failing over."""
+        with self._lock:
+            conns = self._idle.get(addr)
+            if conns:
+                return conns.pop(), True
+        return socket.create_connection(addr, timeout=120), False
+
+    def put(self, addr: Tuple[str, int], sock: socket.socket) -> None:
+        with self._lock:
+            self._idle.setdefault(addr, []).append(sock)
+
+
+_POOL = _UpstreamPool()
+
+
+class _ChunkedTracker:
+    """Incremental chunked-framing parser over RAW spliced bytes: finds
+    where the body ENDS (so the upstream socket can go back to the
+    keep-alive pool) without ever copying or re-framing the payload."""
+
+    def __init__(self):
+        self._line = b""      # partial size/trailer line across reads
+        self._data = 0        # bytes of current chunk (+CRLF) still due
+        self._last = False    # saw the zero-size chunk
+        self.done = False
+
+    def feed(self, piece: bytes) -> None:
+        i, n = 0, len(piece)
+        while i < n and not self.done:
+            if self._data:
+                take = min(self._data, n - i)
+                self._data -= take
+                i += take
+                continue
+            j = piece.find(b"\n", i)
+            if j < 0:
+                self._line += piece[i:]
+                return
+            line = (self._line + piece[i:j]).strip()
+            self._line = b""
+            i = j + 1
+            if self._last:
+                # Trailer section: a blank line ends the body.
+                if line == b"":
+                    self.done = True
+                continue
+            if line == b"":
+                continue          # CRLF between chunks
+            size = int(line.split(b";")[0], 16)
+            if size == 0:
+                self._last = True
+            else:
+                self._data = size + 2   # chunk data + trailing CRLF
 
 
 class Policy:
@@ -108,61 +176,116 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
             self.wfile.write(msg)
 
         def _forward(self, base_url: str, body: Optional[bytes]):
-            """Streaming reverse proxy: chunks reach the client AS the
-            replica produces them (first streamed token is one prefill
-            away, not one full generation — the TTFT that the serve
-            bench measures goes through this path). Reference parity:
-            sky/serve/load_balancer.py:174 StreamingResponse proxy.
+            """Streaming reverse proxy, raw-splice edition: replica
+            response bytes are forwarded VERBATIM — the chunked framing
+            is tracked (to find the body's end for keep-alive reuse)
+            but never decoded and re-encoded, so each upstream read
+            costs exactly one downstream write. Upstream sockets are
+            pooled keep-alive (no TCP handshake on the TTFT path).
+            Reference parity: sky/serve/load_balancer.py:174
+            StreamingResponse proxy.
 
             Retries happen only before the first forwarded byte; a 4xx
             from the replica is forwarded as-is (deterministic client
             error), while connect errors and 5xx raise to the retry
             loop in _proxy.
             """
-            url = base_url + self.path
-            headers = {k: v for k, v in self.headers.items()
-                       if k.lower() not in _HOP_HEADERS}
-            req = urllib.request.Request(url, data=body, headers=headers,
-                                         method=self.command)
-            try:
-                resp = urllib.request.urlopen(req, timeout=120)
-            except urllib.error.HTTPError as e:
-                if 400 <= e.code < 500:
-                    resp = e      # forward the replica's client error
+            parts = urlsplit(base_url)
+            addr = (parts.hostname or "", parts.port or 80)
+            hdrs = [f"{self.command} {self.path} HTTP/1.1",
+                    f"Host: {parts.netloc}",
+                    f"Content-Length: {len(body) if body else 0}"]
+            hdrs += [f"{k}: {v}" for k, v in self.headers.items()
+                     if k.lower() not in _HOP_HEADERS | {"content-length"}]
+            payload = ("\r\n".join(hdrs) + "\r\n\r\n").encode() + (body
+                                                                   or b"")
+            # A pooled socket can be stale (replica closed it while
+            # idle): retry exactly once on a FRESH connect — a failure
+            # there is a real replica failure and raises to _proxy.
+            # The retry attempt bypasses the pool entirely: after a
+            # replica restart EVERY pooled socket is stale, and popping
+            # another one would burn the retry without ever dialing.
+            buf = b""
+            for attempt in (0, 1):
+                if attempt == 0:
+                    sock, reused = _POOL.get(addr)
                 else:
-                    raise
-            with resp:
-                self._response_started = True
-                self.send_response(resp.status)
-                length = resp.headers.get("Content-Length")
-                for k, v in resp.headers.items():
-                    if k.lower() not in _HOP_HEADERS | {"content-length"}:
-                        self.send_header(k, v)
-                chunked = length is None
-                if chunked:
-                    self.send_header("Transfer-Encoding", "chunked")
-                else:
-                    self.send_header("Content-Length", length)
-                self.end_headers()
-                # read1: return as soon as ANY data is available
-                # (urllib decodes the upstream chunking; we re-frame
-                # for our client). A full read() would buffer the
-                # entire generation and destroy streaming TTFT.
-                # (HTTPError bodies may lack read1 — tiny, read whole.)
-                read1 = getattr(resp, "read1", None)
-                while True:
-                    chunk = read1(65536) if read1 else resp.read()
-                    if not chunk:
-                        break
-                    if chunked:
-                        self.wfile.write(f"{len(chunk):x}\r\n".encode())
-                        self.wfile.write(chunk + b"\r\n")
-                    else:
-                        self.wfile.write(chunk)
-                    self.wfile.flush()
-                if chunked:
-                    self.wfile.write(b"0\r\n\r\n")
-                self.wfile.flush()
+                    sock = socket.create_connection(addr, timeout=120)
+                    reused = False
+                try:
+                    sock.sendall(payload)
+                    while b"\r\n\r\n" not in buf:
+                        piece = sock.recv(65536)
+                        if not piece:
+                            raise ConnectionError("upstream closed early")
+                        buf += piece
+                    break
+                except OSError:
+                    sock.close()
+                    buf = b""
+                    if not (reused and attempt == 0):
+                        raise
+            hdr_end = buf.index(b"\r\n\r\n") + 4
+            head, rest = buf[:hdr_end - 4], buf[hdr_end:]
+            status_line, *lines = head.split(b"\r\n")
+            code = int(status_line.split()[1])
+            if code >= 500:
+                sock.close()
+                raise ConnectionError(f"upstream {code}")
+            resp_headers = []
+            clen = None
+            chunked = False
+            upstream_close = False
+            for ln in lines:
+                k, _, v = ln.partition(b":")
+                kl, v = k.strip().lower(), v.strip()
+                if kl == b"content-length":
+                    clen = int(v)
+                elif kl == b"transfer-encoding":
+                    chunked = b"chunked" in v.lower()
+                elif kl == b"connection":
+                    upstream_close = b"close" in v.lower()
+                    continue
+                elif kl in (b"keep-alive", b"proxy-authenticate", b"te",
+                            b"trailers", b"upgrade"):
+                    continue
+                resp_headers.append(ln)
+            # ONE write for status+headers+whatever body bytes arrived
+            # with them (the replica's first token often rides the same
+            # segment as the headers).
+            self._response_started = True
+            out = b"\r\n".join([status_line] + resp_headers)
+            self.wfile.write(out + b"\r\n\r\n" + rest)
+            if self.command == "HEAD" or code in (204, 304):
+                remaining, tracker = 0, None
+            elif chunked:
+                remaining, tracker = None, _ChunkedTracker()
+                tracker.feed(rest)
+            elif clen is not None:
+                remaining, tracker = clen - len(rest), None
+            else:               # EOF-framed: splice to close, no reuse
+                remaining, tracker = -1, None
+                self.close_connection = True
+            while ((tracker is not None and not tracker.done)
+                   or (tracker is None and (remaining is None
+                                            or remaining > 0
+                                            or remaining == -1))):
+                if tracker is None and remaining == 0:
+                    break
+                piece = sock.recv(65536)
+                if not piece:
+                    if remaining == -1:
+                        break       # EOF IS the framing
+                    raise ConnectionError("upstream closed mid-body")
+                self.wfile.write(piece)
+                if tracker is not None:
+                    tracker.feed(piece)
+                elif remaining > 0:
+                    remaining -= len(piece)
+            if upstream_close or remaining == -1:
+                sock.close()
+            else:
+                _POOL.put(addr, sock)
 
         do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _proxy
 
