@@ -49,6 +49,41 @@ def public_key_openssh() -> str:
         return f.read().strip()
 
 
+def get_user_identity() -> dict:
+    """Stable {"id", "name"} for the invoking user.
+
+    Reference parity: sky/global_user_state.py:110 (users) +
+    backend_utils.py get_user_identities — identity derives from who
+    holds the key material, not just the login name, so two people
+    sharing a UNIX account but different sky keys stay distinct.
+    ``SKYPILOT_TPU_USER`` overrides (used by the API server to carry
+    the CLIENT's identity into its request workers).
+    """
+    import getpass
+    import hashlib
+
+    uid = os.environ.get("SKYPILOT_TPU_USER_ID")
+    if uid:
+        # Set by the API server's executor: the request worker acts AS
+        # the submitting client, exactly (no re-hashing).
+        return {"id": uid,
+                "name": os.environ.get("SKYPILOT_TPU_USER_NAME", uid)}
+    override = os.environ.get("SKYPILOT_TPU_USER")
+    if override:
+        return {"id": hashlib.sha256(override.encode()).hexdigest()[:16],
+                "name": override}
+    try:
+        name = getpass.getuser()
+    except Exception:  # noqa: BLE001 — no passwd entry in containers
+        name = "unknown"
+    try:
+        pub = public_key_openssh()
+    except Exception:  # noqa: BLE001 — no ssh-keygen available
+        pub = ""
+    uid = hashlib.sha256(f"{name}:{pub}".encode()).hexdigest()[:16]
+    return {"id": uid, "name": name}
+
+
 def _gcp_http(method: str, url: str, body=None) -> dict:
     import json
     import urllib.request

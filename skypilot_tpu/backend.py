@@ -94,6 +94,30 @@ def _blocklist_scope(err: exceptions.ResourcesUnavailableError,
     return (launchable.cloud, launchable.region, launchable.zone)
 
 
+def check_owner_identity(cluster_name: str) -> None:
+    """Refuse to operate on another identity's cluster.
+
+    Reference parity: sky/backends/backend_utils.py:1509
+    check_owner_identity. Pre-ownership records (owner NULL, from a v1
+    state DB) stay operable by everyone — matching the reference's
+    grandfathering of old clusters.
+    """
+    rec = state.get_cluster(cluster_name)
+    if rec is None or not rec.get("owner"):
+        return
+    from skypilot_tpu import authentication
+    me = authentication.get_user_identity()
+    if rec["owner"] != me["id"]:
+        owner = state.get_user(rec["owner"]) or {}
+        who = owner.get("name") or rec["owner"]
+        raise exceptions.ClusterOwnerIdentityMismatchError(
+            f"cluster {cluster_name!r} is owned by {who} "
+            f"(id {rec['owner']}), not the current user {me['name']} "
+            f"(id {me['id']}). Pick a different cluster name, or set "
+            "SKYPILOT_TPU_USER to the owning identity if this is "
+            "really you.")
+
+
 class RetryingProvisioner:
     """Optimize -> provision -> on failure, blocklist + re-optimize."""
 
@@ -151,8 +175,10 @@ class RetryingProvisioner:
                 f"(Feature.MULTI_NODE_EXEC)")
         handle = ClusterHandle.create(cluster_name, launchable,
                                       task.num_nodes)
+        from skypilot_tpu import authentication
         state.set_cluster(cluster_name, dict(handle), state.ClusterStatus.INIT,
-                          handle["price_per_hour"])
+                          handle["price_per_hour"],
+                          owner=authentication.get_user_identity())
         config = ProvisionConfig(
             cluster_name=cluster_name,
             num_nodes=task.num_nodes,
@@ -236,6 +262,7 @@ class TpuVmBackend:
         # the same name twice (cloud-side duplicate or clobbered
         # handle).
         with cluster_lock(cluster_name):
+            check_owner_identity(cluster_name)
             existing = state.get_cluster(cluster_name)
             if existing is not None:
                 handle = ClusterHandle(existing["handle"])

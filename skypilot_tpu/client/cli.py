@@ -519,13 +519,27 @@ def _api_pid_file() -> str:
 
 @api.command(name="start")
 @click.option("--port", type=int, default=None)
-def api_start(port):
+@click.option("--host", default="127.0.0.1", show_default=True,
+              help="Bind address; 0.0.0.0 shares the server on the "
+                   "network — pair it with --auth.")
+@click.option("--auth", is_flag=True, default=False,
+              help="Require a bearer token (generated once at "
+                   "~/.skypilot_tpu/api_token; clients on other "
+                   "machines copy that file or set "
+                   "SKYPILOT_TPU_API_TOKEN).")
+def api_start(port, host, auth):
     """Start the API server (no-op if one is already running)."""
     from skypilot_tpu.client import sdk as sdk_mod
-    info = sdk_mod.api_start(port)
+    info = sdk_mod.api_start(port, host=host, auth=auth)
+    suffix = ""
+    if auth:
+        with open(sdk_mod._token_path()) as f:
+            suffix = f"?token={f.read().strip()}"
     click.echo(f"API server healthy at {_api_url()} "
                f"(version {info.get('version', '?')}); dashboard at "
-               f"{_api_url()}/dashboard")
+               f"{_api_url()}/dashboard{suffix}")
+    if auth:
+        click.echo(f"auth: bearer token at {sdk_mod._token_path()}")
 
 
 @api.command(name="stop")

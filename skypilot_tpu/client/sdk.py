@@ -29,16 +29,41 @@ def _url() -> str:
     return os.environ.get("SKYTPU_API_SERVER_URL", DEFAULT_URL)
 
 
+def _token_path() -> str:
+    return os.path.join(paths.home(), "api_token")
+
+
+def _headers() -> Dict[str, str]:
+    """Auth + identity headers on every SDK call. The bearer token
+    comes from SKYPILOT_TPU_API_TOKEN or ~/.skypilot_tpu/api_token
+    (written by `api start --auth`); identity rides as X-SkyTPU-User-*
+    so the server's request workers run AS this client (ownership
+    checks, users table)."""
+    h = {"Content-Type": "application/json"}
+    token = os.environ.get("SKYPILOT_TPU_API_TOKEN")
+    if not token and os.path.exists(_token_path()):
+        with open(_token_path()) as f:
+            token = f.read().strip()
+    if token:
+        h["Authorization"] = f"Bearer {token}"
+    from skypilot_tpu import authentication
+    me = authentication.get_user_identity()
+    h["X-SkyTPU-User-Id"] = me["id"]
+    h["X-SkyTPU-User-Name"] = me["name"]
+    return h
+
+
 def _post(path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
     req = urllib.request.Request(
         _url() + path, data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
+        headers=_headers(), method="POST")
     with urllib.request.urlopen(req, timeout=30) as resp:
         return json.loads(resp.read())
 
 
 def _get_json(path: str) -> Any:
-    with urllib.request.urlopen(_url() + path, timeout=30) as resp:
+    req = urllib.request.Request(_url() + path, headers=_headers())
+    with urllib.request.urlopen(req, timeout=30) as resp:
         return json.loads(resp.read())
 
 
@@ -81,9 +106,10 @@ def stream_and_get(request_id: str, timeout: float = 600,
 
 
 def _stream(request_id: str) -> str:
-    with urllib.request.urlopen(
-            _url() + f"/api/stream?request_id={request_id}",
-            timeout=30) as resp:
+    req = urllib.request.Request(
+        _url() + f"/api/stream?request_id={request_id}",
+        headers=_headers())
+    with urllib.request.urlopen(req, timeout=30) as resp:
         return resp.read().decode(errors="replace")
 
 
@@ -170,21 +196,51 @@ def api_info() -> Optional[Dict[str, Any]]:
         return None
 
 
-def api_start(port: Optional[int] = None, wait: float = 15) -> Dict[str, Any]:
+def api_start(port: Optional[int] = None, wait: float = 15,
+              host: str = "127.0.0.1",
+              auth: bool = False) -> Dict[str, Any]:
     """Start a local API server daemon if none is running. The port
     defaults to the one in SKYTPU_API_SERVER_URL (or 46580), and the
-    readiness poll targets that same port."""
+    readiness poll targets that same port.
+
+    ``auth=True`` generates (once) a bearer token at
+    ~/.skypilot_tpu/api_token (0600) and starts the server requiring
+    it — the mode to use with a non-loopback ``host``. The SDK picks
+    the token up from the same file automatically."""
     if port is None:
         port = urllib.parse.urlparse(_url()).port or 46580
     os.environ["SKYTPU_API_SERVER_URL"] = f"http://127.0.0.1:{port}"
     info = api_info()
     if info is not None:
+        if auth:
+            # A server is already up — refuse to silently "enable" auth
+            # if that server accepts unauthenticated requests (the CLI
+            # would otherwise report token auth on an open server).
+            try:
+                req = urllib.request.Request(_url() + "/api/status")
+                urllib.request.urlopen(req, timeout=10)
+                raise exceptions.SkyTpuError(
+                    f"an API server is already running at {_url()} "
+                    "WITHOUT auth; `api stop` it first, then "
+                    "`api start --auth`")
+            except urllib.error.HTTPError as e:
+                if e.code != 401:
+                    raise
         return info
+    cmd = [sys.executable, "-m", "skypilot_tpu.server.server",
+           "--host", host, "--port", str(port)]
+    if auth:
+        if not os.path.exists(_token_path()):
+            import secrets
+            fd = os.open(_token_path(),
+                         os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(secrets.token_hex(16))
+        cmd += ["--auth-token-file", _token_path()]
     log = os.path.join(paths.logs_dir(), "api_server.log")
     with open(log, "ab") as f:
         proc = subprocess.Popen(
-            [sys.executable, "-m", "skypilot_tpu.server.server",
-             "--port", str(port)],
+            cmd,
             stdout=f, stderr=subprocess.STDOUT, start_new_session=True,
             env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
     with open(os.path.join(paths.home(), "api_server.pid"), "w") as f:

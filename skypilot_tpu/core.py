@@ -10,7 +10,8 @@ import sys
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions, state
-from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
+from skypilot_tpu.backend import (ClusterHandle, TpuVmBackend,
+                                  check_owner_identity)
 
 
 def _handle(cluster_name: str) -> ClusterHandle:
@@ -36,14 +37,17 @@ def status(cluster_names: Optional[List[str]] = None,
 
 
 def start(cluster_name: str) -> None:
+    check_owner_identity(cluster_name)
     TpuVmBackend().start(cluster_name)
 
 
 def stop(cluster_name: str) -> None:
+    check_owner_identity(cluster_name)
     TpuVmBackend().stop(_handle(cluster_name))
 
 
 def down(cluster_name: str, purge: bool = False) -> None:
+    check_owner_identity(cluster_name)
     try:
         TpuVmBackend().teardown(_handle(cluster_name))
     except exceptions.ClusterNotUpError:
@@ -53,6 +57,7 @@ def down(cluster_name: str, purge: bool = False) -> None:
 
 
 def autostop(cluster_name: str, idle_minutes: int, down_: bool = False) -> None:
+    check_owner_identity(cluster_name)
     handle = _handle(cluster_name)
     # Arm the cluster-side skylet (survives this client); the state-DB
     # record is kept for `status` display only.
@@ -65,6 +70,7 @@ def queue(cluster_name: str) -> List[Dict[str, Any]]:
 
 
 def cancel(cluster_name: str, job_id: int) -> None:
+    check_owner_identity(cluster_name)
     TpuVmBackend().cancel(_handle(cluster_name), job_id)
 
 

@@ -120,6 +120,8 @@ def exec(task: Task,  # noqa: A001 — mirrors the public API name
 
 def _exec_with_config(task: Task, cluster_name: str,
                       detach_run: bool) -> Tuple[int, ClusterHandle]:
+    from skypilot_tpu.backend import check_owner_identity
+    check_owner_identity(cluster_name)
     rec = state.get_cluster(cluster_name)
     if rec is None:
         raise exceptions.ClusterNotUpError(

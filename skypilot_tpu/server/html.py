@@ -63,9 +63,9 @@ function fill(id, rows, cols) {
 async function refresh() {
   try {
     const [cs, js, rs] = await Promise.all([
-      fetch("/api/clusters").then(r => r.json()),
-      fetch("/api/jobs").then(r => r.json()),
-      fetch("/api/status").then(r => r.json()),
+      fetch("/api/clusters" + window.location.search).then(r => r.json()),
+      fetch("/api/jobs" + window.location.search).then(r => r.json()),
+      fetch("/api/status" + window.location.search).then(r => r.json()),
     ]);
     fill("clusters", cs, ["name", "status", "resources", "autostop"]);
     fill("jobs", js, ["job_id", "name", "status", "task",

@@ -30,6 +30,13 @@ class RequestStatus(enum.Enum):
                         RequestStatus.CANCELLED)
 
 
+# Schema history (PRAGMA user_version):
+#   v1: requests table
+#   v2: + requests.user (JSON {"id","name"} of the submitting client —
+#       the API server stamps it from the request headers and injects
+#       it into the worker so ops run AS that identity)
+SCHEMA_VERSION = 2
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS requests (
     request_id TEXT PRIMARY KEY,
@@ -40,15 +47,18 @@ CREATE TABLE IF NOT EXISTS requests (
     error TEXT,
     pid INTEGER,
     created_at REAL,
-    finished_at REAL
+    finished_at REAL,
+    user TEXT
 );
 """
+
+_MIGRATIONS = {2: "ALTER TABLE requests ADD COLUMN user TEXT;"}
 
 
 @contextlib.contextmanager
 def _db():
-    conn = db.connect(paths.requests_db(), timeout=10)
-    conn.executescript(_SCHEMA)
+    conn = db.open_versioned(paths.requests_db(), _SCHEMA, SCHEMA_VERSION,
+                             _MIGRATIONS, timeout=10)
     try:
         yield conn
         conn.commit()
@@ -56,14 +66,16 @@ def _db():
         conn.close()
 
 
-def create(name: str, payload: Dict[str, Any]) -> str:
+def create(name: str, payload: Dict[str, Any],
+           user: Optional[Dict[str, str]] = None) -> str:
     request_id = uuid.uuid4().hex[:16]
     with _db() as c:
         c.execute(
             "INSERT INTO requests (request_id, name, status, payload,"
-            " created_at) VALUES (?,?,?,?,?)",
+            " created_at, user) VALUES (?,?,?,?,?,?)",
             (request_id, name, RequestStatus.NEW.value,
-             json.dumps(payload), time.time()))
+             json.dumps(payload), time.time(),
+             json.dumps(user) if user else None))
     return request_id
 
 
@@ -105,7 +117,8 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
     with _db() as c:
         row = c.execute(
             "SELECT request_id, name, status, payload, result, error, pid,"
-            " created_at, finished_at FROM requests WHERE request_id=?",
+            " created_at, finished_at, user FROM requests"
+            " WHERE request_id=?",
             (request_id,)).fetchone()
     if row is None:
         return None
@@ -116,6 +129,7 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
         "result": json.loads(row[4]) if row[4] else None,
         "error": row[5], "pid": row[6],
         "created_at": row[7], "finished_at": row[8],
+        "user": json.loads(row[9]) if row[9] else None,
     }
 
 
@@ -131,3 +145,30 @@ def log_path(request_id: str) -> str:
     d = os.path.join(paths.home(), "request_logs")
     os.makedirs(d, exist_ok=True)
     return os.path.join(d, f"{request_id}.log")
+
+
+def gc(max_age_s: float, keep_last: int = 50) -> int:
+    """Delete terminal requests older than ``max_age_s`` (and their log
+    files), always keeping the ``keep_last`` most recent records so
+    `api status` history survives an aggressive TTL. Returns the number
+    of records removed. (Reference analog: the API server's request GC;
+    an unbounded requests DB on a shared server grows without limit.)"""
+    cutoff = time.time() - max_age_s
+    terminal = tuple(s.value for s in RequestStatus if s.is_terminal())
+    with _db() as c:
+        keep = {r[0] for r in c.execute(
+            "SELECT request_id FROM requests ORDER BY created_at DESC"
+            " LIMIT ?", (keep_last,)).fetchall()}
+        rows = c.execute(
+            f"SELECT request_id FROM requests WHERE status IN"
+            f" ({','.join('?' * len(terminal))}) AND finished_at < ?",
+            terminal + (cutoff,)).fetchall()
+        doomed = [r[0] for r in rows if r[0] not in keep]
+        for rid in doomed:
+            c.execute("DELETE FROM requests WHERE request_id=?", (rid,))
+    for rid in doomed:
+        try:
+            os.remove(log_path(rid))
+        except OSError:
+            pass
+    return len(doomed)

@@ -38,6 +38,10 @@ from skypilot_tpu.server.requests_db import RequestStatus
 from skypilot_tpu.utils import paths
 
 MAX_CONCURRENT_REQUESTS = int(os.environ.get("SKYTPU_API_WORKERS", "8"))
+# Terminal requests older than this are garbage-collected (logs too).
+REQUEST_TTL_S = float(os.environ.get("SKYTPU_API_REQUEST_TTL_HOURS",
+                                     "168")) * 3600
+_GC_INTERVAL_S = 600
 
 _ENDPOINTS = {
     "/launch": "launch", "/exec": "exec", "/status": "status",
@@ -58,10 +62,20 @@ class Executor(threading.Thread):
         super().__init__(daemon=True)
         self._procs: Dict[str, subprocess.Popen] = {}
         self._stop = threading.Event()
+        self._last_gc = 0.0
 
     def run(self) -> None:
         while not self._stop.is_set():
             self._reap()
+            if time.time() - self._last_gc > _GC_INTERVAL_S:
+                self._last_gc = time.time()
+                try:
+                    n = requests_db.gc(REQUEST_TTL_S)
+                    if n:
+                        print(f"request GC: removed {n} old records",
+                              file=sys.stderr)
+                except Exception as e:  # noqa: BLE001 — GC never fatal
+                    print(f"request GC failed: {e}", file=sys.stderr)
             if len(self._procs) < MAX_CONCURRENT_REQUESTS:
                 rec = requests_db.next_new()
                 if rec is not None:
@@ -70,10 +84,17 @@ class Executor(threading.Thread):
             time.sleep(0.05)
 
     def _spawn(self, rec: Dict[str, Any]) -> None:
+        env = {**os.environ, "SKYPILOT_TPU_HOME": paths.home()}
+        # The worker runs AS the submitting client: ownership checks in
+        # core/backend resolve get_user_identity() to the identity the
+        # client sent, not the server's own UNIX user.
+        user = rec.get("user") or {}
+        if user.get("id"):
+            env["SKYPILOT_TPU_USER_ID"] = user["id"]
+            env["SKYPILOT_TPU_USER_NAME"] = user.get("name", user["id"])
         proc = subprocess.Popen(
             [sys.executable, "-m", "skypilot_tpu.server.worker",
-             "--request-id", rec["request_id"]],
-            env={**os.environ, "SKYPILOT_TPU_HOME": paths.home()})
+             "--request-id", rec["request_id"]], env=env)
         requests_db.set_pid(rec["request_id"], proc.pid)
         self._procs[rec["request_id"]] = proc
 
@@ -93,9 +114,46 @@ class Executor(threading.Thread):
         self._stop.set()
 
 
-def make_handler():
+def make_handler(auth_token: Optional[str] = None):
     class ApiHandler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+
+        def _authorized(self) -> bool:
+            """Bearer-token check (skipped for /api/health so probes
+            and `api info` work unauthenticated). Browsers cannot set
+            an Authorization header on a plain link, so GETs also
+            accept ?token=<token> — that is how the dashboard URL
+            printed by `api start --auth` carries the credential (the
+            dashboard JS forwards it to its own /api fetches).
+            Constant-time comparisons: the token is the whole
+            credential."""
+            if auth_token is None:
+                return True
+            parsed = urllib.parse.urlparse(self.path)
+            if parsed.path == "/api/health":
+                return True
+            import hmac
+            got = self.headers.get("Authorization", "")
+            want = f"Bearer {auth_token}"
+            if hmac.compare_digest(got.encode(), want.encode()):
+                return True
+            qtok = (urllib.parse.parse_qs(parsed.query).get("token")
+                    or [""])[0]
+            return hmac.compare_digest(qtok.encode(),
+                                       auth_token.encode())
+
+        def _reject_unauthorized(self) -> None:
+            # HTTP/1.1 keep-alive: the unread POST body would otherwise
+            # be parsed as the NEXT request line on this connection.
+            self.close_connection = True
+            self._json(401, {"error": "unauthorized"})
+
+        def _client_identity(self) -> Optional[Dict[str, str]]:
+            uid = self.headers.get("X-SkyTPU-User-Id")
+            if not uid:
+                return None
+            return {"id": uid,
+                    "name": self.headers.get("X-SkyTPU-User-Name", uid)}
 
         # -- helpers -------------------------------------------------------
         def _json(self, code: int, obj: Any) -> None:
@@ -114,6 +172,8 @@ def make_handler():
 
         # -- routes --------------------------------------------------------
         def do_POST(self):
+            if not self._authorized():
+                return self._reject_unauthorized()
             path = urllib.parse.urlparse(self.path).path
             if path == "/api/cancel":
                 body = self._body()
@@ -132,10 +192,13 @@ def make_handler():
             name = _ENDPOINTS.get(path)
             if name is None:
                 return self._json(404, {"error": f"no endpoint {path}"})
-            rid = requests_db.create(name, self._body())
+            rid = requests_db.create(name, self._body(),
+                                     user=self._client_identity())
             return self._json(200, {"request_id": rid})
 
         def do_GET(self):
+            if not self._authorized():
+                return self._reject_unauthorized()
             parsed = urllib.parse.urlparse(self.path)
             qs = urllib.parse.parse_qs(parsed.query)
             if parsed.path == "/api/health":
@@ -211,10 +274,11 @@ class _Server(ThreadingMixIn, HTTPServer):
     allow_reuse_address = True
 
 
-def serve(host: str = "127.0.0.1", port: int = 46580) -> None:
+def serve(host: str = "127.0.0.1", port: int = 46580,
+          auth_token: Optional[str] = None) -> None:
     executor = Executor()
     executor.start()
-    httpd = _Server((host, port), make_handler())
+    httpd = _Server((host, port), make_handler(auth_token))
     try:
         httpd.serve_forever()
     finally:
@@ -223,10 +287,22 @@ def serve(host: str = "127.0.0.1", port: int = 46580) -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address; 0.0.0.0 for a shared server "
+                         "(use --auth-token-file then!)")
     ap.add_argument("--port", type=int, default=46580)
+    ap.add_argument("--auth-token-file", default=None,
+                    help="require `Authorization: Bearer <token>` on "
+                         "every endpoint except /api/health; the token "
+                         "is the file's stripped contents")
     args = ap.parse_args()
-    serve(args.host, args.port)
+    token = None
+    if args.auth_token_file:
+        with open(os.path.expanduser(args.auth_token_file)) as f:
+            token = f.read().strip()
+        if not token:
+            raise SystemExit(f"{args.auth_token_file} is empty")
+    serve(args.host, args.port, auth_token=token)
 
 
 if __name__ == "__main__":

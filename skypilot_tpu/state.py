@@ -21,6 +21,13 @@ class ClusterStatus(enum.Enum):
     STOPPED = "STOPPED"
 
 
+# Schema history (PRAGMA user_version):
+#   v1: clusters / cluster_history / storage
+#   v2: + users table, + clusters.owner (reference parity:
+#       sky/global_user_state.py:110 users table, :175 owner recorded
+#       per cluster)
+SCHEMA_VERSION = 2
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS clusters (
     name TEXT PRIMARY KEY,
@@ -29,7 +36,8 @@ CREATE TABLE IF NOT EXISTS clusters (
     status TEXT,
     autostop_minutes INTEGER DEFAULT -1,
     autostop_down INTEGER DEFAULT 0,
-    price_per_hour REAL DEFAULT 0
+    price_per_hour REAL DEFAULT 0,
+    owner TEXT
 );
 CREATE TABLE IF NOT EXISTS cluster_history (
     name TEXT,
@@ -44,13 +52,29 @@ CREATE TABLE IF NOT EXISTS storage (
     handle TEXT,
     created_at INTEGER
 );
+CREATE TABLE IF NOT EXISTS users (
+    id TEXT PRIMARY KEY,
+    name TEXT,
+    created_at INTEGER
+);
 """
+
+_MIGRATIONS = {
+    2: """
+ALTER TABLE clusters ADD COLUMN owner TEXT;
+CREATE TABLE IF NOT EXISTS users (
+    id TEXT PRIMARY KEY,
+    name TEXT,
+    created_at INTEGER
+);
+""",
+}
 
 
 @contextlib.contextmanager
 def _db():
-    conn = db.connect(paths.state_db(), timeout=10)
-    conn.executescript(_SCHEMA)
+    conn = db.open_versioned(paths.state_db(), _SCHEMA, SCHEMA_VERSION,
+                             _MIGRATIONS, timeout=10)
     try:
         yield conn
         conn.commit()
@@ -59,15 +83,41 @@ def _db():
 
 
 def set_cluster(name: str, handle: Dict[str, Any], status: ClusterStatus,
-                price_per_hour: float = 0.0) -> None:
+                price_per_hour: float = 0.0,
+                owner: Optional[Dict[str, str]] = None) -> None:
+    """Upsert a cluster record. ``owner`` ({"id","name"}) is stamped on
+    CREATE and preserved on update — re-launches by another user do not
+    steal the cluster (they are refused upstream by
+    ``check_owner_identity``)."""
+    if owner is not None:
+        record_user(owner)
     with _db() as c:
         c.execute(
             "INSERT INTO clusters (name, launched_at, handle, status,"
-            " price_per_hour) VALUES (?,?,?,?,?) ON CONFLICT(name) DO UPDATE"
+            " price_per_hour, owner) VALUES (?,?,?,?,?,?)"
+            " ON CONFLICT(name) DO UPDATE"
             " SET handle=excluded.handle, status=excluded.status,"
-            " price_per_hour=excluded.price_per_hour",
+            " price_per_hour=excluded.price_per_hour,"
+            " owner=COALESCE(clusters.owner, excluded.owner)",
             (name, int(time.time()), json.dumps(handle), status.value,
-             price_per_hour))
+             price_per_hour, owner["id"] if owner else None))
+
+
+def record_user(identity: Dict[str, str]) -> None:
+    with _db() as c:
+        c.execute(
+            "INSERT OR IGNORE INTO users (id, name, created_at)"
+            " VALUES (?,?,?)",
+            (identity["id"], identity.get("name", ""), int(time.time())))
+
+
+def get_user(user_id: str) -> Optional[Dict[str, Any]]:
+    with _db() as c:
+        row = c.execute("SELECT id, name, created_at FROM users"
+                        " WHERE id=?", (user_id,)).fetchone()
+    if row is None:
+        return None
+    return {"id": row[0], "name": row[1], "created_at": row[2]}
 
 
 def set_cluster_status(name: str, status: ClusterStatus) -> None:
@@ -80,7 +130,7 @@ def get_cluster(name: str) -> Optional[Dict[str, Any]]:
     with _db() as c:
         row = c.execute(
             "SELECT name, launched_at, handle, status, autostop_minutes,"
-            " autostop_down, price_per_hour FROM clusters WHERE name=?",
+            " autostop_down, price_per_hour, owner FROM clusters WHERE name=?",
             (name,)).fetchone()
     return _row_to_record(row) if row else None
 
@@ -89,7 +139,7 @@ def list_clusters() -> List[Dict[str, Any]]:
     with _db() as c:
         rows = c.execute(
             "SELECT name, launched_at, handle, status, autostop_minutes,"
-            " autostop_down, price_per_hour FROM clusters"
+            " autostop_down, price_per_hour, owner FROM clusters"
             " ORDER BY launched_at DESC").fetchall()
     return [_row_to_record(r) for r in rows]
 
@@ -126,7 +176,7 @@ def cost_report() -> List[Dict[str, Any]]:
 
 
 def _row_to_record(row) -> Dict[str, Any]:
-    name, launched_at, handle, status, am, ad, price = row
+    name, launched_at, handle, status, am, ad, price, owner = row
     return {
         "name": name,
         "launched_at": launched_at,
@@ -135,6 +185,7 @@ def _row_to_record(row) -> Dict[str, Any]:
         "autostop_minutes": am,
         "autostop_down": bool(ad),
         "price_per_hour": price,
+        "owner": owner,
     }
 
 

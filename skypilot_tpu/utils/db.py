@@ -1,14 +1,30 @@
-"""Shared sqlite connection discipline.
+"""Shared sqlite connection discipline + schema versioning.
 
 WAL journaling (readers never block the single writer — controllers and
 RPC handlers share these DBs concurrently) + a busy handler matched to
 the caller's timeout. One helper so tuning changes hit every DB at once.
 Stdlib-only: imported by head-side runtime modules under ``python -S``.
+
+Schema versioning (reference analog:
+tests/backward_compatibility_tests.sh — new client code meeting an old
+``~/.skypilot_tpu`` state dir must upgrade it or fail LOUDLY, never
+misread it): every DB stamps ``PRAGMA user_version``. ``open_versioned``
+creates fresh DBs at the current version, runs registered migrations on
+older ones (in order, committed per step), and refuses DBs written by a
+NEWER client.
 """
 
 from __future__ import annotations
 
 import sqlite3
+from typing import Callable, Dict, Union
+
+Migration = Union[str, Callable[[sqlite3.Connection], None]]
+
+
+class SchemaVersionError(RuntimeError):
+    """DB schema can't be used: newer than this client, or a migration
+    step is missing."""
 
 
 def connect(path: str, timeout: float = 10) -> sqlite3.Connection:
@@ -16,3 +32,72 @@ def connect(path: str, timeout: float = 10) -> sqlite3.Connection:
     conn.execute("PRAGMA journal_mode=WAL")
     conn.execute(f"PRAGMA busy_timeout={int(timeout * 1000)}")
     return conn
+
+
+def open_versioned(path: str, schema: str, version: int,
+                   migrations: Dict[int, Migration] | None = None,
+                   timeout: float = 10) -> sqlite3.Connection:
+    """Connect + create-or-migrate.
+
+    ``schema`` is the CURRENT-version DDL (executed only on fresh DBs).
+    ``migrations[v]`` upgrades v-1 -> v (SQL script or callable); a DB
+    at an older version replays them in order. DBs created before
+    versioning existed (user_version 0 but tables present) count as
+    version 1. A DB stamped NEWER than ``version`` raises
+    SchemaVersionError — old code must never scribble on a new schema.
+    """
+    conn = connect(path, timeout=timeout)
+    try:
+        cur = conn.execute("PRAGMA user_version").fetchone()[0]
+        if cur == version:
+            return conn           # fast path: no write lock taken
+        # Creation/migration runs under ONE exclusive transaction
+        # (BEGIN IMMEDIATE; concurrent openers block on busy_timeout
+        # then re-read the version). Without it, a second connection
+        # can observe a mid-creation DB — tables present, version not
+        # yet stamped — misread it as "pre-versioning v1" and re-run
+        # migrations into a duplicate-column error. Not executescript:
+        # that helper force-commits first, which would break the
+        # atomicity this exists for. PRAGMA user_version is part of
+        # the DB header and IS transactional.
+        conn.execute("BEGIN IMMEDIATE")
+        try:
+            cur = conn.execute("PRAGMA user_version").fetchone()[0]
+            if cur == 0:
+                tables = conn.execute(
+                    "SELECT count(*) FROM sqlite_master"
+                    " WHERE type='table'").fetchone()[0]
+                if tables == 0:
+                    for stmt in schema.split(";"):
+                        if stmt.strip():
+                            conn.execute(stmt)
+                    conn.execute(f"PRAGMA user_version={int(version)}")
+                    conn.commit()
+                    return conn
+                cur = 1           # pre-versioning DB
+            if cur > version:
+                raise SchemaVersionError(
+                    f"{path} is schema v{cur}, but this client only "
+                    f"knows v{version} — upgrade the client (refusing "
+                    "to touch a newer on-disk state)")
+            for v in range(cur + 1, version + 1):
+                step = (migrations or {}).get(v)
+                if step is None:
+                    raise SchemaVersionError(
+                        f"{path} is schema v{cur} and no migration to "
+                        f"v{v} is registered")
+                if callable(step):
+                    step(conn)    # must not commit mid-step
+                else:
+                    for stmt in step.split(";"):
+                        if stmt.strip():
+                            conn.execute(stmt)
+                conn.execute(f"PRAGMA user_version={v}")
+            conn.commit()
+        except BaseException:
+            conn.rollback()
+            raise
+        return conn
+    except BaseException:
+        conn.close()
+        raise

@@ -1,0 +1,300 @@
+"""Users / cluster ownership + sqlite schema versioning.
+
+Reference parity: sky/global_user_state.py:110 (users table), :175
+(owner recorded on the cluster), backends/backend_utils.py:1509
+(check_owner_identity refuses cross-user ops), and
+tests/backward_compatibility_tests.sh (old on-disk state meeting new
+code must migrate or fail loudly — here: PRAGMA user_version +
+registered migrations, tested against a hand-built v1 fixture).
+"""
+
+import socket
+import sqlite3
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import authentication, exceptions, state
+from skypilot_tpu.backend import check_owner_identity
+from skypilot_tpu.utils import db as db_lib
+
+
+@pytest.fixture()
+def home(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    monkeypatch.setenv("SKYPILOT_TPU_USER", "alice")
+    return tmp_path
+
+
+# -- identity ---------------------------------------------------------------
+
+def test_identity_env_override(monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_USER", "alice")
+    a = authentication.get_user_identity()
+    monkeypatch.setenv("SKYPILOT_TPU_USER", "bob")
+    b = authentication.get_user_identity()
+    assert a["name"] == "alice" and b["name"] == "bob"
+    assert a["id"] != b["id"]
+    # Stable: same input, same id.
+    monkeypatch.setenv("SKYPILOT_TPU_USER", "alice")
+    assert authentication.get_user_identity() == a
+
+
+def test_identity_server_injected(monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_USER_ID", "deadbeef")
+    monkeypatch.setenv("SKYPILOT_TPU_USER_NAME", "carol")
+    me = authentication.get_user_identity()
+    assert me == {"id": "deadbeef", "name": "carol"}
+
+
+# -- ownership --------------------------------------------------------------
+
+def test_owner_recorded_and_preserved(home, monkeypatch):
+    me = authentication.get_user_identity()
+    state.set_cluster("c1", {"provider": "local"}, state.ClusterStatus.UP,
+                      owner=me)
+    rec = state.get_cluster("c1")
+    assert rec["owner"] == me["id"]
+    assert state.get_user(me["id"])["name"] == "alice"
+    # A later upsert (status refresh) without owner keeps the original.
+    state.set_cluster("c1", {"provider": "local"},
+                      state.ClusterStatus.STOPPED)
+    assert state.get_cluster("c1")["owner"] == me["id"]
+    # ... and an upsert by ANOTHER user does not steal it.
+    monkeypatch.setenv("SKYPILOT_TPU_USER", "bob")
+    other = authentication.get_user_identity()
+    state.set_cluster("c1", {"provider": "local"}, state.ClusterStatus.UP,
+                      owner=other)
+    assert state.get_cluster("c1")["owner"] == me["id"]
+
+
+def test_check_owner_identity(home, monkeypatch):
+    me = authentication.get_user_identity()
+    state.set_cluster("mine", {"provider": "local"},
+                      state.ClusterStatus.UP, owner=me)
+    check_owner_identity("mine")          # owner: fine
+    check_owner_identity("nonexistent")   # unknown cluster: no-op here
+    monkeypatch.setenv("SKYPILOT_TPU_USER", "mallory")
+    with pytest.raises(exceptions.ClusterOwnerIdentityMismatchError,
+                       match="owned by alice"):
+        check_owner_identity("mine")
+
+
+def test_ownerless_v1_record_grandfathered(home):
+    # Records from pre-ownership schemas have owner NULL: anyone may
+    # operate on them (reference grandfathers old clusters the same way).
+    state.set_cluster("old", {"provider": "local"}, state.ClusterStatus.UP)
+    check_owner_identity("old")
+
+
+def test_core_ops_refuse_foreign_cluster(home, monkeypatch):
+    from skypilot_tpu import core
+    me = authentication.get_user_identity()
+    state.set_cluster("guarded", {"provider": "local",
+                                  "cluster_name": "guarded"},
+                      state.ClusterStatus.UP, owner=me)
+    monkeypatch.setenv("SKYPILOT_TPU_USER", "mallory")
+    for op in (lambda: core.stop("guarded"),
+               lambda: core.down("guarded"),
+               lambda: core.start("guarded"),
+               lambda: core.autostop("guarded", 5),
+               lambda: core.cancel("guarded", 1)):
+        with pytest.raises(exceptions.ClusterOwnerIdentityMismatchError):
+            op()
+    # The record is untouched.
+    assert state.get_cluster("guarded")["status"] == state.ClusterStatus.UP
+
+
+# -- schema versioning ------------------------------------------------------
+
+def _v1_state_db(path):
+    """Hand-built v1 fixture: the exact pre-ownership schema."""
+    conn = sqlite3.connect(path)
+    conn.executescript("""
+CREATE TABLE clusters (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    handle TEXT,
+    status TEXT,
+    autostop_minutes INTEGER DEFAULT -1,
+    autostop_down INTEGER DEFAULT 0,
+    price_per_hour REAL DEFAULT 0
+);
+CREATE TABLE cluster_history (
+    name TEXT, launched_at INTEGER, duration_s REAL,
+    price_per_hour REAL, resources TEXT, num_nodes INTEGER
+);
+CREATE TABLE storage (name TEXT PRIMARY KEY, handle TEXT,
+                      created_at INTEGER);
+INSERT INTO clusters (name, launched_at, handle, status)
+    VALUES ('legacy', 123, '{"provider": "local"}', 'UP');
+""")
+    conn.commit()
+    conn.close()
+
+
+def test_v1_state_db_migrates_in_place(home):
+    from skypilot_tpu.utils import paths
+    _v1_state_db(paths.state_db())
+    # New code reading an old DB: migration runs, data survives, owner
+    # reads as NULL (grandfathered).
+    rec = state.get_cluster("legacy")
+    assert rec["status"] == state.ClusterStatus.UP
+    assert rec["owner"] is None
+    conn = sqlite3.connect(paths.state_db())
+    assert conn.execute("PRAGMA user_version").fetchone()[0] == \
+        state.SCHEMA_VERSION
+    cols = [r[1] for r in conn.execute(
+        "PRAGMA table_info(clusters)").fetchall()]
+    assert "owner" in cols
+    conn.close()
+    # And new writes work on the migrated DB.
+    me = authentication.get_user_identity()
+    state.set_cluster("fresh", {"provider": "local"},
+                      state.ClusterStatus.UP, owner=me)
+    assert state.get_cluster("fresh")["owner"] == me["id"]
+
+
+def test_newer_schema_refused(home, tmp_path):
+    path = str(tmp_path / "future.db")
+    conn = db_lib.open_versioned(path, "CREATE TABLE t (x);", 1)
+    conn.execute("PRAGMA user_version=99")
+    conn.commit()
+    conn.close()
+    with pytest.raises(db_lib.SchemaVersionError, match="newer"):
+        db_lib.open_versioned(path, "CREATE TABLE t (x);", 1)
+
+
+def test_missing_migration_refused(home, tmp_path):
+    path = str(tmp_path / "gap.db")
+    db_lib.open_versioned(path, "CREATE TABLE t (x);", 1).close()
+    with pytest.raises(db_lib.SchemaVersionError, match="no migration"):
+        db_lib.open_versioned(path, "CREATE TABLE t (x);", 3,
+                              migrations={2: "CREATE TABLE u (y);"})
+
+
+def test_migration_chain_runs_in_order(home, tmp_path):
+    path = str(tmp_path / "chain.db")
+    db_lib.open_versioned(path, "CREATE TABLE t (x);", 1).close()
+    conn = db_lib.open_versioned(
+        path, "CREATE TABLE t (x); CREATE TABLE u (y); CREATE TABLE w (z);",
+        3, migrations={2: "CREATE TABLE u (y);", 3: "CREATE TABLE w (z);"})
+    tables = {r[0] for r in conn.execute(
+        "SELECT name FROM sqlite_master WHERE type='table'").fetchall()}
+    assert {"t", "u", "w"} <= tables
+    assert conn.execute("PRAGMA user_version").fetchone()[0] == 3
+    conn.close()
+
+
+def test_requests_db_v1_migrates(home):
+    from skypilot_tpu.server import requests_db
+    from skypilot_tpu.utils import paths
+    conn = sqlite3.connect(paths.requests_db())
+    conn.executescript("""
+CREATE TABLE requests (
+    request_id TEXT PRIMARY KEY, name TEXT, status TEXT, payload TEXT,
+    result TEXT, error TEXT, pid INTEGER, created_at REAL,
+    finished_at REAL
+);
+INSERT INTO requests (request_id, name, status, payload, created_at)
+    VALUES ('abc', 'status', 'SUCCEEDED', '{}', 1.0);
+""")
+    conn.commit()
+    conn.close()
+    rec = requests_db.get("abc")
+    assert rec["name"] == "status" and rec["user"] is None
+    rid = requests_db.create("status", {}, user={"id": "u1", "name": "n"})
+    assert requests_db.get(rid)["user"] == {"id": "u1", "name": "n"}
+
+
+# -- multi-client ownership through the API server --------------------------
+
+@pytest.fixture()
+def api_server(tmp_path, monkeypatch):
+    from skypilot_tpu.server import server as server_mod
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    monkeypatch.setenv("SKYTPU_API_SERVER_URL", f"http://127.0.0.1:{port}")
+    executor = server_mod.Executor()
+    executor.start()
+    httpd = server_mod._Server(("127.0.0.1", port),
+                               server_mod.make_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    executor.stop()
+    httpd.shutdown()
+
+
+def test_two_clients_ownership_via_server(api_server, monkeypatch):
+    """Alice launches through the API server; Bob's down is refused;
+    Alice's own down succeeds. The identity rides the X-SkyTPU-User-*
+    headers into the request worker's environment."""
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    monkeypatch.setenv("SKYPILOT_TPU_USER", "alice")
+    task = Task(name="t", run="echo hi")
+    task.set_resources(Resources(cloud="local"))
+    rid = sdk.launch(task, cluster_name="owned")
+    assert sdk.get(rid, timeout=120)["cluster_name"] == "owned"
+
+    monkeypatch.setenv("SKYPILOT_TPU_USER", "bob")
+    rid = sdk.down("owned")
+    with pytest.raises(exceptions.SkyTpuError,
+                       match="owned by alice"):
+        sdk.get(rid, timeout=60)
+
+    monkeypatch.setenv("SKYPILOT_TPU_USER", "alice")
+    rid = sdk.down("owned")
+    sdk.get(rid, timeout=60)
+    rid = sdk.status()
+    assert not any(r["name"] == "owned" for r in sdk.get(rid, timeout=60))
+
+
+def test_api_auth_required(tmp_path, monkeypatch):
+    """With an auth token configured, unauthenticated calls get 401
+    (except /api/health) and the SDK's token pickup makes them pass."""
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.server import server as server_mod
+
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    url = f"http://127.0.0.1:{port}"
+    monkeypatch.setenv("SKYTPU_API_SERVER_URL", url)
+    httpd = server_mod._Server(
+        ("127.0.0.1", port), server_mod.make_handler(auth_token="sesame"))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        # Health stays open for probes.
+        assert sdk.api_info()["status"] == "healthy"
+        # No token -> 401 on real endpoints.
+        monkeypatch.delenv("SKYPILOT_TPU_API_TOKEN", raising=False)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/api/status", timeout=10)
+        assert ei.value.code == 401
+        # Wrong token -> 401.
+        monkeypatch.setenv("SKYPILOT_TPU_API_TOKEN", "wrong")
+        with pytest.raises(urllib.error.HTTPError) as ei2:
+            sdk.api_status()
+        assert ei2.value.code == 401
+        # Right token -> through.
+        monkeypatch.setenv("SKYPILOT_TPU_API_TOKEN", "sesame")
+        assert sdk.api_status() == []
+        # Browser path: ?token= on a GET (the dashboard link).
+        with urllib.request.urlopen(url + "/dashboard?token=sesame",
+                                    timeout=10) as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei3:
+            urllib.request.urlopen(url + "/dashboard?token=wrong",
+                                   timeout=10)
+        assert ei3.value.code == 401
+    finally:
+        httpd.shutdown()
