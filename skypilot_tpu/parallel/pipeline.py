@@ -46,15 +46,40 @@ Params = Dict[str, Any]
 
 @dataclasses.dataclass(frozen=True)
 class PipelineConfig(llama.LlamaConfig):
-    """Llama config with a pipelined decoder stack."""
+    """Llama config with a pipelined decoder stack.
+
+    ``schedule``:
+      * ``"gpipe"`` — all microbatch outputs are buffered ([M, b, S, D])
+        and the loss runs over the re-assembled batch. Activation
+        memory grows with n_microbatches.
+      * ``"1f1b"``  — the loss for each microbatch is computed IN the
+        tick that drains it and only scalar accumulators survive; the
+        O(M) output buffer disappears, so activation memory is O(
+        n_stages) regardless of microbatch count. Autodiff through the
+        tick scan then replays ticks newest-first, each immediately
+        followed by its own head/loss backward — the classic
+        one-forward-one-backward interleave emerges from the reversed
+        program rather than from hand-scheduled send/recvs.
+
+    On bubbles (measured in examples/pp_schedule_bench.py): a
+    synchronous flat pipeline has bubble (S-1)/(M+S-1) under EITHER
+    schedule — 1F1B's textbook win over GPipe is activation MEMORY,
+    not steady-state bubble. The bubble payoff is indirect and real:
+    O(stages) memory lets n_microbatches rise at fixed HBM, and the
+    bubble fraction falls with M.
+    """
 
     n_stages: int = 2
     n_microbatches: int = 4
+    schedule: str = "gpipe"
 
     def __post_init__(self):
         if self.n_layers % self.n_stages:
             raise ValueError(f"n_layers={self.n_layers} not divisible by "
                              f"n_stages={self.n_stages}")
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule "
+                             f"{self.schedule!r} (gpipe | 1f1b)")
 
     @property
     def layers_per_stage(self) -> int:
@@ -186,6 +211,9 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     """Next-token cross-entropy through the pipelined forward.
 
     Honors ``cfg.xent_chunk`` via the shared llama.xent_metrics epilogue.
+    ``cfg.schedule == "1f1b"`` streams the loss per drained microbatch
+    (O(n_stages) activation memory); both schedules compute the same
+    number (tested to tolerance in tests/test_pipeline.py).
     """
     if constrain is None:
         constrain = lambda x, axes: x
@@ -193,9 +221,85 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
         raise ValueError(
             "packed sequences (segment_ids) are not supported by the "
             "pipeline adapter; use the llama or moe model")
+    if cfg.schedule == "1f1b":
+        return _loss_streaming(params, batch, cfg, constrain)
     tokens = batch["tokens"]
     h = forward_hidden(params, tokens, cfg, constrain, mesh, rules)
     loss, acc, denom = llama.xent_metrics(params, h, tokens,
                                           batch.get("mask"), cfg,
                                           constrain)
     return loss, {"loss": loss, "accuracy": acc, "tokens": denom}
+
+
+def _loss_streaming(params: Params, batch: Dict[str, jax.Array],
+                    cfg: PipelineConfig, constrain
+                    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """The 1F1B-equivalent schedule: each microbatch's head+loss runs
+    in the tick that drains it from the last stage, and only scalar
+    (loss, accuracy, token-count) accumulators cross ticks — there is
+    no [M, b, S, D] output buffer, so activation memory is flat in
+    n_microbatches (the property that lets M grow until the bubble
+    (S-1)/(M+S-1) is negligible). Autodiff replays ticks newest-first,
+    interleaving each tick's stage backward with its loss backward —
+    the 1F1B alternation, derived instead of hand-scheduled.
+
+    Warm-up ticks drain a zeros buffer: rms_norm(0)=0 -> uniform
+    logits -> a FINITE dummy loss, which the validity factor zeroes
+    (finite-times-zero keeps gradients clean where NaN would poison
+    them)."""
+    tokens = batch["tokens"]
+    mask = batch.get("mask")
+    S_stages, M = cfg.n_stages, cfg.n_microbatches
+    B, S = tokens.shape
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    b = B // M
+
+    table = constrain(params["embed"].astype(cfg.dtype),
+                      ("vocab", "embed"))
+    x = table[tokens]
+    micro = x.reshape(M, b, S, x.shape[-1])
+    micro = constrain(micro, ("micro", "batch", "seq", "embed"))
+    micro_tok = tokens.reshape(M, b, S)
+    micro_mask = mask.reshape(M, b, S) if mask is not None else None
+    positions = jnp.arange(S)
+    cos, sin = llama.rope_frequencies(cfg, positions)
+
+    apply_all = jax.vmap(
+        lambda blocks, xs: _stage_apply(cfg, blocks, xs, cos, sin))
+
+    def tick(carry, t):
+        buf, lsum, asum, dsum = carry
+        inp = lax.dynamic_index_in_dim(micro, jnp.minimum(t, M - 1),
+                                       axis=0, keepdims=False)
+        buf = jnp.concatenate([inp[None], buf[:-1]], axis=0)
+        buf = constrain(buf, ("stage", "batch", "seq", "embed"))
+        buf = apply_all(params["blocks"], buf)
+        buf = constrain(buf, ("stage", "batch", "seq", "embed"))
+        idx = t - (S_stages - 1)          # microbatch draining this tick
+        safe = jnp.clip(idx, 0, M - 1)
+        h = llama.rms_norm(buf[-1], params["final_norm"], cfg.norm_eps)
+        tok = lax.dynamic_index_in_dim(micro_tok, safe, axis=0,
+                                       keepdims=False)
+        msk = (lax.dynamic_index_in_dim(micro_mask, safe, axis=0,
+                                        keepdims=False)
+               if micro_mask is not None else None)
+        loss, acc, denom = llama.xent_metrics(params, h, tok, msk, cfg,
+                                              constrain)
+        valid = (idx >= 0).astype(jnp.float32)
+        lsum = lsum + valid * loss * denom
+        asum = asum + valid * acc * denom
+        dsum = dsum + valid * denom
+        return (buf, lsum, asum, dsum), None
+
+    D = x.shape[-1]
+    buf0 = jnp.zeros((S_stages, b, S, D), cfg.dtype)
+    zero = jnp.zeros((), jnp.float32)
+    total_ticks = M + S_stages - 1
+    tick_fn = jax.checkpoint(tick, policy=llama.remat_policy(cfg))
+    (_, lsum, asum, dsum), _ = lax.scan(
+        tick_fn, (buf0, zero, zero, zero), jnp.arange(total_ticks))
+    denom = jnp.maximum(dsum, 1.0)
+    loss = lsum / denom
+    return loss, {"loss": loss, "accuracy": asum / denom,
+                  "tokens": dsum}

@@ -145,6 +145,9 @@ _SLOW_TESTS = {
     "tests/test_ring_attention.py::test_model_forward_with_sp",
     "tests/test_pipeline.py::test_pp_sharded_loss_matches_unsharded",
     "tests/test_pipeline.py::test_param_axes_match_shapes",
+    "tests/test_pipeline.py::test_1f1b_grads_match_gpipe",
+    "tests/test_pipeline.py::test_1f1b_memory_flat_in_microbatches",
+    "tests/test_pipeline.py::test_1f1b_on_pp_mesh",
     "tests/test_vit.py::test_forward_shapes",
     "tests/test_infer.py::test_kv_int8_engine_matches_fp_closely",
     "tests/test_infer.py::test_eos_stops_decode",
