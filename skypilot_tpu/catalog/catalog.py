@@ -21,7 +21,7 @@ _DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
 # Clouds with a priced offerings catalog. 'kubernetes' and 'local' have
 # none by design: their capacity is whatever the cluster/machine has, so
 # they take the synthetic-candidate path in Resources.launchables.
-CATALOG_CLOUDS = ("gcp", "aws")
+CATALOG_CLOUDS = ("gcp", "aws", "azure")
 
 
 @functools.lru_cache(maxsize=None)
@@ -37,6 +37,10 @@ def _df(cloud: str = "gcp") -> pd.DataFrame:
         elif cloud == "aws":
             from skypilot_tpu.catalog.fetchers import generate_static_aws
             generate_static_aws.main(path)
+        elif cloud == "azure":
+            from skypilot_tpu.catalog.fetchers import (
+                generate_static_azure)
+            generate_static_azure.main(path)
         else:
             raise ValueError(f"no catalog for cloud {cloud!r}")
     df = pd.read_csv(path, keep_default_na=False)

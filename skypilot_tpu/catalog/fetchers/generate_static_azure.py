@@ -1,0 +1,100 @@
+"""Generate the static Azure GPU/CPU catalog CSV.
+
+Counterpart of ``generate_static_aws.py`` for the Azure cloud,
+mirroring the reference's per-cloud data-fetcher pattern (reference:
+sky/clouds/service_catalog/data_fetchers/fetch_azure.py — enumerates
+VM SKUs + retail prices into CSVs consumed by one pandas query layer).
+Zero-egress environment: emits a checked-in snapshot of public Azure
+pay-as-you-go pricing (approximate, 2025) rather than calling the
+Retail Prices API; the query layer is identical either way.
+
+Azure has no TPUs — its rows are GPU (NC A100/H100 v4/v5, NCasT4) and
+CPU (D-series) instances, the third leg of the cross-cloud arbitrage:
+a GPU task can land on GCP, AWS, or Azure, whichever is cheapest and
+unblocked.
+
+Run:  python -m skypilot_tpu.catalog.fetchers.generate_static_azure
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+from skypilot_tpu.catalog.fetchers.generate_static import HEADER
+
+# accel, count/VM, VM size, eastus $/hr, vcpus, mem GB, regions
+GPU_VMS = [
+    ("A100-80GB", 8, "Standard_ND96amsr_A100_v4", 32.77, 96, 1924,
+     ["eastus", "westus2", "westeurope"]),
+    ("A100-80GB", 4, "Standard_NC96ads_A100_v4", 14.69, 96, 880,
+     ["eastus", "westus2", "westeurope", "japaneast"]),
+    ("A100-80GB", 2, "Standard_NC48ads_A100_v4", 7.35, 48, 440,
+     ["eastus", "westus2", "westeurope"]),
+    ("A100-80GB", 1, "Standard_NC24ads_A100_v4", 3.67, 24, 220,
+     ["eastus", "westus2", "westeurope", "japaneast"]),
+    ("H100", 8, "Standard_ND96isr_H100_v5", 98.32, 96, 1900,
+     ["eastus", "westus3"]),
+    ("T4", 1, "Standard_NC4as_T4_v3", 0.526, 4, 28,
+     ["eastus", "westus2", "westeurope", "japaneast"]),
+    ("T4", 4, "Standard_NC64as_T4_v3", 4.352, 64, 440,
+     ["eastus", "westus2"]),
+    ("V100", 1, "Standard_NC6s_v3", 3.06, 6, 112,
+     ["eastus", "westus2", "westeurope"]),
+    ("V100", 4, "Standard_NC24s_v3", 12.24, 24, 448,
+     ["eastus", "westus2"]),
+]
+
+# CPU-only (controllers, data prep) — D-series v5.
+CPU_VMS = [
+    ("Standard_D2s_v5", 0.096, 2, 8),
+    ("Standard_D4s_v5", 0.192, 4, 16),
+    ("Standard_D8s_v5", 0.384, 8, 32),
+    ("Standard_D16s_v5", 0.768, 16, 64),
+    ("Standard_D32s_v5", 1.536, 32, 128),
+    ("Standard_E8s_v5", 0.504, 8, 64),
+]
+CPU_REGIONS = ["eastus", "westus2", "westeurope", "japaneast"]
+
+# eastus anchors; other regions carry a flat multiplier.
+REGION_MULT = {"eastus": 1.0, "westus2": 1.0, "westus3": 1.0,
+               "westeurope": 1.08, "japaneast": 1.18}
+
+# Availability zones: Azure zonal regions expose zones 1-3; two are
+# emitted per region so same-region zonal failover has somewhere to go.
+ZONES = ("1", "2")
+
+SPOT_DISCOUNT = 0.35
+
+
+def rows():
+    for accel, count, size, base, vcpus, mem, regions in GPU_VMS:
+        for region in regions:
+            price = base * REGION_MULT.get(region, 1.1)
+            for z in ZONES:
+                yield [accel, count, "azure", size, 0, 1, region,
+                       f"{region}-{z}", round(price, 3),
+                       round(price * SPOT_DISCOUNT, 3), vcpus, mem]
+    for size, base, vcpus, mem in CPU_VMS:
+        for region in CPU_REGIONS:
+            price = base * REGION_MULT.get(region, 1.1)
+            for z in ZONES:
+                yield ["", 0, "azure", size, 0, 1, region,
+                       f"{region}-{z}", round(price, 3),
+                       round(price * SPOT_DISCOUNT, 3), vcpus, mem]
+
+
+def main(path: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(HEADER)
+        for row in rows():
+            w.writerow(row)
+
+
+if __name__ == "__main__":
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "data", "azure.csv")
+    main(out)
+    print(f"wrote {out}")

@@ -19,7 +19,7 @@ from skypilot_tpu.utils import paths
 
 # Known providers, in display order. 'local' is the in-process fake
 # cloud used by tests and demos; it is always credentialed.
-CLOUDS = ("gcp", "aws", "kubernetes", "local")
+CLOUDS = ("gcp", "aws", "azure", "kubernetes", "local")
 
 
 def _cache_path() -> str:
@@ -35,6 +35,9 @@ def _check_one(cloud: str) -> Tuple[bool, str]:
     if cloud == "aws":
         from skypilot_tpu.provision import aws_auth
         return aws_auth.check_credentials()
+    if cloud == "azure":
+        from skypilot_tpu.provision import azure_auth
+        return azure_auth.check_credentials()
     if cloud == "kubernetes":
         try:
             from skypilot_tpu.provision import kubernetes as k8s

@@ -1,0 +1,62 @@
+"""Azure ARM credentials, zero-SDK.
+
+Reference parity: sky/adaptors/azure.py + sky/clouds/azure.py
+check_credentials — the reference rides the azure-mgmt SDKs; here the
+credential IS an ARM bearer token obtained from the ``az`` CLI the
+repo's Azure Blob store already depends on (``az account
+get-access-token``), or from ``AZURE_ACCESS_TOKEN`` +
+``AZURE_SUBSCRIPTION_ID`` directly (CI / workload identity setups that
+mint tokens out-of-band).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Optional, Tuple
+
+_cached: Optional[dict] = None
+_cached_until = 0.0
+
+
+class AzureCredentials:
+    def __init__(self, token: str, subscription: str):
+        self.token = token
+        self.subscription = subscription
+
+
+def load_credentials() -> Optional[AzureCredentials]:
+    """Bearer token + subscription id, or None when unauthenticated.
+    az-CLI tokens are cached until shortly before their expiry."""
+    global _cached, _cached_until
+    tok = os.environ.get("AZURE_ACCESS_TOKEN")
+    sub = os.environ.get("AZURE_SUBSCRIPTION_ID")
+    if tok and sub:
+        return AzureCredentials(tok, sub)
+    if _cached is not None and time.time() < _cached_until:
+        return AzureCredentials(_cached["accessToken"],
+                                _cached["subscription"])
+    try:
+        out = subprocess.run(
+            ["az", "account", "get-access-token", "--output", "json"],
+            capture_output=True, text=True, timeout=30)
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    data = json.loads(out.stdout)
+    _cached = data
+    # expiresOn is local-format; re-fetch conservatively after 10 min
+    # rather than parsing its locale-dependent shape.
+    _cached_until = time.time() + 600
+    return AzureCredentials(data["accessToken"], data["subscription"])
+
+
+def check_credentials() -> Tuple[bool, str]:
+    creds = load_credentials()
+    if creds is None:
+        return False, ("no Azure credentials (run `az login`, or set "
+                       "AZURE_ACCESS_TOKEN + AZURE_SUBSCRIPTION_ID)")
+    return True, f"subscription {creds.subscription}"

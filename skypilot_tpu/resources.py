@@ -58,7 +58,8 @@ class Resources:
             catalog.parse_accelerator(self.accelerators)  # validate
         parse_count(self.cpus, "cpus")
         parse_count(self.memory, "memory")
-        if self.cloud not in (None, "gcp", "aws", "kubernetes", "local"):
+        from skypilot_tpu import check as _check
+        if self.cloud not in (None, *_check.CLOUDS):
             raise ValueError(f"unknown cloud {self.cloud!r}")
         if self.is_tpu() and self.runtime_version is None:
             object.__setattr__(self, "runtime_version",

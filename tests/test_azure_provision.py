@@ -1,0 +1,332 @@
+"""Azure ARM provider tests against a stateful fake ARM API.
+
+Reference parity: the surface of sky/provision/azure/instance.py
+(run/stop/terminate/query/open_ports), tested the way this repo tests
+AWS (tests/test_aws_provision.py): a fake transport that models ARM's
+resource-group/PUT-upsert semantics, so create/resume/spot/ports/
+failover-mapping all run offline.
+"""
+
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import azure
+from skypilot_tpu.provision.common import ProvisionConfig
+
+
+class FakeArm:
+    """Minimal stateful ARM: resources keyed by path, RG-scoped,
+    PUT = upsert, DELETE of an RG removes everything under it. VM
+    power states transition instantly (start/deallocate POSTs)."""
+
+    def __init__(self):
+        self.resources = {}        # canonical path -> body
+        self.power = {}            # vm path -> "running"/"deallocated"
+        self.fail_vm_create = None  # ARM error code to raise on VM PUT
+        self.calls = []
+
+    # -- path helpers -------------------------------------------------------
+    @staticmethod
+    def _split(path):
+        p, _, query = path.partition("?")
+        return p, query
+
+    def __call__(self, method, path, body):
+        p, _ = self._split(path)
+        self.calls.append((method, p))
+        if method == "PUT":
+            return self._put(p, body)
+        if method == "GET":
+            return self._get(p)
+        if method == "POST":
+            return self._post(p)
+        if method == "DELETE":
+            return self._delete(p)
+        raise AssertionError(f"unexpected method {method}")
+
+    def _put(self, p, body):
+        if "/virtualMachines/" in p and self.fail_vm_create:
+            code = self.fail_vm_create
+            return 409, {"error": {"code": code,
+                                   "message": f"fake {code}"}}
+        if "/securityRules/" in p:
+            # Model real ARM: a rule subresource PUT merges into the
+            # parent NSG's securityRules (replacing a same-name rule) —
+            # so tests DO catch a full-body NSG PUT wiping added rules.
+            nsg_path, rule_name = p.split("/securityRules/")
+            nsg = self.resources.get(nsg_path)
+            if nsg is None:
+                return 404, {"error": {"code": "ParentResourceNotFound",
+                                       "message": nsg_path}}
+            rules = nsg.setdefault("properties", {}).setdefault(
+                "securityRules", [])
+            rules[:] = [r for r in rules if r.get("name") != rule_name]
+            rules.append({"name": rule_name, **body})
+            return 200, body
+        self.resources[p] = body
+        if "/virtualMachines/" in p:
+            self.power[p] = "running"
+            self.resources[p] = dict(body, name=p.rsplit("/", 1)[1])
+        if "/publicIPAddresses/" in p:
+            n = sum(1 for k in self.resources
+                    if "/publicIPAddresses/" in k)
+            self.resources[p] = dict(
+                body, properties={**body.get("properties", {}),
+                                  "ipAddress": f"20.0.0.{n}"})
+        if "/networkInterfaces/" in p:
+            n = sum(1 for k in self.resources
+                    if "/networkInterfaces/" in k)
+            props = dict(body.get("properties", {}))
+            for ipc in props.get("ipConfigurations", []):
+                ipc.setdefault("properties", {})[
+                    "privateIPAddress"] = f"10.0.0.{n}"
+            self.resources[p] = dict(body, properties=props)
+        return 200, self.resources[p]
+
+    def _get(self, p):
+        if p.endswith("/instanceView"):
+            vm = p[:-len("/instanceView")]
+            state = self.power.get(vm)
+            if state is None:
+                return 404, {"error": {"code": "ResourceNotFound",
+                                       "message": "no vm"}}
+            return 200, {"statuses": [
+                {"code": "ProvisioningState/succeeded"},
+                {"code": f"PowerState/{state}"}]}
+        if p.endswith("/virtualMachines"):
+            rg = p.split("/resourceGroups/")[1].split("/")[0]
+            vms = [v for k, v in sorted(self.resources.items())
+                   if f"/resourceGroups/{rg}/" in k
+                   and "/virtualMachines/" in k
+                   and not k.endswith("/instanceView")]
+            return 200, {"value": vms}
+        if p in self.resources:
+            return 200, self.resources[p]
+        return 404, {"error": {"code": "ResourceNotFound",
+                               "message": p}}
+
+    def _post(self, p):
+        if p.endswith("/start"):
+            vm = p[:-len("/start")]
+            if vm not in self.power:
+                return 404, {"error": {"code": "ResourceNotFound",
+                                       "message": vm}}
+            self.power[vm] = "running"
+            return 202, {}
+        if p.endswith("/deallocate"):
+            vm = p[:-len("/deallocate")]
+            if vm not in self.power:
+                return 404, {"error": {"code": "ResourceNotFound",
+                                       "message": vm}}
+            self.power[vm] = "deallocated"
+            return 202, {}
+        return 404, {"error": {"code": "NotFound", "message": p}}
+
+    def _delete(self, p):
+        # RG delete: everything under the group goes.
+        m = re.match(r"^/subscriptions/[^/]+/resourceGroups/([^/?]+)$", p)
+        if m:
+            rg = m.group(1)
+            doomed = [k for k in self.resources
+                      if f"/resourceGroups/{rg}/" in k]
+            if not doomed and p not in self.resources:
+                return 404, {"error": {"code": "ResourceGroupNotFound",
+                                       "message": rg}}
+            for k in doomed:
+                self.resources.pop(k, None)
+            self.resources.pop(p, None)
+            for k in [k for k in self.power
+                      if f"/resourceGroups/{rg}/" in k]:
+                self.power.pop(k)
+            return 202, {}
+        self.resources.pop(p, None)
+        return 200, {}
+
+
+@pytest.fixture()
+def fake(monkeypatch, tmp_path):
+    # get_or_generate_keys needs a key; point at a throwaway one.
+    key = tmp_path / "sky-key"
+    pub = tmp_path / "sky-key.pub"
+    pub.write_text("ssh-ed25519 AAAATESTKEY test")
+    key.write_text("private")
+    monkeypatch.setenv("SKYPILOT_TPU_SSH_KEY", str(key))
+    arm = FakeArm()
+    azure.set_transport(arm)
+    yield arm
+    azure.set_transport(None)
+
+
+def _config(name="azc", nodes=1, **kw):
+    return ProvisionConfig(
+        cluster_name=name, num_nodes=nodes, hosts_per_node=1,
+        zone="eastus-1", region="eastus",
+        instance_type="Standard_NC24ads_A100_v4", **kw)
+
+
+def test_create_cluster(fake):
+    record = azure.run_instances(_config(nodes=2))
+    assert record.created_instance_ids == ["azc-0", "azc-1"]
+    assert not record.resumed
+    azure.wait_instances("azc", "eastus-1")
+    assert azure.query_instances("azc", "eastus-1") == "UP"
+    # The network stack exists: RG put, NSG with the SSH rule, VNet.
+    nsg = next(v for k, v in fake.resources.items()
+               if k.endswith("networkSecurityGroups/skytpu-azc-nsg"))
+    rules = nsg["properties"]["securityRules"]
+    assert any(r["properties"]["destinationPortRange"] == "22"
+               for r in rules)
+    # VM carries the cluster tag, ssh key, and the Ubuntu image.
+    vm = next(v for k, v in fake.resources.items()
+              if k.endswith("virtualMachines/azc-0"))
+    assert vm["tags"][azure.CLUSTER_TAG] == "azc"
+    assert vm["properties"]["storageProfile"]["imageReference"][
+        "offer"].startswith("0001-com-ubuntu")
+    assert "AAAATESTKEY" in str(vm["properties"]["osProfile"])
+    assert vm["zones"] == ["1"]
+
+
+def test_run_is_idempotent_and_resumes(fake):
+    azure.run_instances(_config())
+    # Second run: nothing new created.
+    record = azure.run_instances(_config())
+    assert record.created_instance_ids == []
+    assert not record.resumed
+    # Stop, then run again: the VM restarts instead of a new create.
+    azure.stop_instances("azc", "eastus-1")
+    assert azure.query_instances("azc", "eastus-1") == "STOPPED"
+    record = azure.run_instances(_config())
+    assert record.resumed and record.created_instance_ids == []
+    assert azure.query_instances("azc", "eastus-1") == "UP"
+
+
+def test_spot_custom_image_and_labels(fake):
+    azure.run_instances(_config(use_spot=True,
+                                image_id="myPublisher:offer:sku:1.2.3",
+                                labels={"team": "ml"}))
+    vm = next(v for k, v in fake.resources.items()
+              if k.endswith("virtualMachines/azc-0"))
+    assert vm["properties"]["priority"] == "Spot"
+    assert vm["properties"]["evictionPolicy"] == "Deallocate"
+    assert vm["properties"]["storageProfile"]["imageReference"] == {
+        "publisher": "myPublisher", "offer": "offer", "sku": "sku",
+        "version": "1.2.3"}
+    assert vm["tags"]["team"] == "ml"
+
+
+def test_managed_image_id(fake):
+    azure.run_instances(_config(
+        image_id="/subscriptions/s/resourceGroups/g/providers/"
+                 "Microsoft.Compute/images/custom"))
+    vm = next(v for k, v in fake.resources.items()
+              if k.endswith("virtualMachines/azc-0"))
+    assert vm["properties"]["storageProfile"]["imageReference"][
+        "id"].endswith("images/custom")
+
+
+def test_ports_open_as_nsg_rules(fake):
+    azure.run_instances(_config(ports=[8080, 8081]))
+    nsg = next(v for k, v in fake.resources.items()
+               if k.endswith("networkSecurityGroups/skytpu-azc-nsg"))
+    ranges = {r["properties"]["destinationPortRange"]
+              for r in nsg["properties"]["securityRules"]}
+    assert {"22", "8080", "8081"} <= ranges
+    # Post-hoc exposure adds a rule without clobbering existing ones.
+    azure.open_ports("azc", [9090])
+    nsg = next(v for k, v in fake.resources.items()
+               if k.endswith("networkSecurityGroups/skytpu-azc-nsg"))
+    by_name = {r["name"]: r for r in nsg["properties"]["securityRules"]}
+    assert by_name["skytpu-port-9090"]["properties"][
+        "destinationPortRange"] == "9090"
+    # Re-opening the same port is a no-op (idempotent).
+    azure.open_ports("azc", [8080])
+
+
+def test_capacity_and_quota_errors_map_to_failover_taxonomy(fake):
+    fake.fail_vm_create = "SkuNotAvailable"
+    with pytest.raises(exceptions.CapacityError):
+        azure.run_instances(_config())
+    fake.fail_vm_create = "QuotaExceeded"
+    with pytest.raises(exceptions.QuotaExceededError):
+        azure.run_instances(_config(name="azq"))
+    fake.fail_vm_create = "AuthorizationFailed"
+    with pytest.raises(exceptions.NoCloudAccessError):
+        azure.run_instances(_config(name="aza"))
+
+
+def test_cluster_info_and_runners(fake):
+    azure.run_instances(_config(nodes=2))
+    info = azure.get_cluster_info("azc", "eastus-1")
+    assert [h.host_id for h in info.hosts] == [0, 1]
+    assert all(h.external_ip and h.external_ip.startswith("20.0.0.")
+               for h in info.hosts)
+    assert all(h.internal_ip.startswith("10.0.0.") for h in info.hosts)
+    assert info.head.ssh_user == "azureuser"
+    runners = azure.get_command_runners(info)
+    assert len(runners) == 2
+
+
+def test_terminate_deletes_resource_group(fake):
+    azure.run_instances(_config())
+    assert any("/skytpu-azc/" in k for k in fake.resources)
+    azure.terminate_instances("azc", "eastus-1")
+    assert not any("/skytpu-azc/" in k for k in fake.resources)
+    assert azure.query_instances("azc", "eastus-1") == "NOT_FOUND"
+    # Terminating again is clean (RG already gone).
+    azure.terminate_instances("azc", "eastus-1")
+
+
+def test_provision_dispatcher_routes_azure(fake):
+    from skypilot_tpu import provision
+    provision.run_instances("azure", _config())
+    assert provision.query_instances("azure", "azc", "eastus-1") == "UP"
+    provision.open_ports("azure", "azc", [7000], "eastus-1")
+    assert provision.supports("azure", provision.Feature.STOP)
+    provision.terminate_instances("azure", "azc", "eastus-1")
+
+
+def test_region_of_zone():
+    assert azure._region_of_zone("eastus-1") == ("eastus", "1")
+    assert azure._region_of_zone("westeurope-2") == ("westeurope", "2")
+    assert azure._region_of_zone("eastus") == ("eastus", None)
+
+
+def test_bad_image_id_fails_loudly(fake):
+    with pytest.raises(exceptions.InvalidTaskError):
+        azure.run_instances(_config(image_id="not-a-valid-image"))
+
+
+def test_relaunch_preserves_posthoc_ports(fake):
+    """Rules added by open_ports must survive a stop + relaunch: ARM
+    NSG PUTs replace securityRules wholesale, so _ensure_network must
+    not re-PUT the full body over an existing NSG."""
+    azure.run_instances(_config(ports=[8080]))
+    azure.open_ports("azc", [9090])
+    azure.stop_instances("azc", "eastus-1")
+    azure.run_instances(_config(ports=[8080]))
+    nsg = next(v for k, v in fake.resources.items()
+               if k.endswith("networkSecurityGroups/skytpu-azc-nsg"))
+    ranges = {r["properties"]["destinationPortRange"]
+              for r in nsg["properties"]["securityRules"]}
+    assert "9090" in ranges, ranges
+    assert {"22", "8080"} <= ranges
+
+
+def test_rg_delete_does_not_cross_prefix_boundary(fake):
+    azure.run_instances(_config(name="azc"))
+    azure.run_instances(_config(name="azc2"))
+    azure.terminate_instances("azc", "eastus-1")
+    assert azure.query_instances("azc", "eastus-1") == "NOT_FOUND"
+    assert azure.query_instances("azc2", "eastus-1") == "UP"
+
+
+def test_wait_bounded_with_fake_transport(fake):
+    azure.run_instances(_config())
+    fake.power = {k: "starting" for k in fake.power}
+    import time as _t
+    t0 = _t.time()
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        azure.wait_instances("azc", "eastus-1", timeout=600)
+    assert _t.time() - t0 < 5

@@ -88,3 +88,36 @@ def test_both_clouds_exhausted_raises_with_history(clouds):
     hist = getattr(ei.value, "failover_history", [])
     assert hist, "no failover history recorded"
     assert fake_gcp.calls > 0
+
+
+@pytest.fixture
+def three_clouds(clouds, monkeypatch):
+    """The two-cloud fixture plus the fake ARM: azure becomes the third
+    failover leg."""
+    from skypilot_tpu.provision import azure
+    from tests.test_azure_provision import FakeArm
+    fake_arm = FakeArm()
+    azure.set_transport(fake_arm)
+    try:
+        yield (*clouds, fake_arm)
+    finally:
+        azure.set_transport(None)
+
+
+def test_gcp_and_aws_stockout_fail_over_to_azure(three_clouds):
+    """A100-80GB:8 is offered by all three catalogs (azure's
+    ND96amsr is the cheapest 8-GPU box). GCP capacity is gone and EC2
+    keeps erroring, so the SAME cluster must land on Azure — the third
+    leg of the arbitrage."""
+    fake_gcp, fake_ec2, fake_arm = three_clouds
+    fake_ec2.capacity_errors = 99
+    task = Task(name="gpu", run="nvidia-smi")
+    task.set_resources(Resources(accelerators="A100-80GB:8"))
+    handle = RetryingProvisioner().provision(task, "xc3")
+    assert handle.provider == "azure"
+    assert handle.resources.instance_type == "Standard_ND96amsr_A100_v4"
+    assert any("/virtualMachines/" in k for k in fake_arm.resources)
+    from skypilot_tpu.provision import azure
+    assert azure.query_instances("xc3", handle.zone) == "UP"
+    rec = state.get_cluster("xc3")
+    assert state.ClusterStatus(rec["status"]) == state.ClusterStatus.UP
