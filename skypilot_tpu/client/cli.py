@@ -503,6 +503,33 @@ def cost_report():
 
 
 @cli.group()
+def local():
+    """Local kubernetes-in-docker (kind) cluster for real-k8s runs
+    without any cloud credentials."""
+
+
+@local.command(name="up")
+@click.option("--name", default=None,
+              help="kind cluster name (default skytpu-local)")
+def local_up(name):
+    """Create (or reuse) a kind cluster and enable the kubernetes
+    cloud against it."""
+    from skypilot_tpu import core as core_mod
+    ctx = core_mod.local_up(name or core_mod.LOCAL_KIND_CLUSTER)
+    click.echo(f"local kubernetes up (kubectl context {ctx}); "
+               "launch with: skytpu launch --cloud kubernetes ...")
+
+
+@local.command(name="down")
+@click.option("--name", default=None)
+def local_down(name):
+    """Delete the local kind cluster."""
+    from skypilot_tpu import core as core_mod
+    core_mod.local_down(name or core_mod.LOCAL_KIND_CLUSTER)
+    click.echo("local kubernetes deleted")
+
+
+@cli.group()
 def api():
     """The local API server (async request execution + dashboard)."""
 
