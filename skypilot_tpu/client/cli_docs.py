@@ -1,0 +1,66 @@
+"""Generate the CLI reference (docs/cli.md) from the click tree.
+
+Reference parity: the reference's docs site generates its CLI page
+from the click objects (docs/source/reference/cli.rst via
+sphinx-click); this is the markdown equivalent, kept fresh by
+tests/test_cli.py::test_cli_reference_up_to_date.
+
+Run:  python -m skypilot_tpu.client.cli_docs > docs/cli.md
+"""
+
+from __future__ import annotations
+
+import click
+
+from skypilot_tpu.client import cli as cli_mod
+
+
+def _params(cmd: click.Command) -> str:
+    rows = []
+    for p in cmd.params:
+        if isinstance(p, click.Argument):
+            rows.append(f"`{p.name.upper()}`"
+                        + ("" if p.required else " (optional)"))
+        elif isinstance(p, click.Option):
+            names = "/".join(p.opts)
+            rows.append(f"`{names}` — {p.help or ''}".rstrip(" —"))
+    return "".join(f"\n  - {r}" for r in rows)
+
+
+def _walk(cmd: click.Command, path: str, out: list, depth: int) -> None:
+    help_line = (cmd.help or cmd.short_help or "").strip().split("\n\n")[0]
+    help_line = " ".join(help_line.split())
+    if isinstance(cmd, click.Group):
+        if depth > 0:
+            out.append(f"\n## `{path}`\n\n{help_line}\n")
+        for name in sorted(cmd.commands):
+            _walk(cmd.commands[name], f"{path} {name}".strip(), out,
+                  depth + 1)
+    else:
+        out.append(f"\n### `{path}`\n\n{help_line}{_params(cmd)}\n")
+
+
+def generate() -> str:
+    out = [
+        "# CLI reference",
+        "",
+        "Generated from the `skytpu` click tree — do not edit by hand",
+        "(`python -m skypilot_tpu.client.cli_docs > docs/cli.md`).",
+        "",
+        "## Top-level commands",
+    ]
+    root = cli_mod.cli
+    groups = []
+    for name in sorted(root.commands):
+        cmd = root.commands[name]
+        if isinstance(cmd, click.Group):
+            groups.append((name, cmd))
+        else:
+            _walk(cmd, f"skytpu {name}", out, 1)
+    for name, grp in groups:
+        _walk(grp, f"skytpu {name}", out, 1)
+    return "\n".join(out) + "\n"
+
+
+if __name__ == "__main__":
+    print(generate(), end="")

@@ -143,9 +143,17 @@ def local_up(name: str = LOCAL_KIND_CLUSTER) -> str:
     context = f"kind-{name}"
     # kind switches kubectl's current-context itself; make it explicit
     # so a user mid-way into another cluster is not silently retargeted
-    # without record.
-    subprocess.run(["kubectl", "config", "use-context", context],
-                   capture_output=True, text=True, timeout=60)
+    # without record. A FAILED switch must abort: the credential check
+    # below validates the CURRENT context, and launches would otherwise
+    # land on whatever cluster (possibly production) it points at.
+    switched = subprocess.run(["kubectl", "config", "use-context",
+                               context],
+                              capture_output=True, text=True, timeout=60)
+    if switched.returncode != 0:
+        raise exceptions.ProvisionError(
+            f"kubectl could not switch to context {context!r} "
+            f"(is KUBECONFIG pointing somewhere kind did not write?):\n"
+            f"{switched.stderr[-500:]}")
     # check() raises NoCloudAccessError (with cloud-credential
     # remediation advice) when NOTHING is enabled — wrong message for
     # a local-kind user; convert to the kind-specific error either way.

@@ -1,0 +1,80 @@
+"""Corpus prep CLI: tokenized documents -> packed train shards.
+
+The data-prep step of a training pipeline (llm/pipeline-qlora-serve.
+yaml): reads token documents, packs them into fixed [rows, seq]
+buffers with segment ids (native packer when built — see
+input_pipeline.pack), and writes .npz shards that train/eval consume
+with --packed.
+
+Input formats:
+  * ``.jsonl``  — one JSON array of token ids per line
+  * ``.npy``    — object array of int arrays
+  * ``synthetic:N`` — N synthetic documents (demos/tests)
+
+Run:  python -m skypilot_tpu.data.prep_corpus \
+          --input corpus.jsonl --seq 2048 --rows 64 --out /artifacts/packed
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+from skypilot_tpu.data import input_pipeline
+
+
+def _read_docs(path: str, vocab_size: int, seq: int):
+    if path.startswith("synthetic:"):
+        n = int(path.split(":", 1)[1])
+        yield from input_pipeline.synthetic_doc_stream(
+            n, vocab_size, mean_len=max(seq // 3, 16), seed=0)
+        return
+    if path.endswith(".npy"):
+        for doc in np.load(path, allow_pickle=True):
+            yield np.asarray(doc, np.int32)
+        return
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield np.asarray(json.loads(line), np.int32)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", required=True,
+                    help=".jsonl / .npy of token docs, or synthetic:N")
+    ap.add_argument("--out", required=True,
+                    help="output directory for packed-XXXXX.npz shards")
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--rows", type=int, default=64,
+                    help="rows per shard (one shard = one .npz)")
+    ap.add_argument("--vocab-size", type=int, default=128256,
+                    help="only used by synthetic: inputs")
+    ap.add_argument("--pad-id", type=int, default=0)
+    args = ap.parse_args()
+
+    os.makedirs(args.out, exist_ok=True)
+    docs = _read_docs(args.input, args.vocab_size, args.seq)
+    n_shards = 0
+    n_tokens = 0
+    for batch in input_pipeline.packed_batches(
+            docs, args.rows, args.seq, pad_id=args.pad_id):
+        shard = os.path.join(args.out, f"packed-{n_shards:05d}.npz")
+        np.savez(shard, **batch)
+        n_tokens += int(batch["mask"].sum())
+        n_shards += 1
+    meta = {"shards": n_shards, "tokens": n_tokens, "seq": args.seq,
+            "rows": args.rows,
+            "native_packer": input_pipeline._load_native() is not None}
+    with open(os.path.join(args.out, "META.json"), "w") as f:
+        json.dump(meta, f)
+    print(json.dumps(meta), file=sys.stdout, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -143,3 +143,18 @@ def test_api_lifecycle(runner, tmp_path, monkeypatch):
     finally:
         res = runner.invoke(cli_mod.cli, ["api", "stop"])
     assert "Stopped" in res.output
+
+
+def test_cli_reference_up_to_date():
+    """docs/cli.md is generated from the click tree; a CLI change must
+    regenerate it (python -m skypilot_tpu.client.cli_docs > docs/cli.md)."""
+    import os
+
+    from skypilot_tpu.client import cli_docs
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "cli.md")
+    with open(path) as f:
+        on_disk = f.read()
+    assert on_disk == cli_docs.generate(), (
+        "docs/cli.md is stale — regenerate with "
+        "`python -m skypilot_tpu.client.cli_docs > docs/cli.md`")
