@@ -204,24 +204,19 @@ class InferenceEngine:
 
         # Burst decode: k steps in one device program -> one host round
         # trip per k tokens. Crucial when dispatch latency rivals the
-        # per-token compute (small models, remote/relayed TPUs).
+        # per-token compute (small models, remote/relayed TPUs). The
+        # program is the STAGED formulation — in-burst rows accumulate
+        # in a small staging buffer and flush to the cache once per
+        # burst, keeping the big cache a loop invariant (see
+        # kvcache.decode_burst_staged; ~25% faster than a scan of
+        # per-step cache updates on an 8B model).
         @functools.partial(jax.jit, donate_argnums=(1, 2),
                            static_argnames=("k",))
         def _decode_burst(params, cache, rng, active, *, k,
                           qweights=None):
-            from jax import lax as _lax
-            rng, sub = jax.random.split(rng)
-
-            def body(c, key):
-                c, logits = kvcache.decode_step(params, c, cfg,
-                                                qweights=qweights)
-                toks = sampling.sample(logits, key, sp)
-                c = kvcache.commit_tokens(c, toks, active)
-                return c, toks
-
-            cache, toks = _lax.scan(body, cache,
-                                    jax.random.split(sub, k))
-            return cache, rng, toks                # [k, slots]
+            return kvcache.decode_burst_staged(
+                params, cache, rng, active, k, cfg, sp,
+                qweights=qweights)
 
         self._admit_wave_fn = _admit_wave
         self._decode_fn = _decode
