@@ -225,8 +225,9 @@ def main() -> None:
             # while traffic is arriving and slots remain, and go long
             # (max_burst 32, amortizing relay dispatch) once slots are
             # full or arrivals go quiet. The full_load companion phase
-            # measures 32/32 on the same warm server (~740-790 tok/s
-            # median-of-3; engine-only decode is ~1.17k).
+            # measures 32/32 on the same warm server (~1.24k tok/s
+            # median-of-3 with the staged burst; engine-only decode is
+            # ~1.4k — the HTTP/LB tax is down to single digits).
             serve = bench_serve.run_http(
                 config=serve_cfg, requests=24, slots=32,
                 new_tokens=192, max_burst=32, open_burst=4,

@@ -374,7 +374,8 @@ def run_http(config=None, requests=16, slots=16, prompt_len=None,
     # Second phase on the SAME warm server: every slot filled
     # (throughput-optimal load, vs the headroom load above that the
     # TTFT numbers use). Engine-only decode at 32 full slots measures
-    # ~1.17k tok/s on v5e; this reports what survives HTTP + LB.
+    # ~1.4k tok/s on v5e (staged burst); this reports what survives
+    # HTTP + LB (~1.24k).
     full = None
     if full_load and requests >= slots:
         log(f"full-load phase skipped: requests ({requests}) already "
