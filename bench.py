@@ -227,7 +227,7 @@ def main() -> None:
             # full or arrivals go quiet. The full_load companion phase
             # measures 32/32 on the same warm server (~1.24k tok/s
             # median-of-3 with the staged burst; engine-only decode is
-            # ~1.4k — the HTTP/LB tax is down to single digits).
+            # ~1.4k — an ~11% HTTP/LB tax, down from ~30% in r4).
             serve = bench_serve.run_http(
                 config=serve_cfg, requests=24, slots=32,
                 new_tokens=192, max_burst=32, open_burst=4,
