@@ -20,6 +20,18 @@ def params(cfg):
     return llama.init_params(jax.random.key(0), cfg)
 
 
+@pytest.fixture(scope="module")
+def moe_setup():
+    """moe-tiny with generous capacity (no routing drops) + params —
+    shared by every MoE inference test."""
+    import dataclasses
+
+    from skypilot_tpu.models import moe
+    mcfg = dataclasses.replace(moe.CONFIGS["moe-tiny"],
+                               capacity_factor=4.0)
+    return moe, mcfg, moe.init_params(jax.random.key(0), mcfg)
+
+
 def greedy_reference(params, cfg, prompt, n_new):
     """Greedy decode via repeated full forwards (the slow oracle)."""
     toks = list(prompt)
@@ -165,16 +177,10 @@ def test_engine_with_tp_sharded_params(cfg, params):
     assert got == want
 
 
-def test_moe_engine_serves():
+def test_moe_engine_serves(moe_setup):
     """The engine serves sparse MoE models: incremental decode logits
     match the full forward (generous capacity so no routing drops)."""
-    import dataclasses
-
-    from skypilot_tpu.models import moe
-
-    mcfg = dataclasses.replace(moe.CONFIGS["moe-tiny"],
-                               capacity_factor=4.0)
-    mparams = moe.init_params(jax.random.key(0), mcfg)
+    moe, mcfg, mparams = moe_setup
     prompt = [3, 17, 42, 7]
 
     # Incremental: prefill then two decode steps.
@@ -273,3 +279,38 @@ def test_weights_int8_composes_with_kv_int8(cfg, params):
     out = e.generate([[5, 9, 31]], max_new_tokens=5)[0]
     assert len(out) == 5
     assert all(0 <= t < cfg.vocab_size for t in out)
+
+
+@pytest.mark.parametrize("family", ["llama", "moe"])
+def test_staged_burst_cache_matches_oracle(family, cfg, params,
+                                           moe_setup):
+    """The staged burst's ONE-flush cache write must leave the cache
+    exactly as the per-step path would: after a burst, a single
+    decode_step's logits agree with a full forward over the whole
+    generated sequence (wrong flush indices/lengths would corrupt
+    attention here, not just shift tokens). Parametrized over the
+    dense llama path and the MoE (_ffn experts) branch."""
+    if family == "llama":
+        mcfg, mparams = cfg, params
+        fwd = lambda seq: llama.forward(
+            mparams, jnp.asarray([seq], jnp.int32), mcfg)[0, -1]
+    else:
+        moe, mcfg, mparams = moe_setup
+        fwd = lambda seq: moe.forward(
+            mparams, jnp.asarray([seq], jnp.int32), mcfg)[0][0, -1]
+    e = eng.InferenceEngine(mparams, mcfg, n_slots=2, max_len=64,
+                            prompt_buckets=(8,))
+    prompt = [3, 17, 42, 7]
+    e.add_request(list(prompt), max_new_tokens=16)
+    e.admit()
+    out = e.decode_burst(max_burst=4)       # staged program, k=4
+    (req,) = e.slot_req.values()
+    assert len(req.tokens) == 5             # admission token + burst
+    assert list(out.values())[0] == req.tokens[1:]
+
+    # Logits for the NEXT position via the burst-flushed cache...
+    _, logits = kvcache.decode_step(e.params, e.cache, mcfg)
+    got = np.asarray(logits[req.slot])
+    # ...vs the from-scratch oracle over prompt + generated tokens.
+    want = np.asarray(fwd(prompt + req.tokens))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=6e-2)
