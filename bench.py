@@ -77,6 +77,11 @@ def main() -> None:
     ap.add_argument("--qlora-batch", type=int, default=2)
     ap.add_argument("--qlora-seq", type=int, default=2048)
     ap.add_argument("--qlora-rank", type=int, default=16)
+    ap.add_argument("--emit-metrics", action="store_true", default=False,
+                    help="snapshot the observability registry into the "
+                         "output JSON under 'observability' — the same "
+                         "counters/histograms production scrapes from "
+                         "/metrics, so BENCH records carry them")
     args = ap.parse_args()
 
     import jax
@@ -301,6 +306,22 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"serve bench failed: {e}")
             out["serve_error"] = str(e)[:200]
+    if args.emit_metrics:
+        from skypilot_tpu.observability import metrics as obs_metrics
+        # Only families something actually recorded into: a bench run
+        # exercises a slice of the stack, and all-zero families for the
+        # rest would bury the signal. A labeled child exists only once
+        # someone called labels(); unlabeled families always carry their
+        # implicit default child, so those need a nonzero value/count.
+        def _recorded(fam):
+            for s in fam["samples"]:
+                if s["labels"] or s.get("count", 0) or s.get("value", 0):
+                    return True
+            return False
+
+        snap = obs_metrics.REGISTRY.snapshot()
+        out["observability"] = {
+            name: fam for name, fam in snap.items() if _recorded(fam)}
     print(json.dumps(out), flush=True)
 
 

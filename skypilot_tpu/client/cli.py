@@ -117,14 +117,91 @@ def exec_cmd(cluster, yaml_or_command, name, detach_run):
         sky.tail_logs(cluster, job_id, follow=True)
 
 
+def _print_metrics_view(text: str, raw: bool) -> None:
+    """Render /metrics exposition as a compact table (or raw)."""
+    from skypilot_tpu.observability import metrics as metrics_lib
+    if raw:
+        click.echo(text.rstrip("\n"))
+        return
+    families = metrics_lib.parse_exposition(text)
+    fmt = "{:<44}{:<10}{:>14}  {}"
+    click.echo(fmt.format("METRIC", "TYPE", "VALUE", "LABELS"))
+    for name in sorted(families):
+        fam = families[name]
+        if fam["type"] == "histogram":
+            # One row per series: count and mean latency.
+            by_series = {}
+            for labels, value in fam["samples"]:
+                sample = labels.pop("__name__", name)
+                key = tuple(sorted(
+                    (k, v) for k, v in labels.items() if k != "le"))
+                agg = by_series.setdefault(key, {"count": 0.0, "sum": 0.0})
+                if sample.endswith("_count"):
+                    agg["count"] = value
+                elif sample.endswith("_sum"):
+                    agg["sum"] = value
+            for key, agg in sorted(by_series.items()):
+                mean = agg["sum"] / agg["count"] if agg["count"] else 0.0
+                labels_s = ",".join(f"{k}={v}" for k, v in key)
+                click.echo(fmt.format(
+                    name, "histogram",
+                    f"n={agg['count']:.0f} avg={mean:.4g}", labels_s))
+            continue
+        for labels, value in sorted(fam["samples"],
+                                    key=lambda s: sorted(s[0].items())):
+            labels_s = ",".join(f"{k}={v}"
+                                for k, v in sorted(labels.items()))
+            click.echo(fmt.format(name, fam["type"],
+                                  f"{value:g}", labels_s))
+
+
 @cli.command()
 @click.option("--refresh", "-r", is_flag=True, default=False)
 @click.option("--ip", "show_ip", is_flag=True, default=False,
               help="Print only the head host IP of ONE cluster "
                    "(external when it has one), for scripting.")
+@click.option("--metrics", "show_metrics", is_flag=True, default=False,
+              help="Show the API server's live metrics (scraped from "
+                   "its GET /metrics) instead of the cluster table.")
+@click.option("--raw", is_flag=True, default=False,
+              help="With --metrics: print the Prometheus text "
+                   "exposition verbatim.")
 @click.argument("clusters", nargs=-1)
-def status(refresh, show_ip, clusters):
-    """Show clusters."""
+def status(refresh, show_ip, show_metrics, raw, clusters):
+    """Show clusters (or, with --metrics, live server metrics)."""
+    if raw and not show_metrics:
+        raise click.ClickException("--raw only applies with --metrics")
+    if show_metrics:
+        if clusters or refresh or show_ip:
+            raise click.ClickException(
+                "--metrics shows the API server's registry and cannot "
+                "be combined with cluster names, --refresh, or --ip")
+        import urllib.error
+        import urllib.request
+        from skypilot_tpu.client import sdk as sdk_mod
+        req = urllib.request.Request(sdk_mod._url() + "/metrics",
+                                     headers=sdk_mod._headers())
+        try:
+            resp = urllib.request.urlopen(req, timeout=10)
+        except urllib.error.HTTPError as e:
+            # The server IS up — don't tell the user to restart it.
+            raise click.ClickException(
+                f"GET {sdk_mod._url()}/metrics failed: "
+                f"HTTP {e.code} {e.reason}")
+        except OSError:
+            raise click.ClickException(
+                f"API server at {sdk_mod._url()} is not reachable "
+                f"(try `skytpu api start`)")
+        try:
+            with resp:
+                text = resp.read().decode()
+        except OSError as e:
+            # Connected but the body read died: the server is up — a
+            # "restart it" hint here would misdirect.
+            raise click.ClickException(
+                f"GET {sdk_mod._url()}/metrics failed mid-read: {e}")
+        _print_metrics_view(text, raw)
+        return
     if show_ip:
         # Reference parity: `sky status --ip` (sky/cli.py status).
         if len(clusters) != 1:

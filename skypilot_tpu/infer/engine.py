@@ -26,6 +26,47 @@ import numpy as np
 
 from skypilot_tpu.infer import kvcache, sampling
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.utils import timeline
+
+# Live serving metrics (docs/observability.md). Span names match the
+# histogram names exactly, so a Perfetto trace and a /metrics scrape
+# describe the same instrumentation points.
+PREFILL_SECONDS = metrics.histogram(
+    "skytpu_prefill_seconds",
+    "Admission-wave prefill latency, dispatch to first-token fetch, "
+    "by prompt bucket", labelnames=("bucket",))
+PREFILL_REQUESTS = metrics.counter(
+    "skytpu_prefill_requests_total",
+    "Requests prefilled, by prompt bucket", labelnames=("bucket",))
+WAVE_SIZE = metrics.histogram(
+    "skytpu_admission_wave_size",
+    "Real (pre-padding) requests per admission wave",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
+DECODE_STEP_SECONDS = metrics.histogram(
+    "skytpu_decode_step_seconds",
+    "Decode device-call latency, dispatch to token fetch (one call "
+    "decodes a burst of k tokens per active slot)")
+DECODE_TOKENS = metrics.counter(
+    "skytpu_decode_tokens_total",
+    "Output tokens committed to requests by decode")
+TTFT_SECONDS = metrics.histogram(
+    "skytpu_ttft_seconds",
+    "Per-request time to first token (submit/enqueue to first token)")
+TPOT_SECONDS = metrics.histogram(
+    "skytpu_tpot_seconds",
+    "Per-request mean time per output token after the first",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5))
+SLOTS_ACTIVE = metrics.gauge(
+    "skytpu_slots_active", "Decode slots currently serving a request")
+SLOTS_TOTAL = metrics.gauge(
+    "skytpu_slots_total", "Configured decode slot pool size")
+ENGINE_WAITING = metrics.gauge(
+    "skytpu_engine_waiting",
+    "Requests accepted by the engine but not yet prefilled")
+REQUESTS_FINISHED = metrics.counter(
+    "skytpu_requests_finished_total", "Requests fully generated")
 
 
 @dataclasses.dataclass
@@ -48,6 +89,9 @@ class BurstHandle:
     toks: jax.Array                   # [k, slots+1] on device
     k: int
     slot_req: Dict[int, "Request"]    # slot->request snapshot at dispatch
+    # Span opened at dispatch, closed when the tokens are fetched —
+    # double-records into skytpu_decode_step_seconds.
+    span: Optional[timeline.Event] = None
 
 
 def _bucket(n: int, buckets) -> int:
@@ -149,6 +193,8 @@ class InferenceEngine:
         # host-side (one outstanding async burst at a time is the
         # expected pattern; the count caps the next burst).
         self._inflight_tokens = 0
+        SLOTS_TOTAL.set(n_slots)
+        self._update_gauges()
 
         sp = self.sampling_params
 
@@ -252,7 +298,12 @@ class InferenceEngine:
                       eos_id=self.eos_id)
         self._next_rid += 1
         self.waiting.append(req)
+        ENGINE_WAITING.set(len(self.waiting))
         return req.rid
+
+    def _update_gauges(self) -> None:
+        SLOTS_ACTIVE.set(len(self.slot_req))
+        ENGINE_WAITING.set(len(self.waiting))
 
     def _admit(self, on_wave=None) -> None:
         # Waves are grouped by prompt bucket (prefill is O(S^2): one
@@ -291,19 +342,27 @@ class InferenceEngine:
                         rest.append(req)
                 self.waiting = rest + self.waiting
                 dispatched.append(
-                    (wave, slots, self._dispatch_wave(wave, slots,
-                                                      bucket)))
-            for wave, slots, first_dev in dispatched:
-                self._complete_wave(wave, slots, first_dev)
+                    (wave, slots, bucket) + self._dispatch_wave(
+                        wave, slots, bucket))
+            for wave, slots, bucket, first_dev, span in dispatched:
+                self._complete_wave(wave, slots, first_dev, span, bucket)
                 if on_wave is not None:
                     on_wave()
             # on_wave may have drained fresh arrivals into ``waiting``
             # — the outer loop admits them while slots remain.
 
     def _dispatch_wave(self, wave: List["Request"], slots: List[int],
-                       bucket: int) -> jax.Array:
+                       bucket: int) -> Tuple[jax.Array, timeline.Event]:
         """Enqueue one wave's prefill+insert program; returns the
-        (device) first-token array without forcing a host sync."""
+        (device) first-token array without forcing a host sync, plus
+        the open prefill span (closed at completion — the span covers
+        dispatch THROUGH first-token fetch, the latency a request
+        actually experiences)."""
+        WAVE_SIZE.observe(len(wave))
+        span = timeline.Event(
+            "skytpu_prefill_seconds",
+            histogram=PREFILL_SECONDS.labels(bucket=str(bucket)))
+        span.begin()
         if self.pad_waves:
             n = self.max_wave
         else:
@@ -319,20 +378,25 @@ class InferenceEngine:
             self.params, self.cache, jnp.asarray(tokens_b),
             jnp.asarray(true_lens), jnp.asarray(slot_ids), self.rng,
             bucket=bucket, qweights=self.qweights)
-        return first
+        return first, span
 
     def _complete_wave(self, wave: List["Request"], slots: List[int],
-                       first_dev: jax.Array) -> None:
+                       first_dev: jax.Array, span: timeline.Event,
+                       bucket: int) -> None:
         first = np.asarray(first_dev)          # host sync for THIS wave
+        span.end()
         now = time.time()
         for i, (req, slot) in enumerate(zip(wave, slots)):
             tok = int(first[i])
             req.slot = slot
             req.tokens.append(tok)
             req.first_token_s = now
+            PREFILL_REQUESTS.labels(bucket=str(bucket)).inc()
+            TTFT_SECONDS.observe(max(now - req.submit_s, 0.0))
             self.slot_req[slot] = req
             if self._req_finished(req, tok):
                 self._retire(req)
+        self._update_gauges()
 
 
     # -- stepping ----------------------------------------------------------
@@ -352,10 +416,16 @@ class InferenceEngine:
         # dispatch per finished request (reset() still zeroes all).
         req.done = True
         self.finished.append(req)
+        REQUESTS_FINISHED.inc()
+        if req.first_token_s is not None and len(req.tokens) > 1:
+            TPOT_SECONDS.observe(
+                max(time.time() - req.first_token_s, 0.0)
+                / (len(req.tokens) - 1))
         if req.slot is not None:
             self.slot_req.pop(req.slot, None)
             self.free_slots.append(req.slot)
             req.slot = None
+        SLOTS_ACTIVE.set(len(self.slot_req))
 
     def step(self) -> Dict[int, int]:
         """Admit waiting requests, decode one token per active slot.
@@ -383,6 +453,7 @@ class InferenceEngine:
         self.free_slots = list(range(self.n_slots))
         self._inflight_tokens = 0
         self.cache["length"] = jnp.zeros_like(self.cache["length"])
+        self._update_gauges()
 
     def step_burst(self, max_burst: int = 8,
                    on_wave=None) -> Dict[int, List[int]]:
@@ -442,11 +513,15 @@ class InferenceEngine:
         active = np.zeros((self.n_slots + 1,), bool)
         for s in self.slot_req:
             active[s] = True
+        span = timeline.Event("skytpu_decode_step_seconds",
+                              histogram=DECODE_STEP_SECONDS)
+        span.begin()
         self.cache, self.rng, toks = self._decode_burst_fn(
             self.params, self.cache, self.rng, jnp.asarray(active), k=k,
             qweights=self.qweights)
         self._inflight_tokens += k
-        return BurstHandle(toks=toks, k=k, slot_req=dict(self.slot_req))
+        return BurstHandle(toks=toks, k=k, slot_req=dict(self.slot_req),
+                           span=span)
 
     def complete_decode_burst(self, handle: "BurstHandle"
                               ) -> Dict[int, List[int]]:
@@ -455,8 +530,11 @@ class InferenceEngine:
         snapshot taken at dispatch. Requests retired by an earlier
         completion are skipped (their surplus tokens are discarded)."""
         toks = np.asarray(handle.toks)             # [k, slots]
+        if handle.span is not None:
+            handle.span.end()
         self._inflight_tokens -= handle.k
         out: Dict[int, List[int]] = {}
+        n_emitted = 0
         for slot, req in handle.slot_req.items():
             if req.done:
                 continue
@@ -469,6 +547,9 @@ class InferenceEngine:
                     self._retire(req)
                     break
             out[req.rid] = emitted
+            n_emitted += len(emitted)
+        if n_emitted:
+            DECODE_TOKENS.inc(n_emitted)
         return out
 
     def step_decode_once(self) -> Dict[int, int]:
@@ -478,10 +559,12 @@ class InferenceEngine:
         active = np.zeros((self.n_slots + 1,), bool)
         for s in self.slot_req:
             active[s] = True
-        self.cache, self.rng, toks = self._decode_fn(
-            self.params, self.cache, self.rng, jnp.asarray(active),
-            qweights=self.qweights)
-        toks = np.asarray(toks)
+        with timeline.Event("skytpu_decode_step_seconds",
+                            histogram=DECODE_STEP_SECONDS):
+            self.cache, self.rng, toks = self._decode_fn(
+                self.params, self.cache, self.rng, jnp.asarray(active),
+                qweights=self.qweights)
+            toks = np.asarray(toks)
         out: Dict[int, int] = {}
         for slot, req in list(self.slot_req.items()):
             tok = int(toks[slot])
@@ -489,6 +572,7 @@ class InferenceEngine:
             out[req.rid] = tok
             if self._req_finished(req, tok):
                 self._retire(req)
+        DECODE_TOKENS.inc(len(out))
         return out
 
     def run_to_completion(self, max_burst: int = 8) -> List[Request]:

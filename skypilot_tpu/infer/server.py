@@ -6,6 +6,10 @@ thread batches concurrent requests into shared decode bursts.
 
 Endpoints:
   GET  /health              -> 200 {"status": "ok"} once warm
+  GET  /metrics             -> Prometheus text exposition of the
+                               process registry (engine TTFT/TPOT
+                               histograms, slot occupancy, queue depth,
+                               HTTP latencies; docs/observability.md)
   POST /generate            {"tokens": [...], "max_new_tokens": N}
                             -> {"tokens": [...], "ttft_ms": ..., ...}
   POST /generate + "stream": true
@@ -32,6 +36,31 @@ import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 from socketserver import ThreadingMixIn
 from typing import Dict, Optional
+
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.utils import timeline
+
+HTTP_SECONDS = metrics.histogram(
+    "skytpu_http_request_seconds",
+    "Model-server HTTP request latency (streaming requests span the "
+    "full generation)", labelnames=("route",))
+HTTP_REQUESTS = metrics.counter(
+    "skytpu_http_requests_total",
+    "Model-server HTTP requests by route and status code",
+    labelnames=("route", "code"))
+INBOX_DEPTH = metrics.gauge(
+    "skytpu_server_inbox_depth",
+    "Requests accepted by handler threads, not yet drained into the "
+    "engine (queue depth ahead of admission)")
+PENDING_REQUESTS = metrics.gauge(
+    "skytpu_server_pending_requests",
+    "Requests in flight in the serving loop (drained, not finished)")
+BURST_FLUSHES = metrics.counter(
+    "skytpu_server_burst_flushes_total",
+    "Async decode bursts landed (fetched + streamed) by the loop")
+WAVE_FLUSH_SECONDS = metrics.histogram(
+    "skytpu_server_wave_flush_seconds",
+    "Post-admission-wave flush (stream first tokens + re-drain inbox)")
 
 
 class _Pending:
@@ -118,6 +147,7 @@ class ModelServer:
         with self._inbox_lock:
             self._inbox.append((list(tokens), max_new_tokens, p))
             self._last_arrival = time.monotonic()
+            INBOX_DEPTH.set(len(self._inbox))
         return p
 
     def submit(self, tokens, max_new_tokens: int) -> Dict:
@@ -149,9 +179,15 @@ class ModelServer:
 
     def _loop(self) -> None:
         # Warm the compile path before /health flips: the load balancer
-        # must not route traffic into a cold XLA compile.
+        # must not route traffic into a cold XLA compile. The warmup
+        # runs the fully instrumented path and the compile dominates
+        # it — observed, that one sample would skew the serving
+        # histograms' (TTFT/prefill/decode-step) sums and means for the
+        # life of the process, so it records nothing (the trainer skips
+        # its compile step for the same reason).
         try:
-            self.engine.generate([[1]], max_new_tokens=2)
+            with metrics.suppress():
+                self.engine.generate([[1]], max_new_tokens=2)
             self.engine.finished.clear()
         except Exception as e:  # noqa: BLE001
             print(f"model server warmup failed: {e}", file=sys.stderr)
@@ -183,6 +219,10 @@ class ModelServer:
                         p.chunks.put({"error": p.result["error"]})
                     p.event.set()
                 self._pending.clear()
+                # The gauge tracks _pending; left stale it would report
+                # the pre-failure in-flight count for the whole outage
+                # window — exactly when an operator reads it.
+                PENDING_REQUESTS.set(0)
                 busy = False
             if not busy:
                 time.sleep(0.002)
@@ -190,6 +230,7 @@ class ModelServer:
     def _drain_inbox(self) -> None:
         with self._inbox_lock:
             new, self._inbox = self._inbox, []
+            INBOX_DEPTH.set(0)
         for tokens, max_new, p in new:
             rid = self.engine.add_request(tokens, max_new)
             # add_request appends to engine.waiting; keep the Request so
@@ -200,6 +241,8 @@ class ModelServer:
             # not when the loop got around to admitting it.
             p.req.submit_s = p.enqueued_s
             self._pending[rid] = p
+        if new:
+            PENDING_REQUESTS.set(len(self._pending))
 
     def _flush_streams(self) -> None:
         """Push newly decoded tokens to every pending stream. Works for
@@ -214,6 +257,8 @@ class ModelServer:
                 p.cursor += len(new)
                 p.chunks.put({"tokens": list(new)})
 
+    @timeline.event(name="skytpu_server_wave_flush_seconds",
+                    histogram=WAVE_FLUSH_SECONDS)
     def _on_wave(self) -> None:
         # After each admission wave: stream its first tokens, then pull
         # any requests that arrived DURING the wave's prefill into this
@@ -229,6 +274,7 @@ class ModelServer:
         if self._burst is not None:
             handle, self._burst = self._burst, None
             self.engine.complete_decode_burst(handle)
+            BURST_FLUSHES.inc()
             self._flush_streams()
 
     def _step(self) -> bool:
@@ -290,6 +336,8 @@ class ModelServer:
                 p.chunks.put({"done": True, "ttft_ms": ttft,
                               "n_tokens": len(req.tokens)})
             p.event.set()
+        if self.engine.finished:
+            PENDING_REQUESTS.set(len(self._pending))
         self.engine.finished.clear()
         return True
 
@@ -305,9 +353,25 @@ class _Threading(ThreadingMixIn, HTTPServer):
     request_queue_size = 128
 
 
+_KNOWN_ROUTES = frozenset({"/health", "/metrics", "/generate"})
+
+
 def make_handler(model: ModelServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+
+        def _observe(self, code: int) -> None:
+            route = self.path.split("?", 1)[0]
+            if route not in _KNOWN_ROUTES:
+                # Label children are never evicted; arbitrary scanner
+                # paths must not mint unbounded series.
+                route = "other"
+            HTTP_REQUESTS.labels(route=route, code=str(code)).inc()
+            t0 = getattr(self, "_t0", None)
+            if t0 is not None:
+                HTTP_SECONDS.labels(route=route).observe(
+                    time.monotonic() - t0)
+                self._t0 = None
 
         def _json(self, code, obj):
             body = json.dumps(obj).encode()
@@ -316,12 +380,17 @@ def make_handler(model: ModelServer):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            self._observe(code)
 
         def do_GET(self):
+            self._t0 = time.monotonic()
             if self.path == "/health":
                 if model._ready.is_set():
                     return self._json(200, {"status": "ok"})
                 return self._json(503, {"status": "warming"})
+            if self.path == "/metrics":
+                metrics.write_exposition(self)
+                return self._observe(200)
             return self._json(404, {"error": "not found"})
 
         def _stream(self, chunks):
@@ -339,17 +408,25 @@ def make_handler(model: ModelServer):
                 # batch.
                 self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
 
+            code = 200
             try:
                 for chunk in chunks:
                     write_chunk(json.dumps(chunk).encode() + b"\n")
-            except BrokenPipeError:
-                return  # client went away mid-stream
+            except ConnectionError:
+                # Client went away mid-stream (broken pipe OR a reset —
+                # flaky LBs produce both): count it as 499 (client
+                # closed request), not a success.
+                code = 499
+                return
+            finally:
+                self._observe(code)
             try:
                 self.wfile.write(b"0\r\n\r\n")
-            except BrokenPipeError:
+            except ConnectionError:
                 pass
 
         def do_POST(self):
+            self._t0 = time.monotonic()
             if self.path != "/generate":
                 return self._json(404, {"error": "not found"})
             length = int(self.headers.get("Content-Length") or 0)

@@ -21,10 +21,20 @@ from skypilot_tpu import exceptions, provision
 from skypilot_tpu import state as cluster_state
 from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
 from skypilot_tpu.jobs import recovery_strategy, state
+from skypilot_tpu.observability import metrics as obs_metrics
 from skypilot_tpu.runtime.job_queue import JobStatus
 from skypilot_tpu.task import Task
 
 POLL_SECONDS = float(os.environ.get("SKYTPU_JOBS_POLL", "2"))
+
+PREEMPTIONS = obs_metrics.counter(
+    "skytpu_jobs_preemptions_total",
+    "Managed-job cluster losses detected by the monitor (slice "
+    "preempted or job died with the cluster unhealthy)")
+RECOVERY_ATTEMPTS = obs_metrics.counter(
+    "skytpu_jobs_recovery_attempts_total",
+    "Managed-job recovery attempts, by outcome",
+    labelnames=("outcome",))
 
 
 class JobsController:
@@ -197,6 +207,7 @@ class JobsController:
                                      state.ManagedJobStatus.FAILED,
                                      error="task failed on healthy cluster")
                     return False, job_id, handle
+                PREEMPTIONS.inc()
                 recovered = self._recover()
                 if recovered is None:
                     return False, job_id, handle
@@ -208,12 +219,14 @@ class JobsController:
         state.bump_recovery(self.job_id)     # cumulative, for display
         self.task_recoveries += 1            # per-task budget
         if self.task_recoveries > recovery_strategy.MAX_RECOVERY_ATTEMPTS:
+            RECOVERY_ATTEMPTS.labels(outcome="exhausted").inc()
             state.set_status(self.job_id, state.ManagedJobStatus.FAILED,
                              error="max recovery attempts exceeded")
             return None
         if not state.set_status(self.job_id,
                                 state.ManagedJobStatus.RECOVERING):
             # Cancel landed while _monitor was probing — don't relaunch.
+            RECOVERY_ATTEMPTS.labels(outcome="cancelled").inc()
             self._log("cancelled during recovery; tearing down")
             state.set_status(self.job_id, state.ManagedJobStatus.CANCELLED)
             return None
@@ -224,14 +237,17 @@ class JobsController:
             finally:
                 state.release_launch_slot(self.job_id)
         except exceptions.ResourcesUnavailableError as e:
+            RECOVERY_ATTEMPTS.labels(outcome="no_resource").inc()
             state.set_status(self.job_id,
                              state.ManagedJobStatus.FAILED_NO_RESOURCE,
                              error=str(e))
             return None
         if not state.transition_to_running(self.job_id):
+            RECOVERY_ATTEMPTS.labels(outcome="cancelled").inc()
             self._log("cancelled during recovery; tearing down")
             state.set_status(self.job_id, state.ManagedJobStatus.CANCELLED)
             return None
+        RECOVERY_ATTEMPTS.labels(outcome="recovered").inc()
         return job_id, handle
 
     # -- probes ------------------------------------------------------------

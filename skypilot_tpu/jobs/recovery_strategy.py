@@ -17,11 +17,18 @@ from typing import Optional, Set, Tuple
 
 from skypilot_tpu import exceptions, execution, state as cluster_state
 from skypilot_tpu.backend import ClusterHandle, RetryingProvisioner, TpuVmBackend
+from skypilot_tpu.observability import metrics as obs_metrics
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils.registry import JOBS_RECOVERY_STRATEGY_REGISTRY
 
 DEFAULT_STRATEGY = "EAGER_NEXT_ZONE"
 MAX_RECOVERY_ATTEMPTS = 10
+
+RECOVERY_LAUNCHES = obs_metrics.counter(
+    "skytpu_jobs_recovery_launches_total",
+    "Cluster relaunches performed by recovery strategies (the eager "
+    "strategy's blocked-zone fallback counts as a second launch)",
+    labelnames=("strategy",))
 
 
 class StrategyExecutor:
@@ -64,6 +71,7 @@ class StrategyExecutor:
                 cluster_state.remove_cluster(self.cluster_name)
 
     def _relaunch(self, blocked: Set) -> Tuple[int, ClusterHandle]:
+        RECOVERY_LAUNCHES.labels(strategy=type(self).__name__).inc()
         provisioner = RetryingProvisioner(retry_until_up=True)
         handle = provisioner.provision(self.task, self.cluster_name,
                                        initial_blocked=blocked)

@@ -14,7 +14,14 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.observability import metrics as obs_metrics
 from skypilot_tpu.utils import db, paths
+
+MANAGED_TERMINAL = obs_metrics.counter(
+    "skytpu_managed_jobs_terminal_total",
+    "Managed jobs reaching a terminal status in this process, by "
+    "status (first-wins: only the write that applied counts)",
+    labelnames=("status",))
 
 
 class ManagedJobStatus(enum.Enum):
@@ -164,7 +171,10 @@ def set_status(job_id: int, status: ManagedJobStatus,
                 "UPDATE managed_jobs SET status=?, last_error="
                 f"COALESCE(?, last_error) WHERE job_id=?{guard}",
                 (status.value, error, job_id, *blocked))
-        return cur.rowcount > 0
+        applied = cur.rowcount > 0
+    if applied and status.is_terminal():
+        MANAGED_TERMINAL.labels(status=status.value).inc()
+    return applied
 
 
 def transition_to_running(job_id: int) -> bool:

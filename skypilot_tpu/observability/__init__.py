@@ -1,0 +1,26 @@
+"""Live observability: process-global metrics registry + exposition.
+
+``from skypilot_tpu.observability import metrics`` is the one import an
+instrumentation site needs; declare families at module import with
+``metrics.counter/gauge/histogram`` and record on the hot path. Any
+HTTP surface serves ``metrics.render()`` as ``GET /metrics``
+(Content-Type :data:`metrics.CONTENT_TYPE`).
+
+Traces and metrics correlate by name: a ``timeline.Event`` given a
+``histogram=`` child double-records the same span into Perfetto (when
+``SKYTPU_TIMELINE_FILE_PATH`` is set) and into the histogram (always).
+"""
+
+from skypilot_tpu.observability.metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    Counter,
+    Gauge,
+    Histogram,
+    Metric,
+    REGISTRY,
+    Registry,
+    counter,
+    gauge,
+    histogram,
+    render,
+)

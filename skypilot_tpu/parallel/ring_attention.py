@@ -52,6 +52,22 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+if hasattr(jax, "shard_map"):              # jax >= 0.5
+    _shard_map_impl = jax.shard_map
+else:                                      # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def _shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across the 0.4 -> 0.5 rename: the replication
+    check kwarg was ``check_rep`` before it became ``check_vma``."""
+    try:
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=check_vma)
+    except TypeError:
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=check_vma)
+
 NEG_INF = -1e30
 
 
@@ -523,7 +539,7 @@ def zigzag_ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                                            heads_axis, q, k)
     has_seg = segment_ids is not None
     seg = segment_ids if has_seg else _dummy_seg(q)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_zigzag_attn, axis, n, has_seg),
         mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
         out_specs=q_spec, check_vma=False)
@@ -611,7 +627,7 @@ def ring_attention(q, k, v, mesh: Mesh, causal: bool = True,
                                            heads_axis, q, k)
     has_seg = segment_ids is not None
     seg = segment_ids if has_seg else _dummy_seg(q)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_ring_attn, axis, n, causal, has_seg),
         mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
         out_specs=q_spec, check_vma=False)
@@ -642,7 +658,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = True,
                 f"{axis}={n}; use ring_attention instead")
     has_seg = segment_ids is not None
     seg = segment_ids if has_seg else _dummy_seg(q)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_ulysses_local, axis, n, causal, has_seg),
         mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
         out_specs=q_spec, check_vma=False)

@@ -19,6 +19,18 @@ from skypilot_tpu.utils import db
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.observability import metrics as obs_metrics
+
+JOB_TRANSITIONS = obs_metrics.counter(
+    "skytpu_jobs_transitions_total",
+    "Job status transitions recorded in this process, by new status",
+    labelnames=("status",))
+JOBS_BY_STATE = obs_metrics.gauge(
+    "skytpu_jobs_by_state",
+    "Jobs in the cluster job DB by status (refreshed by "
+    "update_state_gauges — the skylet tick and /metrics scrapes)",
+    labelnames=("status",))
+
 
 class JobStatus(enum.Enum):
     INIT = "INIT"
@@ -70,21 +82,30 @@ def add_job(db_path: str, name: Optional[str], run_cmd: str,
             " VALUES (?,?,?,?,?)",
             (name, time.time(), JobStatus.PENDING.value, run_cmd,
              json.dumps(metadata or {})))
-        return int(cur.lastrowid)
+        job_id = int(cur.lastrowid)
+    # Count only after the INSERT committed: the metric must not claim
+    # transitions the DB never saw.
+    JOB_TRANSITIONS.labels(status=JobStatus.PENDING.value).inc()
+    return job_id
 
 
 def set_status(db_path: str, job_id: int, status: JobStatus) -> None:
     now = time.time()
     with _db(db_path) as c:
         if status == JobStatus.RUNNING:
-            c.execute("UPDATE jobs SET status=?, started_at=? WHERE job_id=?",
-                      (status.value, now, job_id))
+            cur = c.execute(
+                "UPDATE jobs SET status=?, started_at=? WHERE job_id=?",
+                (status.value, now, job_id))
         elif status.is_terminal():
-            c.execute("UPDATE jobs SET status=?, ended_at=? WHERE job_id=?",
-                      (status.value, now, job_id))
+            cur = c.execute(
+                "UPDATE jobs SET status=?, ended_at=? WHERE job_id=?",
+                (status.value, now, job_id))
         else:
-            c.execute("UPDATE jobs SET status=? WHERE job_id=?",
-                      (status.value, job_id))
+            cur = c.execute("UPDATE jobs SET status=? WHERE job_id=?",
+                            (status.value, job_id))
+        applied = cur.rowcount > 0
+    if applied:
+        JOB_TRANSITIONS.labels(status=status.value).inc()
 
 
 def set_run_cmd(db_path: str, job_id: int, run_cmd: str) -> None:
@@ -148,6 +169,24 @@ def last_activity_time(db_path: str) -> float:
             "SELECT MAX(COALESCE(ended_at, started_at, submitted_at))"
             " FROM jobs").fetchone()
     return float(row[0]) if row and row[0] else 0.0
+
+
+def update_state_gauges(db_path: str) -> Dict[str, int]:
+    """Refresh ``skytpu_jobs_by_state`` from the DB (every status gets
+    a sample, zeroed when empty, so scrapes see transitions back to
+    zero). Returns the counts for callers that want them."""
+    counts = {s.value: 0 for s in JobStatus}
+    try:
+        with _db(db_path) as c:
+            for status, n in c.execute(
+                    "SELECT status, COUNT(*) FROM jobs GROUP BY status"):
+                if status in counts:
+                    counts[status] = n
+    except (sqlite3.Error, OSError):
+        return counts   # daemon metrics must never take the tick down
+    for status, n in counts.items():
+        JOBS_BY_STATE.labels(status=status).set(n)
+    return counts
 
 
 def _to_rec(row) -> Dict[str, Any]:

@@ -19,7 +19,19 @@ import os
 import sys
 import time
 
+from skypilot_tpu.observability import metrics as obs_metrics
 from skypilot_tpu.runtime import constants, job_queue, topology
+from skypilot_tpu.utils import timeline
+
+SKYLET_TICKS = obs_metrics.counter(
+    "skytpu_skylet_ticks_total", "Skylet poll-loop iterations")
+SKYLET_HEARTBEAT = obs_metrics.gauge(
+    "skytpu_skylet_last_tick_timestamp_seconds",
+    "Unix time of the skylet's last poll tick; scrape-side heartbeat "
+    "age = now - this")
+AUTOSTOP_FIRED = obs_metrics.counter(
+    "skytpu_autostop_fired_total",
+    "Autostop stop/terminate actions taken", labelnames=("down",))
 
 
 def _read_autostop(cdir: str):
@@ -30,10 +42,25 @@ def _read_autostop(cdir: str):
         return None
 
 
+def observe_tick(db: str) -> None:
+    """Per-tick observability: liveness + job-state gauges for scrapers
+    of this daemon's registry, and a throttled atomic trace flush
+    (save_periodic skips ticks with little news — re-serializing the
+    whole buffer every poll would eat short poll intervals alive)."""
+    SKYLET_TICKS.inc()
+    SKYLET_HEARTBEAT.set(time.time())
+    job_queue.update_state_gauges(db)
+    try:
+        timeline.save_periodic()
+    except OSError:
+        pass    # an unwritable trace path must not take the tick down
+
+
 def run(cluster_name: str, poll_interval: float) -> int:
     cdir = topology.cluster_dir(cluster_name)
     db = os.path.join(cdir, constants.JOB_DB)
     while True:
+        observe_tick(db)
         try:
             meta = topology.load(cdir)
         except (OSError, ValueError):
@@ -58,10 +85,13 @@ def run(cluster_name: str, poll_interval: float) -> int:
                     else:
                         provision.stop_instances(
                             meta["provider"], cluster_name, meta["zone"])
+                    AUTOSTOP_FIRED.labels(
+                        down=str(bool(cfg.get("down")))).inc()
                     with open(os.path.join(cdir, "autostop_fired"),
                               "w") as f:
                         f.write(json.dumps(
                             {"at": time.time(), "down": cfg.get("down")}))
+                    timeline.save_now()
                     return 0
                 except Exception as e:  # noqa: BLE001
                     if getattr(e, "no_failover", False):

@@ -33,9 +33,28 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 from socketserver import ThreadingMixIn
 from typing import Any, Dict, Optional
 
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.requests_db import RequestStatus
 from skypilot_tpu.utils import paths
+
+API_REQUESTS = metrics.counter(
+    "skytpu_api_requests_total",
+    "API-server requests accepted for async execution, by endpoint",
+    labelnames=("endpoint",))
+API_REQUESTS_FINISHED = metrics.counter(
+    "skytpu_api_requests_finished_total",
+    "Async API requests reaped by the executor, by final status",
+    labelnames=("status",))
+API_WORKERS_BUSY = metrics.gauge(
+    "skytpu_api_workers_busy",
+    "Worker subprocesses currently executing API requests")
+API_REQUEST_SECONDS = metrics.histogram(
+    "skytpu_api_request_seconds",
+    "Async API request wall time, dispatch to worker exit, by endpoint",
+    labelnames=("endpoint",),
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+             300.0, 1800.0))
 
 MAX_CONCURRENT_REQUESTS = int(os.environ.get("SKYTPU_API_WORKERS", "8"))
 # Terminal requests older than this are garbage-collected (logs too).
@@ -61,6 +80,7 @@ class Executor(threading.Thread):
     def __init__(self):
         super().__init__(daemon=True)
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._spawned_at: Dict[str, tuple] = {}   # rid -> (name, t0)
         self._stop = threading.Event()
         self._last_gc = 0.0
 
@@ -97,11 +117,14 @@ class Executor(threading.Thread):
              "--request-id", rec["request_id"]], env=env)
         requests_db.set_pid(rec["request_id"], proc.pid)
         self._procs[rec["request_id"]] = proc
+        self._spawned_at[rec["request_id"]] = (rec["name"], time.time())
+        API_WORKERS_BUSY.set(len(self._procs))
 
     def _reap(self) -> None:
         for rid, proc in list(self._procs.items()):
             if proc.poll() is not None:
                 del self._procs[rid]
+                API_WORKERS_BUSY.set(len(self._procs))
                 # Worker died before recording a result? Mark failed.
                 rec = requests_db.get(rid)
                 if rec and not rec["status"].is_terminal():
@@ -109,6 +132,14 @@ class Executor(threading.Thread):
                         rid, RequestStatus.FAILED,
                         error=f"worker exited with {proc.returncode} "
                               f"without recording a result")
+                    rec = requests_db.get(rid)
+                name, t0 = self._spawned_at.pop(rid, (None, None))
+                if name is not None:
+                    API_REQUEST_SECONDS.labels(endpoint=name).observe(
+                        time.time() - t0)
+                if rec:
+                    API_REQUESTS_FINISHED.labels(
+                        status=rec["status"].value).inc()
 
     def stop(self) -> None:
         self._stop.set()
@@ -194,6 +225,7 @@ def make_handler(auth_token: Optional[str] = None):
                 return self._json(404, {"error": f"no endpoint {path}"})
             rid = requests_db.create(name, self._body(),
                                      user=self._client_identity())
+            API_REQUESTS.labels(endpoint=name).inc()
             return self._json(200, {"request_id": rid})
 
         def do_GET(self):
@@ -204,6 +236,14 @@ def make_handler(auth_token: Optional[str] = None):
             if parsed.path == "/api/health":
                 return self._json(200, {"status": "healthy",
                                         "version": _version()})
+            if parsed.path == "/metrics":
+                # This process's registry: executor gauges, request
+                # counters, and anything library code running in the
+                # server process recorded (GETs may auth via ?token=,
+                # which is how a Prometheus scrape_config's params
+                # carry the credential).
+                metrics.write_exposition(self)
+                return
             if parsed.path == "/api/clusters":
                 from skypilot_tpu import state as gstate
                 rows = []

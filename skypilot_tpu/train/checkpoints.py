@@ -26,6 +26,22 @@ from typing import Any, Optional
 
 import jax
 
+from skypilot_tpu.observability import metrics as obs_metrics
+from skypilot_tpu.utils import timeline
+
+# Saves are async: ``_save_seconds`` is the dispatch cost the train
+# loop pays inline; ``_wait_seconds`` is the durability tail paid at
+# wait(). Their sum bounds the true checkpoint wall time.
+CKPT_SAVE_SECONDS = obs_metrics.histogram(
+    "skytpu_checkpoint_save_seconds",
+    "CheckpointManager.save dispatch latency (async saves: the inline "
+    "cost only)")
+CKPT_WAIT_SECONDS = obs_metrics.histogram(
+    "skytpu_checkpoint_wait_seconds",
+    "CheckpointManager.wait latency (async save durability tail)")
+CKPT_SAVES = obs_metrics.counter(
+    "skytpu_checkpoint_saves_total", "Checkpoint saves accepted")
+
 
 class CheckpointManager:
     """Thin wrapper over ``orbax.checkpoint.CheckpointManager``.
@@ -63,8 +79,14 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Queue an async save. Returns False if skipped by interval."""
-        return self._mgr.save(
-            step, args=self._ocp.args.StandardSave(state), force=force)
+        with timeline.Event("skytpu_checkpoint_save_seconds",
+                            histogram=CKPT_SAVE_SECONDS):
+            saved = self._mgr.save(
+                step, args=self._ocp.args.StandardSave(state),
+                force=force)
+        if saved:
+            CKPT_SAVES.inc()
+        return saved
 
     def restore(self, target: Optional[Any] = None,
                 step: Optional[int] = None) -> Any:
@@ -89,7 +111,9 @@ class CheckpointManager:
 
     def wait(self) -> None:
         """Block until queued async saves are durable."""
-        self._mgr.wait_until_finished()
+        with timeline.Event("skytpu_checkpoint_wait_seconds",
+                            histogram=CKPT_WAIT_SECONDS):
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
         self._mgr.close()

@@ -266,6 +266,7 @@ def main() -> None:
             state, metrics = step_fn(state, batch_data)
         if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
             loss = float(metrics["loss"])
+            trainer.observe_loss(loss)
             log(f"step {step + 1}/{args.steps} loss={loss:.4f}")
         if mgr and (step + 1) % args.ckpt_every == 0:
             mgr.save(step + 1, state)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -28,7 +29,65 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import metrics as obs_metrics
 from skypilot_tpu.parallel import sharding as sh
+
+# Step-time note: the histogram records the HOST-side step call. The
+# state is donated, so dispatching step k+1 blocks until step k's
+# buffers free — at steady state the call duration converges to the
+# true device step time without ever forcing a host sync for the
+# metric's sake.
+STEP_SECONDS = obs_metrics.histogram(
+    "skytpu_train_step_seconds",
+    "Train step call latency (back-pressured by donated state to the "
+    "device step time at steady state)")
+TRAIN_STEPS = obs_metrics.counter(
+    "skytpu_train_steps_total", "Train steps dispatched")
+TRAIN_TOKENS = obs_metrics.counter(
+    "skytpu_train_tokens_total", "Tokens dispatched to train steps")
+TRAIN_TOKENS_PER_S = obs_metrics.gauge(
+    "skytpu_train_tokens_per_second",
+    "Dispatch-rate tokens/s, EMA over recent steps")
+TRAIN_LOSS = obs_metrics.gauge(
+    "skytpu_train_loss",
+    "Most recently fetched training loss (see observe_loss)")
+
+
+def observe_loss(loss: float) -> None:
+    """Record a fetched loss into the gauge. Called where the train
+    loop already pays the host sync (its logging cadence) — the step
+    wrapper itself never forces a device fetch."""
+    TRAIN_LOSS.set(float(loss))
+
+
+def _instrument_step(step_fn: Callable) -> Callable:
+    ema = {"rate": 0.0, "warm": False}
+
+    @functools.wraps(step_fn)
+    def wrapper(state, batch):
+        t0 = time.monotonic()
+        out = step_fn(state, batch)
+        dt = max(time.monotonic() - t0, 1e-9)
+        TRAIN_STEPS.inc()
+        tokens = getattr(batch.get("tokens"), "size", 0) \
+            if hasattr(batch, "get") else 0
+        if tokens:
+            TRAIN_TOKENS.inc(tokens)
+        if not ema["warm"]:
+            # The first call pays the XLA compile (tens of seconds at
+            # scale); seeding the EMA or the histogram with it would
+            # poison both for dozens of steps.
+            ema["warm"] = True
+            return out
+        STEP_SECONDS.observe(dt)
+        if tokens:
+            rate = tokens / dt
+            ema["rate"] = (rate if ema["rate"] == 0.0
+                           else 0.9 * ema["rate"] + 0.1 * rate)
+            TRAIN_TOKENS_PER_S.set(ema["rate"])
+        return out
+
+    return wrapper
 
 
 @dataclasses.dataclass(frozen=True)
@@ -175,15 +234,15 @@ def make_train_step(cfg: llama.LlamaConfig, tc: TrainConfig,
         return new_state, metrics
 
     if mesh is None:
-        return jax.jit(step, donate_argnums=(0,))
+        return _instrument_step(jax.jit(step, donate_argnums=(0,)))
     shardings = state_shardings(cfg, mesh, rules, model)
     batch_spec = NamedSharding(mesh, P(("dp", "fsdp")))
-    return jax.jit(
+    return _instrument_step(jax.jit(
         step,
         donate_argnums=(0,),
         in_shardings=(shardings, batch_spec),
         out_shardings=(shardings, None),
-    )
+    ))
 
 
 def synthetic_batch(cfg: llama.LlamaConfig, batch_size: int, seq_len: int,

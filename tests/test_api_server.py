@@ -134,3 +134,41 @@ def test_server_concurrent_load(api_server):
         rids = list(pool.map(submit, range(10)))
     assert all(c == 200 for c in codes)
     assert len(set(rids)) == 10
+
+
+def test_metrics_endpoint(api_server):
+    import json as json_lib
+    import urllib.request
+
+    from skypilot_tpu.observability import metrics as metrics_lib
+
+    rid = sdk.launch(_local_task("echo metrics"), cluster_name="apim")
+    sdk.get(rid, timeout=120)
+
+    def scrape():
+        with urllib.request.urlopen(f"{api_server}/metrics",
+                                    timeout=10) as r:
+            assert r.status == 200
+            assert (r.headers.get("Content-Type")
+                    == metrics_lib.CONTENT_TYPE)
+            return metrics_lib.parse_exposition(r.read().decode())
+
+    fams = scrape()
+    launched = fams["skytpu_api_requests_total"]
+    assert any(labels.get("endpoint") == "launch" and v >= 1
+               for labels, v in launched["samples"])
+    assert "skytpu_api_workers_busy" in fams
+
+    def finished_ok(fams):
+        fam = fams.get("skytpu_api_requests_finished_total")
+        return fam and any(
+            labels.get("status") == "SUCCEEDED" and v >= 1
+            for labels, v in fam["samples"])
+
+    # The DB records SUCCEEDED before the executor reaps the worker
+    # process (its loop ticks every 50ms) — poll the scrape briefly.
+    deadline = time.time() + 30
+    while not finished_ok(fams) and time.time() < deadline:
+        time.sleep(0.1)
+        fams = scrape()
+    assert finished_ok(fams)

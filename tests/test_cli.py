@@ -158,3 +158,72 @@ def test_cli_reference_up_to_date():
     assert on_disk == cli_docs.generate(), (
         "docs/cli.md is stale — regenerate with "
         "`python -m skypilot_tpu.client.cli_docs > docs/cli.md`")
+
+
+def test_status_metrics_view(runner, monkeypatch):
+    """`status --metrics` scrapes the API server's /metrics and renders
+    counters/gauges/histograms; --raw prints the exposition verbatim."""
+    import json
+    import socket
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from skypilot_tpu.observability import metrics as metrics_lib
+
+    reg = metrics_lib.Registry()
+    reg.counter("skytpu_api_requests_total", "reqs",
+                labelnames=("endpoint",)).labels(endpoint="launch").inc(3)
+    reg.gauge("skytpu_api_workers_busy", "busy").set(1)
+    h = reg.histogram("skytpu_api_request_seconds", "lat",
+                      buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(2.0)
+    text = reg.render()
+
+    class FakeApi(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = text.encode()
+            self.send_response(200 if self.path == "/metrics" else 404)
+            self.send_header("Content-Type", metrics_lib.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), FakeApi)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        monkeypatch.setenv("SKYTPU_API_SERVER_URL",
+                           f"http://127.0.0.1:{httpd.server_port}")
+        res = runner.invoke(cli_mod.cli, ["status", "--metrics"])
+        assert res.exit_code == 0, res.output
+        assert "skytpu_api_requests_total" in res.output
+        assert "endpoint=launch" in res.output
+        assert "n=2" in res.output            # histogram series summary
+        assert "avg=1.25" in res.output
+        res = runner.invoke(cli_mod.cli, ["status", "--metrics", "--raw"])
+        assert res.exit_code == 0, res.output
+        assert res.output.strip() == text.strip()
+    finally:
+        httpd.shutdown()
+
+
+def test_status_metrics_unreachable(runner, monkeypatch):
+    monkeypatch.setenv("SKYTPU_API_SERVER_URL", "http://127.0.0.1:1")
+    res = runner.invoke(cli_mod.cli, ["status", "--metrics"])
+    assert res.exit_code != 0
+    assert "not reachable" in res.output
+
+
+def test_status_metrics_rejects_cluster_args(runner):
+    # --metrics is a server-registry view; silently ignoring cluster
+    # names (or --refresh/--ip) would mislead.
+    for extra in (["my-cluster"], ["--refresh"], ["--ip", "c"]):
+        res = runner.invoke(cli_mod.cli, ["status", "--metrics"] + extra)
+        assert res.exit_code != 0
+        assert "cannot be combined" in res.output
+    res = runner.invoke(cli_mod.cli, ["status", "--raw"])
+    assert res.exit_code != 0
+    assert "--raw only applies" in res.output

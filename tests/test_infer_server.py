@@ -3,6 +3,7 @@
 import json
 import socket
 import threading
+import urllib.error
 import urllib.request
 
 import jax
@@ -322,3 +323,47 @@ def test_pad_waves_single_program_per_bucket():
     want = plain.generate(prompts, max_new_tokens=4)
     got = padded.generate(prompts, max_new_tokens=4)
     assert got == want
+
+
+def test_metrics_endpoint_exposition(model_server):
+    """GET /metrics returns valid Prometheus text exposition carrying
+    the serving histograms after at least one request (acceptance
+    criterion of the observability PR)."""
+    from skypilot_tpu.observability import metrics as metrics_lib
+
+    url, _, _ = model_server
+    code, _ = _post(f"{url}/generate",
+                    {"tokens": [2, 7, 1], "max_new_tokens": 3})
+    assert code == 200
+    with urllib.request.urlopen(f"{url}/metrics", timeout=30) as r:
+        assert r.status == 200
+        assert r.headers.get("Content-Type") == metrics_lib.CONTENT_TYPE
+        text = r.read().decode()
+    fams = metrics_lib.parse_exposition(text)
+    for name in ("skytpu_ttft_seconds", "skytpu_decode_step_seconds"):
+        assert fams[name]["type"] == "histogram"
+        count = sum(v for labels, v in fams[name]["samples"]
+                    if labels.get("__name__") == f"{name}_count")
+        assert count >= 1, name
+    slots = fams["skytpu_slots_active"]
+    assert slots["type"] == "gauge" and slots["samples"]
+    # The gauge is process-global and other tests in this module build
+    # their own engines, so assert a pool exists rather than its size.
+    assert fams["skytpu_slots_total"]["samples"][0][1] >= 1
+    # The HTTP layer observed itself too, labeled by route.
+    http = fams["skytpu_http_requests_total"]
+    assert any(labels.get("route") == "/generate" and v >= 1
+               for labels, v in http["samples"])
+    # Server wave-flush span double-records into its histogram.
+    assert "skytpu_server_wave_flush_seconds" in fams
+    # Unknown paths collapse into route="other": a scanner must not
+    # mint unbounded label series in the process-global registry.
+    try:
+        urllib.request.urlopen(f"{url}/wp-login.php", timeout=30)
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    with urllib.request.urlopen(f"{url}/metrics", timeout=30) as r:
+        fams2 = metrics_lib.parse_exposition(r.read().decode())
+    routes = {labels.get("route")
+              for labels, _ in fams2["skytpu_http_requests_total"]["samples"]}
+    assert "other" in routes and "/wp-login.php" not in routes

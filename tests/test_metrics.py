@@ -1,0 +1,312 @@
+"""Observability metrics core: registry semantics, label handling,
+histogram bucket boundaries, concurrency, and the Prometheus text
+exposition format (golden test + parser round-trip)."""
+
+import json
+import threading
+
+import pytest
+
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.utils import timeline
+
+
+# -- counters / gauges ------------------------------------------------------
+
+def test_counter_basics():
+    reg = metrics.Registry()
+    c = reg.counter("c_total", "help")
+    c.inc()
+    c.inc(2.5)
+    assert reg.get("c_total")._require_default().value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_inc_dec():
+    reg = metrics.Registry()
+    g = reg.gauge("g", "help")
+    g.set(10)
+    g.dec(3)
+    g.inc()
+    assert g._require_default().value == 8
+
+
+def test_labeled_metric_rejects_direct_use():
+    reg = metrics.Registry()
+    c = reg.counter("c_total", "", labelnames=("route",))
+    with pytest.raises(ValueError):
+        c.inc()
+    c.labels(route="/x").inc()
+    assert c.labels("/x").value == 1
+
+
+def test_label_cardinality_and_identity():
+    reg = metrics.Registry()
+    c = reg.counter("c_total", "", labelnames=("a", "b"))
+    c.labels("1", "x").inc()
+    c.labels(a="1", b="x").inc()          # same child, either style
+    c.labels("2", "x").inc()
+    assert c.labels("1", "x").value == 2
+    assert len(c.children()) == 2
+    with pytest.raises(ValueError):
+        c.labels("1")                     # wrong arity
+    with pytest.raises(ValueError):
+        c.labels(a="1", wrong="x")        # wrong names
+    with pytest.raises(ValueError):
+        c.labels("1", b="x")              # mixed styles
+
+
+def test_registry_redeclare_conflicts():
+    reg = metrics.Registry()
+    c = reg.counter("m", "")
+    assert reg.counter("m", "") is c      # idempotent re-declare
+    with pytest.raises(ValueError):
+        reg.gauge("m", "")                # same name, new type
+    reg.counter("l", "", labelnames=("x",))
+    with pytest.raises(ValueError):
+        reg.counter("l", "", labelnames=("y",))   # new labels
+    with pytest.raises(ValueError):
+        reg.register(metrics.Counter("m"))
+    h = reg.histogram("hb", "", buckets=(0.1, 1.0))
+    assert reg.histogram("hb", "", buckets=(1.0, 0.1)) is h  # order-free
+    with pytest.raises(ValueError):
+        reg.histogram("hb", "", buckets=(0.5, 5.0))   # new buckets
+
+
+def test_labeled_counter_children_are_monotone():
+    reg = metrics.Registry()
+    c = reg.counter("c_total", "", labelnames=("k",))
+    child = c.labels(k="a")
+    child.inc(2)
+    with pytest.raises(ValueError):
+        child.inc(-1)                     # would read as a reset
+    with pytest.raises(TypeError):
+        child.dec()
+    with pytest.raises(TypeError):
+        child.set(0)
+    assert child.value == 2
+
+
+# -- histograms -------------------------------------------------------------
+
+def test_histogram_bucket_boundaries_le_inclusive():
+    reg = metrics.Registry()
+    h = reg.histogram("h", "", buckets=(0.1, 1.0, 10.0))
+    for v in (0.1, 0.05, 1.0, 5.0, 100.0):
+        h.observe(v)
+    (_, child), = h.children()
+    counts, total = child.hist_state()
+    # le=0.1 gets 0.05 AND the exactly-on-boundary 0.1.
+    assert counts == [2, 1, 1, 1]
+    assert total == pytest.approx(106.15)
+    rendered = reg.render()
+    assert 'h_bucket{le="0.1"} 2' in rendered      # cumulative
+    assert 'h_bucket{le="1"} 3' in rendered
+    assert 'h_bucket{le="10"} 4' in rendered
+    assert 'h_bucket{le="+Inf"} 5' in rendered
+    assert "h_count 5" in rendered
+
+
+def test_histogram_rejects_bad_buckets():
+    reg = metrics.Registry()
+    with pytest.raises(ValueError):
+        reg.histogram("h1", "", buckets=())
+    with pytest.raises(ValueError):
+        reg.histogram("h2", "", buckets=(1.0, 1.0))
+
+
+def test_histogram_timer():
+    reg = metrics.Registry()
+    h = reg.histogram("h", "", buckets=(10.0,))
+    with h.time():
+        pass
+    (_, child), = h.children()
+    counts, total = child.hist_state()
+    assert sum(counts) == 1 and 0 <= total < 10
+
+
+def test_suppress_discards_this_threads_observations():
+    reg = metrics.Registry()
+    c = reg.counter("c_total", "", labelnames=("k",))
+    g = reg.gauge("g", "")
+    h = reg.histogram("h_seconds", "", buckets=(1.0,))
+    g.set(5)
+    with metrics.suppress():
+        c.labels(k="a").inc()
+        g.set(99)
+        g.dec(2)
+        h.observe(0.5)
+        with metrics.suppress():      # nesting is fine
+            h.observe(0.5)
+    assert c.labels(k="a").value == 0  # child exists, value untouched
+    assert g._require_default().value == 5
+    (_, child), = h.children()
+    counts, hsum = child.hist_state()
+    assert sum(counts) == 0 and hsum == 0
+    h.observe(0.25)                    # recording resumes after exit
+    counts, _ = child.hist_state()
+    assert sum(counts) == 1
+    # Suppression is per-thread: a concurrent recorder is unaffected.
+    with metrics.suppress():
+        t = threading.Thread(target=lambda: c.labels(k="b").inc())
+        t.start()
+        t.join()
+    assert c.labels(k="b").value == 1
+
+
+# -- concurrency ------------------------------------------------------------
+
+def test_concurrent_increments_are_exact():
+    reg = metrics.Registry()
+    c = reg.counter("c_total", "", labelnames=("t",))
+    h = reg.histogram("h", "", buckets=(0.5, 1.5))
+    n_threads, per_thread = 8, 500
+
+    def work(i):
+        for _ in range(per_thread):
+            c.labels(t=str(i % 2)).inc()
+            h.observe(1.0)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(child.value for _, child in c.children())
+    assert total == n_threads * per_thread
+    (_, child), = h.children()
+    counts, hsum = child.hist_state()
+    assert sum(counts) == n_threads * per_thread
+    assert hsum == pytest.approx(n_threads * per_thread * 1.0)
+
+
+# -- exposition format ------------------------------------------------------
+
+def test_exposition_golden():
+    reg = metrics.Registry()
+    c = reg.counter("skytpu_reqs_total", "Requests served",
+                    labelnames=("route",))
+    c.labels(route="/generate").inc(3)
+    g = reg.gauge("skytpu_slots", "Active slots")
+    g.set(2)
+    h = reg.histogram("skytpu_lat_seconds", "Latency",
+                      buckets=(0.5, 2.5))
+    h.observe(0.2)
+    h.observe(7.0)
+    assert reg.render() == (
+        "# HELP skytpu_lat_seconds Latency\n"
+        "# TYPE skytpu_lat_seconds histogram\n"
+        'skytpu_lat_seconds_bucket{le="0.5"} 1\n'
+        'skytpu_lat_seconds_bucket{le="2.5"} 1\n'
+        'skytpu_lat_seconds_bucket{le="+Inf"} 2\n'
+        "skytpu_lat_seconds_sum 7.2\n"
+        "skytpu_lat_seconds_count 2\n"
+        "# HELP skytpu_reqs_total Requests served\n"
+        "# TYPE skytpu_reqs_total counter\n"
+        'skytpu_reqs_total{route="/generate"} 3\n'
+        "# HELP skytpu_slots Active slots\n"
+        "# TYPE skytpu_slots gauge\n"
+        "skytpu_slots 2\n")
+
+
+def test_exposition_escapes_label_values():
+    reg = metrics.Registry()
+    c = reg.counter("c_total", 'multi\nline "help"', labelnames=("v",))
+    c.labels(v='a"b\\c\nd').inc()
+    out = reg.render()
+    assert '# HELP c_total multi\\nline "help"' in out
+    assert 'c_total{v="a\\"b\\\\c\\nd"} 1' in out
+    # And the parser round-trips the escaped value.
+    fam = metrics.parse_exposition(out)["c_total"]
+    (labels, value), = fam["samples"]
+    assert labels == {"v": 'a"b\\c\nd'} and value == 1
+    # Literal backslash followed by 'n' must NOT decode as a newline
+    # (ordered str.replace chains get this wrong).
+    c.labels(v="a\\nb").inc()
+    fam = metrics.parse_exposition(reg.render())["c_total"]
+    values = {labels["v"] for labels, _ in fam["samples"]}
+    assert "a\\nb" in values
+
+
+def test_parse_exposition_roundtrip():
+    reg = metrics.Registry()
+    reg.counter("a_total", "", labelnames=("x", "y")).labels(
+        x="1,2", y="z").inc(4)
+    reg.gauge("b", "").set(-1.5)
+    h = reg.histogram("c_seconds", "", labelnames=("op",),
+                      buckets=(1.0,))
+    h.labels(op="p").observe(0.5)
+    fams = metrics.parse_exposition(reg.render())
+    assert fams["a_total"]["type"] == "counter"
+    assert fams["a_total"]["samples"] == [({"x": "1,2", "y": "z"}, 4.0)]
+    assert fams["b"]["samples"] == [({}, -1.5)]
+    hist = fams["c_seconds"]
+    assert hist["type"] == "histogram"
+    count = next(v for labels, v in hist["samples"]
+                 if labels.get("__name__") == "c_seconds_count")
+    assert count == 1.0
+
+
+def test_snapshot_is_json_able():
+    reg = metrics.Registry()
+    reg.counter("a_total", "h").inc(2)
+    h = reg.histogram("b_seconds", "", buckets=(1.0,))
+    h.observe(0.5)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    assert snap["a_total"]["samples"][0]["value"] == 2
+    assert snap["b_seconds"]["samples"][0]["count"] == 1
+    assert snap["b_seconds"]["samples"][0]["buckets"]["1"] == 1
+
+
+def test_global_registry_sugar():
+    before = metrics.REGISTRY.get("skytpu_test_sugar_total")
+    assert before is None
+    c = metrics.counter("skytpu_test_sugar_total", "t")
+    assert metrics.counter("skytpu_test_sugar_total", "t") is c
+    assert "skytpu_test_sugar_total" in metrics.render()
+
+
+# -- timeline bridge --------------------------------------------------------
+
+def test_timeline_event_records_histogram_without_tracing(monkeypatch):
+    monkeypatch.delenv(timeline.ENV_VAR, raising=False)
+    timeline._events.clear()
+    reg = metrics.Registry()
+    h = reg.histogram("span_seconds", "", buckets=(60.0,))
+    with timeline.Event("span_seconds", histogram=h._require_default()):
+        pass
+    (_, child), = h.children()
+    counts, _ = child.hist_state()
+    assert sum(counts) == 1
+    assert not timeline._events        # tracing stayed off
+
+
+def test_timeline_decorator_histogram_bridge(monkeypatch, tmp_path):
+    reg = metrics.Registry()
+    h = reg.histogram("op_seconds", "", buckets=(60.0,))
+
+    @timeline.event(name="op_seconds", histogram=h._require_default())
+    def op():
+        return 7
+
+    monkeypatch.delenv(timeline.ENV_VAR, raising=False)
+    assert op() == 7
+    # Now with tracing on: same call double-records trace + histogram.
+    out = tmp_path / "t.json"
+    monkeypatch.setenv(timeline.ENV_VAR, str(out))
+    try:
+        assert op() == 7
+        timeline.save_now()
+        (_, child), = h.children()
+        counts, _ = child.hist_state()
+        assert sum(counts) == 2
+        names = [e["name"] for e in
+                 json.loads(out.read_text())["traceEvents"]]
+        assert "op_seconds" in names
+    finally:
+        # The buffer is process-global; don't leak our span into later
+        # tests that assert tracing-off leaves it empty.
+        timeline._events.clear()
+        timeline._named_tids.clear()
