@@ -239,6 +239,53 @@ def status(refresh, show_ip, show_metrics, raw, clusters):
             h.get("num_nodes", 1), f"{r['price_per_hour']:.2f}"))
 
 
+@cli.command(name="trace")
+@click.argument("request_id")
+@click.option("--perfetto", "perfetto_path", default=None,
+              help="Also write the assembled trace as Chrome "
+                   "trace-format JSON (Perfetto/chrome://tracing "
+                   "loadable) to this path.")
+def trace_cmd(request_id, perfetto_path):
+    """Reconstruct one request's cross-process span tree.
+
+    REQUEST_ID is an API request id (as returned by every async
+    endpoint and shown by `skytpu api status`) or a raw 32-hex trace
+    id. Spans and lifecycle events are read from the structured event
+    logs under ~/.skypilot_tpu/events/ (see docs/observability.md).
+    """
+    import json as json_lib
+    import re as re_mod
+
+    from skypilot_tpu.observability import trace_view, tracing
+    from skypilot_tpu.server import requests_db
+
+    trace_id = None
+    rec = requests_db.get(request_id)
+    if rec is not None:
+        trace = rec.get("trace") or {}
+        ctx = tracing.parse_traceparent(trace.get("tp"))
+        if ctx is None:
+            raise click.ClickException(
+                f"request {request_id!r} predates tracing (no trace "
+                f"context recorded)")
+        trace_id = ctx.trace_id
+    elif re_mod.fullmatch(r"[0-9a-f]{32}", request_id):
+        trace_id = request_id
+    else:
+        raise click.ClickException(
+            f"no request {request_id!r} (and not a 32-hex trace id)")
+    records = trace_view.load_trace(trace_id)
+    if not records:
+        raise click.ClickException(
+            f"no events recorded for trace {trace_id} (still in an "
+            f"unflushed buffer, or logged under another home?)")
+    if perfetto_path:
+        with open(os.path.expanduser(perfetto_path), "w") as f:
+            json_lib.dump(trace_view.to_perfetto(records), f)
+        click.echo(f"perfetto trace written to {perfetto_path}")
+    click.echo(trace_view.render(records, trace_id))
+
+
 @cli.command()
 @click.argument("cluster")
 def queue(cluster):

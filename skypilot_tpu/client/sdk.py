@@ -19,6 +19,7 @@ import urllib.request
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import paths
 
@@ -54,11 +55,18 @@ def _headers() -> Dict[str, str]:
 
 
 def _post(path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
-    req = urllib.request.Request(
-        _url() + path, data=json.dumps(payload).encode(),
-        headers=_headers(), method="POST")
-    with urllib.request.urlopen(req, timeout=30) as resp:
-        return json.loads(resp.read())
+    # Every submission opens a client-side span and sends its context
+    # as a W3C-style traceparent header; the server adopts the trace so
+    # `skytpu trace <request_id>` shows the submit hop too. (Polling
+    # GETs are deliberately unspanned — one request, not 300 polls.)
+    with tracing.start_span(f"sdk.request:{path}") as span:
+        headers = _headers()
+        headers["traceparent"] = tracing.format_traceparent(span.ctx)
+        req = urllib.request.Request(
+            _url() + path, data=json.dumps(payload).encode(),
+            headers=headers, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
 
 
 def _get_json(path: str) -> Any:

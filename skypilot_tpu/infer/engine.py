@@ -26,7 +26,7 @@ import numpy as np
 
 from skypilot_tpu.infer import kvcache, sampling
 from skypilot_tpu.models import llama
-from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.utils import timeline
 
 # Live serving metrics (docs/observability.md). Span names match the
@@ -80,6 +80,12 @@ class Request:
     first_token_s: Optional[float] = None
     done: bool = False
     eos_id: Optional[int] = None
+    # Identity of this request's trace span ("engine.request", recorded
+    # at retirement): queue-wait/prefill/decode child spans parent to
+    # it. parent_id links it into an external trace (the HTTP caller's
+    # traceparent) when one rode in with the request.
+    span_ctx: Optional[tracing.SpanContext] = None
+    parent_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -291,11 +297,23 @@ class InferenceEngine:
                        out_shardings=shardings)(jax.random.key(seed))
 
     def add_request(self, prompt: List[int],
-                    max_new_tokens: int = 128) -> int:
+                    max_new_tokens: int = 128,
+                    trace_ctx: Optional[tracing.SpanContext] = None
+                    ) -> int:
         _bucket(len(prompt), self.buckets)   # validate length up front
         req = Request(rid=self._next_rid, prompt=list(prompt),
                       max_new_tokens=max_new_tokens, submit_s=time.time(),
                       eos_id=self.eos_id)
+        # Per-request span identity, minted at submit so child spans
+        # recorded before retirement can already parent to it. The
+        # parent comes from the caller's explicit context (the HTTP
+        # handler's traceparent — admission runs on the loop thread,
+        # which has no ambient context) or the ambient one.
+        parent = trace_ctx if trace_ctx is not None else tracing.current()
+        req.span_ctx = tracing.SpanContext(
+            parent.trace_id if parent else tracing.new_trace_id(),
+            tracing.new_span_id())
+        req.parent_id = parent.span_id if parent else None
         self._next_rid += 1
         self.waiting.append(req)
         ENGINE_WAITING.set(len(self.waiting))
@@ -363,6 +381,11 @@ class InferenceEngine:
             "skytpu_prefill_seconds",
             histogram=PREFILL_SECONDS.labels(bucket=str(bucket)))
         span.begin()
+        for req in wave:
+            # Queue wait ends where the prefill dispatch begins.
+            tracing.record_span(
+                "engine.queue_wait", req.submit_s, span.begin_s,
+                parent=req.span_ctx, attrs={"rid": req.rid})
         if self.pad_waves:
             n = self.max_wave
         else:
@@ -386,6 +409,13 @@ class InferenceEngine:
         first = np.asarray(first_dev)          # host sync for THIS wave
         span.end()
         now = time.time()
+        for req in wave:
+            # The latency the request experienced: dispatch through
+            # first-token fetch (same window as the histogram span).
+            tracing.record_span(
+                "engine.prefill", span.begin_s, now,
+                parent=req.span_ctx,
+                attrs={"rid": req.rid, "bucket": bucket})
         for i, (req, slot) in enumerate(zip(wave, slots)):
             tok = int(first[i])
             req.slot = slot
@@ -417,9 +447,29 @@ class InferenceEngine:
         req.done = True
         self.finished.append(req)
         REQUESTS_FINISHED.inc()
-        if req.first_token_s is not None and len(req.tokens) > 1:
+        now = time.time()
+        decoded = req.first_token_s is not None and len(req.tokens) > 1
+        if req.span_ctx is not None:
+            if decoded:
+                # ONE decode span per request (first token ->
+                # retirement): a span per slot per burst floods the
+                # flight-recorder ring at high occupancy — 64 slots at
+                # ~100 bursts/s would leave only seconds of history.
+                # Device-call timing stays on the
+                # skytpu_decode_step_seconds histogram/timeline span.
+                tracing.record_span(
+                    "engine.decode", req.first_token_s, now,
+                    parent=req.span_ctx,
+                    attrs={"rid": req.rid,
+                           "tokens": len(req.tokens) - 1})
+            tracing.record_span(
+                "engine.request", req.submit_s, now,
+                ctx=req.span_ctx, parent_id=req.parent_id,
+                attrs={"rid": req.rid, "prompt_len": len(req.prompt),
+                       "n_tokens": len(req.tokens)})
+        if decoded:
             TPOT_SECONDS.observe(
-                max(time.time() - req.first_token_s, 0.0)
+                max(now - req.first_token_s, 0.0)
                 / (len(req.tokens) - 1))
         if req.slot is not None:
             self.slot_req.pop(req.slot, None)

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import queue
 import sys
 import threading
@@ -37,7 +38,7 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 from socketserver import ThreadingMixIn
 from typing import Dict, Optional
 
-from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.utils import timeline
 
 HTTP_SECONDS = metrics.histogram(
@@ -133,11 +134,16 @@ class ModelServer:
         self._pending: Dict[int, _Pending] = {}   # loop-thread only
         self._ready = threading.Event()
         self._stop = threading.Event()
+        # Off-thread event-log heartbeat: engine spans become durable
+        # (visible to a separate-process `skytpu trace`) within ~5s of
+        # recording, and the O(ring) flush serialization never runs on
+        # the serving loop between decode waves.
+        tracing.ensure_flush_thread()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def _add(self, tokens, max_new_tokens: int,
-             stream: bool = False) -> _Pending:
+             stream: bool = False, trace_ctx=None) -> _Pending:
         from skypilot_tpu.infer import engine as eng
         # Validate eagerly (oversized prompt -> clean 400) without
         # touching the engine from this thread.
@@ -145,20 +151,25 @@ class ModelServer:
         p = _Pending()
         p.stream = stream
         with self._inbox_lock:
-            self._inbox.append((list(tokens), max_new_tokens, p))
+            # The caller's trace context rides the inbox tuple: the
+            # loop thread (which has no ambient context) hands it to
+            # add_request so the engine's per-request spans join the
+            # HTTP caller's trace.
+            self._inbox.append((list(tokens), max_new_tokens, p,
+                                trace_ctx))
             self._last_arrival = time.monotonic()
             INBOX_DEPTH.set(len(self._inbox))
         return p
 
-    def submit(self, tokens, max_new_tokens: int) -> Dict:
-        p = self._add(tokens, max_new_tokens)
+    def submit(self, tokens, max_new_tokens: int, trace_ctx=None) -> Dict:
+        p = self._add(tokens, max_new_tokens, trace_ctx=trace_ctx)
         t0 = time.time()
         p.event.wait()
         out = dict(p.result or {})
         out["total_ms"] = round((time.time() - t0) * 1e3, 2)
         return out
 
-    def submit_stream(self, tokens, max_new_tokens: int):
+    def submit_stream(self, tokens, max_new_tokens: int, trace_ctx=None):
         """Iterator of chunk dicts: {"tokens": [...]} as decoded, then
         one {"done": true, "ttft_ms": ...} (or {"error": ...}).
 
@@ -166,7 +177,8 @@ class ModelServer:
         written), so an oversized prompt raises here as a clean 400 —
         not mid-stream after a 200 went out.
         """
-        p = self._add(tokens, max_new_tokens, stream=True)
+        p = self._add(tokens, max_new_tokens, stream=True,
+                      trace_ctx=trace_ctx)
 
         def gen():
             while True:
@@ -231,8 +243,14 @@ class ModelServer:
         with self._inbox_lock:
             new, self._inbox = self._inbox, []
             INBOX_DEPTH.set(0)
-        for tokens, max_new, p in new:
-            rid = self.engine.add_request(tokens, max_new)
+        for tokens, max_new, p, trace_ctx in new:
+            # trace_ctx only when one rode in: simple engine doubles
+            # (and older engines) without the kwarg keep working.
+            if trace_ctx is not None:
+                rid = self.engine.add_request(tokens, max_new,
+                                              trace_ctx=trace_ctx)
+            else:
+                rid = self.engine.add_request(tokens, max_new)
             # add_request appends to engine.waiting; keep the Request so
             # emitted tokens can be diffed without a rid->req search.
             p.req = self.engine.waiting[-1]
@@ -437,14 +455,17 @@ def make_handler(model: ModelServer):
                 stream = bool(body.get("stream", False))
             except (ValueError, TypeError, KeyError) as e:
                 return self._json(400, {"error": f"bad request: {e}"})
+            trace_ctx = tracing.parse_traceparent(
+                self.headers.get("traceparent"))
             if stream:
                 try:
-                    chunks = model.submit_stream(tokens, max_new)
+                    chunks = model.submit_stream(tokens, max_new,
+                                                 trace_ctx=trace_ctx)
                 except ValueError as e:  # oversized prompt etc.
                     return self._json(400, {"error": str(e)})
                 return self._stream(chunks)
             try:
-                out = model.submit(tokens, max_new)
+                out = model.submit(tokens, max_new, trace_ctx=trace_ctx)
             except ValueError as e:      # oversized prompt etc.
                 return self._json(400, {"error": str(e)})
             if "error" in out:
@@ -506,6 +527,16 @@ def main() -> None:
                          "(Megatron head/mlp/vocab split — serves "
                          "models bigger than one chip's HBM)")
     args = ap.parse_args()
+
+    # Long-lived serving daemon: sever any inherited trace root. A
+    # server launched as a task inherits SKYTPU_TRACEPARENT from the
+    # launch request's rpc chain — without this, every headerless
+    # /generate for the life of the server would attach its engine
+    # spans to that ONE launch trace (the same spawn-time-root
+    # misattribution the skylet avoids via the persisted arm context).
+    # Requests that carry their own traceparent are unaffected.
+    os.environ.pop(tracing.ENV_VAR, None)
+    tracing.set_process_name("model-server")
 
     import jax
 

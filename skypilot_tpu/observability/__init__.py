@@ -9,6 +9,11 @@ HTTP surface serves ``metrics.render()`` as ``GET /metrics``
 Traces and metrics correlate by name: a ``timeline.Event`` given a
 ``histogram=`` child double-records the same span into Perfetto (when
 ``SKYTPU_TIMELINE_FILE_PATH`` is set) and into the histogram (always).
+
+Per-request distributed tracing lives in ``tracing.py`` (W3C-style
+traceparent context + structured JSONL event log; ``skytpu trace``
+assembles the cross-process tree) and ``trace_view.py`` (assembly/
+rendering). See docs/observability.md §Distributed tracing.
 """
 
 from skypilot_tpu.observability.metrics import (  # noqa: F401

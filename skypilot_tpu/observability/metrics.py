@@ -40,6 +40,14 @@ def _suppressed() -> bool:
     return getattr(_suppress_local, "depth", 0) > 0
 
 
+def suppressed() -> bool:
+    """Whether this thread is inside a :func:`suppress` block. Public
+    so sibling recorders (the tracing event log) can honor the same
+    discard window — warmup work skewing span summaries is the same
+    bug as warmup work skewing histograms."""
+    return _suppressed()
+
+
 @contextlib.contextmanager
 def suppress():
     """Discard every observation THIS thread records inside the block

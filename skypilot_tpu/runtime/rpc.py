@@ -28,6 +28,7 @@ import sys
 import time
 from typing import Any, Callable, Dict
 
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.runtime import constants, job_queue, topology
 from skypilot_tpu.utils import command_runner
 
@@ -211,8 +212,14 @@ def _m_set_autostop(cluster_name, cdir, p):
     else:
         tmp = cfg_path + ".tmp"
         with open(tmp, "w") as f:
+            # "trace": the arming request's context, persisted so the
+            # skylet attributes autostop outcomes (fired/retry/disarm —
+            # possibly days later, long after this rpc process died) to
+            # the request that ARMED autostop, not to whichever request
+            # originally spawned the skylet.
             json.dump({"idle_minutes": idle, "down": bool(p.get("down")),
-                       "set_at": time.time()}, f)
+                       "set_at": time.time(),
+                       "trace": tracing.traceparent()}, f)
         os.replace(tmp, cfg_path)
         _ensure_skylet(cluster_name, cdir)
     return {"autostop": idle}
@@ -481,14 +488,32 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cluster", required=True)
     args = ap.parse_args()
+    tracing.set_process_name("rpc")
+    method = "?"
     try:
         req = json.loads(sys.stdin.read() or "{}")
-        result = dispatch(args.cluster, req.get("method", "ping"),
-                          req.get("params") or {})
+        method = req.get("method", "ping")
+        # Install the caller's trace context as this process's root —
+        # via the env so daemons spawned here (skylet, driver,
+        # controllers: _child_env copies os.environ) inherit it and
+        # their lifecycle events join the originating request's trace.
+        if tracing.parse_traceparent(req.get("trace")) is not None:
+            os.environ[tracing.ENV_VAR] = req["trace"]
+        with tracing.start_span(f"rpc.dispatch:{method}",
+                                attrs={"cluster": args.cluster}):
+            result = dispatch(args.cluster, method,
+                              req.get("params") or {})
         resp = {"ok": True, "result": result}
     except RpcMethodError as e:
+        tracing.add_event("rpc.error",
+                          attrs={"method": method, "etype": e.etype,
+                                 "message": str(e)[:500]})
         resp = {"ok": False, "error": str(e), "etype": e.etype}
     except Exception as e:  # noqa: BLE001 — the wire must always answer
+        tracing.add_event("rpc.error",
+                          attrs={"method": method,
+                                 "etype": type(e).__name__,
+                                 "message": str(e)[:500]})
         resp = {"ok": False, "error": f"{type(e).__name__}: {e}",
                 "etype": type(e).__name__}
     sys.stdout.write(MARKER + json.dumps(resp) + "\n")

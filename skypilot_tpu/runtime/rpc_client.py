@@ -11,13 +11,30 @@ from __future__ import annotations
 
 import json
 import shlex
+import subprocess
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.runtime import job_queue
 from skypilot_tpu.runtime.rpc import MARKER
 from skypilot_tpu.utils.command_runner import CommandRunner
+
+# Skylet-transport health on /metrics: every cluster RPC records its
+# round-trip latency (one observation per transport attempt) and
+# failures by kind — "transport" (runner rc != 0), "protocol" (no
+# response frame in the output), "remote" (the method raised on the
+# head).
+RPC_SECONDS = metrics.histogram(
+    "skytpu_rpc_seconds",
+    "Cluster RPC round-trip latency per transport attempt, by method",
+    labelnames=("method",))
+RPC_FAILURES = metrics.counter(
+    "skytpu_rpc_failures_total",
+    "Cluster RPC failures by method and kind "
+    "(transport | protocol | remote)",
+    labelnames=("method", "kind"))
 
 
 class ClusterRpcError(exceptions.SkyTpuError):
@@ -32,6 +49,7 @@ _IDEMPOTENT = frozenset(
      "jobs_get", "jobs_list", "jobs_log", "jobs_tail", "serve_status"})
 _TRANSPORT_RETRIES = 3
 _RETRY_BACKOFF_SECONDS = 1.0
+DEFAULT_TIMEOUT_SECONDS = 120.0
 
 
 class ClusterRpc:
@@ -39,15 +57,53 @@ class ClusterRpc:
         self.runner = head_runner
         self.cluster_name = cluster_name
 
-    def call(self, method: str, **params: Any) -> Any:
+    def call(self, method: str, *,
+             timeout: float = DEFAULT_TIMEOUT_SECONDS,
+             **params: Any) -> Any:
+        with tracing.start_span(
+                f"rpc.{method}",
+                attrs={"cluster": self.cluster_name}) as span:
+            return self._call(method, span, timeout, params)
+
+    def _call(self, method: str, span, timeout: float,
+              params: Dict[str, Any]) -> Any:
         cmd = (self.runner.framework_invocation("skypilot_tpu.runtime.rpc")
                + f" --cluster {shlex.quote(self.cluster_name)}")
-        payload = json.dumps({"method": method, "params": params})
+        # The trace context rides IN the request: the head-side rpc
+        # process parents its dispatch span (and anything it spawns —
+        # skylet, driver) to this client-side span.
+        payload = json.dumps({"method": method, "params": params,
+                              "trace": tracing.format_traceparent(
+                                  span.ctx)})
         attempts = _TRANSPORT_RETRIES if method in _IDEMPOTENT else 1
         for attempt in range(attempts):
-            rc, out, err = self.runner.run(cmd, stdin=payload, timeout=120)
+            t0 = time.monotonic()
+            try:
+                rc, out, err = self.runner.run(cmd, stdin=payload,
+                                               timeout=timeout)
+            except subprocess.TimeoutExpired:
+                # A timeout IS a transport failure — the exact failure
+                # mode the timeout parameter exists for must show up in
+                # the latency histogram and the failure counter, and
+                # surface as the typed RPC error, not a raw
+                # TimeoutExpired.
+                rc, out = -1, ""
+                err = f"timed out after {timeout}s"
+            except OSError as e:
+                # Socket/exec-level transport failures (the agent
+                # runner's ConnectionRefusedError during a head outage,
+                # a dropped SSH pipe — and TimeoutError, an OSError
+                # subclass) take the same path: counted as
+                # kind=transport, retried when idempotent, surfaced as
+                # the typed RPC error.
+                rc, out = -1, ""
+                err = f"{type(e).__name__}: {e}"
+            finally:
+                RPC_SECONDS.labels(method=method).observe(
+                    time.monotonic() - t0)
             if rc == 0:
                 break
+            RPC_FAILURES.labels(method=method, kind="transport").inc()
             if attempt + 1 < attempts:
                 time.sleep(_RETRY_BACKOFF_SECONDS * (attempt + 1))
         if rc != 0:
@@ -60,10 +116,12 @@ class ClusterRpc:
                 resp = json.loads(line[len(MARKER):])
                 break
         if resp is None:
+            RPC_FAILURES.labels(method=method, kind="protocol").inc()
             raise ClusterRpcError(
                 f"cluster rpc {method!r}: no response frame in output: "
                 f"{out[-500:]!r}")
         if not resp["ok"]:
+            RPC_FAILURES.labels(method=method, kind="remote").inc()
             exc_cls = getattr(exceptions, resp.get("etype", ""), None)
             if isinstance(exc_cls, type) and issubclass(exc_cls, Exception):
                 raise exc_cls(resp["error"])

@@ -20,6 +20,7 @@ import sys
 import time
 
 from skypilot_tpu.observability import metrics as obs_metrics
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.runtime import constants, job_queue, topology
 from skypilot_tpu.utils import timeline
 
@@ -52,6 +53,7 @@ def observe_tick(db: str) -> None:
     job_queue.update_state_gauges(db)
     try:
         timeline.save_periodic()
+        tracing.flush_periodic()
     except OSError:
         pass    # an unwritable trace path must not take the tick down
 
@@ -71,6 +73,13 @@ def run(cluster_name: str, poll_interval: float) -> int:
             # rpc set_autostop method respawns us when a config appears.
             return 0
         if cfg.get("idle_minutes", -1) >= 0:
+            # Attribute autostop outcomes to the request that ARMED
+            # autostop (context persisted in the config by the
+            # set_autostop rpc), never this daemon's spawn-time root —
+            # a pre-upgrade config without the field records the events
+            # unattributed (DETACHED) rather than misattributed.
+            arm_ctx = (tracing.parse_traceparent(cfg.get("trace"))
+                       or tracing.DETACHED)
             last = max(job_queue.last_activity_time(db),
                        meta.get("launched_at") or 0.0,
                        cfg.get("set_at") or 0.0)
@@ -87,6 +96,11 @@ def run(cluster_name: str, poll_interval: float) -> int:
                             meta["provider"], cluster_name, meta["zone"])
                     AUTOSTOP_FIRED.labels(
                         down=str(bool(cfg.get("down")))).inc()
+                    tracing.add_event(
+                        "skylet.autostop_fired",
+                        attrs={"cluster": cluster_name,
+                               "down": bool(cfg.get("down"))},
+                        ctx=arm_ctx, echo=True)
                     with open(os.path.join(cdir, "autostop_fired"),
                               "w") as f:
                         f.write(json.dumps(
@@ -98,9 +112,16 @@ def run(cluster_name: str, poll_interval: float) -> int:
                         # Permanent refusal (e.g. multislice/multi-host
                         # TPU cannot stop): retrying forever would spam
                         # the cloud API while the user believes autostop
-                        # is armed. Disarm loudly.
-                        print(f"autostop impossible, disarming: {e}",
-                              file=sys.stderr)
+                        # is armed. Disarm loudly — a typed event record
+                        # (echoed to skylet.log) instead of a bare
+                        # print, so the failure shows up in `skytpu
+                        # trace` for the request that armed autostop.
+                        tracing.add_event(
+                            "skylet.autostop_disarmed",
+                            attrs={"cluster": cluster_name,
+                                   "error_type": type(e).__name__,
+                                   "message": str(e)[:500]},
+                            ctx=arm_ctx, echo=True)
                         with open(os.path.join(cdir, "autostop_failed"),
                                   "w") as f:
                             f.write(str(e))
@@ -113,12 +134,17 @@ def run(cluster_name: str, poll_interval: float) -> int:
                     # Transient cloud error: stay alive and retry next
                     # tick — exiting here would permanently disarm
                     # autostop and let an idle cluster bill forever.
-                    print(f"autostop attempt failed (will retry): {e}",
-                          file=sys.stderr)
+                    tracing.add_event(
+                        "skylet.autostop_retry",
+                        attrs={"cluster": cluster_name,
+                               "error_type": type(e).__name__,
+                               "message": str(e)[:500]},
+                        ctx=arm_ctx, echo=True)
         time.sleep(poll_interval)
 
 
 def main() -> None:
+    tracing.set_process_name("skylet")
     ap = argparse.ArgumentParser()
     ap.add_argument("--cluster-name", required=True)
     ap.add_argument("--poll-interval", type=float,

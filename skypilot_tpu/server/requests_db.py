@@ -35,7 +35,14 @@ class RequestStatus(enum.Enum):
 #   v2: + requests.user (JSON {"id","name"} of the submitting client —
 #       the API server stamps it from the request headers and injects
 #       it into the worker so ops run AS that identity)
-SCHEMA_VERSION = 2
+#   v3: + requests.trace (JSON {"tp": traceparent of the request's own
+#       span, "parent": the client's span id or null} — the trace
+#       context the executor injects into the worker and records the
+#       request span under; see observability/tracing.py), and an index
+#       covering the status-filtered scans (next_new() runs every
+#       executor tick and gc() every few minutes; both full-scanned an
+#       unindexed status column)
+SCHEMA_VERSION = 3
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS requests (
@@ -48,11 +55,19 @@ CREATE TABLE IF NOT EXISTS requests (
     pid INTEGER,
     created_at REAL,
     finished_at REAL,
-    user TEXT
+    user TEXT,
+    trace TEXT
 );
+CREATE INDEX IF NOT EXISTS idx_requests_status
+    ON requests (status, created_at);
 """
 
-_MIGRATIONS = {2: "ALTER TABLE requests ADD COLUMN user TEXT;"}
+_MIGRATIONS = {
+    2: "ALTER TABLE requests ADD COLUMN user TEXT;",
+    3: ("ALTER TABLE requests ADD COLUMN trace TEXT;"
+        "CREATE INDEX IF NOT EXISTS idx_requests_status"
+        " ON requests (status, created_at);"),
+}
 
 
 @contextlib.contextmanager
@@ -67,15 +82,17 @@ def _db():
 
 
 def create(name: str, payload: Dict[str, Any],
-           user: Optional[Dict[str, str]] = None) -> str:
+           user: Optional[Dict[str, str]] = None,
+           trace: Optional[Dict[str, Any]] = None) -> str:
     request_id = uuid.uuid4().hex[:16]
     with _db() as c:
         c.execute(
             "INSERT INTO requests (request_id, name, status, payload,"
-            " created_at, user) VALUES (?,?,?,?,?,?)",
+            " created_at, user, trace) VALUES (?,?,?,?,?,?,?)",
             (request_id, name, RequestStatus.NEW.value,
              json.dumps(payload), time.time(),
-             json.dumps(user) if user else None))
+             json.dumps(user) if user else None,
+             json.dumps(trace) if trace else None))
     return request_id
 
 
@@ -117,7 +134,7 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
     with _db() as c:
         row = c.execute(
             "SELECT request_id, name, status, payload, result, error, pid,"
-            " created_at, finished_at, user FROM requests"
+            " created_at, finished_at, user, trace FROM requests"
             " WHERE request_id=?",
             (request_id,)).fetchone()
     if row is None:
@@ -130,6 +147,7 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
         "error": row[5], "pid": row[6],
         "created_at": row[7], "finished_at": row[8],
         "user": json.loads(row[9]) if row[9] else None,
+        "trace": json.loads(row[10]) if row[10] else None,
     }
 
 

@@ -33,7 +33,7 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 from socketserver import ThreadingMixIn
 from typing import Any, Dict, Optional
 
-from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.requests_db import RequestStatus
 from skypilot_tpu.utils import paths
@@ -87,15 +87,33 @@ class Executor(threading.Thread):
     def run(self) -> None:
         while not self._stop.is_set():
             self._reap()
+            try:
+                # Heartbeat flush: any buffered span/event (request
+                # spans recorded at reap, GC events) becomes durable
+                # within ~5s without paying a whole-buffer rewrite per
+                # reaped request — the throttle skips clean/fresh
+                # buffers at the cost of two compares.
+                tracing.flush_periodic(min_new_records=256,
+                                       max_age_s=5.0)
+            except OSError:
+                pass   # unwritable events dir must not kill the loop
             if time.time() - self._last_gc > _GC_INTERVAL_S:
                 self._last_gc = time.time()
                 try:
                     n = requests_db.gc(REQUEST_TTL_S)
-                    if n:
-                        print(f"request GC: removed {n} old records",
-                              file=sys.stderr)
+                    n_logs = tracing.gc_event_logs()
+                    if n or n_logs:
+                        tracing.add_event(
+                            "server.request_gc",
+                            attrs={"removed_requests": n,
+                                   "removed_event_logs": n_logs},
+                            echo=True)
                 except Exception as e:  # noqa: BLE001 — GC never fatal
-                    print(f"request GC failed: {e}", file=sys.stderr)
+                    tracing.add_event(
+                        "server.request_gc_failed",
+                        attrs={"error_type": type(e).__name__,
+                               "message": str(e)[:500]},
+                        echo=True)
             if len(self._procs) < MAX_CONCURRENT_REQUESTS:
                 rec = requests_db.next_new()
                 if rec is not None:
@@ -112,6 +130,12 @@ class Executor(threading.Thread):
         if user.get("id"):
             env["SKYPILOT_TPU_USER_ID"] = user["id"]
             env["SKYPILOT_TPU_USER_NAME"] = user.get("name", user["id"])
+        # Trace context crosses the process boundary as env: every span
+        # the worker (and anything the worker spawns) opens becomes a
+        # child of this request's span.
+        trace = rec.get("trace") or {}
+        if trace.get("tp"):
+            env[tracing.ENV_VAR] = trace["tp"]
         proc = subprocess.Popen(
             [sys.executable, "-m", "skypilot_tpu.server.worker",
              "--request-id", rec["request_id"]], env=env)
@@ -140,6 +164,31 @@ class Executor(threading.Thread):
                 if rec:
                     API_REQUESTS_FINISHED.labels(
                         status=rec["status"].value).inc()
+                    self._record_request_span(rec)
+
+    def _record_request_span(self, rec: Dict[str, Any]) -> None:
+        """Close the request-lifecycle span (created-at to finished-at,
+        in the server process) under the identity persisted at accept
+        time. Durability is deferred to the executor loop's throttled
+        heartbeat flush (~5s bound) — see run(). Restart-proof:
+        everything needed lives in the request record."""
+        trace = rec.get("trace") or {}
+        ctx = tracing.parse_traceparent(trace.get("tp"))
+        if ctx is None:
+            return
+        status = rec["status"]
+        tracing.record_span(
+            f"api.request:{rec['name']}",
+            rec["created_at"], rec["finished_at"] or time.time(),
+            ctx=ctx, parent_id=trace.get("parent"),
+            attrs={"request_id": rec["request_id"],
+                   "status": status.value},
+            status="ok" if status == RequestStatus.SUCCEEDED else "error",
+            error_type=None if status == RequestStatus.SUCCEEDED
+            else status.value)
+        # Durability rides the executor loop's throttled heartbeat
+        # flush (see run()) — an eager whole-buffer flush per reaped
+        # request would redo O(ring) serialization on a busy server.
 
     def stop(self) -> None:
         self._stop.set()
@@ -223,8 +272,22 @@ def make_handler(auth_token: Optional[str] = None):
             name = _ENDPOINTS.get(path)
             if name is None:
                 return self._json(404, {"error": f"no endpoint {path}"})
+            # Adopt the client's trace (malformed/absent header starts
+            # a fresh one) and mint the request's own span id NOW; the
+            # span itself is recorded when the executor reaps the
+            # worker, from this persisted identity.
+            client_ctx = tracing.parse_traceparent(
+                self.headers.get("traceparent"))
+            req_ctx = tracing.SpanContext(
+                client_ctx.trace_id if client_ctx
+                else tracing.new_trace_id(),
+                tracing.new_span_id())
+            trace = {"tp": tracing.format_traceparent(req_ctx),
+                     "parent": client_ctx.span_id if client_ctx
+                     else None}
             rid = requests_db.create(name, self._body(),
-                                     user=self._client_identity())
+                                     user=self._client_identity(),
+                                     trace=trace)
             API_REQUESTS.labels(endpoint=name).inc()
             return self._json(200, {"request_id": rid})
 
@@ -316,6 +379,7 @@ class _Server(ThreadingMixIn, HTTPServer):
 
 def serve(host: str = "127.0.0.1", port: int = 46580,
           auth_token: Optional[str] = None) -> None:
+    tracing.set_process_name("api-server")
     executor = Executor()
     executor.start()
     httpd = _Server((host, port), make_handler(auth_token))

@@ -13,6 +13,7 @@ import sys
 import traceback
 from typing import Any, Dict
 
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.requests_db import RequestStatus
 
@@ -90,6 +91,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--request-id", required=True)
     args = ap.parse_args()
+    tracing.set_process_name("worker")
     rec = requests_db.get(args.request_id)
     if rec is None:
         sys.exit(1)
@@ -97,10 +99,21 @@ def main() -> None:
     with open(log, "a", buffering=1) as f, \
             contextlib.redirect_stdout(f), contextlib.redirect_stderr(f):
         try:
-            result = _execute(rec["name"], rec["payload"])
+            # Child of the request span the server injected via
+            # SKYTPU_TRACEPARENT; every RPC span below nests in here.
+            with tracing.start_span(
+                    f"worker.execute:{rec['name']}",
+                    attrs={"request_id": args.request_id}):
+                result = _execute(rec["name"], rec["payload"])
             requests_db.finish(args.request_id, RequestStatus.SUCCEEDED,
                                result=result)
         except Exception as e:  # noqa: BLE001 — report to the client
+            tracing.add_event(
+                "worker.error",
+                attrs={"request_id": args.request_id,
+                       "error_type": type(e).__name__,
+                       "message": str(e)[:500]},
+                echo=True)
             traceback.print_exc()
             requests_db.finish(args.request_id, RequestStatus.FAILED,
                                error=f"{type(e).__name__}: {e}")

@@ -27,6 +27,7 @@ from typing import Any, Optional
 import jax
 
 from skypilot_tpu.observability import metrics as obs_metrics
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.utils import timeline
 
 # Saves are async: ``_save_seconds`` is the dispatch cost the train
@@ -79,8 +80,10 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Queue an async save. Returns False if skipped by interval."""
-        with timeline.Event("skytpu_checkpoint_save_seconds",
-                            histogram=CKPT_SAVE_SECONDS):
+        with tracing.start_span("train.checkpoint_save",
+                                attrs={"step": int(step)}), \
+                timeline.Event("skytpu_checkpoint_save_seconds",
+                               histogram=CKPT_SAVE_SECONDS):
             saved = self._mgr.save(
                 step, args=self._ocp.args.StandardSave(state),
                 force=force)
@@ -111,8 +114,9 @@ class CheckpointManager:
 
     def wait(self) -> None:
         """Block until queued async saves are durable."""
-        with timeline.Event("skytpu_checkpoint_wait_seconds",
-                            histogram=CKPT_WAIT_SECONDS):
+        with tracing.start_span("train.checkpoint_wait"), \
+                timeline.Event("skytpu_checkpoint_wait_seconds",
+                               histogram=CKPT_WAIT_SECONDS):
             self._mgr.wait_until_finished()
 
     def close(self) -> None:

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from skypilot_tpu.models import llama
 from skypilot_tpu.observability import metrics as obs_metrics
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.parallel import sharding as sh
 
 # Step-time note: the histogram records the HOST-side step call. The
@@ -66,6 +67,7 @@ def _instrument_step(step_fn: Callable) -> Callable:
     @functools.wraps(step_fn)
     def wrapper(state, batch):
         t0 = time.monotonic()
+        t0_wall = time.time()
         out = step_fn(state, batch)
         dt = max(time.monotonic() - t0, 1e-9)
         TRAIN_STEPS.inc()
@@ -80,6 +82,10 @@ def _instrument_step(step_fn: Callable) -> Callable:
             ema["warm"] = True
             return out
         STEP_SECONDS.observe(dt)
+        # Per-step trace span (joins an ambient trace when the run was
+        # launched with one; the compile step is skipped like above).
+        tracing.record_span("train.step", t0_wall, t0_wall + dt,
+                            attrs={"tokens": tokens} if tokens else None)
         if tokens:
             rate = tokens / dt
             ema["rate"] = (rate if ema["rate"] == 0.0

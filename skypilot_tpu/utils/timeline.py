@@ -151,6 +151,13 @@ class Event:
     def begin(self) -> None:
         self._begin_us = time.time() * 1e6
 
+    @property
+    def begin_s(self) -> float:
+        """Wall-clock begin time in seconds (0.0 before ``begin()``).
+        Lets co-instrumented systems (the tracing event log) reuse this
+        span's timestamps instead of re-reading the clock."""
+        return self._begin_us / 1e6
+
     def end(self) -> None:
         dur_us = time.time() * 1e6 - self._begin_us
         if self._histogram is not None:

@@ -1,0 +1,74 @@
+"""Lint: daemon/server-side modules must use the structured event log
+(``tracing.add_event``/``start_span``), not bare ``print(...)`` — a
+print is invisible to `skytpu trace` and unparseable by anything.
+
+Scope: the runtime, server, and jobs layers (the processes whose
+diagnostics feed the flight recorder). CLI-facing modules are out of
+scope, and a small allowlist grandfathers pre-tracing call sites that
+are genuine console/log output; new files start at zero.
+"""
+
+import ast
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "skypilot_tpu")
+
+SCOPED_DIRS = ("runtime", "server", "jobs")
+
+# path (relative to skypilot_tpu/) -> max allowed bare print() calls.
+# These predate the structured event log and are legitimate console or
+# per-job-log output; do NOT add entries — record an event (optionally
+# echo=True) instead.
+ALLOWLIST = {
+    "runtime/driver.py": 2,      # per-job driver log lines
+    "runtime/hostd.py": 1,       # CLI startup error before any log
+    "jobs/controller.py": 1,     # the controller's own log stream
+    "jobs/core.py": 1,           # client-facing tail_logs note
+}
+
+
+def _bare_prints(path):
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    hits = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            hits.append(node.lineno)
+    return hits
+
+
+def _scoped_files():
+    for d in SCOPED_DIRS:
+        root = os.path.join(PKG, d)
+        for dirpath, _, names in os.walk(root):
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def test_no_new_bare_prints_in_daemon_modules():
+    violations = []
+    for path in _scoped_files():
+        rel = os.path.relpath(path, PKG)
+        hits = _bare_prints(path)
+        allowed = ALLOWLIST.get(rel, 0)
+        if len(hits) > allowed:
+            violations.append(f"{rel}: {len(hits)} print() at lines "
+                              f"{hits} (allowed: {allowed})")
+    assert not violations, (
+        "bare print() in daemon/server modules — use "
+        "tracing.add_event(..., echo=True) so the message reaches the "
+        "structured event log:\n  " + "\n  ".join(violations))
+
+
+@pytest.mark.parametrize("rel", sorted(ALLOWLIST))
+def test_allowlist_entries_still_exist(rel):
+    """A renamed/cleaned-up file must drop its allowlist entry, or the
+    budget silently covers a future regression elsewhere."""
+    assert os.path.exists(os.path.join(PKG, rel)), (
+        f"{rel} gone — remove its ALLOWLIST entry")

@@ -1,0 +1,552 @@
+"""Distributed tracing: context propagation across the request
+lifecycle (CLI/SDK -> API server -> worker -> rpc), the structured
+event log, and the `skytpu trace` assembly.
+
+The e2e test runs a real API server (thread) + real worker subprocess
+and asserts the assembled tree spans at least two distinct processes —
+the acceptance bar for per-request debugging at production scale.
+"""
+
+import json
+import os
+import socket
+import sqlite3
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.observability import tracing, trace_view
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracing(tmp_path, monkeypatch):
+    """Isolate every test: its own home/events dir and a clean buffer
+    (the module-global ring + log-file name would otherwise leak state
+    across tests in this process)."""
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    monkeypatch.delenv("SKYTPU_EVENTS_DIR", raising=False)
+    monkeypatch.delenv(tracing.ENV_VAR, raising=False)
+    tracing._reset_for_tests()
+    yield
+    tracing._reset_for_tests()
+
+
+# -- traceparent wire format -------------------------------------------------
+
+def test_traceparent_round_trip():
+    ctx = tracing.SpanContext(tracing.new_trace_id(),
+                              tracing.new_span_id())
+    assert tracing.parse_traceparent(tracing.format_traceparent(ctx)) \
+        == ctx
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-zz-xx-01",
+    "00-" + "a" * 31 + "-" + "b" * 16 + "-01",     # short trace id
+    "00-" + "a" * 32 + "-" + "b" * 15 + "-01",     # short span id
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",     # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",     # all-zero span id
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",     # unknown version
+])
+def test_malformed_traceparent_rejected(bad):
+    assert tracing.parse_traceparent(bad) is None
+
+
+def test_malformed_header_falls_back_to_fresh_trace(monkeypatch):
+    monkeypatch.setenv(tracing.ENV_VAR, "not-a-traceparent")
+    with tracing.start_span("s") as sp:
+        pass
+    rec = tracing.buffered_records()[-1]
+    assert rec["parent"] is None            # fresh root, not a crash
+    assert rec["trace"] == sp.ctx.trace_id
+
+
+# -- context stack + env root ------------------------------------------------
+
+def test_nested_spans_parent_child():
+    with tracing.start_span("outer") as outer:
+        with tracing.start_span("inner") as inner:
+            assert tracing.current() == inner.ctx
+        assert tracing.current() == outer.ctx
+    assert tracing.current() is None
+    by_name = {r["name"]: r for r in tracing.buffered_records()}
+    assert by_name["inner"]["parent"] == outer.ctx.span_id
+    assert by_name["inner"]["trace"] == outer.ctx.trace_id
+    assert by_name["outer"]["parent"] is None
+
+
+def test_env_root_parents_spans(monkeypatch):
+    root = tracing.SpanContext(tracing.new_trace_id(),
+                               tracing.new_span_id())
+    monkeypatch.setenv(tracing.ENV_VAR, tracing.format_traceparent(root))
+    with tracing.start_span("child"):
+        pass
+    rec = tracing.buffered_records()[-1]
+    assert rec["trace"] == root.trace_id
+    assert rec["parent"] == root.span_id
+
+
+def test_span_records_exception_status():
+    with pytest.raises(ValueError):
+        with tracing.start_span("boom"):
+            raise ValueError("nope")
+    rec = tracing.buffered_records()[-1]
+    assert rec["status"] == "error"
+    assert rec["error_type"] == "ValueError"
+
+
+def test_add_event_detached_never_uses_ambient(monkeypatch):
+    """ctx=DETACHED records unattributed even with an env root present
+    (pre-upgrade autostop.json path: unattributed beats misattributed)."""
+    root = tracing.SpanContext(tracing.new_trace_id(),
+                               tracing.new_span_id())
+    monkeypatch.setenv(tracing.ENV_VAR, tracing.format_traceparent(root))
+    tracing.add_event("skylet.autostop_fired", ctx=tracing.DETACHED)
+    rec = tracing.buffered_records()[-1]
+    assert "trace" not in rec and "parent" not in rec
+
+
+def test_ring_buffer_bounded():
+    for i in range(tracing._MAX_RECORDS + 100):
+        tracing.add_event("e", attrs={"i": i})
+    assert len(tracing.buffered_records()) <= tracing._MAX_RECORDS
+
+
+def test_suppress_discards_spans():
+    from skypilot_tpu.observability import metrics
+    with metrics.suppress():
+        with tracing.start_span("warmup"):
+            pass
+        tracing.add_event("warmup_event")
+    assert tracing.buffered_records() == []
+
+
+# -- event log flush + assembly ---------------------------------------------
+
+def test_flush_and_load_trace_round_trip():
+    with tracing.start_span("root") as root:
+        with tracing.start_span("child"):
+            tracing.add_event("lifecycle", attrs={"k": "v"})
+    tracing.flush()
+    files = os.listdir(tracing.events_dir())
+    assert len(files) == 1 and files[0].endswith(".jsonl")
+    records = trace_view.load_trace(root.ctx.trace_id)
+    assert {r["name"] for r in records} == {"root", "child", "lifecycle"}
+    out = trace_view.render(records, root.ctx.trace_id)
+    assert "root" in out and "child" in out and "lifecycle" in out
+    # child indents under root; the event attaches under child
+    assert out.index("root") < out.index("child") < out.index("lifecycle")
+
+
+def test_corrupt_log_lines_skipped():
+    with tracing.start_span("ok") as sp:
+        pass
+    tracing.flush()
+    with open(os.path.join(tracing.events_dir(), "junk.jsonl"),
+              "w") as f:
+        f.write("{not json\n\n")
+        f.write(json.dumps({"kind": "span", "name": "other-trace",
+                            "trace": "f" * 32, "span": "1" * 16,
+                            "parent": None, "start_s": 0, "end_s": 1,
+                            "pid": 1, "proc": "x"}) + "\n")
+    records = trace_view.load_trace(sp.ctx.trace_id)
+    assert [r["name"] for r in records] == ["ok"]
+
+
+def test_orphan_span_roots_subtree():
+    """A span whose parent never flushed must not vanish."""
+    ctx = tracing.SpanContext(tracing.new_trace_id(), "a" * 16)
+    tracing.record_span("orphan", 1.0, 2.0, ctx=ctx,
+                        parent_id="dead0000dead0000")
+    roots = trace_view.build_tree(tracing.buffered_records())
+    assert [n["rec"]["name"] for n in roots] == ["orphan"]
+
+
+def test_perfetto_export_loadable():
+    with tracing.start_span("s"):
+        tracing.add_event("e")
+    doc = trace_view.to_perfetto(tracing.buffered_records())
+    json.loads(json.dumps(doc))                      # serializable
+    phases = [e["ph"] for e in doc["traceEvents"]]
+    assert "X" in phases and "i" in phases and "M" in phases
+
+
+def test_gc_event_logs_deletes_only_old_and_beyond_cap():
+    """A file dies only when it is BOTH beyond the newest-N cap AND
+    older than the TTL: a request burst must never GC minutes-old logs
+    whose requests the requests DB still serves."""
+    d = tracing.events_dir()
+    os.makedirs(d, exist_ok=True)
+    now = time.time()
+    ages = {"old-0": 9000, "old-1": 8000, "old-2": 7000,   # stale
+            "new-0": 30, "new-1": 20, "new-2": 10}         # fresh
+    for name, age in ages.items():
+        path = os.path.join(d, f"{name}.jsonl")
+        with open(path, "w") as f:
+            f.write("{}\n")
+        os.utime(path, (now - age, now - age))
+    # orphaned mkstemp temp (SIGKILL mid-flush): stale -> pruned too
+    stale_tmp = os.path.join(d, "dead-1.jsonl.a1b2c3")
+    with open(stale_tmp, "w") as f:
+        f.write("{")
+    os.utime(stale_tmp, (now - 9999, now - 9999))
+    removed = tracing.gc_event_logs(max_files=2, max_age_s=3600)
+    # the 3 stale files are beyond the newest-2 cap AND old -> gone;
+    # new-2 is beyond the cap but fresh -> kept; stale temp -> gone
+    assert removed == 4
+    assert sorted(os.listdir(d)) == ["new-0.jsonl", "new-1.jsonl",
+                                     "new-2.jsonl"]
+
+
+# -- requests_db schema v3 ---------------------------------------------------
+
+def test_requests_db_v3_trace_and_index():
+    from skypilot_tpu.server import requests_db
+    trace = {"tp": "00-" + "a" * 32 + "-" + "b" * 16 + "-01",
+             "parent": None}
+    rid = requests_db.create("status", {}, trace=trace)
+    rec = requests_db.get(rid)
+    assert rec["trace"] == trace
+    from skypilot_tpu.utils import paths
+    conn = sqlite3.connect(paths.requests_db())
+    try:
+        assert conn.execute("PRAGMA user_version").fetchone()[0] == 3
+        idx = [r[1] for r in conn.execute(
+            "PRAGMA index_list(requests)").fetchall()]
+        assert "idx_requests_status" in idx
+    finally:
+        conn.close()
+
+
+def test_requests_db_migrates_v2_to_v3():
+    """A v2 DB (pre-trace) opened by this client gains the column and
+    the status index without losing rows."""
+    from skypilot_tpu.utils import paths
+    path = paths.requests_db()
+    conn = sqlite3.connect(path)
+    conn.execute(
+        "CREATE TABLE requests (request_id TEXT PRIMARY KEY, name TEXT,"
+        " status TEXT, payload TEXT, result TEXT, error TEXT,"
+        " pid INTEGER, created_at REAL, finished_at REAL, user TEXT)")
+    conn.execute(
+        "INSERT INTO requests (request_id, name, status, payload,"
+        " created_at) VALUES ('old1', 'status', 'SUCCEEDED', '{}', 1.0)")
+    conn.execute("PRAGMA user_version=2")
+    conn.commit()
+    conn.close()
+    from skypilot_tpu.server import requests_db
+    rec = requests_db.get("old1")
+    assert rec["name"] == "status" and rec["trace"] is None
+    conn = sqlite3.connect(path)
+    try:
+        assert conn.execute("PRAGMA user_version").fetchone()[0] == 3
+        idx = [r[1] for r in conn.execute(
+            "PRAGMA index_list(requests)").fetchall()]
+        assert "idx_requests_status" in idx
+    finally:
+        conn.close()
+
+
+# -- RPC carry + transport knobs --------------------------------------------
+
+class _CaptureRunner:
+    """Command-runner double capturing the RPC wire payload."""
+
+    def __init__(self, rc=0, marker_resp=None):
+        self.rc = rc
+        self.calls = []
+        from skypilot_tpu.runtime.rpc import MARKER
+        resp = marker_resp or {"ok": True, "result": {"pong": True}}
+        self.out = MARKER + json.dumps(resp)
+
+    def framework_invocation(self, module):
+        return f"python -m {module}"
+
+    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None,
+            stdin=None):
+        self.calls.append({"cmd": cmd, "stdin": stdin,
+                           "timeout": timeout})
+        return self.rc, self.out, ""
+
+
+def test_rpc_call_carries_trace_and_timeout():
+    from skypilot_tpu.runtime import rpc_client
+    runner = _CaptureRunner()
+    rpc = rpc_client.ClusterRpc(runner, "c1")
+    with tracing.start_span("caller") as caller:
+        rpc.call("ping", timeout=7.5)
+    sent = json.loads(runner.calls[0]["stdin"])
+    assert runner.calls[0]["timeout"] == 7.5
+    carried = tracing.parse_traceparent(sent["trace"])
+    assert carried.trace_id == caller.ctx.trace_id
+    # the carried span is the rpc.ping span, a CHILD of the caller span
+    by_name = {r["name"]: r for r in tracing.buffered_records()}
+    assert by_name["rpc.ping"]["span"] == carried.span_id
+    assert by_name["rpc.ping"]["parent"] == caller.ctx.span_id
+
+
+def test_rpc_default_timeout_and_metrics():
+    from skypilot_tpu.observability import metrics
+    from skypilot_tpu.runtime import rpc_client
+    runner = _CaptureRunner()
+    rpc_client.ClusterRpc(runner, "c1").call("ping")
+    assert runner.calls[0]["timeout"] == \
+        rpc_client.DEFAULT_TIMEOUT_SECONDS
+    fam = metrics.REGISTRY.get("skytpu_rpc_seconds")
+    counts = {vals: child.hist_state()[0]
+              for vals, child in fam.children()}
+    assert sum(counts[("ping",)]) >= 1
+
+
+def test_rpc_timeout_is_transport_failure():
+    """A hung transport must surface as the typed RPC error AND count
+    as kind=transport — not escape as a raw TimeoutExpired that skips
+    the instrumentation."""
+    import subprocess as sp
+    from skypilot_tpu.observability import metrics
+    from skypilot_tpu.runtime import rpc_client
+
+    class _HungRunner(_CaptureRunner):
+        def run(self, cmd, env=None, cwd=None, timeout=None,
+                log_path=None, stdin=None):
+            raise sp.TimeoutExpired(cmd, timeout)
+
+    with pytest.raises(rpc_client.ClusterRpcError) as ei:
+        rpc_client.ClusterRpc(_HungRunner(), "c1").call(
+            "set_autostop", timeout=3)
+    assert "timed out after 3" in str(ei.value)
+    fam = metrics.REGISTRY.get("skytpu_rpc_failures_total")
+    vals = {v: c.value for v, c in fam.children()}
+    assert vals.get(("set_autostop", "transport"), 0) >= 1
+
+
+def test_rpc_connection_error_is_transport_failure_and_retries():
+    """An agent-down ConnectionRefusedError (OSError, not a timeout)
+    must count as kind=transport, retry for idempotent methods, and
+    surface as the typed RPC error."""
+    from skypilot_tpu.observability import metrics
+    from skypilot_tpu.runtime import rpc_client
+
+    class _DownRunner(_CaptureRunner):
+        def run(self, cmd, env=None, cwd=None, timeout=None,
+                log_path=None, stdin=None):
+            self.calls.append({})
+            raise ConnectionRefusedError("agent down")
+
+    runner = _DownRunner()
+    before = 0
+    fam = metrics.REGISTRY.get("skytpu_rpc_failures_total")
+    if fam is not None:
+        before = {v: c.value for v, c in fam.children()}.get(
+            ("ping", "transport"), 0)
+    with pytest.raises(rpc_client.ClusterRpcError) as ei:
+        rpc_client.ClusterRpc(runner, "c1").call("ping")
+    assert "ConnectionRefusedError" in str(ei.value)
+    assert len(runner.calls) == rpc_client._TRANSPORT_RETRIES  # retried
+    fam = metrics.REGISTRY.get("skytpu_rpc_failures_total")
+    vals = {v: c.value for v, c in fam.children()}
+    assert vals.get(("ping", "transport"), 0) >= \
+        before + rpc_client._TRANSPORT_RETRIES
+
+
+def test_set_autostop_persists_arming_trace(monkeypatch, tmp_path):
+    """The skylet must attribute autostop outcomes to the request that
+    ARMED autostop: set_autostop persists the caller's context, and
+    add_event(ctx=...) attaches to it."""
+    from skypilot_tpu.runtime import rpc as rpc_mod
+    cdir = str(tmp_path / "cdir")
+    os.makedirs(cdir, exist_ok=True)
+    arm = tracing.SpanContext(tracing.new_trace_id(),
+                              tracing.new_span_id())
+    monkeypatch.setenv(tracing.ENV_VAR, tracing.format_traceparent(arm))
+    monkeypatch.setattr(rpc_mod, "_ensure_skylet", lambda *a: None)
+    rpc_mod._m_set_autostop("c1", cdir, {"idle_minutes": 5,
+                                         "down": False})
+    from skypilot_tpu.runtime import topology
+    with open(os.path.join(cdir, topology.AUTOSTOP_CONFIG)) as f:
+        cfg = json.load(f)
+    ctx = tracing.parse_traceparent(cfg["trace"])
+    assert ctx.trace_id == arm.trace_id
+    monkeypatch.delenv(tracing.ENV_VAR)
+    tracing.add_event("skylet.autostop_fired", attrs={"down": False},
+                      ctx=ctx)
+    rec = tracing.buffered_records()[-1]
+    assert rec["trace"] == arm.trace_id and rec["parent"] == ctx.span_id
+
+
+def test_rpc_failure_counted_by_kind():
+    from skypilot_tpu.observability import metrics
+    from skypilot_tpu.runtime import rpc_client
+    runner = _CaptureRunner(
+        marker_resp={"ok": False, "error": "x", "etype": "Nope"})
+    with pytest.raises(rpc_client.ClusterRpcError):
+        rpc_client.ClusterRpc(runner, "c1").call("ping")
+    fam = metrics.REGISTRY.get("skytpu_rpc_failures_total")
+    vals = {v: c.value for v, c in fam.children()}
+    assert vals.get(("ping", "remote"), 0) >= 1
+    # the rpc.ping span carries the error status
+    rec = [r for r in tracing.buffered_records()
+           if r["name"] == "rpc.ping"][-1]
+    assert rec["status"] == "error"
+
+
+def test_rpc_subprocess_installs_carried_context(tmp_path):
+    """The head-side rpc process parents its dispatch span to the
+    carried context and flushes it to ITS home's event log."""
+    import subprocess
+    import sys
+    home = tmp_path / "headhome"
+    parent = tracing.SpanContext(tracing.new_trace_id(),
+                                 tracing.new_span_id())
+    req = {"method": "ping", "params": {},
+           "trace": tracing.format_traceparent(parent)}
+    env = {**os.environ, "SKYPILOT_TPU_HOME": str(home)}
+    env.pop(tracing.ENV_VAR, None)
+    out = subprocess.run(
+        [sys.executable, "-S", "-m", "skypilot_tpu.runtime.rpc",
+         "--cluster", "tc"],
+        input=json.dumps(req), capture_output=True, text=True, env=env,
+        cwd="/root/repo", timeout=60)
+    assert out.returncode == 0, out.stderr
+    records = trace_view.load_trace(
+        parent.trace_id, dirs=[str(home / "events")])
+    disp = [r for r in records if r["name"] == "rpc.dispatch:ping"]
+    assert disp and disp[0]["parent"] == parent.span_id
+    assert disp[0]["proc"] == "rpc"
+
+
+# -- engine span volume ------------------------------------------------------
+
+def test_engine_records_one_decode_span_per_request():
+    """Per-slot-per-burst decode spans would flood the ring at high
+    occupancy; the engine records exactly one engine.decode per
+    finished multi-token request (plus queue_wait/prefill/request)."""
+    import jax
+    from skypilot_tpu.infer import engine as eng
+    from skypilot_tpu.models import llama
+    cfg = llama.CONFIGS["llama3-tiny"]
+    params = llama.init_params(jax.random.key(0), cfg)
+    e = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                            prompt_buckets=(16, 64))
+    caller = tracing.SpanContext(tracing.new_trace_id(),
+                                 tracing.new_span_id())
+    e.add_request([1, 2, 3], max_new_tokens=12, trace_ctx=caller)
+    e.run_to_completion(max_burst=4)          # several bursts
+    recs = [r for r in tracing.buffered_records()
+            if r.get("trace") == caller.trace_id]
+    names = [r["name"] for r in recs]
+    assert names.count("engine.decode") == 1
+    assert names.count("engine.request") == 1
+    assert names.count("engine.prefill") == 1
+    assert names.count("engine.queue_wait") == 1
+    req = next(r for r in recs if r["name"] == "engine.request")
+    assert req["parent"] == caller.span_id
+
+
+# -- e2e: CLI/SDK -> API server -> worker -----------------------------------
+
+@pytest.fixture()
+def api_server(tmp_path, monkeypatch):
+    from skypilot_tpu.server import server as server_mod
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    monkeypatch.setenv("SKYTPU_API_SERVER_URL",
+                       f"http://127.0.0.1:{port}")
+    executor = server_mod.Executor()
+    executor.start()
+    httpd = server_mod._Server(("127.0.0.1", port),
+                               server_mod.make_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    executor.stop()
+    httpd.shutdown()
+
+
+def _wait_reaped(rid, timeout=60):
+    """The request span is recorded (and flushed) when the executor
+    reaps the worker — shortly after the DB flips to a terminal
+    status."""
+    from skypilot_tpu.observability import tracing as tr
+    from skypilot_tpu.server import requests_db
+    deadline = time.time() + timeout
+    rec = requests_db.get(rid)
+    trace_id = tr.parse_traceparent(rec["trace"]["tp"]).trace_id
+    while time.time() < deadline:
+        records = trace_view.load_trace(trace_id)
+        if any(r["name"].startswith("api.request:") for r in records):
+            return trace_id, records
+        time.sleep(0.2)
+    raise AssertionError(f"request span for {rid} never flushed")
+
+
+def test_trace_e2e_spans_two_processes(api_server):
+    """Acceptance: a request that traversed SDK -> API server -> worker
+    assembles into ONE tree with >= 3 spans from >= 2 distinct
+    processes, parent/child edges intact, and --perfetto loads."""
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    from skypilot_tpu.client import sdk
+
+    rid = sdk.status()               # cheap worker: sky.status, no rpc
+    sdk.get(rid, timeout=120)
+    tracing.flush()                  # the client-side sdk.request span
+    trace_id, records = _wait_reaped(rid)
+
+    spans = [r for r in records if r["kind"] == "span"]
+    assert len(spans) >= 3
+    assert len({r["pid"] for r in spans}) >= 2
+    by_name = {r["name"]: r for r in spans}
+    api = by_name["api.request:status"]
+    worker = by_name["worker.execute:status"]
+    sdk_span = by_name["sdk.request:/status"]
+    # one tree: sdk -> api request -> worker execution
+    assert api["parent"] == sdk_span["span"]
+    assert worker["parent"] == api["span"]
+    assert worker["proc"] == "worker"
+    assert api["pid"] != worker["pid"]
+
+    runner = CliRunner()
+    perfetto = os.path.join(os.path.dirname(tracing.events_dir()),
+                            "trace.json")
+    res = runner.invoke(cli_mod.cli,
+                        ["trace", rid, "--perfetto", perfetto])
+    assert res.exit_code == 0, res.output
+    assert "api.request:status" in res.output
+    assert "worker.execute:status" in res.output
+    # the tree indents the worker under the request span
+    api_line = next(line for line in res.output.splitlines()
+                    if "api.request:status" in line)
+    worker_line = next(line for line in res.output.splitlines()
+                       if "worker.execute:status" in line)
+    assert (len(worker_line) - len(worker_line.lstrip())
+            > len(api_line) - len(api_line.lstrip()))
+    with open(perfetto) as f:
+        doc = json.load(f)
+    assert len([e for e in doc["traceEvents"]
+                if e["ph"] == "X"]) >= 3
+
+
+def test_trace_cli_unknown_request(api_server):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    res = CliRunner().invoke(cli_mod.cli, ["trace", "nope"])
+    assert res.exit_code != 0
+    assert "no request" in res.output
+
+
+def test_failed_request_trace_marks_error(api_server):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.client import sdk
+    rid = sdk.queue("no-such-cluster")
+    with pytest.raises(exceptions.SkyTpuError):
+        sdk.get(rid, timeout=60)
+    trace_id, records = _wait_reaped(rid)
+    api = next(r for r in records
+               if r["name"] == "api.request:queue")
+    assert api["status"] == "error"
+    worker_err = [r for r in records if r["name"] == "worker.error"]
+    assert worker_err and worker_err[0]["attrs"]["error_type"]
