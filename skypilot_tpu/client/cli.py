@@ -155,6 +155,75 @@ def _print_metrics_view(text: str, raw: bool) -> None:
                                   f"{value:g}", labels_s))
 
 
+def _fleet_fetch(need_metrics: bool = True):
+    """Fetch the API server's federated fleet view: (families or None,
+    health payload). Raises ClickException with an actionable message
+    when the server is unreachable."""
+    import json as json_lib
+    import urllib.error
+    import urllib.request
+
+    from skypilot_tpu.client import sdk as sdk_mod
+    from skypilot_tpu.observability import metrics as metrics_lib
+
+    def fetch(path):
+        req = urllib.request.Request(sdk_mod._url() + path,
+                                     headers=sdk_mod._headers())
+        try:
+            with urllib.request.urlopen(req, timeout=20) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            raise click.ClickException(
+                f"GET {sdk_mod._url()}{path} failed: "
+                f"HTTP {e.code} {e.reason}")
+        except OSError:
+            raise click.ClickException(
+                f"API server at {sdk_mod._url()} is not reachable "
+                f"(try `skytpu api start`)")
+
+    families = None
+    if need_metrics:
+        families = metrics_lib.parse_exposition(
+            fetch("/metrics/fleet").decode())
+    payload = json_lib.loads(fetch("/api/fleet/health"))
+    return families, payload
+
+
+_HEALTH_MARK = {"healthy": "+", "degraded": "~", "dead": "x"}
+
+
+def _health_lines(payload) -> list:
+    """Component table + alert lines shared by `status --health` and
+    `skytpu top`."""
+    lines = []
+    comps = payload.get("components", [])
+    counts = {}
+    for c in comps:
+        counts[c["status"]] = counts.get(c["status"], 0) + 1
+    summary = ", ".join(f"{n} {s}" for s, n in sorted(counts.items()))
+    alerts = payload.get("alerts", [])
+    lines.append(f"fleet: {payload.get('status', '?').upper()} "
+                 f"({summary or 'no components'}) — "
+                 f"{len(alerts)} active alert(s)")
+    import time as time_mod
+    for a in alerts:
+        age = max(time_mod.time() - a.get("since", time_mod.time()), 0)
+        lines.append(f"  ALERT {a.get('rule')}: "
+                     f"{a.get('attrs', {}).get('kind', '')} "
+                     f"firing for {age:.0f}s")
+    fmt = "{:<3}{:<18}{:<22}{:<10}{:>10}  {}"
+    lines.append(fmt.format("", "COMPONENT", "INSTANCE", "HEALTH",
+                            "SEEN(S)", "REASON"))
+    for c in comps:
+        seen = c.get("last_seen_s")
+        lines.append(fmt.format(
+            _HEALTH_MARK.get(c["status"], "?"), c["component"],
+            c["instance"], c["status"],
+            f"{seen:.0f}" if seen is not None else "-",
+            c.get("reason") or ""))
+    return lines
+
+
 @cli.command()
 @click.option("--refresh", "-r", is_flag=True, default=False)
 @click.option("--ip", "show_ip", is_flag=True, default=False,
@@ -163,14 +232,28 @@ def _print_metrics_view(text: str, raw: bool) -> None:
 @click.option("--metrics", "show_metrics", is_flag=True, default=False,
               help="Show the API server's live metrics (scraped from "
                    "its GET /metrics) instead of the cluster table.")
+@click.option("--health", "show_health", is_flag=True, default=False,
+              help="Show fleet component health (API server's "
+                   "/api/fleet/health) instead of the cluster table.")
 @click.option("--raw", is_flag=True, default=False,
               help="With --metrics: print the Prometheus text "
                    "exposition verbatim.")
 @click.argument("clusters", nargs=-1)
-def status(refresh, show_ip, show_metrics, raw, clusters):
-    """Show clusters (or, with --metrics, live server metrics)."""
+def status(refresh, show_ip, show_metrics, show_health, raw, clusters):
+    """Show clusters (or live server metrics / fleet health)."""
     if raw and not show_metrics:
         raise click.ClickException("--raw only applies with --metrics")
+    if show_health:
+        if clusters or refresh or show_ip or show_metrics:
+            raise click.ClickException(
+                "--health shows the fleet component table and cannot "
+                "be combined with cluster names or other modes")
+        _, payload = _fleet_fetch(need_metrics=False)
+        for line in _health_lines(payload):
+            click.echo(line)
+        if payload.get("status") != "healthy":
+            sys.exit(2)
+        return
     if show_metrics:
         if clusters or refresh or show_ip:
             raise click.ClickException(
@@ -237,6 +320,135 @@ def status(refresh, show_ip, show_metrics, raw, clusters):
             r["name"], r["status"].value,
             f"{h.get('provider')}:{desc}@{h.get('zone')}",
             h.get("num_nodes", 1), f"{r['price_per_hour']:.2f}"))
+
+
+def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
+    """One `skytpu top` frame: the health table plus fleet-wide rates
+    and latencies. Counter rates need two snapshots — the first frame
+    (and --once) shows '-' where a delta would go."""
+    from skypilot_tpu.observability import aggregate, slo
+
+    span = (now - prev_ts) if prev_ts else None
+
+    def rate(name, match=None, sample_name=None):
+        if prev is None or not span:
+            return None
+        d = aggregate.delta(prev, fams, name, match=match,
+                            sample_name=sample_name)
+        return d / span if d is not None else None
+
+    def rate_prefix(name, label, prefix):
+        if prev is None or not span:
+            return None
+        d = aggregate.filtered_delta(
+            prev, fams, name,
+            lambda labels: str(labels.get(label, "")).startswith(prefix))
+        return d / span if d is not None else None
+
+    def gauge(name, agg="sum"):
+        return aggregate.sample_value(fams, name, agg=agg)
+
+    def f_rate(v):
+        return f"{v:6.2f}/s" if v is not None else "      -"
+
+    def f_ms(v):
+        return f"{v * 1e3:7.1f}ms" if v is not None else "        -"
+
+    lines = _health_lines(payload)
+    lines.append("")
+    have = fams.keys()
+    if "skytpu_http_requests_total" in have or \
+            "skytpu_ttft_seconds" in have:
+        ttft = aggregate.histogram_quantile(prev, fams,
+                                            "skytpu_ttft_seconds", 0.95)
+        slots = gauge("skytpu_slots_active")
+        slots_total = gauge("skytpu_slots_total")
+        lines.append(
+            f"serve   req {f_rate(rate('skytpu_http_requests_total'))}"
+            f"  5xx {f_rate(rate_prefix('skytpu_http_requests_total', 'code', '5'))}"
+            f"  ttft p95 {f_ms(ttft)}"
+            f"  slots {slots:.0f}/{slots_total:.0f}"
+            if slots is not None and slots_total else
+            f"serve   req {f_rate(rate('skytpu_http_requests_total'))}"
+            f"  5xx {f_rate(rate_prefix('skytpu_http_requests_total', 'code', '5'))}"
+            f"  ttft p95 {f_ms(ttft)}")
+    if "skytpu_lb_proxied_total" in have:
+        lines.append(
+            f"lb      proxied {f_rate(rate('skytpu_lb_proxied_total'))}"
+            f"  retries {f_rate(rate('skytpu_lb_retries_total'))}")
+    if "skytpu_api_requests_total" in have:
+        busy = gauge("skytpu_api_workers_busy")
+        lines.append(
+            f"api     req {f_rate(rate('skytpu_api_requests_total'))}"
+            f"  workers busy {busy:.0f}" if busy is not None else
+            f"api     req {f_rate(rate('skytpu_api_requests_total'))}")
+    if "skytpu_train_step_last_seconds" in have:
+        last = gauge("skytpu_train_step_last_seconds", agg="max")
+        med = gauge("skytpu_train_step_median_seconds", agg="max")
+        tps = gauge("skytpu_train_tokens_per_second")
+        lines.append(f"train   step {f_ms(last)} (median {f_ms(med)})"
+                     f"  tokens {f_rate(tps)}")
+    # Oldest heartbeat = worst skylet; the freshest would mask a
+    # wedged sibling.
+    hb = gauge("skytpu_skylet_last_tick_timestamp_seconds", agg="min")
+    if hb:
+        lines.append(f"skylet  oldest heartbeat age {max(now - hb, 0):.0f}s")
+    down = [t for t in fams.get("skytpu_fleet_scrape_up",
+                                {"samples": []})["samples"]
+            if t[1] == 0]
+    if down:
+        names = ", ".join(
+            f"{lab.get('component')}/{lab.get('instance')}"
+            for lab, _ in down)
+        lines.append(f"scrape  DOWN: {names}")
+    return "\n".join(lines)
+
+
+@cli.command(name="top")
+@click.option("--interval", "-n", type=float, default=2.0,
+              show_default=True, help="Seconds between refreshes.")
+@click.option("--once", is_flag=True, default=False,
+              help="Render a single frame and exit (scripting/tests; "
+                   "rate columns need two frames and show '-').")
+def top(interval, once):
+    """Live fleet overview: component health, rates, latencies, alerts.
+
+    Data comes from the API server's federation tier (`GET
+    /metrics/fleet` + `/api/fleet/health`), so one terminal covers the
+    API server, every model-server replica, the load balancers, serve
+    controllers, and local skylets.
+    """
+    import time as time_mod
+    prev, prev_ts = None, None
+    try:
+        while True:
+            try:
+                families, payload = _fleet_fetch()
+            except click.ClickException:
+                if once:
+                    raise
+                # The monitoring view must survive the outage it
+                # exists to display: render a DOWN frame and retry
+                # next interval instead of dying mid-incident.
+                click.clear()
+                click.echo(f"fleet: API SERVER UNREACHABLE "
+                           f"(retrying every {max(interval, 0.1):g}s, "
+                           f"Ctrl-C to exit)")
+                prev, prev_ts = None, None
+                time_mod.sleep(max(interval, 0.1))
+                continue
+            now = time_mod.time()
+            frame = _render_top_frame(prev, prev_ts, families, now,
+                                      payload)
+            if once:
+                click.echo(frame)
+                return
+            click.clear()
+            click.echo(frame)
+            prev, prev_ts = families, now
+            time_mod.sleep(max(interval, 0.1))
+    except KeyboardInterrupt:
+        pass
 
 
 @cli.command(name="trace")

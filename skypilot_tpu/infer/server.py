@@ -38,6 +38,7 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 from socketserver import ThreadingMixIn
 from typing import Dict, Optional
 
+from skypilot_tpu.observability import health as health_lib
 from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.utils import timeline
 
@@ -129,6 +130,11 @@ class ModelServer:
         # engines without the pair fall back to sync decode_burst.
         self._burst = None
         self._async_decode = hasattr(engine, "dispatch_decode_burst")
+        # Component health detail behind GET /healthz: "" while
+        # serving; a reason string while warming or after a failed
+        # engine reset (the two _ready-unset states a probe must tell
+        # apart — one recovers by waiting, one needs replacement).
+        self.health_reason = "warming"
         self._inbox_lock = threading.Lock()
         self._inbox: list = []
         self._pending: Dict[int, _Pending] = {}   # loop-thread only
@@ -203,6 +209,7 @@ class ModelServer:
             self.engine.finished.clear()
         except Exception as e:  # noqa: BLE001
             print(f"model server warmup failed: {e}", file=sys.stderr)
+        self.health_reason = ""
         self._ready.set()
         while not self._stop.is_set():
             try:
@@ -224,6 +231,7 @@ class ModelServer:
                 except Exception as e2:  # noqa: BLE001
                     print(f"engine reset failed, marking unhealthy: "
                           f"{e2}", file=sys.stderr)
+                    self.health_reason = "engine reset failed"
                     self._ready.clear()
                 for p in self._pending.values():
                     p.result = {"error": f"engine failure: {e}"}
@@ -371,7 +379,8 @@ class _Threading(ThreadingMixIn, HTTPServer):
     request_queue_size = 128
 
 
-_KNOWN_ROUTES = frozenset({"/health", "/metrics", "/generate"})
+_KNOWN_ROUTES = frozenset({"/health", "/healthz", "/metrics",
+                           "/generate"})
 
 
 def make_handler(model: ModelServer):
@@ -406,6 +415,15 @@ def make_handler(model: ModelServer):
                 if model._ready.is_set():
                     return self._json(200, {"status": "ok"})
                 return self._json(503, {"status": "warming"})
+            if self.path == "/healthz":
+                # The fleet health model's shape: always 200 (the
+                # probe succeeded), status carries the verdict.
+                ready = model._ready.is_set()
+                health_lib.write_healthz(
+                    self,
+                    health_lib.HEALTHY if ready else health_lib.DEGRADED,
+                    reason=model.health_reason)
+                return self._observe(200)
             if self.path == "/metrics":
                 metrics.write_exposition(self)
                 return self._observe(200)

@@ -1,0 +1,270 @@
+"""Component health model: one cheap answer per fleet process.
+
+Every participating process answers a ``healthz`` probe — HTTP on the
+servers (API server, model server, load balancer all serve ``GET
+/healthz``), RPC on the skylet (the ``healthz`` method reads the
+heartbeat the skylet's tick loop persists) — and every answer has the
+same shape::
+
+    {"status": "healthy" | "degraded" | "dead",
+     "reason": <human string>,
+     "last_seen_s": <seconds since the component last showed life>}
+
+``healthy`` serves traffic; ``degraded`` is up but impaired (model
+server warming/engine-reset-failed, LB with zero ready replicas, stale
+skylet heartbeat); ``dead`` is unreachable or known-gone. Derivations
+reuse what already exists — the ``skytpu_skylet_last_tick_timestamp_
+seconds`` heartbeat gauge and the serve DB's replica probe state — no
+new wire protocol.
+
+Stdlib-only (the rpc ``healthz`` method runs under ``python -S``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DEAD = "dead"
+
+# Heartbeat older than this marks a daemon degraded (it ticks every
+# ~10s by default); a dead process file-ages past it within a minute.
+DEFAULT_STALE_AFTER_S = 60.0
+
+
+def component(comp: str, instance: str, status: str, reason: str = "",
+              last_seen_s: Optional[float] = None) -> Dict[str, Any]:
+    return {"component": comp, "instance": instance, "status": status,
+            "reason": reason, "last_seen_s": last_seen_s}
+
+
+def healthz_payload(status: str, reason: str = "",
+                    last_seen_s: float = 0.0) -> Dict[str, Any]:
+    """The body every ``GET /healthz`` returns."""
+    return {"status": status, "reason": reason,
+            "last_seen_s": round(last_seen_s, 3)}
+
+
+def write_healthz(handler, status: str, reason: str = "",
+                  last_seen_s: float = 0.0) -> None:
+    """Serve ``GET /healthz`` on a ``BaseHTTPRequestHandler``: 200 for
+    healthy/degraded (the probe succeeded; the STATUS carries the
+    verdict), so only an unreachable process reads as dead."""
+    body = json.dumps(healthz_payload(status, reason,
+                                      last_seen_s)).encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def probe_http(url: str, timeout: float = 2.0,
+               comp: str = "", instance: str = "") -> Dict[str, Any]:
+    """Probe a ``/healthz`` URL. Connection failure = dead; a 200 with
+    a payload passes the payload's own verdict through; a non-200
+    (e.g. the model server's 503-while-warming ``/health``) = degraded."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            raw = r.read().decode("utf-8", errors="replace")
+        try:
+            payload = json.loads(raw)
+        except ValueError:
+            payload = {}
+        status = payload.get("status", HEALTHY)
+        if status not in (HEALTHY, DEGRADED, DEAD):
+            # /health-style {"status": "ok"} answers map onto the model.
+            status = HEALTHY if status in ("ok", "healthy") else DEGRADED
+        return component(comp, instance, status,
+                         reason=payload.get("reason", ""),
+                         last_seen_s=payload.get("last_seen_s", 0.0))
+    except urllib.error.HTTPError as e:
+        # last_seen_s stays unknown (None): an error reply proves the
+        # port answers, not that the COMPONENT showed life — reporting
+        # the probe's own latency here would render as "seen 0s ago"
+        # for a server that has been failing for an hour.
+        return component(comp, instance, DEGRADED,
+                         reason=f"HTTP {e.code}", last_seen_s=None)
+    except Exception as e:  # noqa: BLE001 — unreachable = dead
+        return component(comp, instance, DEAD,
+                         reason=f"{type(e).__name__}: {e}",
+                         last_seen_s=None)
+
+
+def pid_running(pid: Optional[int]) -> bool:
+    """The one pid-liveness check the health model uses (signal 0)."""
+    if pid is None:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def _pid_alive(pidfile: str) -> Optional[bool]:
+    """Pidfile liveness: None = no/unreadable pidfile (distinct from a
+    recorded-but-dead pid)."""
+    try:
+        pid = int(open(pidfile).read().strip())
+    except (OSError, ValueError):
+        return None
+    return pid_running(pid)
+
+
+def skylet_expected(cdir: str) -> bool:
+    """Whether a live skylet SHOULD exist for this cluster dir: armed
+    autostop that has not fired yet, or a recorded still-running pid.
+    Shared by :func:`skylet_health` and endpoint discovery — a skylet
+    that exited by design (unarmed, or after successfully firing
+    autostop) must read as idle, not dead, and its frozen heartbeat
+    must not feed the staleness SLO rule forever."""
+    if _pid_alive(os.path.join(cdir, "skylet.pid")):
+        return True
+    return (os.path.exists(os.path.join(cdir, "autostop.json"))
+            and not os.path.exists(os.path.join(cdir,
+                                                "autostop_fired")))
+
+
+def skylet_health(cdir: str,
+                  stale_after_s: float = DEFAULT_STALE_AFTER_S
+                  ) -> Dict[str, Any]:
+    """Skylet liveness from what the head's disk already holds: the
+    pidfile and the heartbeat gauge inside the per-tick exposition
+    file. Answerable by the rpc ``healthz`` method without touching
+    the daemon itself."""
+    from skypilot_tpu.observability import aggregate, metrics
+    name = os.path.basename(cdir.rstrip(os.sep))
+    pidfile = os.path.join(cdir, "skylet.pid")
+    alive = _pid_alive(pidfile)
+    last_tick: Optional[float] = None
+    try:
+        with open(os.path.join(cdir, aggregate.METRICS_FILENAME),
+                  encoding="utf-8") as f:
+            fams = metrics.parse_exposition(f.read())
+        last_tick = aggregate.sample_value(
+            fams, "skytpu_skylet_last_tick_timestamp_seconds", agg="max")
+    except (OSError, ValueError):
+        pass
+    age = time.time() - last_tick if last_tick else None
+    if not alive and not skylet_expected(cdir):
+        # The skylet exits by design when autostop is unset (and after
+        # a SUCCESSFUL autostop fire — autostop.json stays behind but
+        # the autostop_fired marker records the outcome); absence is
+        # health, not death.
+        fired = os.path.exists(os.path.join(cdir, "autostop_fired"))
+        return component("skylet", name, HEALTHY,
+                         reason=("autostop fired; cluster stopped"
+                                 if fired else
+                                 "idle (autostop not armed)"),
+                         last_seen_s=age)
+    if alive:
+        if age is not None and age > stale_after_s:
+            return component(
+                "skylet", name, DEGRADED,
+                reason=f"heartbeat stale ({age:.0f}s since last tick)",
+                last_seen_s=age)
+        return component("skylet", name, HEALTHY, last_seen_s=age)
+    return component("skylet", name, DEAD,
+                     reason="autostop armed but skylet process gone",
+                     last_seen_s=age)
+
+
+def _probe_replica(r: Dict[str, Any], name: str,
+                   timeout: float) -> Dict[str, Any]:
+    inst = f"{name}/{r['replica_id']}"
+    status_v = r["status"].value
+    if status_v in ("PROVISIONING", "STARTING"):
+        return component("model-server", inst, DEGRADED,
+                         reason=status_v.lower())
+    if status_v in ("FAILED", "PREEMPTED", "SHUTDOWN", "SHUTTING_DOWN"):
+        return component("model-server", inst, DEAD,
+                         reason=status_v.lower())
+    if not r["url"]:
+        return component("model-server", inst, DEGRADED,
+                         reason="no url yet")
+    probed = probe_http(f"{r['url']}/healthz", timeout=timeout,
+                        comp="model-server", instance=inst)
+    if probed["status"] == HEALTHY and status_v == "NOT_READY":
+        # The controller's prober disagrees: trust the pessimist (the
+        # LB is not routing there).
+        probed = component("model-server", inst, DEGRADED,
+                           reason="controller marked NOT_READY",
+                           last_seen_s=probed["last_seen_s"])
+    return probed
+
+
+def fleet_health(api_self: Optional[Dict[str, Any]] = None,
+                 host: str = "127.0.0.1",
+                 timeout: float = 2.0) -> List[Dict[str, Any]]:
+    """Assemble the component table this host can see: serve
+    controllers (pid liveness), load balancers (HTTP healthz),
+    replicas (HTTP healthz cross-checked against the serve DB's probe
+    state), and local-home skylets. ``api_self`` prepends the calling
+    API server's own entry. HTTP probes run on a small thread pool —
+    sequential probing of N down components would cost N x timeout
+    exactly when the table matters (an outage)."""
+    import functools
+    jobs: List[Any] = []   # resolved components OR callables to probe
+    if api_self is not None:
+        jobs.append(api_self)
+    try:
+        from skypilot_tpu.serve import serve_state
+        for svc in serve_state.list_services():
+            if svc["status"].is_terminal():
+                continue
+            name = svc["name"]
+            ctrl_alive = pid_running(svc.get("controller_pid"))
+            jobs.append(component(
+                "serve-controller", name,
+                HEALTHY if ctrl_alive else DEAD,
+                reason="" if ctrl_alive else "controller process gone"))
+            if svc.get("lb_port"):
+                jobs.append(functools.partial(
+                    probe_http,
+                    f"http://{host}:{svc['lb_port']}/healthz",
+                    timeout=timeout, comp="load-balancer",
+                    instance=name))
+            for r in serve_state.list_replicas(name):
+                jobs.append(functools.partial(_probe_replica, r, name,
+                                              timeout))
+    except Exception:  # noqa: BLE001 — no serve DB is a healthy fleet
+        pass
+    try:
+        from skypilot_tpu.observability import aggregate
+        from skypilot_tpu.utils import paths
+        clusters_root = os.path.join(paths.home(), "clusters")
+        if os.path.isdir(clusters_root):
+            for cname in sorted(os.listdir(clusters_root)):
+                cdir = os.path.join(clusters_root, cname)
+                if os.path.exists(os.path.join(
+                        cdir, aggregate.METRICS_FILENAME)):
+                    jobs.append(functools.partial(skylet_health, cdir))
+    except OSError:
+        pass
+    callables = [j for j in jobs if callable(j)]
+    if len(callables) > 1:
+        import concurrent.futures
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(callables))) as pool:
+            futures = {id(j): pool.submit(j) for j in jobs
+                       if callable(j)}
+            return [futures[id(j)].result() if callable(j) else j
+                    for j in jobs]
+    return [j() if callable(j) else j for j in jobs]
+
+
+def worst(components: List[Dict[str, Any]]) -> str:
+    """Fleet-level rollup: dead beats degraded beats healthy."""
+    rank = {HEALTHY: 0, DEGRADED: 1, DEAD: 2}
+    status = HEALTHY
+    for c in components:
+        if rank.get(c["status"], 0) > rank[status]:
+            status = c["status"]
+    return status

@@ -23,6 +23,7 @@ from __future__ import annotations
 import bisect
 import contextlib
 import math
+import os
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -478,6 +479,30 @@ def write_exposition(handler) -> None:
     handler.send_header("Content-Length", str(len(body)))
     handler.end_headers()
     handler.wfile.write(body)
+
+
+def write_exposition_file(path: str) -> None:
+    """Atomically persist the global registry's exposition to ``path``
+    (tempfile + ``os.replace``, the timeline/tracing pattern). This is
+    how daemons WITHOUT an HTTP surface (skylet, serve controller)
+    publish their registries: the federation tier and the rpc
+    ``get_metrics`` method read the file, and its mtime doubles as a
+    liveness signal."""
+    import tempfile
+    body = render()
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d,
+                               prefix=os.path.basename(path) + ".")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(body)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def parse_exposition(text: str) -> Dict[str, dict]:

@@ -221,12 +221,44 @@ def _m_set_autostop(cluster_name, cdir, p):
                        "set_at": time.time(),
                        "trace": tracing.traceparent()}, f)
         os.replace(tmp, cfg_path)
+        # Arming anew invalidates a previous fire's outcome marker —
+        # left behind, a later skylet crash would read as "exited by
+        # design" to the health model instead of dead.
+        try:
+            os.remove(os.path.join(cdir, "autostop_fired"))
+        except OSError:
+            pass
         _ensure_skylet(cluster_name, cdir)
     return {"autostop": idle}
 
 
 def _m_is_idle(cluster_name, cdir, p):
     return {"idle": job_queue.is_idle(_db(cdir))}
+
+
+def _m_get_metrics(cluster_name, cdir, p):
+    """The head's daemon registries for the federation tier: the
+    skylet publishes its registry to ``metrics.prom`` every tick (it
+    has no HTTP surface), so this method is a file read — cheap enough
+    for a scrape loop even over SSH."""
+    from skypilot_tpu.observability import aggregate
+    path = os.path.join(cdir, aggregate.METRICS_FILENAME)
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        return {"exposition": text, "mtime": os.path.getmtime(path)}
+    except OSError:
+        return {"exposition": "", "mtime": None}
+
+
+def _m_healthz(cluster_name, cdir, p):
+    """Cheap component-health probe of the head's skylet: pidfile
+    liveness + the heartbeat gauge persisted in ``metrics.prom``,
+    answered in the common {status, reason, last_seen_s} shape."""
+    from skypilot_tpu.observability import health
+    h = health.skylet_health(cdir)
+    return {"status": h["status"], "reason": h["reason"],
+            "last_seen_s": h["last_seen_s"]}
 
 
 # -- controller-as-task methods --------------------------------------------
@@ -449,6 +481,8 @@ _METHODS: Dict[str, Callable] = {
     "read_logs": _m_read_logs,
     "set_autostop": _m_set_autostop,
     "is_idle": _m_is_idle,
+    "get_metrics": _m_get_metrics,
+    "healthz": _m_healthz,
     "jobs_submit": _m_jobs_submit,
     "jobs_list": _m_jobs_list,
     "jobs_get": _m_jobs_get,

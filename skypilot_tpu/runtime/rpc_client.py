@@ -46,7 +46,8 @@ class ClusterRpcError(exceptions.SkyTpuError):
 # job keeps running on the head).
 _IDEMPOTENT = frozenset(
     {"ping", "get_job", "list_jobs", "read_logs", "is_idle",
-     "jobs_get", "jobs_list", "jobs_log", "jobs_tail", "serve_status"})
+     "jobs_get", "jobs_list", "jobs_log", "jobs_tail", "serve_status",
+     "get_metrics", "healthz"})
 _TRANSPORT_RETRIES = 3
 _RETRY_BACKOFF_SECONDS = 1.0
 DEFAULT_TIMEOUT_SECONDS = 120.0
@@ -161,6 +162,15 @@ class ClusterRpc:
 
     def is_idle(self) -> bool:
         return self.call("is_idle")["idle"]
+
+    def get_metrics(self, timeout: float = 20.0) -> Dict[str, Any]:
+        """The head's persisted exposition ({"exposition", "mtime"});
+        empty exposition when no daemon has published yet."""
+        return self.call("get_metrics", timeout=timeout)
+
+    def healthz(self, timeout: float = 20.0) -> Dict[str, Any]:
+        """Skylet component health: {status, reason, last_seen_s}."""
+        return self.call("healthz", timeout=timeout)
 
 
 def _rehydrate(job: Dict[str, Any]) -> Dict[str, Any]:

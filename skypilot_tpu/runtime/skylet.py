@@ -45,13 +45,20 @@ def _read_autostop(cdir: str):
 
 def observe_tick(db: str) -> None:
     """Per-tick observability: liveness + job-state gauges for scrapers
-    of this daemon's registry, and a throttled atomic trace flush
-    (save_periodic skips ticks with little news — re-serializing the
-    whole buffer every poll would eat short poll intervals alive)."""
+    of this daemon's registry, an atomic exposition-file write (the
+    skylet has no HTTP surface — the rpc ``get_metrics``/``healthz``
+    methods and the fleet federation tier read ``metrics.prom``, and
+    its heartbeat gauge is what the health model derives staleness
+    from), and a throttled atomic trace flush (save_periodic skips
+    ticks with little news — re-serializing the whole buffer every poll
+    would eat short poll intervals alive)."""
     SKYLET_TICKS.inc()
     SKYLET_HEARTBEAT.set(time.time())
     job_queue.update_state_gauges(db)
     try:
+        from skypilot_tpu.observability import aggregate
+        obs_metrics.write_exposition_file(
+            os.path.join(os.path.dirname(db), aggregate.METRICS_FILENAME))
         timeline.save_periodic()
         tracing.flush_periodic()
     except OSError:

@@ -15,12 +15,33 @@ import subprocess
 import sys
 import time
 
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import autoscalers, replica_managers, serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
 from skypilot_tpu.utils import paths
 
 POLL_SECONDS = float(os.environ.get("SKYTPU_SERVE_POLL", "2"))
+
+READY_REPLICAS = metrics.gauge(
+    "skytpu_serve_ready_replicas",
+    "Replicas currently READY, per service", labelnames=("service",))
+TARGET_REPLICAS = metrics.gauge(
+    "skytpu_serve_target_replicas",
+    "Autoscaler's current overall replica target, per service",
+    labelnames=("service",))
+
+
+def _publish_metrics(service_name: str) -> None:
+    """The controller has no HTTP surface; its registry (probe
+    failures, per-replica probe gauges, ready/target) is published as
+    an atomic exposition file the federation tier reads. Never lets an
+    unwritable home kill the control loop."""
+    try:
+        metrics.write_exposition_file(os.path.join(
+            paths.home(), f"serve-metrics-{service_name}.prom"))
+    except OSError:
+        pass
 
 
 def run(service_name: str) -> int:
@@ -111,6 +132,9 @@ def run(service_name: str) -> int:
                                    serve_state.qps(service_name),
                                    len(ready), len(alive), cur_live)
             manager.drain_old_versions(target)
+            READY_REPLICAS.labels(service=service_name).set(len(ready))
+            TARGET_REPLICAS.labels(service=service_name).set(target)
+            _publish_metrics(service_name)
     finally:
         lb.terminate()
         manager.terminate_all()
@@ -118,6 +142,11 @@ def run(service_name: str) -> int:
         if final is not None and final["status"] != ServiceStatus.FAILED:
             serve_state.set_service_status(service_name,
                                            ServiceStatus.SHUTDOWN)
+        try:
+            os.remove(os.path.join(paths.home(),
+                                   f"serve-metrics-{service_name}.prom"))
+        except OSError:
+            pass
     return 0
 
 

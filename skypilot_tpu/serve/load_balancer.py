@@ -19,11 +19,29 @@ import threading
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
+from skypilot_tpu.observability import health as health_lib
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import serve_state
 
 _HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "host",
                 "proxy-authenticate", "proxy-authorization", "te",
                 "trailers", "upgrade"}
+
+# The LB's own telemetry (it used to be the one fleet hop with none):
+# per-backend proxied counts by response code, and failed forward
+# attempts that cost a retry/failover. Exposed on the LB's own
+# `GET /metrics` (reserved path — proxied traffic never collides with
+# it because replicas are addressed by the federation tier directly).
+LB_PROXIED = metrics.counter(
+    "skytpu_lb_proxied_total",
+    "Requests proxied by the load balancer, by backend replica URL and "
+    'response code (backend="none", code="503" when no replica '
+    "answered)", labelnames=("backend", "code"))
+LB_RETRIES = metrics.counter(
+    "skytpu_lb_retries_total",
+    "Forward attempts that failed and triggered failover to another "
+    "replica (or the terminal 503), by backend",
+    labelnames=("backend",))
 
 
 class _UpstreamPool:
@@ -144,6 +162,21 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
         protocol_version = "HTTP/1.1"
 
         def _proxy(self):
+            # The LB's own observability surface rides reserved paths
+            # on the proxy port (plain GETs, never forwarded): the
+            # federation tier scrapes /metrics, the health model
+            # probes /healthz.
+            route = self.path.split("?", 1)[0]
+            if self.command == "GET" and route == "/metrics":
+                return metrics.write_exposition(self)
+            if self.command == "GET" and route == "/healthz":
+                n_ready = len(serve_state.ready_urls(service))
+                if n_ready:
+                    return health_lib.write_healthz(
+                        self, health_lib.HEALTHY,
+                        reason=f"{n_ready} ready replicas")
+                return health_lib.write_healthz(
+                    self, health_lib.DEGRADED, reason="no ready replicas")
             serve_state.record_request(service)
             body = None
             length = int(self.headers.get("Content-Length") or 0)
@@ -158,17 +191,20 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
                     break
                 tried.append(url)
                 try:
-                    self._forward(url, body)
+                    code = self._forward(url, body)
                     policy.done(url)
+                    LB_PROXIED.labels(backend=url, code=str(code)).inc()
                     return
                 except Exception:  # noqa: BLE001 — try next replica
                     policy.done(url)
+                    LB_RETRIES.labels(backend=url).inc()
                     if self._response_started:
                         # Bytes already reached the client: a retry
                         # would corrupt the stream. Drop the connection
                         # so the client sees a clean truncation.
                         self.close_connection = True
                         return
+            LB_PROXIED.labels(backend="none", code="503").inc()
             self.send_response(503)
             msg = b"no ready replicas"
             self.send_header("Content-Length", str(len(msg)))
@@ -286,6 +322,7 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
                 sock.close()
             else:
                 _POOL.put(addr, sock)
+            return code
 
         do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _proxy
 

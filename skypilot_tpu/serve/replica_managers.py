@@ -18,12 +18,33 @@ from typing import Dict, List, Optional
 from skypilot_tpu import exceptions, execution
 from skypilot_tpu import state as cluster_state
 from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
 from skypilot_tpu.task import Task
 
 PROBE_FAILURES_BEFORE_NOT_READY = 3
+
+# Probe outcomes used to live only in serve-DB status flips; the
+# counters/gauges below make them scrapeable (the controller publishes
+# its registry per tick; the health model reads the last-probe-ok
+# gauge to tell "degraded replica" from "never probed").
+PROBE_FAILURES = metrics.counter(
+    "skytpu_serve_probe_failures_total",
+    "Readiness-probe failures observed by the controller's prober, "
+    "by service", labelnames=("service",))
+REPLICA_PROBE_OK = metrics.gauge(
+    "skytpu_serve_replica_probe_ok",
+    "1 when the replica's most recent readiness probe succeeded, 0 "
+    "after a failure (a terminated replica's series keeps its last "
+    "value — pair with the last-probe-ok timestamp for staleness)",
+    labelnames=("service", "replica"))
+REPLICA_PROBE_OK_TS = metrics.gauge(
+    "skytpu_serve_replica_last_probe_ok_timestamp_seconds",
+    "Unix time of the replica's last successful readiness probe "
+    "(staleness source for the component health model)",
+    labelnames=("service", "replica"))
 
 
 def _apply_resource_overrides(task_config: dict,
@@ -269,12 +290,18 @@ class ReplicaManager:
                         use_spot=r.get("is_spot") or None)
                 continue
             ok = self._probe_one(r)
+            REPLICA_PROBE_OK.labels(service=self.service,
+                                    replica=str(rid)).set(1 if ok else 0)
             if ok:
+                REPLICA_PROBE_OK_TS.labels(
+                    service=self.service, replica=str(rid)).set(
+                        time.time())
                 self._probe_failures[rid] = 0
                 if r["status"] != ReplicaStatus.READY:
                     serve_state.set_replica_status(self.service, rid,
                                                    ReplicaStatus.READY)
             else:
+                PROBE_FAILURES.labels(service=self.service).inc()
                 # STARTING grace period: initial_delay before failures count.
                 if r["status"] == ReplicaStatus.STARTING and \
                         time.time() - r["launched_at"] < \

@@ -33,7 +33,8 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 from socketserver import ThreadingMixIn
 from typing import Any, Dict, Optional
 
-from skypilot_tpu.observability import metrics, tracing
+from skypilot_tpu.observability import aggregate, health, metrics, slo, \
+    tracing
 from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.requests_db import RequestStatus
 from skypilot_tpu.utils import paths
@@ -61,6 +62,60 @@ MAX_CONCURRENT_REQUESTS = int(os.environ.get("SKYTPU_API_WORKERS", "8"))
 REQUEST_TTL_S = float(os.environ.get("SKYTPU_API_REQUEST_TTL_HOURS",
                                      "168")) * 3600
 _GC_INTERVAL_S = 600
+# SLO watchdog cadence (seconds between fleet snapshots).
+SLO_INTERVAL_S = float(os.environ.get("SKYTPU_SLO_INTERVAL", "15"))
+# Per-endpoint scrape timeout for /metrics/fleet federation passes.
+FLEET_SCRAPE_TIMEOUT_S = float(
+    os.environ.get("SKYTPU_FLEET_SCRAPE_TIMEOUT", "2"))
+
+# The fleet-facing singletons, installed by serve() (and by the test
+# fixtures that assemble Executor/_Server by hand): the handler checks
+# executor liveness for its own healthz and reads the watchdog's
+# active alerts for /api/fleet/health.
+_EXECUTOR: Optional["Executor"] = None
+_WATCHDOG: Optional[slo.Watchdog] = None
+
+
+def fleet_snapshot() -> aggregate.FleetSnapshot:
+    """One federation pass over everything this server can see — its
+    own registry plus every discovered fleet endpoint."""
+    return aggregate.federate(
+        aggregate.discover_endpoints(self_text=metrics.render),
+        timeout=FLEET_SCRAPE_TIMEOUT_S)
+
+
+def _api_self_health() -> Dict[str, Any]:
+    ok = _EXECUTOR is not None and _EXECUTOR.is_alive()
+    return health.component(
+        "api-server", "self",
+        health.HEALTHY if ok else health.DEGRADED,
+        reason="" if ok else "request executor thread not running")
+
+
+def fleet_health() -> Dict[str, Any]:
+    """The /api/fleet/health payload: component table + rollup +
+    whatever alerts the watchdog currently holds."""
+    components = health.fleet_health(api_self=_api_self_health())
+    return {"status": health.worst(components),
+            "components": components,
+            "alerts": (_WATCHDOG.active_alerts()
+                       if _WATCHDOG is not None else [])}
+
+
+def start_watchdog(interval_s: float = SLO_INTERVAL_S,
+                   rules: Optional[list] = None) -> slo.Watchdog:
+    """Install (or return) the server's SLO watchdog: federated
+    snapshot + health model every interval, typed slo.breach /
+    slo.recovered events on transitions."""
+    global _WATCHDOG
+    if _WATCHDOG is None:
+        _WATCHDOG = slo.Watchdog(
+            rules=rules, interval_s=interval_s,
+            snapshot_fn=lambda: (
+                fleet_snapshot().families,
+                health.fleet_health(api_self=_api_self_health())))
+    _WATCHDOG.start()
+    return _WATCHDOG
 
 _ENDPOINTS = {
     "/launch": "launch", "/exec": "exec", "/status": "status",
@@ -83,6 +138,11 @@ class Executor(threading.Thread):
         self._spawned_at: Dict[str, tuple] = {}   # rid -> (name, t0)
         self._stop = threading.Event()
         self._last_gc = 0.0
+        # Healthz wiring: the newest executor is the one /healthz
+        # liveness-checks (tests construct Executor + handler by hand,
+        # so registration can't live only in serve()).
+        global _EXECUTOR
+        _EXECUTOR = self
 
     def run(self) -> None:
         while not self._stop.is_set():
@@ -307,6 +367,24 @@ def make_handler(auth_token: Optional[str] = None):
                 # carry the credential).
                 metrics.write_exposition(self)
                 return
+            if parsed.path == "/metrics/fleet":
+                # Federated exposition: one scrape target covering the
+                # fleet (this registry + LBs + model-server replicas +
+                # controller/skylet exposition files), merged with
+                # type-correct semantics (see observability/aggregate).
+                body = fleet_snapshot().render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", metrics.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if parsed.path == "/healthz":
+                h = _api_self_health()
+                health.write_healthz(self, h["status"], h["reason"])
+                return
+            if parsed.path == "/api/fleet/health":
+                return self._json(200, fleet_health())
             if parsed.path == "/api/clusters":
                 from skypilot_tpu import state as gstate
                 rows = []
@@ -382,10 +460,12 @@ def serve(host: str = "127.0.0.1", port: int = 46580,
     tracing.set_process_name("api-server")
     executor = Executor()
     executor.start()
+    watchdog = start_watchdog()
     httpd = _Server((host, port), make_handler(auth_token))
     try:
         httpd.serve_forever()
     finally:
+        watchdog.stop()
         executor.stop()
 
 

@@ -18,8 +18,10 @@ replacement for that recipe layer.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
+import statistics
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -52,6 +54,19 @@ TRAIN_TOKENS_PER_S = obs_metrics.gauge(
 TRAIN_LOSS = obs_metrics.gauge(
     "skytpu_train_loss",
     "Most recently fetched training loss (see observe_loss)")
+# Step-time regression pair for the SLO watchdog: the trailing median
+# is the baseline ("what a step normally costs on this run"), and the
+# watchdog compares the windowed mean (histogram sum/count delta)
+# against it — a data-pipeline stall or a slow host shows up without
+# anyone pre-configuring an absolute step-time threshold.
+TRAIN_STEP_LAST = obs_metrics.gauge(
+    "skytpu_train_step_last_seconds",
+    "Most recent post-compile train step wall time")
+TRAIN_STEP_MEDIAN = obs_metrics.gauge(
+    "skytpu_train_step_median_seconds",
+    "Trailing median of recent post-compile step times (SLO regression "
+    "baseline)")
+_MEDIAN_WINDOW = 101
 
 
 def observe_loss(loss: float) -> None:
@@ -63,6 +78,7 @@ def observe_loss(loss: float) -> None:
 
 def _instrument_step(step_fn: Callable) -> Callable:
     ema = {"rate": 0.0, "warm": False}
+    recent = collections.deque(maxlen=_MEDIAN_WINDOW)
 
     @functools.wraps(step_fn)
     def wrapper(state, batch):
@@ -82,6 +98,9 @@ def _instrument_step(step_fn: Callable) -> Callable:
             ema["warm"] = True
             return out
         STEP_SECONDS.observe(dt)
+        recent.append(dt)
+        TRAIN_STEP_LAST.set(dt)
+        TRAIN_STEP_MEDIAN.set(statistics.median(recent))
         # Per-step trace span (joins an ambient trace when the run was
         # launched with one; the compile step is skipped like above).
         tracing.record_span("train.step", t0_wall, t0_wall + dt,

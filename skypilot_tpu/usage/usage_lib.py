@@ -22,10 +22,26 @@ import time
 import uuid
 from typing import Any, Dict, Optional
 
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.utils import paths
 
 DISABLE_ENV = "SKYTPU_DISABLE_USAGE_COLLECTION"
 ENDPOINT_ENV = "SKYTPU_USAGE_ENDPOINT"
+# HTTP sends are strictly bounded: a dead/blackholed endpoint must
+# never stall an entrypoint past this (connect + read, urllib's
+# combined timeout), after which the record falls back to the local
+# file sink.
+TIMEOUT_ENV = "SKYTPU_USAGE_TIMEOUT"
+DEFAULT_SEND_TIMEOUT_S = 1.0
+
+USAGE_REPORTS = metrics.counter(
+    "skytpu_usage_reports_total",
+    "Usage records written, by sink (http endpoint or local file)",
+    labelnames=("sink",))
+USAGE_SEND_FAILURES = metrics.counter(
+    "skytpu_usage_send_failures_total",
+    "Usage HTTP endpoint sends that failed (swallowed; record fell "
+    "back to the local file sink)")
 
 _run_id: Optional[str] = None
 
@@ -67,6 +83,14 @@ class MessageToReport:
         }
 
 
+def _send_timeout() -> float:
+    try:
+        return float(os.environ.get(TIMEOUT_ENV,
+                                    DEFAULT_SEND_TIMEOUT_S))
+    except ValueError:
+        return DEFAULT_SEND_TIMEOUT_S
+
+
 def _sink(record: Dict[str, Any]) -> None:
     endpoint = os.environ.get(ENDPOINT_ENV)
     if endpoint:
@@ -75,15 +99,19 @@ def _sink(record: Dict[str, Any]) -> None:
             req = urllib.request.Request(
                 endpoint, data=json.dumps(record).encode(),
                 headers={"Content-Type": "application/json"})
-            urllib.request.urlopen(req, timeout=2)
+            urllib.request.urlopen(req, timeout=_send_timeout())
+            USAGE_REPORTS.labels(sink="http").inc()
             return
         except Exception:  # noqa: BLE001 — telemetry must never break ops
-            pass
+            # Swallowed but COUNTED: a silently dead endpoint would
+            # otherwise be indistinguishable from opted-out telemetry.
+            USAGE_SEND_FAILURES.inc()
     usage_dir = os.path.join(paths.home(), "usage")
     os.makedirs(usage_dir, exist_ok=True)
     with open(os.path.join(usage_dir, "usage.jsonl"), "a",
               encoding="utf-8") as f:
         f.write(json.dumps(record) + "\n")
+    USAGE_REPORTS.labels(sink="file").inc()
 
 
 def report(message: MessageToReport) -> None:

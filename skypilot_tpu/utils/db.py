@@ -16,7 +16,10 @@ NEWER client.
 
 from __future__ import annotations
 
+import collections
+import os
 import sqlite3
+import threading
 from typing import Callable, Dict, Union
 
 Migration = Union[str, Callable[[sqlite3.Connection], None]]
@@ -27,10 +30,57 @@ class SchemaVersionError(RuntimeError):
     step is missing."""
 
 
+# WAL keepers: one idle connection per DB path, held for the life of
+# the process. Every caller here opens a connection per operation (the
+# multi-process-safe discipline), but in WAL mode the LAST connection
+# to close runs a full checkpoint + fsync — so connection-per-op turns
+# every state write into a checkpoint, ~10x the cost on slow disks.
+# With a keeper holding the DB open, per-op connections are never the
+# last one; checkpoints amortize over the WAL's auto-checkpoint
+# threshold instead. The keeper holds no transaction (it never reads
+# after the opening pragma), so it blocks neither writers nor
+# checkpointers. Bounded LRU: a process touches a handful of DBs; test
+# suites churn through tmp homes and must not leak fds.
+_MAX_KEEPERS = 8
+_keeper_lock = threading.Lock()
+_keepers: "collections.OrderedDict[str, sqlite3.Connection]" = \
+    collections.OrderedDict()
+
+
+def _ensure_keeper(path: str) -> None:
+    key = os.path.abspath(path)
+    with _keeper_lock:
+        if key in _keepers:
+            _keepers.move_to_end(key)
+            return
+        try:
+            keeper = sqlite3.connect(path, timeout=1,
+                                     check_same_thread=False)
+            keeper.execute("PRAGMA journal_mode=WAL")
+        except sqlite3.Error:
+            return                 # best-effort: never fail a caller
+        _keepers[key] = keeper
+        while len(_keepers) > _MAX_KEEPERS:
+            _, evicted = _keepers.popitem(last=False)
+            try:
+                evicted.close()
+            except sqlite3.Error:
+                pass
+
+
 def connect(path: str, timeout: float = 10) -> sqlite3.Connection:
     conn = sqlite3.connect(path, timeout=timeout)
     conn.execute("PRAGMA journal_mode=WAL")
+    # WAL's recommended durability level: commits append to the WAL
+    # without an fsync each (checkpoints still sync), which is the
+    # difference between ~2ms and ~50ms per write transaction on slow
+    # disks — these DBs take one commit per job/request state change.
+    # Consistency is unaffected (a crash never corrupts); only an OS/
+    # power loss can drop the last commits, and every writer here
+    # re-derives state from the cluster/provider on restart anyway.
+    conn.execute("PRAGMA synchronous=NORMAL")
     conn.execute(f"PRAGMA busy_timeout={int(timeout * 1000)}")
+    _ensure_keeper(path)
     return conn
 
 

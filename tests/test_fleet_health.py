@@ -1,0 +1,728 @@
+"""Fleet health tier: metrics federation merge semantics, the
+component health model, the SLO watchdog's multi-window burn rate, the
+new LB/replica/usage telemetry, and an e2e `skytpu top` / `GET
+/metrics/fleet` pass over three live local processes."""
+
+import http.server
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu.observability import aggregate, health, metrics, slo
+
+
+# -- merge semantics --------------------------------------------------------
+
+def _regs_pair():
+    r1, r2 = metrics.Registry(), metrics.Registry()
+    r1.counter("skytpu_m_total", "").inc(3)
+    r2.counter("skytpu_m_total", "").inc(4)
+    r1.gauge("skytpu_m_gauge", "").set(5)
+    r2.gauge("skytpu_m_gauge", "").set(7)
+    r1.histogram("skytpu_m_seconds", "", buckets=(1.0, 5.0)).observe(0.5)
+    r2.histogram("skytpu_m_seconds", "", buckets=(1.0, 5.0)).observe(3.0)
+    return r1, r2
+
+
+def _federate(*regs, components=None):
+    eps = [aggregate.endpoint(components[i] if components else "c",
+                              f"i{i}", get_text=regs[i].render)
+           for i in range(len(regs))]
+    return aggregate.federate(eps)
+
+
+def test_merge_counters_sum_across_instances():
+    snap = _federate(*_regs_pair())
+    assert snap.errors == []
+    assert aggregate.sample_value(snap.families, "skytpu_m_total") == 7.0
+
+
+def test_merge_gauges_keep_instance_labels():
+    snap = _federate(*_regs_pair())
+    samples = snap.families["skytpu_m_gauge"]["samples"]
+    assert sorted((l["instance"], v) for l, v in samples) == [
+        ("i0", 5.0), ("i1", 7.0)]
+
+
+def test_merge_histograms_sum_buckets_and_roundtrip():
+    snap = _federate(*_regs_pair())
+    fams = metrics.parse_exposition(snap.render())   # render round-trips
+    count = aggregate.sample_value(fams, "skytpu_m_seconds",
+                                   sample_name="skytpu_m_seconds_count")
+    total = aggregate.sample_value(fams, "skytpu_m_seconds",
+                                   sample_name="skytpu_m_seconds_sum")
+    assert count == 2.0 and total == pytest.approx(3.5)
+    le1 = next(v for l, v in fams["skytpu_m_seconds"]["samples"]
+               if l.get("le") == "1")
+    assert le1 == 1.0
+
+
+def test_merge_bucket_mismatch_reported_not_summed():
+    r1, r2 = _regs_pair()
+    r3 = metrics.Registry()
+    r3.histogram("skytpu_m_seconds", "", buckets=(2.0,)).observe(0.5)
+    snap = _federate(r1, r2, r3)
+    assert any("bucket mismatch" in e and "skytpu_m_seconds" in e
+               for e in snap.errors)
+    # Fallback keeps the data visible per-instance instead of summing.
+    fam = snap.families["skytpu_m_seconds"]
+    assert all("instance" in labels for labels, _ in fam["samples"])
+    # The merged exposition carries the error count.
+    assert "skytpu_fleet_merge_errors 1" in snap.render()
+
+
+def test_merge_type_conflict_skips_family():
+    r1 = metrics.Registry()
+    r1.counter("skytpu_conflict", "").inc()
+    r2 = metrics.Registry()
+    r2.gauge("skytpu_conflict", "").set(1)
+    snap = _federate(r1, r2)
+    assert "skytpu_conflict" not in snap.families
+    assert any("type conflict" in e for e in snap.errors)
+
+
+def test_scrape_down_target_reported_not_fatal():
+    r1, _ = _regs_pair()
+    with socket.socket() as s:            # a port nothing listens on
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    eps = [aggregate.endpoint("a", "up", get_text=r1.render),
+           aggregate.endpoint("b", "down",
+                              url=f"http://127.0.0.1:{dead_port}/metrics")]
+    snap = aggregate.federate(eps, timeout=0.5)
+    by_inst = {t["instance"]: t for t in snap.targets}
+    assert by_inst["up"]["ok"] and not by_inst["down"]["ok"]
+    # scrape_up is synthesized at render time; check via the text.
+    fams = metrics.parse_exposition(snap.render())
+    up = {(l["component"], l["instance"]): v
+          for l, v in fams["skytpu_fleet_scrape_up"]["samples"]}
+    assert up[("a", "up")] == 1.0 and up[("b", "down")] == 0.0
+
+
+def test_stale_exposition_file_counts_as_down(tmp_path):
+    p = tmp_path / "metrics.prom"
+    p.write_text("# TYPE skytpu_x_total counter\nskytpu_x_total 1\n")
+    old = time.time() - 1000
+    os.utime(p, (old, old))
+    fams, err = aggregate.scrape(
+        aggregate.endpoint("skylet", "c1", path=str(p),
+                           stale_after_s=60.0))
+    assert fams is None and "stale" in err
+    fams, err = aggregate.scrape(
+        aggregate.endpoint("skylet", "c1", path=str(p)))
+    assert err is None and "skytpu_x_total" in fams
+
+
+# -- snapshot math ----------------------------------------------------------
+
+def _counter_fams(**series):
+    return {"skytpu_c_total": {"type": "counter", "samples": [
+        ({"k": k}, float(v)) for k, v in series.items()]}}
+
+
+def test_delta_clamps_counter_reset():
+    prev = _counter_fams(a=100)
+    cur = _counter_fams(a=5)              # process restarted mid-window
+    assert aggregate.delta(prev, cur, "skytpu_c_total") == 0.0
+    assert aggregate.delta(prev, _counter_fams(a=130),
+                           "skytpu_c_total") == 30.0
+
+
+def test_filtered_delta_clamps_per_series():
+    # One replica reset (100 -> 2), another grew (50 -> 70): the reset
+    # must not erase the survivor's increase.
+    prev = _counter_fams(a=100, b=50)
+    cur = _counter_fams(a=2, b=70)
+    got = aggregate.filtered_delta(prev, cur, "skytpu_c_total",
+                                   lambda l: True)
+    assert got == pytest.approx(20.0)     # max(2-100, 0) + (70-50)
+
+
+def test_histogram_quantile_windowed():
+    def hist(counts):                      # le: 0.1 / 1 / +Inf
+        cum, samples = 0, []
+        for le, n in zip(("0.1", "1", "+Inf"), counts):
+            cum += n
+            samples.append(({"__name__": "skytpu_h_seconds_bucket",
+                             "le": le}, float(cum)))
+        return {"skytpu_h_seconds": {"type": "histogram",
+                                     "samples": samples}}
+    prev = hist((100, 0, 0))               # all fast so far
+    cur = hist((100, 0, 20))               # window: 20 slow samples
+    q = aggregate.histogram_quantile(prev, cur, "skytpu_h_seconds", 0.95)
+    assert q == 1.0                        # +Inf answers the last bound
+    assert aggregate.histogram_quantile(
+        prev, prev, "skytpu_h_seconds", 0.95) is None   # empty window
+
+
+# -- component health model -------------------------------------------------
+
+def _write_heartbeat(cdir, ts):
+    with open(os.path.join(cdir, aggregate.METRICS_FILENAME), "w") as f:
+        f.write("# TYPE skytpu_skylet_last_tick_timestamp_seconds gauge\n"
+                f"skytpu_skylet_last_tick_timestamp_seconds {ts}\n")
+
+
+def test_skylet_health_states(tmp_path):
+    cdir = str(tmp_path / "clusters" / "c1")
+    os.makedirs(cdir)
+    # No autostop armed, no skylet: idle by design, not dead.
+    assert health.skylet_health(cdir)["status"] == "healthy"
+    # Armed + alive + fresh heartbeat.
+    with open(os.path.join(cdir, "skylet.pid"), "w") as f:
+        f.write(str(os.getpid()))
+    with open(os.path.join(cdir, "autostop.json"), "w") as f:
+        f.write("{}")
+    _write_heartbeat(cdir, time.time())
+    h = health.skylet_health(cdir)
+    assert h["status"] == "healthy" and h["last_seen_s"] < 5
+    # Alive but the heartbeat went stale: degraded.
+    _write_heartbeat(cdir, time.time() - 600)
+    h = health.skylet_health(cdir)
+    assert h["status"] == "degraded" and "stale" in h["reason"]
+    # Armed but the process is gone: dead.
+    import subprocess
+    import sys
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    with open(os.path.join(cdir, "skylet.pid"), "w") as f:
+        f.write(str(proc.pid))
+    assert health.skylet_health(cdir)["status"] == "dead"
+    assert health.skylet_expected(cdir)
+    # Autostop FIRED successfully: autostop.json stays behind but the
+    # marker proves the exit was by design — healthy, not dead, and
+    # the frozen heartbeat must stop feeding the staleness SLO rule.
+    with open(os.path.join(cdir, "autostop_fired"), "w") as f:
+        f.write("{}")
+    h = health.skylet_health(cdir)
+    assert h["status"] == "healthy" and "fired" in h["reason"]
+    assert not health.skylet_expected(cdir)
+
+
+def test_discover_skips_by_design_exited_skylets(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    alive = tmp_path / "clusters" / "armed"
+    gone = tmp_path / "clusters" / "fired"
+    for d in (alive, gone):
+        os.makedirs(d)
+        _write_heartbeat(str(d), time.time() - 10_000)
+    (alive / "skylet.pid").write_text(str(os.getpid()))
+    (gone / "autostop.json").write_text("{}")
+    (gone / "autostop_fired").write_text("{}")
+    eps = aggregate.discover_endpoints()
+    skylets = {e["instance"] for e in eps if e["component"] == "skylet"}
+    # The live (here: wedged) skylet federates — its old heartbeat IS
+    # the staleness signal; the by-design-exited one must not breach
+    # the heartbeat rule forever.
+    assert skylets == {"armed"}
+
+
+def test_rpc_get_metrics_and_healthz(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    from skypilot_tpu.runtime import rpc, skylet
+    db = str(tmp_path / "clusters" / "rc1" / "jobs.db")
+    os.makedirs(os.path.dirname(db))
+    skylet.observe_tick(db)               # writes metrics.prom
+    got = rpc.dispatch("rc1", "get_metrics", {})
+    fams = metrics.parse_exposition(got["exposition"])
+    assert "skytpu_skylet_ticks_total" in fams
+    assert got["mtime"] is not None
+    hz = rpc.dispatch("rc1", "healthz", {})
+    assert hz["status"] == "healthy"
+    assert set(hz) == {"status", "reason", "last_seen_s"}
+
+
+def test_probe_http_maps_statuses(tmp_path):
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/healthz":
+                health.write_healthz(self, health.DEGRADED,
+                                     reason="warming")
+            else:
+                body = b'{"status": "ok"}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        got = health.probe_http(f"{base}/healthz", comp="m", instance="1")
+        assert got["status"] == "degraded" and got["reason"] == "warming"
+        # /health-style {"status": "ok"} maps onto the model.
+        assert health.probe_http(f"{base}/health")["status"] == "healthy"
+    finally:
+        httpd.shutdown()
+    # Unreachable = dead.
+    got = health.probe_http(f"http://127.0.0.1:1/healthz", timeout=0.5)
+    assert got["status"] == "dead"
+
+
+# -- SLO watchdog -----------------------------------------------------------
+
+def _http_fams(ok, err):
+    return {"skytpu_http_requests_total": {"type": "counter", "samples": [
+        ({"route": "/generate", "code": "200"}, float(ok)),
+        ({"route": "/generate", "code": "500"}, float(err))]}}
+
+
+def test_slo_multiwindow_needs_both_windows():
+    rule = slo.SloRule("5xx", "ratio", threshold=0.1,
+                       metric="skytpu_http_requests_total",
+                       label_prefix={"code": "5"}, min_events=5.0,
+                       short_window_s=10, long_window_s=60)
+    wd = slo.Watchdog(rules=[rule])
+    t0 = time.time() - 200
+    assert wd.observe(_http_fams(100, 0), [], ts=t0) == []
+    # A short error burst: short window breaches, long does not -> no
+    # page (the single-slow-request guarantee).
+    assert wd.observe(_http_fams(110, 3), [], ts=t0 + 15) == []
+    assert wd.active_alerts() == []
+    # Sustained errors: both windows breach -> one slo.breach.
+    ev = wd.observe(_http_fams(120, 40), [], ts=t0 + 70)
+    assert [e["event"] for e in ev] == ["slo.breach"]
+    assert wd.active_alerts()[0]["rule"] == "5xx"
+    # Still breached: no duplicate event.
+    assert wd.observe(_http_fams(125, 60), [], ts=t0 + 85) == []
+    # Healthy again on both windows -> slo.recovered.
+    ev = wd.observe(_http_fams(400, 60), [], ts=t0 + 160)
+    assert [e["event"] for e in ev] == ["slo.recovered"]
+    assert wd.active_alerts() == []
+
+
+def test_slo_breach_events_are_typed_and_echoed():
+    from skypilot_tpu.observability import tracing
+    rule = slo.SloRule("dead", "component_dead", threshold=0.0)
+    wd = slo.Watchdog(rules=[rule])
+    wd.observe({}, [health.component("model-server", "s/1",
+                                     health.DEAD, "gone")])
+    recs = [r for r in tracing.buffered_records()
+            if r.get("name") == "slo.breach"
+            and r.get("attrs", {}).get("rule") == "dead"]
+    assert recs and recs[-1]["attrs"]["dead_components"] == \
+        ["model-server/s/1"]
+
+
+def test_slo_heartbeat_staleness_is_instant():
+    rule = slo.SloRule("hb", "heartbeat_staleness", threshold=120.0,
+                       metric="skytpu_skylet_last_tick_timestamp_seconds")
+    wd = slo.Watchdog(rules=[rule])
+    now = time.time()
+    # One FRESH skylet must not mask a wedged sibling: staleness reads
+    # the OLDEST heartbeat across instances.
+    fams = {"skytpu_skylet_last_tick_timestamp_seconds": {
+        "type": "gauge", "samples": [({"instance": "c1"}, now - 300),
+                                     ({"instance": "c2"}, now)]}}
+    ev = wd.observe(fams, [], ts=now)
+    assert [e["event"] for e in ev] == ["slo.breach"]
+    fams["skytpu_skylet_last_tick_timestamp_seconds"]["samples"] = [
+        ({"instance": "c1"}, now), ({"instance": "c2"}, now)]
+    ev = wd.observe(fams, [], ts=now + 1)
+    assert [e["event"] for e in ev] == ["slo.recovered"]
+
+
+def test_slo_ratio_excludes_monitoring_routes():
+    """The watchdog's own /metrics scrapes and /healthz probes must not
+    pad the 5xx-ratio denominator (they would dilute the error ratio
+    of a low-traffic service below its threshold)."""
+    (rule,) = [r for r in slo.DEFAULT_RULES if r.name == "http-5xx-ratio"]
+    rule = slo.SloRule.from_dict({**rule.to_dict(),
+                                  "short_window_s": 10,
+                                  "long_window_s": 30})
+
+    def fams(gen_ok, gen_err, monitor):
+        return {"skytpu_http_requests_total": {
+            "type": "counter", "samples": [
+                ({"route": "/generate", "code": "200"}, float(gen_ok)),
+                ({"route": "/generate", "code": "500"}, float(gen_err)),
+                ({"route": "/metrics", "code": "200"}, float(monitor)),
+                ({"route": "/healthz", "code": "200"}, float(monitor)),
+            ]}}
+
+    wd = slo.Watchdog(rules=[rule])
+    t0 = time.time() - 100
+    wd.observe(fams(50, 0, 1000), [], ts=t0)
+    wd.observe(fams(52, 2, 2000), [], ts=t0 + 35)
+    # All real traffic in the window is 5xx; the 2000+ monitor hits
+    # would mask it if they counted in the denominator.
+    ev = wd.observe(fams(52, 8, 3000), [], ts=t0 + 70)
+    assert [e["event"] for e in ev] == ["slo.breach"]
+
+
+def test_slo_train_step_regression():
+    rule = slo.SloRule("regress", "train_step_regression", threshold=1.5,
+                       metric="skytpu_train_step_seconds",
+                       baseline_metric="skytpu_train_step_median_seconds",
+                       min_events=3.0, short_window_s=10,
+                       long_window_s=30)
+
+    def fams(count, total, median):
+        return {
+            "skytpu_train_step_seconds": {"type": "histogram", "samples": [
+                ({"__name__": "skytpu_train_step_seconds_count"},
+                 float(count)),
+                ({"__name__": "skytpu_train_step_seconds_sum"},
+                 float(total))]},
+            "skytpu_train_step_median_seconds": {
+                "type": "gauge", "samples": [({}, float(median))]}}
+
+    wd = slo.Watchdog(rules=[rule])
+    t0 = time.time() - 100
+    wd.observe(fams(100, 100.0, 1.0), [], ts=t0)        # 1s steps
+    wd.observe(fams(110, 110.0, 1.0), [], ts=t0 + 35)
+    # Steps now take 3x the trailing median on both windows.
+    ev = wd.observe(fams(130, 170.0, 1.0), [], ts=t0 + 70)
+    assert [e["event"] for e in ev] == ["slo.breach"]
+
+
+def test_slo_rules_load_and_fallback(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    assert [r.name for r in slo.load_rules()] == \
+        [r.name for r in slo.DEFAULT_RULES]
+    path = tmp_path / slo.RULES_FILENAME
+    path.write_text(json.dumps([
+        {"name": "custom", "kind": "rate", "threshold": 1.0,
+         "metric": "skytpu_rpc_failures_total",
+         "labels": {"kind": "transport"}}]))
+    rules = slo.load_rules()
+    assert len(rules) == 1 and rules[0].name == "custom"
+    assert rules[0].labels == {"kind": "transport"}
+    path.write_text("not json")
+    assert [r.name for r in slo.load_rules()] == \
+        [r.name for r in slo.DEFAULT_RULES]
+    path.write_text(json.dumps([{"name": "x", "kind": "rate",
+                                 "threshold": 1, "bogus_field": 2}]))
+    assert [r.name for r in slo.load_rules()] == \
+        [r.name for r in slo.DEFAULT_RULES]
+
+
+# -- LB telemetry (satellite) -----------------------------------------------
+
+class _EchoReplica(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(n)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def lb_service(tmp_path, monkeypatch):
+    from skypilot_tpu.serve import load_balancer, serve_state
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "home"))
+    replica = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                              _EchoReplica)
+    threading.Thread(target=replica.serve_forever, daemon=True).start()
+    rurl = f"http://127.0.0.1:{replica.server_address[1]}"
+    serve_state.add_service("fh", {}, {}, 0)
+    serve_state.upsert_replica("fh", 1, "r1",
+                               serve_state.ReplicaStatus.READY, rurl)
+    httpd = load_balancer._ThreadingServer(
+        ("127.0.0.1", 0),
+        load_balancer.make_handler("fh",
+                                   load_balancer.RoundRobinPolicy()))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", rurl
+    httpd.shutdown()
+    replica.shutdown()
+
+
+def test_lb_exposes_metrics_and_healthz(lb_service):
+    from skypilot_tpu.serve import load_balancer, serve_state
+    lb_url, rurl = lb_service
+    before = load_balancer.LB_PROXIED.labels(
+        backend=rurl, code="200").value
+    req = urllib.request.Request(lb_url + "/echo", data=b"hi",
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.read() == b"hi"
+    with urllib.request.urlopen(lb_url + "/metrics", timeout=30) as r:
+        assert r.headers["Content-Type"] == metrics.CONTENT_TYPE
+        fams = metrics.parse_exposition(r.read().decode())
+    got = next(v for l, v in fams["skytpu_lb_proxied_total"]["samples"]
+               if l == {"backend": rurl, "code": "200"})
+    assert got == before + 1
+    with urllib.request.urlopen(lb_url + "/healthz", timeout=30) as r:
+        hz = json.loads(r.read())
+    assert hz["status"] == "healthy" and "1 ready" in hz["reason"]
+    # No ready replicas -> degraded (the LB is up; routing is not).
+    serve_state.set_replica_status("fh", 1,
+                                   serve_state.ReplicaStatus.NOT_READY)
+    with urllib.request.urlopen(lb_url + "/healthz", timeout=30) as r:
+        assert json.loads(r.read())["status"] == "degraded"
+
+
+def test_lb_counts_retries_and_503(lb_service):
+    from skypilot_tpu.serve import load_balancer, serve_state
+    lb_url, rurl = lb_service
+    retries0 = load_balancer.LB_RETRIES.labels(backend=rurl).value
+    none0 = load_balancer.LB_PROXIED.labels(backend="none",
+                                            code="503").value
+    # Point the only replica at a dead port: forward fails, retry
+    # counted, terminal 503 counted under backend="none".
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+    serve_state.upsert_replica("fh", 1, "r1",
+                               serve_state.ReplicaStatus.READY, dead)
+    req = urllib.request.Request(lb_url + "/echo", data=b"x",
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 503
+    assert load_balancer.LB_RETRIES.labels(backend=dead).value >= 1
+    assert load_balancer.LB_PROXIED.labels(
+        backend="none", code="503").value == none0 + 1
+    assert load_balancer.LB_RETRIES.labels(backend=rurl).value == retries0
+
+
+# -- replica probe telemetry (satellite) ------------------------------------
+
+def test_replica_probe_metrics(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    from skypilot_tpu.serve import replica_managers, serve_state
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    serve_state.add_service("pm", {}, {}, 0)
+    serve_state.upsert_replica("pm", 1, "c1",
+                               serve_state.ReplicaStatus.READY,
+                               "http://127.0.0.1:1")
+    mgr = replica_managers.ReplicaManager("pm", SkyServiceSpec(), {})
+    monkeypatch.setattr(mgr, "_cluster_gone", lambda name: False)
+    fails0 = replica_managers.PROBE_FAILURES.labels(service="pm").value
+    monkeypatch.setattr(mgr, "_probe_one", lambda r: False)
+    for _ in range(replica_managers.PROBE_FAILURES_BEFORE_NOT_READY):
+        mgr.probe_all()
+    assert replica_managers.PROBE_FAILURES.labels(
+        service="pm").value == fails0 + 3
+    assert replica_managers.REPLICA_PROBE_OK.labels(
+        service="pm", replica="1").value == 0
+    (row,) = serve_state.list_replicas("pm")
+    assert row["status"] == serve_state.ReplicaStatus.NOT_READY
+    monkeypatch.setattr(mgr, "_probe_one", lambda r: True)
+    t0 = time.time()
+    mgr.probe_all()
+    assert replica_managers.REPLICA_PROBE_OK.labels(
+        service="pm", replica="1").value == 1
+    assert replica_managers.REPLICA_PROBE_OK_TS.labels(
+        service="pm", replica="1").value >= t0
+    assert replica_managers.PROBE_FAILURES.labels(
+        service="pm").value == fails0 + 3
+
+
+# -- usage sends bounded (satellite) ----------------------------------------
+
+def test_usage_dead_endpoint_bounded_and_counted(tmp_path, monkeypatch):
+    from skypilot_tpu.usage import usage_lib
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path))
+    monkeypatch.delenv(usage_lib.DISABLE_ENV, raising=False)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    monkeypatch.setenv(usage_lib.ENDPOINT_ENV,
+                       f"http://127.0.0.1:{dead_port}/ingest")
+    monkeypatch.setenv(usage_lib.TIMEOUT_ENV, "0.5")
+    fails0 = usage_lib.USAGE_SEND_FAILURES._require_default().value
+    file0 = usage_lib.USAGE_REPORTS.labels(sink="file").value
+    t0 = time.time()
+    with usage_lib.entrypoint_context("launch"):
+        pass
+    assert time.time() - t0 < 5.0          # bounded, never stalls
+    assert usage_lib.USAGE_SEND_FAILURES._require_default().value == \
+        fails0 + 1
+    # The record fell back to the local file sink (and was counted).
+    assert usage_lib.USAGE_REPORTS.labels(sink="file").value == file0 + 1
+    assert (tmp_path / "usage" / "usage.jsonl").exists()
+
+
+# -- trainer regression source ----------------------------------------------
+
+def test_trainer_exports_step_median(monkeypatch):
+    import numpy as np
+
+    from skypilot_tpu.train import trainer
+    calls = {"n": 0}
+
+    def fake_step(state, batch):
+        calls["n"] += 1
+        return state, {}
+
+    wrapped = trainer._instrument_step(fake_step)
+    batch = {"tokens": np.zeros((2, 4), dtype=np.int32)}
+    wrapped(None, batch)                   # compile call: skipped
+    for _ in range(3):
+        wrapped(None, batch)
+    assert calls["n"] == 4
+    last = trainer.TRAIN_STEP_LAST._require_default().value
+    med = trainer.TRAIN_STEP_MEDIAN._require_default().value
+    assert last > 0 and med > 0
+
+
+# -- e2e: three live processes, /metrics/fleet, status --health, top --------
+
+class _FakeModelProcess:
+    """A model-server stand-in with its OWN registry (as a separate
+    process would have): /health, /healthz, /metrics."""
+
+    def __init__(self, requests_total: float, queue_depth: float):
+        reg = metrics.Registry()
+        reg.counter("skytpu_fake_requests_total", "t").inc(requests_total)
+        reg.gauge("skytpu_fake_queue_depth", "t").set(queue_depth)
+        reg.histogram("skytpu_fake_latency_seconds", "t",
+                      buckets=(0.1, 1.0)).observe(0.05)
+
+        class H(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = reg.render().encode()
+                    ctype = metrics.CONTENT_TYPE
+                elif self.path in ("/health", "/healthz"):
+                    body = json.dumps(
+                        health.healthz_payload(health.HEALTHY)).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_POST = do_GET
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def kill(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def fleet(tmp_path, monkeypatch):
+    """API server + load balancer + two model-server stand-ins, all
+    live on localhost, registered in the serve DB the way `serve up`
+    would leave them."""
+    from skypilot_tpu.serve import load_balancer, serve_state
+    from skypilot_tpu.server import server as server_mod
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "home"))
+    m1 = _FakeModelProcess(requests_total=3, queue_depth=2)
+    m2 = _FakeModelProcess(requests_total=4, queue_depth=5)
+    lb = load_balancer._ThreadingServer(
+        ("127.0.0.1", 0),
+        load_balancer.make_handler("svc",
+                                   load_balancer.RoundRobinPolicy()))
+    threading.Thread(target=lb.serve_forever, daemon=True).start()
+    serve_state.add_service("svc", {}, {}, lb.server_address[1])
+    serve_state.set_controller_pid("svc", os.getpid())
+    serve_state.set_service_status("svc", serve_state.ServiceStatus.READY)
+    serve_state.upsert_replica("svc", 1, "c1",
+                               serve_state.ReplicaStatus.READY, m1.url)
+    serve_state.upsert_replica("svc", 2, "c2",
+                               serve_state.ReplicaStatus.READY, m2.url)
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    monkeypatch.setenv("SKYTPU_API_SERVER_URL",
+                       f"http://127.0.0.1:{port}")
+    executor = server_mod.Executor()
+    executor.start()
+    httpd = server_mod._Server(("127.0.0.1", port),
+                               server_mod.make_handler())
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    monkeypatch.setattr(server_mod, "_WATCHDOG", None)
+    yield {"api": f"http://127.0.0.1:{port}", "m1": m1, "m2": m2,
+           "server_mod": server_mod}
+    executor.stop()
+    httpd.shutdown()
+    m1.kill()
+    m2.kill()
+
+
+def test_e2e_fleet_metrics_health_top_and_breach(fleet):
+    server_mod = fleet["server_mod"]
+    # 1) GET /metrics/fleet merges all three processes: counters
+    # summed, gauges instance-labeled, LB + API server families there.
+    with urllib.request.urlopen(f"{fleet['api']}/metrics/fleet",
+                                timeout=30) as r:
+        assert r.headers["Content-Type"] == metrics.CONTENT_TYPE
+        fams = metrics.parse_exposition(r.read().decode())
+    assert aggregate.sample_value(fams, "skytpu_fake_requests_total") \
+        == 7.0
+    depths = {l["instance"]: v
+              for l, v in fams["skytpu_fake_queue_depth"]["samples"]}
+    assert depths == {"svc/1": 2.0, "svc/2": 5.0}
+    assert "skytpu_api_requests_total" in fams   # the API server's own
+    up = {(l["component"], l["instance"]): v
+          for l, v in fams["skytpu_fleet_scrape_up"]["samples"]}
+    assert up[("api-server", "self")] == 1.0
+    assert up[("load-balancer", "svc")] == 1.0
+    assert up[("model-server", "svc/1")] == 1.0
+    assert up[("model-server", "svc/2")] == 1.0
+
+    # 2) skytpu status --health: every component healthy.
+    from skypilot_tpu.client import cli as cli_mod
+    out = CliRunner().invoke(cli_mod.cli, ["status", "--health"])
+    assert out.exit_code == 0, out.output
+    assert "fleet: HEALTHY" in out.output
+    for needle in ("api-server", "load-balancer", "model-server",
+                   "serve-controller"):
+        assert needle in out.output
+    assert "dead" not in out.output
+
+    # 3) skytpu top --once renders the fleet table.
+    out = CliRunner().invoke(cli_mod.cli, ["top", "--once"])
+    assert out.exit_code == 0, out.output
+    assert "COMPONENT" in out.output and "model-server" in out.output
+    assert "0 active alert(s)" in out.output
+
+    # 4) Kill one model server: within one watchdog interval the
+    # component flips to dead and a typed slo.breach event fires.
+    from skypilot_tpu.observability import tracing
+    wd = server_mod.start_watchdog(interval_s=30)  # tick driven below
+    assert wd.tick() == []                          # healthy baseline
+    fleet["m1"].kill()
+    events = wd.tick()                              # one interval later
+    assert any(e["event"] == "slo.breach"
+               and e["rule"] == "component-alive" for e in events)
+    assert any("model-server/svc/1" in str(e.get("dead_components"))
+               for e in events)
+    recs = [r for r in tracing.buffered_records()
+            if r.get("name") == "slo.breach"]
+    assert recs, "breach must land in the structured event log"
+    out = CliRunner().invoke(cli_mod.cli, ["status", "--health"])
+    assert out.exit_code == 2                       # non-healthy fleet
+    assert "dead" in out.output
+    out = CliRunner().invoke(cli_mod.cli, ["top", "--once"])
+    assert "ALERT component-alive" in out.output
+    wd.stop()
