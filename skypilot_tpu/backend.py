@@ -34,7 +34,7 @@ from skypilot_tpu.resources import Resources
 from skypilot_tpu.runtime import job_queue, topology
 from skypilot_tpu.runtime.rpc_client import ClusterRpc
 from skypilot_tpu.task import Task
-from skypilot_tpu.utils import paths, timeline
+from skypilot_tpu.utils import paths, retry, timeline
 
 # Head-side location of the intra-cluster SSH key (pushed by
 # instance_setup for ssh-reachable hosts).
@@ -132,27 +132,39 @@ class RetryingProvisioner:
                   initial_blocked: Optional[set] = None) -> ClusterHandle:
         blocked: set = set(initial_blocked or set())
         history: List[Exception] = []
-        rounds = 0
-        while True:
-            try:
-                launchable = optimizer.optimize_task(task, blocked)
-            except exceptions.ResourcesUnavailableError as e:
-                rounds += 1
-                if self.retry_until_up and rounds < self.max_rounds:
-                    # All candidates blocked: clear blocklist, back off,
-                    # and sweep the full candidate list again.
-                    blocked.clear()
-                    time.sleep(self.backoff_seconds)
-                    continue
-                raise e.with_failover_history(history)
-            try:
-                return self._provision_one(task, cluster_name, launchable)
-            except exceptions.ResourcesUnavailableError as e:
-                history.append(e)
-                blocked.add(_blocklist_scope(e, launchable))
-                print(f"Provision failed on {launchable}: {e}; "
-                      f"failing over ({len(blocked)} blocked)",
-                      file=sys.stderr)
+
+        def sweep() -> ClusterHandle:
+            """One full failover pass: keep blocklisting + re-optimizing
+            until a candidate provisions or every candidate is blocked
+            (ResourcesUnavailableError carrying the failover history)."""
+            while True:
+                try:
+                    launchable = optimizer.optimize_task(task, blocked)
+                except exceptions.ResourcesUnavailableError as e:
+                    raise e.with_failover_history(history)
+                try:
+                    return self._provision_one(task, cluster_name,
+                                               launchable)
+                except exceptions.ResourcesUnavailableError as e:
+                    history.append(e)
+                    blocked.add(_blocklist_scope(e, launchable))
+                    print(f"Provision failed on {launchable}: {e}; "
+                          f"failing over ({len(blocked)} blocked)",
+                          file=sys.stderr)
+
+        if not self.retry_until_up:
+            return sweep()
+        # retry_until_up: between sweeps, clear the blocklist and back
+        # off (capacity comes back) — the backoff/attempt budget rides
+        # the shared retry policy so chaos runs can assert it.
+        return retry.call(
+            sweep, name="provision.sweep",
+            policy=retry.RetryPolicy(
+                max_attempts=self.max_rounds,
+                backoff_base_s=self.backoff_seconds,
+                backoff_multiplier=2.0, backoff_max_s=300.0,
+                retry_on=(exceptions.ResourcesUnavailableError,)),
+            on_retry=lambda attempt, exc, pause: blocked.clear())
 
     def _provision_one(self, task: Task, cluster_name: str,
                        launchable: Resources) -> ClusterHandle:

@@ -1,0 +1,174 @@
+"""Fault-plan schema: the declarative half of the chaos layer.
+
+A plan is JSON — a seed plus an ordered list of fault rules — so a
+chaos run is a *reproducible artifact*: check the plan into a repo,
+point ``SKYTPU_CHAOS_PLAN`` at it, and the same seed fires the same
+faults in the same order (see ``docs/robustness.md`` for the full
+schema and the injection-point catalog).
+
+Stdlib-only: chaos points live inside head-side runtime modules that
+run under ``python -S``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+# Injection-point catalog: every chaos.point() call site in the tree.
+# ``skytpu chaos points`` prints this; docs/robustness.md documents it;
+# tests/test_chaos.py asserts the code and the catalog agree.
+KNOWN_POINTS: Dict[str, str] = {
+    "provision.run_instances":
+        "instance create/resume, per provider attempt "
+        "(ctx: provider, cluster, zone)",
+    "provision.stop_instances":
+        "instance stop (ctx: provider, cluster, zone)",
+    "provision.terminate_instances":
+        "instance teardown (ctx: provider, cluster, zone)",
+    "provision.query_instances":
+        "cloud-side status query (ctx: provider, cluster, zone)",
+    "provision.wait_instances":
+        "wait-until-ready poll (ctx: provider, cluster, zone)",
+    "rpc.transport":
+        "cluster RPC transport attempt, client side; ConnectionError "
+        "faults ride the transport-failure retry path "
+        "(ctx: method, cluster)",
+    "jobs.transition":
+        "cluster job-queue status write (ctx: status, job_id)",
+    "jobs.recovery":
+        "managed-job recovery relaunch (ctx: strategy, cluster)",
+    "skylet.tick":
+        "skylet poll-loop iteration (ctx: cluster)",
+    "serve.probe":
+        "replica readiness probe; a fault counts as one probe failure "
+        "(ctx: service, replica)",
+    "serve.lb.forward":
+        "load-balancer forward attempt; a fault triggers replica "
+        "failover (ctx: backend)",
+    "train.checkpoint_save":
+        "checkpoint save dispatch (ctx: step)",
+    "train.checkpoint_restore":
+        "checkpoint restore (ctx: step)",
+}
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One fault schedule bound to an injection point.
+
+    Selection: a point hit is *eligible* when ``point`` matches and
+    every ``match`` key equals the point's context (stringified). The
+    first ``after`` eligible hits pass through untouched; then the rule
+    fires on each eligible hit — every time by default, with chance
+    ``probability`` under the plan's seeded PRNG, at most ``times``
+    total. Effect: sleep ``latency_s`` (if set), then raise ``error``
+    (unless the rule is latency-only). A rule with neither ``times``
+    nor ``probability`` is a standing fault — e.g. a network partition
+    of one RPC target — active for the whole run.
+    """
+
+    point: str
+    match: Dict[str, str] = dataclasses.field(default_factory=dict)
+    times: Optional[int] = None       # max fires; None = unlimited
+    after: int = 0                    # eligible hits to skip first
+    probability: Optional[float] = None   # None = always fire
+    latency_s: float = 0.0
+    error: Optional[str] = None       # exception name; None + latency
+                                      # = latency-only fault
+    message: str = ""
+
+    # runtime counters (not part of the schema)
+    hits: int = 0
+    fired: int = 0
+
+    def effect(self) -> str:
+        if self.error is None and self.latency_s > 0:
+            return "latency"
+        return self.error or "ChaosError"
+
+
+@dataclasses.dataclass
+class Plan:
+    seed: int
+    rules: List[FaultRule]
+
+
+_RULE_FIELDS = {"point", "match", "times", "after", "probability",
+                "latency_s", "error", "message"}
+
+
+def parse_plan(raw: Any) -> Plan:
+    """Validate a decoded plan dict into a :class:`Plan`; raises
+    ``ValueError`` naming the offending rule/field (a typo'd plan must
+    fail the run loudly, not silently inject nothing)."""
+    if not isinstance(raw, dict):
+        raise ValueError(f"chaos plan must be a JSON object, got "
+                         f"{type(raw).__name__}")
+    unknown_top = set(raw) - {"seed", "faults"}
+    if unknown_top:
+        raise ValueError(f"chaos plan: unknown keys {sorted(unknown_top)}")
+    seed = raw.get("seed", 0)
+    if not isinstance(seed, int):
+        raise ValueError(f"chaos plan: seed must be an int, got {seed!r}")
+    faults = raw.get("faults", [])
+    if not isinstance(faults, list):
+        raise ValueError("chaos plan: 'faults' must be a list of rules")
+    rules: List[FaultRule] = []
+    for i, r in enumerate(faults):
+        where = f"faults[{i}]"
+        if not isinstance(r, dict):
+            raise ValueError(f"chaos plan: {where} must be an object")
+        unknown = set(r) - _RULE_FIELDS
+        if unknown:
+            raise ValueError(
+                f"chaos plan: {where}: unknown keys {sorted(unknown)}")
+        point = r.get("point")
+        if not point or not isinstance(point, str):
+            raise ValueError(f"chaos plan: {where}: 'point' is required")
+        match = r.get("match", {})
+        if not isinstance(match, dict):
+            raise ValueError(f"chaos plan: {where}: 'match' must be an "
+                             f"object of context-key -> value")
+        times = r.get("times")
+        if times is not None and (not isinstance(times, int) or times < 0):
+            raise ValueError(f"chaos plan: {where}: 'times' must be a "
+                             f"non-negative int")
+        after = r.get("after", 0)
+        if not isinstance(after, int) or after < 0:
+            raise ValueError(f"chaos plan: {where}: 'after' must be a "
+                             f"non-negative int")
+        prob = r.get("probability")
+        if prob is not None and not (isinstance(prob, (int, float))
+                                     and 0.0 <= prob <= 1.0):
+            raise ValueError(f"chaos plan: {where}: 'probability' must "
+                             f"be in [0, 1]")
+        latency = r.get("latency_s", 0.0)
+        if not isinstance(latency, (int, float)) or latency < 0:
+            raise ValueError(f"chaos plan: {where}: 'latency_s' must be "
+                             f"a non-negative number")
+        error = r.get("error")
+        if error is not None and not isinstance(error, str):
+            raise ValueError(f"chaos plan: {where}: 'error' must be an "
+                             f"exception class name")
+        rules.append(FaultRule(
+            point=point, match={k: str(v) for k, v in match.items()},
+            times=times, after=after, probability=prob,
+            latency_s=float(latency), error=error,
+            message=str(r.get("message", ""))))
+    return Plan(seed=seed, rules=rules)
+
+
+def load_plan_file(path: str) -> Plan:
+    with open(os.path.expanduser(path), encoding="utf-8") as f:
+        return parse_plan(json.load(f))
+
+
+def unknown_points(plan: Plan) -> List[str]:
+    """Rule points absent from the catalog — allowed at runtime (a
+    plan may predate a renamed point) but surfaced by ``skytpu chaos
+    validate`` because they inject nothing."""
+    return sorted({r.point for r in plan.rules
+                   if r.point not in KNOWN_POINTS})

@@ -1123,6 +1123,51 @@ def bench_delete(benchmark):
     click.echo(f"Deleted benchmark {benchmark!r}.")
 
 
+@cli.group(name="chaos")
+def chaos_group():
+    """Deterministic fault injection (see docs/robustness.md)."""
+
+
+@chaos_group.command(name="validate")
+@click.argument("plan_path")
+def chaos_validate(plan_path):
+    """Parse a fault-plan JSON file and print the normalized schedule.
+
+    Exits non-zero on a malformed plan; warns on rules bound to
+    injection points the tree does not define (they inject nothing).
+    """
+    from skypilot_tpu import chaos as chaos_lib
+    try:
+        plan = chaos_lib.load_plan_file(plan_path)
+    except (OSError, ValueError) as e:
+        raise click.ClickException(f"invalid chaos plan: {e}")
+    click.echo(f"seed: {plan.seed}")
+    fmt = "{:<30}{:<28}{:<8}{:<7}{:<7}{:<9}{}"
+    click.echo(fmt.format("POINT", "MATCH", "TIMES", "AFTER", "PROB",
+                          "LATENCY", "EFFECT"))
+    for r in plan.rules:
+        match = ",".join(f"{k}={v}" for k, v in r.match.items()) or "-"
+        click.echo(fmt.format(
+            r.point, match[:26],
+            "inf" if r.times is None else str(r.times), str(r.after),
+            "-" if r.probability is None else f"{r.probability:g}",
+            f"{r.latency_s:g}s" if r.latency_s else "-", r.effect()))
+    unknown = chaos_lib.unknown_points(plan)
+    if unknown:
+        click.echo(f"WARNING: unknown injection point(s) — these rules "
+                   f"inject nothing: {', '.join(unknown)}", err=True)
+
+
+@chaos_group.command(name="points")
+def chaos_points():
+    """List the injection points a fault plan can target."""
+    from skypilot_tpu import chaos as chaos_lib
+    fmt = "{:<32}{}"
+    click.echo(fmt.format("POINT", "WHERE / CONTEXT"))
+    for name in sorted(chaos_lib.KNOWN_POINTS):
+        click.echo(fmt.format(name, chaos_lib.KNOWN_POINTS[name]))
+
+
 def main():
     try:
         cli()

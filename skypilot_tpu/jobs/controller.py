@@ -22,6 +22,7 @@ from skypilot_tpu import state as cluster_state
 from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
 from skypilot_tpu.jobs import recovery_strategy, state
 from skypilot_tpu.observability import metrics as obs_metrics
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.runtime.job_queue import JobStatus
 from skypilot_tpu.task import Task
 
@@ -218,10 +219,23 @@ class JobsController:
         the managed job reached a terminal state instead."""
         state.bump_recovery(self.job_id)     # cumulative, for display
         self.task_recoveries += 1            # per-task budget
-        if self.task_recoveries > recovery_strategy.MAX_RECOVERY_ATTEMPTS:
+        budget = recovery_strategy.max_recovery_attempts()
+        if self.task_recoveries > budget:
             RECOVERY_ATTEMPTS.labels(outcome="exhausted").inc()
-            state.set_status(self.job_id, state.ManagedJobStatus.FAILED,
-                             error="max recovery attempts exceeded")
+            # A typed terminal state + event, not a bare exception: the
+            # giving-up decision must be visible in `skytpu jobs queue`
+            # (FAILED_RECOVERY != the task failing) and in the trace.
+            tracing.add_event(
+                "jobs.recovery_gave_up",
+                attrs={"managed_job_id": self.job_id,
+                       "cluster": self.cluster_name,
+                       "attempts": self.task_recoveries - 1,
+                       "max_attempts": budget},
+                echo=True)
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.FAILED_RECOVERY,
+                             error=f"recovery budget exhausted after "
+                                   f"{budget} attempts")
             return None
         if not state.set_status(self.job_id,
                                 state.ManagedJobStatus.RECOVERING):
@@ -230,6 +244,17 @@ class JobsController:
             self._log("cancelled during recovery; tearing down")
             state.set_status(self.job_id, state.ManagedJobStatus.CANCELLED)
             return None
+        # Backoff BEFORE the relaunch (attempt 2 onwards): a slice in a
+        # preemption loop must not re-provision at poll speed. Routed
+        # through the shared retry policy so the pause is configurable
+        # and jittered like every other retry in the tree. AFTER the
+        # RECOVERING write: the cancel check above runs pre-sleep, and
+        # the queue shows RECOVERING (not a stale RUNNING) during the
+        # pause.
+        if self.task_recoveries > 1:
+            from skypilot_tpu.utils import retry
+            retry.pause(recovery_strategy.recovery_backoff_policy(),
+                        self.task_recoveries - 2)
         try:
             state.acquire_launch_slot(self.job_id)
             try:

@@ -12,17 +12,74 @@ preemption we immediately blocklist the zone we were just evicted from.
 
 from __future__ import annotations
 
-import time
+import os
 from typing import Optional, Set, Tuple
 
-from skypilot_tpu import exceptions, execution, state as cluster_state
+from skypilot_tpu import chaos, exceptions, execution
+from skypilot_tpu import state as cluster_state
 from skypilot_tpu.backend import ClusterHandle, RetryingProvisioner, TpuVmBackend
 from skypilot_tpu.observability import metrics as obs_metrics
 from skypilot_tpu.task import Task
+from skypilot_tpu.utils import retry
 from skypilot_tpu.utils.registry import JOBS_RECOVERY_STRATEGY_REGISTRY
 
 DEFAULT_STRATEGY = "EAGER_NEXT_ZONE"
-MAX_RECOVERY_ATTEMPTS = 10
+MAX_RECOVERY_ATTEMPTS = 10           # default; see max_recovery_attempts()
+DEFAULT_RECOVERY_BACKOFF_SECONDS = 1.0
+
+
+def _tunable(env_var: str, config_key: str, default, cast):
+    """env > config > default, with malformed values FALLING BACK (plus
+    a typed event) instead of raising: these knobs exist for an
+    operator rescuing a job mid-incident — a typo'd export must not
+    turn the next recovery into FAILED_CONTROLLER."""
+    from skypilot_tpu.observability import tracing
+    env = os.environ.get(env_var)
+    if env:
+        try:
+            return cast(env)
+        except ValueError:
+            # Fall THROUGH to the config layer — the next-best value
+            # the operator expressed, not a silent jump to defaults.
+            tracing.add_event(
+                "jobs.config_invalid",
+                attrs={"source": env_var, "value": env[:100]},
+                echo=True)
+    from skypilot_tpu import config
+    raw = config.get_nested(("jobs", config_key), default)
+    try:
+        return cast(raw)
+    except (TypeError, ValueError):
+        tracing.add_event(
+            "jobs.config_invalid",
+            attrs={"source": f"jobs.{config_key}",
+                   "value": str(raw)[:100], "fallback": default},
+            echo=True)
+        return default
+
+
+def max_recovery_attempts() -> int:
+    """Per-task recovery budget: ``SKYTPU_JOBS_MAX_RECOVERY_ATTEMPTS``
+    env > ``jobs.max_recovery_attempts`` in ``~/.skypilot_tpu/
+    config.yaml`` > the default (10). Read per recovery, not at import:
+    the controller is a long-lived process and an operator raising the
+    budget mid-incident should win."""
+    return _tunable("SKYTPU_JOBS_MAX_RECOVERY_ATTEMPTS",
+                    "max_recovery_attempts", MAX_RECOVERY_ATTEMPTS, int)
+
+
+def recovery_backoff_policy() -> retry.RetryPolicy:
+    """Backoff between recovery attempts (env
+    ``SKYTPU_JOBS_RECOVERY_BACKOFF`` > config
+    ``jobs.recovery_backoff_seconds`` > 1s base), jittered exponential
+    capped at 60s — a slice stuck in a preemption loop must not hammer
+    the provisioning API at poll speed."""
+    base = _tunable("SKYTPU_JOBS_RECOVERY_BACKOFF",
+                    "recovery_backoff_seconds",
+                    DEFAULT_RECOVERY_BACKOFF_SECONDS, float)
+    return retry.RetryPolicy(max_attempts=max_recovery_attempts(),
+                             backoff_base_s=base,
+                             backoff_multiplier=2.0, backoff_max_s=60.0)
 
 RECOVERY_LAUNCHES = obs_metrics.counter(
     "skytpu_jobs_recovery_launches_total",
@@ -71,6 +128,8 @@ class StrategyExecutor:
                 cluster_state.remove_cluster(self.cluster_name)
 
     def _relaunch(self, blocked: Set) -> Tuple[int, ClusterHandle]:
+        chaos.point("jobs.recovery", strategy=type(self).__name__,
+                    cluster=self.cluster_name)
         RECOVERY_LAUNCHES.labels(strategy=type(self).__name__).inc()
         provisioner = RetryingProvisioner(retry_until_up=True)
         handle = provisioner.provision(self.task, self.cluster_name,

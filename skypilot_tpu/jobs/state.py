@@ -35,6 +35,10 @@ class ManagedJobStatus(enum.Enum):
     FAILED_PRECHECKS = "FAILED_PRECHECKS"
     FAILED_NO_RESOURCE = "FAILED_NO_RESOURCE"
     FAILED_CONTROLLER = "FAILED_CONTROLLER"
+    # The recovery budget (jobs.max_recovery_attempts) ran out: the job
+    # kept being preempted and the controller gave up — distinct from
+    # FAILED (the task itself failed on a healthy cluster).
+    FAILED_RECOVERY = "FAILED_RECOVERY"
     CANCELLING = "CANCELLING"
     CANCELLED = "CANCELLED"
 
@@ -43,6 +47,7 @@ class ManagedJobStatus(enum.Enum):
                         ManagedJobStatus.FAILED_PRECHECKS,
                         ManagedJobStatus.FAILED_NO_RESOURCE,
                         ManagedJobStatus.FAILED_CONTROLLER,
+                        ManagedJobStatus.FAILED_RECOVERY,
                         ManagedJobStatus.CANCELLED)
 
 

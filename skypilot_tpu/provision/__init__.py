@@ -13,6 +13,7 @@ import enum
 import importlib
 from typing import Any, FrozenSet
 
+from skypilot_tpu import chaos
 from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
                                            ProvisionConfig, ProvisionRecord)
 
@@ -54,24 +55,38 @@ def _impl(provider: str):
 
 def run_instances(provider: str, config: ProvisionConfig) -> ProvisionRecord:
     """Create (or resume) the cluster's instances. Idempotent."""
+    # Chaos points sit in the dispatcher — ABOVE every provider — so
+    # one fault plan covers gcp/aws/azure/k8s/local identically (a
+    # CapacityError injected here is indistinguishable to the failover
+    # loop from a real zone stockout).
+    chaos.point("provision.run_instances", provider=provider,
+                cluster=config.cluster_name, zone=config.zone)
     return _impl(provider).run_instances(config)
 
 
 def stop_instances(provider: str, cluster_name: str, zone: str) -> None:
+    chaos.point("provision.stop_instances", provider=provider,
+                cluster=cluster_name, zone=zone)
     return _impl(provider).stop_instances(cluster_name, zone)
 
 
 def terminate_instances(provider: str, cluster_name: str, zone: str) -> None:
+    chaos.point("provision.terminate_instances", provider=provider,
+                cluster=cluster_name, zone=zone)
     return _impl(provider).terminate_instances(cluster_name, zone)
 
 
 def query_instances(provider: str, cluster_name: str, zone: str) -> str:
     """'UP' | 'STOPPED' | 'PARTIAL' | 'NOT_FOUND' (cloud ground truth)."""
+    chaos.point("provision.query_instances", provider=provider,
+                cluster=cluster_name, zone=zone)
     return _impl(provider).query_instances(cluster_name, zone)
 
 
 def wait_instances(provider: str, cluster_name: str, zone: str,
                    timeout: float = 600) -> None:
+    chaos.point("provision.wait_instances", provider=provider,
+                cluster=cluster_name, zone=zone)
     return _impl(provider).wait_instances(cluster_name, zone, timeout)
 
 

@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision import aws_auth
+from skypilot_tpu.utils import retry
 from skypilot_tpu.provision import Feature as _F
 from skypilot_tpu.provision.common import (ClusterInfo, HostInfo,
                                            ProvisionConfig, ProvisionRecord)
@@ -437,14 +438,16 @@ def terminate_instances(cluster_name: str, zone: str) -> None:
         or _find_security_group(cluster_name, region)
     if sg_id is None:
         return
-    for _ in range(30):
-        try:
-            _api("DeleteSecurityGroup", {"GroupId": sg_id}, region)
-            return
-        except Exception:  # noqa: BLE001 — DependencyViolation until gone
-            if _transport is not None:
-                return
-            time.sleep(5)
+    try:
+        retry.call(
+            lambda: _api("DeleteSecurityGroup", {"GroupId": sg_id}, region),
+            name="aws.delete_sg",
+            policy=retry.RetryPolicy(
+                max_attempts=1 if _transport is not None else 30,
+                backoff_base_s=5.0, backoff_multiplier=1.0,
+                backoff_max_s=5.0, jitter=0.0))
+    except Exception:  # noqa: BLE001 — DependencyViolation until gone
+        return
 
 
 def query_instances(cluster_name: str, zone: str) -> str:

@@ -9,7 +9,12 @@ Serves three purposes, mirroring the reference's offline-test strategy
 2. Fault injection for the failover loop — a ``fail_marker`` file in the
    cluster dir (or SKYTPU_LOCAL_FAIL_ATTEMPTS env) makes the next N
    ``run_instances`` calls raise CapacityError, exercising
-   blocklist/re-optimize/retry paths without a cloud.
+   blocklist/re-optimize/retry paths without a cloud. (Legacy seam: new
+   fault scenarios should use the seedable plans of
+   ``skypilot_tpu/chaos`` instead — its ``provision.*`` points sit in
+   the dispatcher above every provider, and SKYTPU_LOCAL_ZONES gives
+   this fake cloud multiple zones for stockout-failover runs; see
+   docs/robustness.md.)
 3. Remote-cluster emulation — with SKYTPU_LOCAL_FAKE_SSH=1, hosts are
    reached through FakeSSHRunner (scrubbed env, $HOME-rooted layout),
    so the whole on-cluster runtime (rpc, driver, skylet, rsynced

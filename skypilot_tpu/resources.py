@@ -12,6 +12,7 @@ accelerator_args dict.
 from __future__ import annotations
 
 import dataclasses
+import os
 import re
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -119,11 +120,18 @@ class Resources:
             # Catalog-less clouds: capacity is whatever the machine/
             # cluster has, so the sole candidate is the spec itself
             # (price 0 — kubernetes nodes are owned capacity; the
-            # reference prices k8s at 0 too).
-            zone = "local" if self.cloud == "local" else "default"
-            r = self.copy(region=zone, zone=zone, _price=0.0)
-            return ([] if _is_blocked(self.cloud, zone, zone, blocked)
-                    else [r])
+            # reference prices k8s at 0 too). The local fake cloud can
+            # present MULTIPLE zones (SKYTPU_LOCAL_ZONES="zone-a,
+            # zone-b") so zone-scoped failover/blocklist paths — the
+            # chaos harness's stockout scenarios — run offline.
+            if self.cloud == "local":
+                zones = [z.strip() for z in os.environ.get(
+                    "SKYTPU_LOCAL_ZONES", "local").split(",") if z.strip()]
+            else:
+                zones = ["default"]
+            return [self.copy(region=z, zone=z, _price=0.0)
+                    for z in zones
+                    if not _is_blocked(self.cloud, z, z, blocked)]
         out = []
         min_cpus, cpus_plus = parse_count(self.cpus, "cpus")
         min_mem, mem_plus = parse_count(self.memory, "memory")

@@ -19,6 +19,7 @@ from skypilot_tpu.utils import db
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import chaos
 from skypilot_tpu.observability import metrics as obs_metrics
 
 JOB_TRANSITIONS = obs_metrics.counter(
@@ -90,6 +91,9 @@ def add_job(db_path: str, name: Optional[str], run_cmd: str,
 
 
 def set_status(db_path: str, job_id: int, status: JobStatus) -> None:
+    # Before the write: an injected fault means the transition never
+    # reached the DB, exactly like a crash between decide and commit.
+    chaos.point("jobs.transition", status=status.value, job_id=job_id)
     now = time.time()
     with _db(db_path) as c:
         if status == JobStatus.RUNNING:

@@ -15,10 +15,11 @@ import subprocess
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from skypilot_tpu import exceptions
+from skypilot_tpu import chaos, exceptions
 from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.runtime import job_queue
 from skypilot_tpu.runtime.rpc import MARKER
+from skypilot_tpu.utils import retry
 from skypilot_tpu.utils.command_runner import CommandRunner
 
 # Skylet-transport health on /metrics: every cluster RPC records its
@@ -49,8 +50,28 @@ _IDEMPOTENT = frozenset(
      "jobs_get", "jobs_list", "jobs_log", "jobs_tail", "serve_status",
      "get_metrics", "healthz"})
 _TRANSPORT_RETRIES = 3
-_RETRY_BACKOFF_SECONDS = 1.0
 DEFAULT_TIMEOUT_SECONDS = 120.0
+
+
+class _TransportFailure(Exception):
+    """One failed transport attempt (rc != 0 / timeout / OSError)."""
+
+    def __init__(self, rc: int, detail: str):
+        super().__init__(detail)
+        self.rc = rc
+        self.detail = detail
+
+
+# Jittered exponential backoff between transport attempts, capped well
+# below any sane timeout; attempts AND backoffs share one overall
+# deadline (default: the caller's ``timeout`` — so attempts × timeout
+# can never stretch a 120s budget into 6 minutes of hang).
+_TRANSPORT_POLICY = retry.RetryPolicy(
+    max_attempts=_TRANSPORT_RETRIES, backoff_base_s=1.0,
+    backoff_multiplier=2.0, backoff_max_s=8.0,
+    retry_on=(_TransportFailure,))
+_SINGLE_ATTEMPT = retry.RetryPolicy(max_attempts=1,
+                                    retry_on=(_TransportFailure,))
 
 
 class ClusterRpc:
@@ -60,14 +81,21 @@ class ClusterRpc:
 
     def call(self, method: str, *,
              timeout: float = DEFAULT_TIMEOUT_SECONDS,
+             deadline_s: Optional[float] = None,
              **params: Any) -> Any:
+        """One RPC round trip. ``timeout`` bounds each transport
+        attempt; ``deadline_s`` bounds the WHOLE call including retries
+        and backoffs (default: ``timeout`` — the caller's budget is a
+        total, not a per-attempt multiplier)."""
         with tracing.start_span(
                 f"rpc.{method}",
                 attrs={"cluster": self.cluster_name}) as span:
-            return self._call(method, span, timeout, params)
+            return self._call(method, span, timeout,
+                              deadline_s if deadline_s is not None
+                              else timeout, params)
 
     def _call(self, method: str, span, timeout: float,
-              params: Dict[str, Any]) -> Any:
+              deadline_s: float, params: Dict[str, Any]) -> Any:
         cmd = (self.runner.framework_invocation("skypilot_tpu.runtime.rpc")
                + f" --cluster {shlex.quote(self.cluster_name)}")
         # The trace context rides IN the request: the head-side rpc
@@ -76,12 +104,28 @@ class ClusterRpc:
         payload = json.dumps({"method": method, "params": params,
                               "trace": tracing.format_traceparent(
                                   span.ctx)})
-        attempts = _TRANSPORT_RETRIES if method in _IDEMPOTENT else 1
-        for attempt in range(attempts):
+        deadline = retry.Deadline(deadline_s)
+        attempts_made = [0]
+
+        def attempt() -> str:
+            # The first attempt gets the caller's per-attempt timeout
+            # verbatim (the accounting overhead between Deadline() and
+            # here must not shave it); RETRIES are clamped to the
+            # remaining overall budget.
+            first = attempts_made[0] == 0
+            attempts_made[0] += 1
+            per_timeout = (timeout if first and deadline_s >= timeout
+                           else deadline.clamp(timeout))
             t0 = time.monotonic()
             try:
-                rc, out, err = self.runner.run(cmd, stdin=payload,
-                                               timeout=timeout)
+                # The chaos point rides INSIDE the transport-failure
+                # classification: an injected ConnectionError/OSError is
+                # counted, retried (idempotent methods), and typed
+                # exactly like a real dropped SSH pipe.
+                chaos.point("rpc.transport", method=method,
+                            cluster=self.cluster_name)
+                rc, out, err = self.runner.run(
+                    cmd, stdin=payload, timeout=per_timeout)
             except subprocess.TimeoutExpired:
                 # A timeout IS a transport failure — the exact failure
                 # mode the timeout parameter exists for must show up in
@@ -89,7 +133,7 @@ class ClusterRpc:
                 # surface as the typed RPC error, not a raw
                 # TimeoutExpired.
                 rc, out = -1, ""
-                err = f"timed out after {timeout}s"
+                err = f"timed out after {per_timeout:.6g}s"
             except OSError as e:
                 # Socket/exec-level transport failures (the agent
                 # runner's ConnectionRefusedError during a head outage,
@@ -102,15 +146,25 @@ class ClusterRpc:
             finally:
                 RPC_SECONDS.labels(method=method).observe(
                     time.monotonic() - t0)
-            if rc == 0:
-                break
-            RPC_FAILURES.labels(method=method, kind="transport").inc()
-            if attempt + 1 < attempts:
-                time.sleep(_RETRY_BACKOFF_SECONDS * (attempt + 1))
-        if rc != 0:
+            if rc != 0:
+                RPC_FAILURES.labels(method=method, kind="transport").inc()
+                raise _TransportFailure(
+                    rc, err.strip() or out.strip())
+            return out
+
+        policy = (_TRANSPORT_POLICY if method in _IDEMPOTENT
+                  else _SINGLE_ATTEMPT)
+        try:
+            out = retry.call(attempt, name=f"rpc.{method}",
+                             deadline=deadline, policy=policy)
+        except _TransportFailure as e:
             raise ClusterRpcError(
                 f"cluster rpc {method!r} on {self.cluster_name!r} failed "
-                f"(rc={rc}): {err.strip() or out.strip()}")
+                f"(rc={e.rc}): {e.detail}") from None
+        except retry.DeadlineExceededError as e:
+            raise ClusterRpcError(
+                f"cluster rpc {method!r} on {self.cluster_name!r} failed: "
+                f"deadline ({deadline_s}s) exceeded: {e}") from None
         resp = None
         for line in reversed(out.splitlines()):
             if line.startswith(MARKER):

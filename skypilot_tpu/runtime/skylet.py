@@ -19,6 +19,7 @@ import os
 import sys
 import time
 
+from skypilot_tpu import chaos
 from skypilot_tpu.observability import metrics as obs_metrics
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.runtime import constants, job_queue, topology
@@ -69,6 +70,10 @@ def run(cluster_name: str, poll_interval: float) -> int:
     cdir = topology.cluster_dir(cluster_name)
     db = os.path.join(cdir, constants.JOB_DB)
     while True:
+        # A fault here kills the tick before any observation/autostop
+        # work — the chaos stand-in for a wedged/crashed skylet (the
+        # heartbeat-staleness SLO is what must catch it).
+        chaos.point("skylet.tick", cluster=cluster_name)
         observe_tick(db)
         try:
             meta = topology.load(cdir)

@@ -19,6 +19,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
+from skypilot_tpu import chaos
 from skypilot_tpu.observability import health as health_lib
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import serve_state
@@ -226,6 +227,9 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
             error), while connect errors and 5xx raise to the retry
             loop in _proxy.
             """
+            # Before the first byte moves: an injected fault lands in
+            # _proxy's retry loop and triggers clean replica failover.
+            chaos.point("serve.lb.forward", backend=base_url)
             parts = urlsplit(base_url)
             addr = (parts.hostname or "", parts.port or 80)
             hdrs = [f"{self.command} {self.path} HTTP/1.1",

@@ -15,7 +15,7 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
-from skypilot_tpu import exceptions, execution
+from skypilot_tpu import chaos, exceptions, execution
 from skypilot_tpu import state as cluster_state
 from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
 from skypilot_tpu.observability import metrics
@@ -319,6 +319,10 @@ class ReplicaManager:
             return False
         url = r["url"] + self.spec.readiness_path
         try:
+            # Inside the any-error-is-not-ready classification: an
+            # injected fault counts as exactly one failed probe.
+            chaos.point("serve.probe", service=self.service,
+                        replica=str(r["replica_id"]))
             data = (self.spec.post_data.encode()
                     if self.spec.post_data else None)
             req = urllib.request.Request(url, data=data)

@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 import jax
 
+from skypilot_tpu import chaos
 from skypilot_tpu.observability import metrics as obs_metrics
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.utils import timeline
@@ -80,6 +81,7 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Queue an async save. Returns False if skipped by interval."""
+        chaos.point("train.checkpoint_save", step=int(step))
         with tracing.start_span("train.checkpoint_save",
                                 attrs={"step": int(step)}), \
                 timeline.Event("skytpu_checkpoint_save_seconds",
@@ -101,6 +103,7 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}")
+        chaos.point("train.checkpoint_restore", step=int(step))
         if target is None:
             return self._mgr.restore(step)
         return self._mgr.restore(

@@ -1,0 +1,253 @@
+"""Unified retry/backoff/deadline/circuit-breaker policy.
+
+Every retry loop in the tree routes through this module (enforced by
+``tests/test_no_adhoc_retry.py``): one place owns the backoff math,
+deadline accounting, and retry telemetry, so the chaos harness
+(``skypilot_tpu/chaos``) can assert recovery behavior against a single
+policy surface instead of N hand-rolled ``time.sleep`` loops — the
+reference scatters retries across cloud adapters and the backend
+(sky/backends/cloud_vm_ray_backend.py, sky/utils/common_utils.py's
+``retry``), which is exactly what made its failover behavior hard to
+test.
+
+Stdlib-only: head-side runtime processes import this under
+``python -S``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+from skypilot_tpu.observability import metrics, tracing
+
+RETRIES = metrics.counter(
+    "skytpu_retries_total",
+    "Retry-policy attempt outcomes by policy name "
+    "(retried | gave_up | deadline_exceeded | circuit_open)",
+    labelnames=("name", "outcome"))
+
+# Module-level RNG for backoff jitter. Deterministic tests (and the
+# seeded chaos harness) pass their own ``random.Random(seed)``.
+_rng = random.Random()
+
+
+class RetryError(Exception):
+    """Internal marker base; public failures re-raise the last cause."""
+
+
+class DeadlineExceededError(Exception):
+    """The overall deadline expired before an attempt succeeded. Carries
+    the last attempt's exception as ``__cause__`` when one happened."""
+
+
+class CircuitOpenError(Exception):
+    """The circuit breaker is open: calls fail fast without attempting."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Declarative retry behavior: capped jittered exponential backoff.
+
+    ``backoff(attempt)`` for attempt 0,1,2,... is
+    ``min(base * multiplier**attempt, cap)`` scaled down by up to
+    ``jitter`` (a fraction in [0, 1]) — jitter only ever *shortens* a
+    sleep, so the cap is a hard upper bound and deadline math stays
+    conservative. ``retry_on`` classifies retryable failures;
+    ``give_up_on`` carves out subclasses that must fail immediately
+    (e.g. a typed permanent refusal inside a broad transient class).
+    """
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.5
+    backoff_multiplier: float = 2.0
+    backoff_max_s: float = 30.0
+    jitter: float = 0.25
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,)
+    give_up_on: Tuple[Type[BaseException], ...] = ()
+
+    def backoff_s(self, attempt: int,
+                  rng: Optional[random.Random] = None) -> float:
+        base = min(self.backoff_base_s * self.backoff_multiplier ** attempt,
+                   self.backoff_max_s)
+        if self.jitter <= 0:
+            return base
+        return base * (1.0 - self.jitter * (rng or _rng).random())
+
+    def retryable(self, exc: BaseException) -> bool:
+        if isinstance(exc, self.give_up_on):
+            return False
+        return isinstance(exc, self.retry_on)
+
+
+#: One attempt, no sleeping — for call sites that gate retrying on a
+#: runtime condition (e.g. only idempotent RPC methods retry).
+NO_RETRY = RetryPolicy(max_attempts=1)
+
+
+class Deadline:
+    """Overall wall-clock budget shared across attempts AND backoffs.
+
+    ``Deadline(None)`` is unbounded. ``clamp(t)`` shrinks a per-attempt
+    timeout to the remaining budget so attempts × timeout can never
+    exceed the caller's intended total (the ClusterRpc bug this class
+    exists to fix).
+    """
+
+    def __init__(self, seconds: Optional[float]):
+        self.seconds = seconds
+        self._t0 = time.monotonic()
+
+    def remaining(self) -> Optional[float]:
+        if self.seconds is None:
+            return None
+        return self.seconds - (time.monotonic() - self._t0)
+
+    def expired(self) -> bool:
+        rem = self.remaining()
+        return rem is not None and rem <= 0
+
+    def clamp(self, timeout: Optional[float]) -> Optional[float]:
+        rem = self.remaining()
+        if rem is None:
+            return timeout
+        rem = max(rem, 0.0)
+        return rem if timeout is None else min(timeout, rem)
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit: after ``failure_threshold`` failures
+    in a row the circuit opens and :func:`call` fails fast with
+    ``CircuitOpenError`` (no attempt, no sleep) until ``reset_after_s``
+    elapses; the next call then runs as a half-open probe — success
+    closes the circuit, failure re-opens it for another window.
+
+    Thread-safe, and the half-open probe is exclusive: granting it
+    re-arms the window, so concurrent callers keep failing fast until
+    the probe reports back — N handler threads must not all hammer the
+    dependency the breaker exists to protect.
+    """
+
+    def __init__(self, name: str, failure_threshold: int = 5,
+                 reset_after_s: float = 30.0):
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.reset_after_s = reset_after_s
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if time.monotonic() - self._opened_at < self.reset_after_s:
+                return False
+            # Claim the half-open probe: re-arm the window so only THIS
+            # caller probes; a success will close the circuit.
+            self._opened_at = time.monotonic()
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._opened_at = time.monotonic()
+
+
+def call(fn: Callable[[], Any], *,
+         policy: RetryPolicy = RetryPolicy(),
+         name: Optional[str] = None,
+         deadline: Optional[Deadline] = None,
+         breaker: Optional[CircuitBreaker] = None,
+         on_retry: Optional[Callable[[int, BaseException, float],
+                                     None]] = None,
+         sleep: Callable[[float], None] = time.sleep,
+         rng: Optional[random.Random] = None) -> Any:
+    """Run ``fn()`` under ``policy``. THE retry loop.
+
+    * Retries only failures ``policy`` classifies retryable; anything
+      else re-raises immediately.
+    * Never sleeps past ``deadline``: when the remaining budget cannot
+      cover the next backoff (or is already spent), the last failure
+      re-raises now instead of burning budget asleep — a caller's
+      deadline bounds the WHOLE call, not just the attempts.
+    * ``on_retry(attempt, exc, backoff_s)`` fires before each backoff
+      (telemetry, blocklist resets); ``name`` additionally records
+      ``skytpu_retries_total`` and a typed ``retry.backoff`` event so
+      traces show every recovery pause.
+    * ``breaker``: consult/record a :class:`CircuitBreaker`; an open
+      circuit raises ``CircuitOpenError`` without attempting.
+    """
+    if breaker is not None and not breaker.allow():
+        if name:
+            RETRIES.labels(name=name, outcome="circuit_open").inc()
+        raise CircuitOpenError(
+            f"circuit {breaker.name!r} open after "
+            f"{breaker.failure_threshold} consecutive failures")
+    attempt = 0
+    while True:
+        if deadline is not None and deadline.expired():
+            if name:
+                RETRIES.labels(name=name,
+                               outcome="deadline_exceeded").inc()
+            raise DeadlineExceededError(
+                f"deadline ({deadline.seconds}s) expired before attempt "
+                f"{attempt + 1}")
+        try:
+            result = fn()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if breaker is not None:
+                breaker.record_failure()
+            if not policy.retryable(e) or attempt + 1 >= policy.max_attempts:
+                if name:
+                    RETRIES.labels(name=name, outcome="gave_up").inc()
+                raise
+            pause = policy.backoff_s(attempt, rng=rng)
+            if deadline is not None:
+                rem = deadline.remaining()
+                if rem is not None and pause >= rem:
+                    # Sleeping would eat the rest of the budget: fail
+                    # with the real cause now, not a late timeout.
+                    if name:
+                        RETRIES.labels(name=name,
+                                       outcome="deadline_exceeded").inc()
+                    raise
+            if on_retry is not None:
+                on_retry(attempt, e, pause)
+            if name:
+                RETRIES.labels(name=name, outcome="retried").inc()
+                tracing.add_event(
+                    "retry.backoff",
+                    attrs={"policy": name, "attempt": attempt,
+                           "backoff_s": round(pause, 3),
+                           "error_type": type(e).__name__,
+                           "message": str(e)[:200]})
+            if pause > 0:
+                sleep(pause)
+            attempt += 1
+            continue
+        if breaker is not None:
+            breaker.record_success()
+        return result
+
+
+def pause(policy: RetryPolicy, attempt: int, *,
+          sleep: Callable[[float], None] = time.sleep,
+          rng: Optional[random.Random] = None) -> float:
+    """Sleep one policy backoff and return the pause taken — for loops
+    whose retry decision lives elsewhere (e.g. the managed-job monitor,
+    where "retry" means a full recovery launch driven by job state, not
+    re-calling a function)."""
+    t = policy.backoff_s(attempt, rng=rng)
+    if t > 0:
+        sleep(t)
+    return t

@@ -1,0 +1,566 @@
+"""Chaos harness: seeded fault plans driven through end-to-end
+recovery scenarios against the local fake cloud.
+
+Each scenario injects faults through the named chaos points and asserts
+the system CONVERGES (terminal state reached exactly once, no duplicate
+cluster launches) and EXPLAINS itself (typed ``chaos.injected`` /
+``slo.breach`` / recovery events in the structured log, recovery
+counters matching the injected faults). Determinism: the same plan +
+seed reproduces the same injection sequence, so a failing chaos run is
+a reproducible artifact, not a flake.
+"""
+
+import ast
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu import chaos, exceptions
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def chaos_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+    monkeypatch.setenv("SKYTPU_LOCAL_CLUSTERS_ROOT", str(tmp_path / "cloud"))
+    monkeypatch.delenv("SKYTPU_CHAOS_PLAN", raising=False)
+    monkeypatch.delenv("SKYTPU_CHAOS_PLAN_JSON", raising=False)
+    chaos._reset_for_tests()
+    from skypilot_tpu.observability import tracing
+    tracing._reset_for_tests()
+    yield
+    chaos._reset_for_tests()
+
+
+def _events(name):
+    from skypilot_tpu.observability import tracing
+    return [r for r in tracing.buffered_records() if r.get("name") == name]
+
+
+# -- plan schema ------------------------------------------------------------
+
+def test_plan_validation_rejects_malformed():
+    with pytest.raises(ValueError, match="seed"):
+        chaos.parse_plan({"seed": "nope"})
+    with pytest.raises(ValueError, match="faults\\[0\\].*point"):
+        chaos.parse_plan({"faults": [{"times": 1}]})
+    with pytest.raises(ValueError, match="probability"):
+        chaos.parse_plan({"faults": [{"point": "x", "probability": 2}]})
+    with pytest.raises(ValueError, match="unknown keys"):
+        chaos.parse_plan({"faults": [{"point": "x", "nope": 1}]})
+    plan = chaos.parse_plan({"seed": 3, "faults": [
+        {"point": "rpc.transport", "times": 1}]})
+    assert plan.seed == 3 and plan.rules[0].point == "rpc.transport"
+
+
+def test_point_catalog_matches_code():
+    """Every chaos.point() call site in the tree must be cataloged in
+    plan.KNOWN_POINTS (and vice versa) — a fault plan targeting a
+    point that silently vanished injects nothing."""
+    in_code = set()
+    pkg = os.path.join(REPO, "skypilot_tpu")
+    for dirpath, _, names in os.walk(pkg):
+        if "__pycache__" in dirpath or os.path.join("skypilot_tpu",
+                                                    "chaos") in dirpath:
+            continue
+        for fname in names:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fname),
+                      encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "point"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "chaos"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)):
+                    in_code.add(node.args[0].value)
+    assert in_code == set(chaos.KNOWN_POINTS), (
+        f"catalog drift — in code only: "
+        f"{sorted(in_code - set(chaos.KNOWN_POINTS))}; in catalog only: "
+        f"{sorted(set(chaos.KNOWN_POINTS) - in_code)}")
+
+
+# -- injector semantics -----------------------------------------------------
+
+def test_same_seed_reproduces_injection_sequence():
+    plan = {"seed": 1234, "faults": [
+        {"point": "rpc.transport", "probability": 0.4,
+         "error": "ConnectionError"}]}
+
+    def run_sequence():
+        inj = chaos.configure(plan)
+        seq = []
+        for _ in range(50):
+            try:
+                chaos.point("rpc.transport", method="ping", cluster="c")
+                seq.append(".")
+            except ConnectionError:
+                seq.append("X")
+        return seq, [f["seq"] for f in inj.fired]
+
+    seq1, fired1 = run_sequence()
+    seq2, fired2 = run_sequence()
+    assert seq1 == seq2 and fired1 == fired2
+    assert 0 < seq1.count("X") < 50       # probabilistic, but seeded
+    # A different seed yields a different sequence.
+    plan2 = dict(plan, seed=99)
+    inj = chaos.configure(plan2)
+    seq3 = []
+    for _ in range(50):
+        try:
+            chaos.point("rpc.transport", method="ping", cluster="c")
+            seq3.append(".")
+        except ConnectionError:
+            seq3.append("X")
+    assert seq3 != seq1
+
+
+def test_reusing_the_same_plan_object_starts_fresh():
+    """Injector must copy rule counters: re-running the SAME parsed
+    Plan (the reproducibility workflow) starts from zero fires."""
+    plan = chaos.parse_plan({"seed": 0, "faults": [
+        {"point": "skylet.tick", "times": 1}]})
+    for _ in range(2):
+        chaos.configure(plan)
+        with pytest.raises(chaos.ChaosError):
+            chaos.point("skylet.tick", cluster="c")
+        chaos.point("skylet.tick", cluster="c")   # exhausted
+
+
+def test_malformed_env_plan_disables_injection_loudly(monkeypatch):
+    """A typo'd plan must NOT leak ValueError into production paths
+    (probe loops would misread it as component failure) — injection
+    disables with a typed chaos.plan_invalid event instead."""
+    monkeypatch.setenv("SKYTPU_CHAOS_PLAN_JSON", "{not json")
+    chaos._reset_for_tests()
+    chaos.point("serve.probe", service="s", replica="1")   # no raise
+    assert not chaos.active()
+    assert len(_events("chaos.plan_invalid")) == 1
+
+
+def test_env_inline_plan_activates_and_emits_typed_event(monkeypatch):
+    monkeypatch.setenv("SKYTPU_CHAOS_PLAN_JSON", json.dumps(
+        {"seed": 0, "faults": [{"point": "jobs.transition", "times": 1,
+                                "match": {"status": "RUNNING"}}]}))
+    chaos._reset_for_tests()
+    assert chaos.active()
+    chaos.point("jobs.transition", status="PENDING", job_id=1)  # no match
+    with pytest.raises(chaos.ChaosError):
+        chaos.point("jobs.transition", status="RUNNING", job_id=1)
+    chaos.point("jobs.transition", status="RUNNING", job_id=1)  # exhausted
+    evs = _events("chaos.injected")
+    assert len(evs) == 1
+    assert evs[0]["attrs"]["point"] == "jobs.transition"
+    assert evs[0]["attrs"]["ctx.status"] == "RUNNING"
+
+
+def test_plan_file_activation_and_latency_only_fault(tmp_path,
+                                                     monkeypatch):
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(json.dumps(
+        {"seed": 0, "faults": [{"point": "serve.probe",
+                                "latency_s": 0.15}]}))
+    monkeypatch.setenv("SKYTPU_CHAOS_PLAN", str(plan_path))
+    chaos._reset_for_tests()
+    t0 = time.monotonic()
+    chaos.point("serve.probe", service="s", replica="1")   # sleeps, no raise
+    assert time.monotonic() - t0 >= 0.14
+    assert _events("chaos.injected")[0]["attrs"]["effect"] == "latency"
+
+
+def test_after_skips_leading_hits():
+    chaos.configure({"seed": 0, "faults": [
+        {"point": "train.checkpoint_save", "after": 2, "times": 1}]})
+    chaos.point("train.checkpoint_save", step=1)
+    chaos.point("train.checkpoint_save", step=2)
+    with pytest.raises(chaos.ChaosError):
+        chaos.point("train.checkpoint_save", step=3)
+    chaos.point("train.checkpoint_save", step=4)
+
+
+# -- scenario 1: provisioning stockout -> zone failover ---------------------
+
+def _local_task(run="true", name=None):
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    t = Task(name=name, run=run)
+    t.set_resources(Resources(cloud="local"))
+    return t
+
+
+def test_stockout_zone_failover(monkeypatch):
+    """Two zones stock out (seeded CapacityError at the provision
+    dispatcher); the failover loop blocklists each and lands the SAME
+    cluster in the third zone — one cluster, no duplicate launches."""
+    monkeypatch.setenv("SKYTPU_LOCAL_ZONES", "zone-a,zone-b,zone-c")
+    from skypilot_tpu import state
+    from skypilot_tpu.backend import RetryingProvisioner
+    inj = chaos.configure({"seed": 7, "faults": [
+        {"point": "provision.run_instances", "times": 2,
+         "error": "CapacityError",
+         "message": "[chaos] ZONE_RESOURCE_POOL_EXHAUSTED"}]})
+
+    handle = RetryingProvisioner().provision(_local_task(), "chaos-fo")
+    assert handle.zone == "zone-c"
+    # The injection sequence is the failover path: zone-a then zone-b.
+    assert [f["ctx"]["zone"] for f in inj.fired] == ["zone-a", "zone-b"]
+    assert inj.observed["provision.run_instances"] == 3
+    assert len(_events("chaos.injected")) == 2
+    rec = state.get_cluster("chaos-fo")
+    assert state.ClusterStatus(rec["status"]) == state.ClusterStatus.UP
+    # No duplicate launches: the fake cloud holds exactly ONE cluster.
+    clusters_root = os.environ["SKYTPU_LOCAL_CLUSTERS_ROOT"]
+    assert os.listdir(clusters_root) == ["chaos-fo"]
+
+
+def test_stockout_everywhere_is_typed_with_history(monkeypatch):
+    monkeypatch.setenv("SKYTPU_LOCAL_ZONES", "zone-a,zone-b")
+    from skypilot_tpu.backend import RetryingProvisioner
+    chaos.configure({"seed": 7, "faults": [
+        {"point": "provision.run_instances", "error": "CapacityError"}]})
+    with pytest.raises(exceptions.ResourcesUnavailableError) as ei:
+        RetryingProvisioner().provision(_local_task(), "chaos-exhaust")
+    assert len(ei.value.failover_history) == 2     # one per zone
+
+
+# -- scenario 2: preemption mid-job -> EAGER_NEXT_ZONE recovery -------------
+
+def test_preemption_recovery_blocklists_evicted_zone(monkeypatch):
+    """Slice preempted mid-job: EAGER_NEXT_ZONE tears down, blocklists
+    the evicted zone, and relaunches the job in the next zone. A
+    standing chaos stockout on the evicted zone is the tripwire — a
+    broken blocklist would trip it; an intact one never re-attempts
+    zone-a at all."""
+    monkeypatch.setenv("SKYTPU_LOCAL_ZONES", "zone-a,zone-b")
+    from skypilot_tpu.backend import TpuVmBackend
+    from skypilot_tpu.jobs import recovery_strategy
+    from skypilot_tpu.provision import local as local_provider
+    from skypilot_tpu.runtime.job_queue import JobStatus
+
+    task = _local_task(run="echo recovered-ok", name="chaos-mj")
+    strat = recovery_strategy.EagerNextZoneStrategy(task, "chaos-prempt")
+    job1, handle1 = strat.launch()
+    assert handle1.zone == "zone-a"
+
+    # Preempt: the fake cloud loses the whole slice out-of-band, then
+    # chaos declares zone-a permanently stocked out.
+    local_provider.terminate_instances("chaos-prempt", "zone-a")
+    inj = chaos.configure({"seed": 11, "faults": [
+        {"point": "provision.run_instances", "match": {"zone": "zone-a"},
+         "error": "CapacityError"}]})
+    launches_before = recovery_strategy.RECOVERY_LAUNCHES.labels(
+        strategy="EagerNextZoneStrategy").value
+
+    job2, handle2 = strat.recover()
+    assert handle2.zone == "zone-b"
+    # The evicted zone was never even attempted (blocklist worked) —
+    # every provision attempt the injector observed targeted zone-b.
+    zones_tried = [o["ctx"]["zone"] for o in inj.observations
+                   if o["point"] == "provision.run_instances"]
+    assert zones_tried == ["zone-b"]
+    assert inj.fired == []
+    assert recovery_strategy.RECOVERY_LAUNCHES.labels(
+        strategy="EagerNextZoneStrategy").value == launches_before + 1
+
+    # Convergence: the relaunched job runs to SUCCEEDED on the new
+    # cluster, and the sky holds exactly one cluster (no duplicates).
+    backend = TpuVmBackend()
+    assert backend.wait_job(handle2, job2,
+                            timeout=60) == JobStatus.SUCCEEDED
+    clusters_root = os.environ["SKYTPU_LOCAL_CLUSTERS_ROOT"]
+    assert os.listdir(clusters_root) == ["chaos-prempt"]
+    backend.teardown(handle2)
+
+
+# -- scenario 3: RPC partition -> retries, typed error, deadline ------------
+
+def test_rpc_partition_retries_then_typed_error():
+    from skypilot_tpu.runtime.rpc_client import (RPC_FAILURES, ClusterRpc,
+                                                 ClusterRpcError)
+    from skypilot_tpu.utils.command_runner import LocalRunner
+    inj = chaos.configure({"seed": 5, "faults": [
+        {"point": "rpc.transport", "error": "ConnectionError",
+         "message": "[chaos] partition: head unreachable"}]})
+    before = RPC_FAILURES.labels(method="ping", kind="transport").value
+    rpc = ClusterRpc(LocalRunner(), "chaos-part")
+    # Generous budget: asserts the retry count, not the deadline.
+    with pytest.raises(ClusterRpcError, match="partition"):
+        rpc.call("ping", timeout=30.0)
+    assert inj.observed["rpc.transport"] == 3      # idempotent: 3 tries
+    assert RPC_FAILURES.labels(method="ping",
+                               kind="transport").value == before + 3
+    assert len(_events("chaos.injected")) == 3
+    # Non-idempotent methods never retry a partition.
+    with pytest.raises(ClusterRpcError):
+        rpc.call("submit", timeout=5.0)
+    assert inj.observed["rpc.transport"] == 4
+
+
+def test_rpc_partition_respects_overall_deadline():
+    """attempts x timeout must not stretch the caller's budget ~3x:
+    with a 1.2s budget the retry loop gives up early — and never
+    hangs past the deadline."""
+    from skypilot_tpu.runtime.rpc_client import ClusterRpc, ClusterRpcError
+    from skypilot_tpu.utils.command_runner import LocalRunner
+    inj = chaos.configure({"seed": 5, "faults": [
+        {"point": "rpc.transport", "error": "ConnectionError"}]})
+    rpc = ClusterRpc(LocalRunner(), "chaos-deadline")
+    t0 = time.monotonic()
+    with pytest.raises(ClusterRpcError):
+        rpc.call("ping", timeout=1.2)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.5, f"hung {elapsed:.1f}s past a 1.2s budget"
+    assert inj.observed["rpc.transport"] < 3
+
+
+# -- scenario 4: replica death -> replacement within one probe cycle --------
+
+def _mk_manager(service):
+    from skypilot_tpu.serve.replica_managers import ReplicaManager
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec(initial_delay_seconds=60.0, replica_port=18080)
+    task_config = {"run": "true", "resources": {"cloud": "local"}}
+    return ReplicaManager(service, spec, task_config)
+
+
+def test_replica_death_replaced_within_one_probe_cycle():
+    from skypilot_tpu.observability import health, slo
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+
+    svc = "chaos-svc"
+    dead_url = "http://127.0.0.1:1"       # nothing listens on port 1
+    serve_state.upsert_replica(svc, 1, f"sky-serve-{svc}-1",
+                               ReplicaStatus.READY, dead_url)
+    mgr = _mk_manager(svc)
+
+    # One probe cycle: the dead replica (its cluster has no state
+    # record — the slice is gone) is retired and a replacement launch
+    # is already in flight.
+    mgr.probe_all()
+    rows = {r["replica_id"]: r for r in serve_state.list_replicas(svc)}
+    assert rows[2]["status"] in (ReplicaStatus.PROVISIONING,
+                                 ReplicaStatus.STARTING)
+    assert rows.get(1) is None or rows[1]["status"] in (
+        ReplicaStatus.PREEMPTED, ReplicaStatus.SHUTTING_DOWN,
+        ReplicaStatus.SHUTDOWN)
+
+    # The SLO watchdog explains the death: a component_dead rule over
+    # the (really-probed) dead endpoint fires a typed slo.breach.
+    comp = health.probe_http(dead_url, comp="replica", instance=f"{svc}/1")
+    assert comp["status"] == health.DEAD
+    watchdog = slo.Watchdog(
+        rules=[slo.SloRule("component-alive", "component_dead",
+                           threshold=0.0)],
+        snapshot_fn=lambda: ({}, [comp]))
+    transitions = watchdog.tick()
+    assert [t["event"] for t in transitions] == ["slo.breach"]
+    assert len(_events("slo.breach")) == 1
+
+    # Wait out the replacement launch, then probe again: the fresh
+    # replica is within its initial_delay grace — NO second relaunch.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        rows = {r["replica_id"]: r
+                for r in serve_state.list_replicas(svc)}
+        if rows.get(2, {}).get("status") == ReplicaStatus.STARTING:
+            break
+        time.sleep(0.2)
+    assert rows[2]["status"] == ReplicaStatus.STARTING, rows
+    mgr.probe_all()
+    rows = {r["replica_id"]: r for r in serve_state.list_replicas(svc)}
+    assert rows[2]["status"] == ReplicaStatus.STARTING
+    assert max(rows) == 2                  # exactly one replacement
+    mgr.terminate_all()
+
+
+def test_injected_probe_failures_flip_replica_then_self_heal():
+    """Seeded probe faults: exactly 3 injected failures flip a READY
+    replica NOT_READY (the controller's failure threshold); when the
+    fault schedule exhausts, the next cycle flips it back — recovery
+    counters match injected faults 1:1."""
+    from skypilot_tpu import state as cluster_state
+    from skypilot_tpu.provision import local as local_provider
+    from skypilot_tpu.provision.common import ProvisionConfig
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.replica_managers import (
+        PROBE_FAILURES, PROBE_FAILURES_BEFORE_NOT_READY)
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    import http.server
+    import socketserver
+
+    svc = "chaos-heal"
+    # A real, healthy replica endpoint...
+    class Ok(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = socketserver.TCPServer(("127.0.0.1", 0), Ok)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    # ...backed by a live fake-cloud cluster so the prober doesn't take
+    # the cluster-gone path.
+    cluster = f"sky-serve-{svc}-1"
+    local_provider.run_instances(ProvisionConfig(
+        cluster_name=cluster, num_nodes=1, hosts_per_node=1,
+        zone="local", region="local", accelerator=None,
+        accelerator_count=0, instance_type=None, use_spot=False,
+        runtime_version=None, disk_size=None, image_id=None))
+    cluster_state.set_cluster(cluster, {"provider": "local",
+                                        "zone": "local"},
+                              cluster_state.ClusterStatus.UP, 0.0)
+    serve_state.upsert_replica(svc, 1, cluster, ReplicaStatus.READY, url)
+    mgr = _mk_manager(svc)
+
+    inj = chaos.configure({"seed": 2, "faults": [
+        {"point": "serve.probe", "match": {"service": svc},
+         "times": PROBE_FAILURES_BEFORE_NOT_READY}]})
+    before = PROBE_FAILURES.labels(service=svc).value
+
+    for i in range(PROBE_FAILURES_BEFORE_NOT_READY):
+        mgr.probe_all()
+    assert serve_state.list_replicas(svc)[0]["status"] == \
+        ReplicaStatus.NOT_READY
+    assert PROBE_FAILURES.labels(service=svc).value - before == \
+        PROBE_FAILURES_BEFORE_NOT_READY == len(inj.fired)
+    assert len(_events("chaos.injected")) == \
+        PROBE_FAILURES_BEFORE_NOT_READY
+
+    # Fault schedule exhausted: one clean probe heals the replica.
+    mgr.probe_all()
+    assert serve_state.list_replicas(svc)[0]["status"] == \
+        ReplicaStatus.READY
+    httpd.shutdown()
+
+
+# -- scenario 4c: LB partition from one replica -> clean failover -----------
+
+def test_lb_fails_over_around_partitioned_replica():
+    """A standing fault partitions the LB from replica 1: every request
+    fails over to replica 2 before any byte reaches the client, the
+    failed attempts land in the retry counter, and the injected count
+    matches the retries 1:1."""
+    import http.server
+    import urllib.request
+    from skypilot_tpu.serve import load_balancer, serve_state
+
+    class Ok(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            body = b"from-r2"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    replica2 = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Ok)
+    threading.Thread(target=replica2.serve_forever, daemon=True).start()
+    url1 = "http://127.0.0.1:1"           # partitioned (and dead anyway)
+    url2 = f"http://127.0.0.1:{replica2.server_address[1]}"
+    svc = "chaos-lb"
+    serve_state.add_service(svc, {}, {}, 0)
+    serve_state.upsert_replica(svc, 1, "r1",
+                               serve_state.ReplicaStatus.READY, url1)
+    serve_state.upsert_replica(svc, 2, "r2",
+                               serve_state.ReplicaStatus.READY, url2)
+    inj = chaos.configure({"seed": 3, "faults": [
+        {"point": "serve.lb.forward", "match": {"backend": url1},
+         "error": "ConnectionError",
+         "message": "[chaos] partitioned from r1"}]})
+    retries_before = load_balancer.LB_RETRIES.labels(backend=url1).value
+
+    lb = load_balancer._ThreadingServer(
+        ("127.0.0.1", 0),
+        load_balancer.make_handler(svc, load_balancer.RoundRobinPolicy()))
+    threading.Thread(target=lb.serve_forever, daemon=True).start()
+    try:
+        bodies = set()
+        for _ in range(4):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{lb.server_address[1]}/x",
+                    timeout=10) as r:
+                assert r.status == 200
+                bodies.add(r.read())
+        assert bodies == {b"from-r2"}      # every request converged
+        r1_attempts = [f for f in inj.fired
+                       if f["ctx"]["backend"] == url1]
+        assert len(r1_attempts) >= 1       # round-robin did try r1
+        assert load_balancer.LB_RETRIES.labels(backend=url1).value \
+            - retries_before == len(r1_attempts)
+    finally:
+        lb.shutdown()
+        replica2.shutdown()
+
+
+# -- recovery-budget exhaustion -> typed give-up ----------------------------
+
+def test_recovery_exhaustion_records_typed_give_up(monkeypatch):
+    monkeypatch.setenv("SKYTPU_JOBS_MAX_RECOVERY_ATTEMPTS", "2")
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.jobs.controller import JobsController
+    from skypilot_tpu.jobs.state import ManagedJobStatus
+
+    jid = jobs_state.add("chaos-exhaust", {"run": "true"},
+                         "EAGER_NEXT_ZONE")
+    jobs_state.set_status(jid, ManagedJobStatus.RUNNING)
+    ctl = object.__new__(JobsController)
+    ctl.job_id = jid
+    ctl.cluster_name = "sky-jobs-chaos"
+    ctl.task_recoveries = 2               # budget already spent
+    assert ctl._recover() is None
+    rec = jobs_state.get(jid)
+    assert rec["status"] == ManagedJobStatus.FAILED_RECOVERY
+    assert "recovery budget exhausted" in rec["last_error"]
+    evs = _events("jobs.recovery_gave_up")
+    assert len(evs) == 1 and evs[0]["attrs"]["max_attempts"] == 2
+    # Terminal exactly once: a late SUCCEEDED must not apply.
+    assert not jobs_state.set_status(jid, ManagedJobStatus.SUCCEEDED)
+    assert jobs_state.get(jid)["status"] == \
+        ManagedJobStatus.FAILED_RECOVERY
+
+
+def test_recovery_budget_configurable_via_config_file(tmp_path,
+                                                      monkeypatch):
+    from skypilot_tpu import config
+    from skypilot_tpu.jobs import recovery_strategy
+    monkeypatch.delenv("SKYTPU_JOBS_MAX_RECOVERY_ATTEMPTS", raising=False)
+    assert recovery_strategy.max_recovery_attempts() == 10   # default
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text("jobs:\n  max_recovery_attempts: 4\n"
+                   "  recovery_backoff_seconds: 0.25\n")
+    monkeypatch.setenv("SKYPILOT_TPU_CONFIG", str(cfg))
+    config.reload()
+    try:
+        assert recovery_strategy.max_recovery_attempts() == 4
+        pol = recovery_strategy.recovery_backoff_policy()
+        assert pol.backoff_base_s == 0.25 and pol.max_attempts == 4
+        # Env beats config.
+        monkeypatch.setenv("SKYTPU_JOBS_MAX_RECOVERY_ATTEMPTS", "7")
+        assert recovery_strategy.max_recovery_attempts() == 7
+        # A typo'd override falls through to the config layer (typed
+        # event) instead of turning the next recovery into
+        # FAILED_CONTROLLER.
+        monkeypatch.setenv("SKYTPU_JOBS_MAX_RECOVERY_ATTEMPTS", "ten")
+        assert recovery_strategy.max_recovery_attempts() == 4
+        assert len(_events("jobs.config_invalid")) == 1
+    finally:
+        config.reload()

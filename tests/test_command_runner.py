@@ -1,0 +1,116 @@
+"""utils/command_runner.py failure modes: timeouts, nonzero exits vs.
+transport errors, and partial-output preservation — the classification
+contract the RPC layer's transport-failure handling builds on."""
+
+import os
+import subprocess
+
+import pytest
+
+from skypilot_tpu.utils.command_runner import CommandRunner, LocalRunner
+
+
+@pytest.fixture(autouse=True)
+def sky_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "skyhome"))
+
+
+# -- raw runner behavior ----------------------------------------------------
+
+def test_nonzero_exit_preserves_output():
+    rc, out, err = LocalRunner().run(
+        "echo partial-stdout; echo partial-stderr >&2; exit 7")
+    assert rc == 7
+    assert "partial-stdout" in out
+    assert "partial-stderr" in err
+
+
+def test_timeout_raises_with_partial_output():
+    with pytest.raises(subprocess.TimeoutExpired) as ei:
+        LocalRunner().run("echo before-hang; exec sleep 30", timeout=0.5)
+    got = ei.value.stdout or ei.value.output or b""
+    if isinstance(got, bytes):
+        got = got.decode(errors="replace")
+    assert "before-hang" in got
+
+
+def test_log_path_keeps_partial_output_on_failure(tmp_path):
+    log = tmp_path / "logs" / "cmd.log"
+    rc, out, err = LocalRunner().run(
+        "echo logged-line; exit 3", log_path=str(log))
+    assert rc == 3
+    assert (out, err) == ("", "")           # tee'd, not captured
+    assert "logged-line" in log.read_text()
+
+
+def test_log_path_keeps_partial_output_on_timeout(tmp_path):
+    log = tmp_path / "logs" / "cmd.log"
+    with pytest.raises(subprocess.TimeoutExpired):
+        LocalRunner().run("echo flushed; exec sleep 30",
+                          timeout=0.5, log_path=str(log))
+    assert "flushed" in log.read_text()
+
+
+def test_read_file_missing_returns_none(tmp_path):
+    r = LocalRunner()
+    assert r.read_file(str(tmp_path / "nope")) is None
+    p = tmp_path / "yes"
+    p.write_text("content")
+    assert r.read_file(str(p)) == "content"
+
+
+# -- classification through the RPC transport -------------------------------
+# rc != 0, TimeoutExpired, and OSError must ALL surface as the typed
+# ClusterRpcError counted as kind=transport — never a raw exception.
+
+class _FailingRunner(CommandRunner):
+    def __init__(self, exc=None, rc=None, out="", err=""):
+        super().__init__()
+        self.exc = exc
+        self.rc = rc
+        self.out, self.err = out, err
+        self.calls = 0
+
+    def run(self, cmd, env=None, cwd=None, timeout=None, log_path=None,
+            stdin=None):
+        self.calls += 1
+        if self.exc is not None:
+            raise self.exc
+        return self.rc, self.out, self.err
+
+    def framework_invocation(self, module):
+        return f"python3 -m {module}"
+
+
+def _transport_count(method):
+    from skypilot_tpu.runtime.rpc_client import RPC_FAILURES
+    return RPC_FAILURES.labels(method=method, kind="transport").value
+
+
+@pytest.mark.parametrize("runner", [
+    _FailingRunner(exc=ConnectionRefusedError("head down")),
+    _FailingRunner(exc=subprocess.TimeoutExpired("cmd", 1.0)),
+    _FailingRunner(rc=255, err="ssh: connection reset"),
+], ids=["oserror", "timeout", "nonzero-rc"])
+def test_rpc_classifies_as_transport_and_retries(runner):
+    from skypilot_tpu.runtime.rpc_client import ClusterRpc, ClusterRpcError
+    before = _transport_count("ping")
+    rpc = ClusterRpc(runner, "t-cluster")
+    # Budget comfortably above the worst-case backoff total (1s + 2s):
+    # this asserts the retry count, not the deadline cutoff.
+    with pytest.raises(ClusterRpcError):
+        rpc.call("ping", timeout=10.0)
+    # Idempotent method: all transport attempts burned and counted.
+    assert runner.calls == 3
+    assert _transport_count("ping") - before == 3
+
+
+def test_rpc_partial_output_lands_in_typed_error():
+    """The head's stderr tail rides the ClusterRpcError message — the
+    diagnostic a human needs must not vanish with the raw rc."""
+    from skypilot_tpu.runtime.rpc_client import ClusterRpc, ClusterRpcError
+    runner = _FailingRunner(rc=1, out="partial head output",
+                            err="traceback: ImportError")
+    with pytest.raises(ClusterRpcError, match="ImportError"):
+        ClusterRpc(runner, "t-cluster").call("submit", timeout=3.0)
+    assert runner.calls == 1        # non-idempotent: exactly one attempt

@@ -1,0 +1,197 @@
+"""utils/retry.py: backoff math, deadlines, classification, breaker."""
+
+import random
+import time
+
+import pytest
+
+from skypilot_tpu.utils import retry
+
+
+def test_backoff_exponential_capped_no_jitter():
+    p = retry.RetryPolicy(backoff_base_s=1.0, backoff_multiplier=2.0,
+                          backoff_max_s=5.0, jitter=0.0)
+    assert [p.backoff_s(a) for a in range(5)] == [1.0, 2.0, 4.0, 5.0, 5.0]
+
+
+def test_backoff_jitter_bounded_and_seed_deterministic():
+    p = retry.RetryPolicy(backoff_base_s=2.0, jitter=0.5)
+    seq1 = [p.backoff_s(0, rng=random.Random(42)) for _ in range(1)]
+    seq2 = [p.backoff_s(0, rng=random.Random(42)) for _ in range(1)]
+    assert seq1 == seq2
+    for _ in range(50):
+        b = p.backoff_s(0, rng=random.Random())
+        # Jitter only shortens: cap stays a hard upper bound.
+        assert 1.0 <= b <= 2.0
+
+
+def test_call_retries_then_succeeds():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    out = retry.call(fn, policy=retry.RetryPolicy(
+        max_attempts=5, backoff_base_s=0.001, jitter=0.0))
+    assert out == "ok" and len(calls) == 3
+
+
+def test_call_exhausts_and_reraises_last():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError(f"attempt {len(calls)}")
+
+    with pytest.raises(ValueError, match="attempt 3"):
+        retry.call(fn, policy=retry.RetryPolicy(
+            max_attempts=3, backoff_base_s=0.001, jitter=0.0))
+    assert len(calls) == 3
+
+
+def test_call_non_retryable_raises_immediately():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise KeyError("not transient")
+
+    with pytest.raises(KeyError):
+        retry.call(fn, policy=retry.RetryPolicy(
+            max_attempts=5, backoff_base_s=0.001,
+            retry_on=(ValueError,)))
+    assert len(calls) == 1
+
+
+def test_give_up_on_carves_out_subclass():
+    class Transient(Exception):
+        pass
+
+    class Permanent(Transient):
+        pass
+
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise Permanent("permanent refusal")
+
+    with pytest.raises(Permanent):
+        retry.call(fn, policy=retry.RetryPolicy(
+            max_attempts=5, backoff_base_s=0.001,
+            retry_on=(Transient,), give_up_on=(Permanent,)))
+    assert len(calls) == 1
+
+
+def test_deadline_stops_retry_without_sleeping_past_budget():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("x")
+
+    t0 = time.monotonic()
+    with pytest.raises(ValueError):
+        retry.call(fn,
+                   policy=retry.RetryPolicy(max_attempts=100,
+                                            backoff_base_s=0.5,
+                                            jitter=0.0),
+                   deadline=retry.Deadline(0.3))
+    elapsed = time.monotonic() - t0
+    # Budget 0.3s with 0.5s backoffs: at most one pause fits nothing —
+    # the loop must give up with the REAL error well under a second.
+    assert elapsed < 1.0
+    assert len(calls) <= 2
+
+
+def test_deadline_clamp_shrinks_per_attempt_timeout():
+    d = retry.Deadline(10.0)
+    assert d.clamp(120.0) <= 10.0
+    assert d.clamp(1.0) == 1.0
+    assert retry.Deadline(None).clamp(7.0) == 7.0
+    assert retry.Deadline(None).remaining() is None
+
+
+def test_deadline_expired_raises_before_first_attempt():
+    d = retry.Deadline(0.0)
+    time.sleep(0.001)
+    with pytest.raises(retry.DeadlineExceededError):
+        retry.call(lambda: "never", deadline=d)
+
+
+def test_on_retry_hook_fires_per_backoff():
+    seen = []
+
+    def fn():
+        if len(seen) < 2:
+            raise ValueError("x")
+        return 1
+
+    retry.call(fn,
+               policy=retry.RetryPolicy(max_attempts=5,
+                                        backoff_base_s=0.001, jitter=0.0),
+               on_retry=lambda attempt, exc, pause: seen.append(
+                   (attempt, type(exc).__name__, pause)))
+    assert seen == [(0, "ValueError", 0.001), (1, "ValueError", 0.002)]
+
+
+def test_named_policy_records_metrics_and_events():
+    from skypilot_tpu.observability import tracing
+
+    def fn():
+        raise ValueError("x")
+
+    before = retry.RETRIES.labels(name="unit.test",
+                                  outcome="retried").value
+    with pytest.raises(ValueError):
+        retry.call(fn, name="unit.test", policy=retry.RetryPolicy(
+            max_attempts=3, backoff_base_s=0.001, jitter=0.0))
+    assert retry.RETRIES.labels(name="unit.test",
+                                outcome="retried").value == before + 2
+    evs = [r for r in tracing.buffered_records()
+           if r.get("name") == "retry.backoff"
+           and r.get("attrs", {}).get("policy") == "unit.test"]
+    assert len(evs) >= 2
+
+
+def test_circuit_breaker_half_open_probe_is_exclusive():
+    """Only ONE caller gets the half-open probe per reset window —
+    concurrent callers keep failing fast until the probe reports."""
+    br = retry.CircuitBreaker("unit", failure_threshold=1,
+                              reset_after_s=0.05)
+    br.record_failure()
+    assert not br.allow()
+    time.sleep(0.08)
+    assert br.allow()          # claims the probe, re-arms the window
+    assert not br.allow()      # a second concurrent caller stays blocked
+    br.record_success()
+    assert br.allow()          # closed again
+
+
+def test_circuit_breaker_opens_and_half_opens():
+    br = retry.CircuitBreaker("unit", failure_threshold=2,
+                              reset_after_s=0.15)
+
+    def boom():
+        raise ValueError("x")
+
+    for _ in range(2):
+        with pytest.raises(ValueError):
+            retry.call(boom, policy=retry.NO_RETRY, breaker=br)
+    # Open: fails fast without running fn.
+    with pytest.raises(retry.CircuitOpenError):
+        retry.call(lambda: "never", breaker=br)
+    time.sleep(0.2)
+    # Half-open probe: a success closes the circuit again.
+    assert retry.call(lambda: "ok", breaker=br) == "ok"
+    assert retry.call(lambda: "ok", breaker=br) == "ok"
+
+
+def test_pause_returns_backoff_taken():
+    p = retry.RetryPolicy(backoff_base_s=0.01, jitter=0.0)
+    slept = []
+    took = retry.pause(p, 1, sleep=slept.append)
+    assert took == 0.02 and slept == [0.02]
