@@ -312,6 +312,43 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"serve bench failed: {e}")
             out["serve_error"] = str(e)[:200]
+        # Prefix-cache + chunked-prefill phase (engine-only, its own
+        # guard): warm-prefix TTFT and the decode-interference numbers
+        # ride the same BENCH artifact so the r-trajectory captures
+        # this PR's effect.
+        try:
+            from skypilot_tpu.infer import bench_serve as _bs
+            ps = _bs.run_prefix_share(config=serve_cfg,
+                                      weights_int8=big, kv_int8=big)
+            out["serve_prefix_cold_ttft_ms"] = ps["cold_ttft_ms"]
+            out["serve_prefix_warm_ttft_ms"] = ps["warm_ttft_ms"]
+            out["serve_prefix_warm_speedup"] = ps["warm_speedup"]
+            out["serve_prefix_hit_rate"] = ps["hit_rate"]
+            out["serve_prefix_parity_ok"] = ps["parity_ok"]
+            out["serve_decode_stall_ms"] = ps["decode_stall_p99_ms"]
+            out["serve_tpot_admission_ratio"] = \
+                ps["interference"]["tpot_admission_ratio"]
+            out["serve_tpot_admission_ratio_monolith"] = \
+                ps["interference"]["monolith_ratio"]
+            # Gates: warm >= 30% below cold; decode TPOT p99 during
+            # admission <= 1.3x idle (vs the monolith's multi-x spike).
+            out["serve_prefix_regressed"] = bool(
+                not ps["warm_below_70pct_of_cold"]
+                or not ps["parity_ok"])
+            out["serve_interference_regressed"] = bool(
+                ps["interference"]["tpot_admission_ratio"] > 1.3)
+            if out["serve_prefix_regressed"]:
+                log("SERVE PREFIX REGRESSION: warm "
+                    f"{ps['warm_ttft_ms']}ms vs cold "
+                    f"{ps['cold_ttft_ms']}ms "
+                    f"(parity_ok={ps['parity_ok']})")
+            if out["serve_interference_regressed"]:
+                log("SERVE INTERFERENCE REGRESSION: admission TPOT "
+                    f"p99 x{ps['interference']['tpot_admission_ratio']}"
+                    " > 1.3x idle")
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"prefix-share bench failed: {e}")
+            out["serve_prefix_error"] = str(e)[:200]
     if args.emit_metrics:
         from skypilot_tpu.observability import metrics as obs_metrics
         # Only families something actually recorded into: a bench run

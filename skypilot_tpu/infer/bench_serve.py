@@ -87,7 +87,8 @@ def run(config=None, requests=16, slots=16, prompt_len=96,
 
 def _build_engine(config, slots, prompt_len, new_tokens, kv_int8,
                   weights_int8, max_wave=None, buckets=None,
-                  pad_waves=False):
+                  pad_waves=False, prefill_chunk=None,
+                  prefix_pool=None):
     import jax
 
     from skypilot_tpu.infer import engine as eng
@@ -97,21 +98,18 @@ def _build_engine(config, slots, prompt_len, new_tokens, kv_int8,
     max_len = prompt_len + new_tokens + 8
     if buckets is None:
         buckets = (prompt_len,)
+    kw = dict(n_slots=slots, max_len=max_len, prompt_buckets=buckets,
+              kv_int8=kv_int8, max_wave=max_wave, pad_waves=pad_waves,
+              prefill_chunk=prefill_chunk, prefix_pool=prefix_pool)
     if weights_int8:
         # Build int8 weights directly — the fp init of an 8B-class
         # config (32 GB) would never fit the chip that the int8 model
         # (8 GB) serves from.
         from skypilot_tpu.infer import kvcache
         params, qw = kvcache.random_quantized_params(cfg)
-        return cfg, eng.InferenceEngine(
-            params, cfg, n_slots=slots, max_len=max_len,
-            prompt_buckets=buckets, kv_int8=kv_int8, qweights=qw,
-            max_wave=max_wave, pad_waves=pad_waves)
+        return cfg, eng.InferenceEngine(params, cfg, qweights=qw, **kw)
     params = llama.init_params(jax.random.key(0), cfg)
-    return cfg, eng.InferenceEngine(
-        params, cfg, n_slots=slots, max_len=max_len,
-        prompt_buckets=buckets, kv_int8=kv_int8,
-        max_wave=max_wave, pad_waves=pad_waves)
+    return cfg, eng.InferenceEngine(params, cfg, **kw)
 
 
 def _mixed_prompts(rng, vocab, requests, lo=512, hi=1024):
@@ -489,6 +487,225 @@ def run_http(config=None, requests=16, slots=16, prompt_len=None,
     }
 
 
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2] if xs else None
+
+
+def _p99(xs):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * 0.99))] if xs else None
+
+
+def _interference(engine, fillers, longs, burst, idle_bursts=8):
+    """Decode-interference report: per-token decode cadence while long
+    prompts are being admitted vs idle decode.
+
+    ``fillers`` (short prompts, long generations) occupy slots and keep
+    decoding; once steady, ``longs`` (long prompts) are injected and
+    the scheduler runs the server's alternation (one prefill chunk —
+    or, chunk-disabled, the whole monolith wave — between decode
+    bursts). TPOT here is the REQUEST-experienced cadence: the wall
+    interval between consecutive burst completions divided by the burst
+    size, so time decode spent stalled behind prefill is charged to it.
+    Returns stats in ms plus the admission-vs-idle p99 ratio.
+    """
+    import time as _time
+
+    for p in fillers:
+        engine.add_request(p, max_new_tokens=engine.max_len)
+    engine.admit()
+    engine.decode_burst(burst)            # warm the cadence
+    idle = []
+    for _ in range(idle_bursts):
+        t0 = _time.time()
+        engine.decode_burst(burst)
+        idle.append(_time.time() - t0)
+    for p in longs:
+        engine.add_request(p, max_new_tokens=4)
+    intervals, stalls = [], []
+    t_last = _time.time()
+    while engine.waiting or engine.chunking:
+        engine.admit()
+        if engine.chunking:
+            t0 = _time.time()
+            engine.prefill_chunk_step()
+            stalls.append(_time.time() - t0)
+        engine.decode_burst(burst)
+        now = _time.time()
+        intervals.append(now - t_last)
+        t_last = now
+    # Drain and reset so the caller gets a quiet engine back.
+    engine.reset()
+    idle_tpot = _median(idle) / burst * 1e3
+    adm_p99 = (_p99(intervals) / burst * 1e3 if intervals
+               else idle_tpot)
+    return {
+        "idle_tpot_ms": round(idle_tpot, 3),
+        "admission_tpot_p99_ms": round(adm_p99, 3),
+        "tpot_admission_ratio": round(adm_p99 / max(idle_tpot, 1e-9),
+                                      3),
+        "decode_stall_p99_ms": (round(_p99(stalls) * 1e3, 3)
+                                if stalls else 0.0),
+        "admission_bursts": len(intervals),
+    }
+
+
+def run_prefix_share(config=None, requests=12, slots=16,
+                     system_len=None, tail_len=None, new_tokens=None,
+                     max_burst=16, prefill_chunk=None, prefix_pool=8,
+                     kv_int8=False, weights_int8=False,
+                     smoke=False) -> dict:
+    """Prefix-share workload: every prompt = one shared system prompt +
+    a unique tail (the dominant production shape). Measures cold
+    (empty prefix cache) vs warm (system prompt resident) TTFT on the
+    same engine, asserts greedy token parity between the two passes,
+    and appends the decode-interference report (chunked scheduler vs
+    the per-bucket monolith). ``smoke=True`` shrinks everything to a
+    CPU-CI-sized regression guard (run_smoke)."""
+    import jax
+    import numpy as np
+
+    on_cpu = jax.default_backend() == "cpu"
+    if config is None:
+        config = "llama3-tiny" if on_cpu else "llama3-400m"
+    small = smoke or on_cpu
+    if system_len is None:
+        system_len = 24 if small else 768
+    if tail_len is None:
+        tail_len = 6 if small else 48
+    if new_tokens is None:
+        new_tokens = 6 if small else 48
+    if prefill_chunk is None:
+        prefill_chunk = 8 if small else 256
+    if small:
+        requests = min(requests, 4)
+        slots = min(slots, 4)
+        max_burst = min(max_burst, 4)
+        prefix_pool = min(prefix_pool, 4)
+    requests = min(requests, slots)   # one admission pass => all cold
+    bucket = system_len + tail_len
+    short_bucket = min(32, bucket)
+    # Row headroom so the interference phase's filler requests never
+    # push the burst cap below the measured burst size — a shrunken k
+    # would compile a fresh decode program mid-measurement.
+    iburst = min(max_burst, 4 if small else 8)
+    headroom = 48 if small else 0
+    cfg, e = _build_engine(config, slots, bucket,
+                           new_tokens + headroom, kv_int8,
+                           weights_int8, buckets=(short_bucket, bucket),
+                           prefill_chunk=prefill_chunk,
+                           prefix_pool=prefix_pool)
+    rng = np.random.default_rng(0)
+    system = rng.integers(1, cfg.vocab_size, system_len).tolist()
+
+    def make_prompts(salt):
+        return [system + rng.integers(1, cfg.vocab_size,
+                                      tail_len).tolist()
+                for _ in range(requests)]
+
+    prompts = make_prompts(0)
+
+    # Warmup: compile claim/chunk/pool-store/decode programs (first
+    # request, cold) AND the pool-load path (second, identical request
+    # hits the prefix just stored) — the warm timed pass must not pay
+    # a first-sight XLA compile.
+    e.add_request(prompts[0], max_new_tokens=2)
+    e.run_to_completion(max_burst=max_burst)
+    e.add_request(prompts[0], max_new_tokens=2)
+    e.run_to_completion(max_burst=max_burst)
+    e.finished.clear()
+    e.clear_prefix_cache()
+
+    def timed_pass(ps):
+        for p in ps:
+            e.add_request(p, max_new_tokens=new_tokens)
+        done = e.run_to_completion(max_burst=max_burst)
+        float(e.cache["length"][0])     # honest host sync
+        ttfts = [(r.first_token_s - r.submit_s) * 1e3 for r in done]
+        out = {tuple(r.prompt): list(r.tokens) for r in done}
+        hits = sum(1 for r in done if r.cached_len > 0)
+        chunks = sum(r.n_chunks for r in done)
+        e.finished.clear()
+        return _median(ttfts), out, hits, chunks
+
+    cold_ttft, cold_out, cold_hits, cold_chunks = timed_pass(prompts)
+    warm_ttft, warm_out, warm_hits, warm_chunks = timed_pass(prompts)
+    parity_ok = all(warm_out[k] == cold_out[k] for k in cold_out)
+
+    log(f"prefix-share: cold={cold_ttft:.1f}ms warm={warm_ttft:.1f}ms "
+        f"hits {warm_hits}/{requests} parity={parity_ok}")
+
+    n_f = max(slots // 2, 1)
+    fillers = [rng.integers(1, cfg.vocab_size, 4).tolist()
+               for _ in range(n_f)]
+    longs = [rng.integers(1, cfg.vocab_size, bucket).tolist()
+             for _ in range(min(slots - n_f, n_f, 4))]
+    interference = _interference(e, fillers, longs, burst=iburst,
+                                 idle_bursts=4 if small else 8)
+    # Free the chunked engine BEFORE building the monolith comparison:
+    # two live 8B-class weight sets would not fit the 16 GB chip the
+    # engine is sized for (the OOM would silently eat this phase's
+    # numbers via bench.py's guard).
+    del e, timed_pass          # timed_pass's closure also pins the engine
+    import gc
+    gc.collect()
+    # The same workload against the per-bucket monolith: the
+    # interference chunked prefill removes.
+    _, e_mono = _build_engine(config, slots, bucket,
+                              new_tokens + headroom, kv_int8,
+                              weights_int8,
+                              buckets=(short_bucket, bucket),
+                              prefill_chunk=0, prefix_pool=0)
+    # Warm the exact wave shapes the measured window will admit (the
+    # monolith's long-bucket wave would otherwise compile mid-window).
+    for p in longs:
+        e_mono.add_request(p, max_new_tokens=2)
+    e_mono.run_to_completion(max_burst=iburst)
+    e_mono.generate([fillers[0]], max_new_tokens=2)
+    e_mono.finished.clear()
+    mono = _interference(e_mono, fillers, longs, burst=iburst,
+                         idle_bursts=4 if small else 8)
+    interference["monolith_tpot_p99_ms"] = mono["admission_tpot_p99_ms"]
+    interference["monolith_ratio"] = mono["tpot_admission_ratio"]
+    log(f"interference: idle {interference['idle_tpot_ms']}ms/tok, "
+        f"admission p99 {interference['admission_tpot_p99_ms']} "
+        f"(x{interference['tpot_admission_ratio']}), monolith "
+        f"x{interference['monolith_ratio']}")
+
+    return {
+        "cold_ttft_ms": round(cold_ttft, 2),
+        "warm_ttft_ms": round(warm_ttft, 2),
+        "warm_speedup": round(cold_ttft / max(warm_ttft, 1e-9), 3),
+        # Acceptance bar: warm-prefix median TTFT >= 30% below cold.
+        "warm_below_70pct_of_cold": bool(warm_ttft <= 0.7 * cold_ttft),
+        "hit_rate": round(warm_hits / max(requests, 1), 3),
+        "cold_hits": cold_hits,
+        "parity_ok": bool(parity_ok),
+        "prefix_hits": warm_hits,
+        # Structural (timing-independent) evidence of reuse: chunk
+        # programs run per pass — the warm pass prefills suffixes only.
+        "cold_chunks": cold_chunks,
+        "warm_chunks": warm_chunks,
+        "decode_stall_p99_ms": interference["decode_stall_p99_ms"],
+        "interference": interference,
+        "requests": requests,
+        "system_len": system_len,
+        "tail_len": tail_len,
+        "prefill_chunk": prefill_chunk,
+        "prefix_pool": prefix_pool,
+        "config": config,
+        "kv_int8": kv_int8,
+        "weights_int8": weights_int8,
+    }
+
+
+def run_smoke() -> dict:
+    """CI-sized prefix-share + interference pass (tier-1 regression
+    guard for the chunk scheduler; see tests/test_prefix_cache.py)."""
+    return run_prefix_share(smoke=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None)
@@ -516,7 +733,40 @@ def main() -> None:
     ap.add_argument("--engine-only", action="store_true",
                     help="bench the engine directly (no HTTP/LB; "
                          "engine-internal TTFT)")
+    ap.add_argument("--prefix-share", action="store_true",
+                    help="prefix-share workload (shared system prompt "
+                         "+ unique tails): warm-vs-cold TTFT, greedy "
+                         "parity, and the decode-interference report")
+    ap.add_argument("--prefix-pool", type=int, default=8)
+    ap.add_argument("--prefill-chunk", type=int, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized prefix-share pass (tier-1 "
+                         "regression guard for the chunk scheduler)")
     args = ap.parse_args()
+    if args.smoke or args.prefix_share:
+        if args.smoke:
+            r = run_smoke()
+        else:
+            r = run_prefix_share(
+                config=args.config, requests=args.requests,
+                slots=args.slots, new_tokens=args.new_tokens,
+                max_burst=args.max_burst,
+                prefill_chunk=args.prefill_chunk,
+                prefix_pool=args.prefix_pool,
+                kv_int8=args.kv_int8, weights_int8=args.weights_int8)
+        print(json.dumps({
+            "metric": "serve_prefix_warm_ttft",
+            "value": r["warm_ttft_ms"],
+            "unit": "ms",
+            "cold_ttft_ms": r["cold_ttft_ms"],
+            "warm_speedup": r["warm_speedup"],
+            "parity_ok": r["parity_ok"],
+            "hit_rate": r["hit_rate"],
+            "decode_stall_p99_ms": r["decode_stall_p99_ms"],
+            "interference": r["interference"],
+            "config": r["config"],
+        }))
+        return
     if args.engine_only:
         r = run(config=args.config, requests=args.requests,
                 slots=args.slots, prompt_len=args.prompt_len or 96,

@@ -15,10 +15,13 @@ TPU (reference: examples/tpu/v6e/README.md §Serve — 11.42 req/s,
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
+import hashlib
+import os
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +70,28 @@ ENGINE_WAITING = metrics.gauge(
     "Requests accepted by the engine but not yet prefilled")
 REQUESTS_FINISHED = metrics.counter(
     "skytpu_requests_finished_total", "Requests fully generated")
+PREFIX_HITS = metrics.counter(
+    "skytpu_prefix_cache_hits_total",
+    "Admissions that reused a resident prompt-prefix's KV rows "
+    "(suffix-only prefill)")
+PREFIX_MISSES = metrics.counter(
+    "skytpu_prefix_cache_misses_total",
+    "Admissions eligible for prefix reuse (pool enabled, prompt longer "
+    "than one chunk) that found no resident prefix")
+PREFIX_EVICTIONS = metrics.counter(
+    "skytpu_prefix_cache_evictions_total",
+    "Prefix-pool rows evicted (LRU) to admit a new prefix")
+PREFILL_CHUNKS = metrics.counter(
+    "skytpu_prefill_chunks_total",
+    "Chunked-prefill device calls (one fixed-size chunk each, "
+    "interleaved with decode bursts)")
+DECODE_STALL_SECONDS = metrics.histogram(
+    "skytpu_decode_stall_seconds",
+    "Time active decode slots waited on a prefill device call (one "
+    "chunk or one admission wave) — the interference chunked prefill "
+    "bounds",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0))
 
 
 @dataclasses.dataclass
@@ -86,6 +111,11 @@ class Request:
     # traceparent) when one rode in with the request.
     span_ctx: Optional[tracing.SpanContext] = None
     parent_id: Optional[str] = None
+    # Prefix-cache / chunked-prefill stats (surfaced in the server's
+    # response trailer and the prefill span's attrs).
+    cached_len: int = 0
+    n_chunks: int = 0
+    prefill_begin_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -100,11 +130,114 @@ class BurstHandle:
     span: Optional[timeline.Event] = None
 
 
+class PromptTooLongError(ValueError):
+    """Prompt exceeds the engine's largest prompt bucket. A client
+    error, not an engine failure: the server maps it to HTTP 400 with a
+    typed body (``typed_error``) instead of a 500."""
+
+    def __init__(self, prompt_len: int, max_prompt_len: int):
+        super().__init__(
+            f"prompt length {prompt_len} exceeds max bucket "
+            f"{max_prompt_len}")
+        self.prompt_len = prompt_len
+        self.max_prompt_len = max_prompt_len
+        self.typed_error = {
+            "type": "prompt_too_long",
+            "message": str(self),
+            "prompt_len": prompt_len,
+            "max_prompt_len": max_prompt_len,
+        }
+
+
 def _bucket(n: int, buckets) -> int:
     for b in buckets:
         if n <= b:
             return b
-    raise ValueError(f"prompt length {n} exceeds max bucket {buckets[-1]}")
+    raise PromptTooLongError(n, buckets[-1])
+
+
+class PrefixIndex:
+    """Host-side index over the prefix-pool rows.
+
+    Hash granularity is the prefill chunk: a prompt's prefix is
+    cacheable at every multiple of ``block`` tokens, keyed by a
+    blake2b-128 digest of the token bytes (content-addressed — a
+    Python ``hash`` collision would silently serve the wrong prefix).
+    One pool row holds one stored prefix; every chunk-multiple key of
+    that prefix points at the row, so a shorter shared prefix hits the
+    same row. Eviction is LRU over rows (a hit or a store bumps the
+    row); evicting a row drops all of its keys.
+    """
+
+    def __init__(self, rows: int, block: int):
+        self.rows = rows
+        self.block = block
+        self.clear()
+
+    def clear(self) -> None:
+        self._tick = 0
+        self._keys: Dict[bytes, Tuple[int, int]] = {}  # -> (row, n_tok)
+        self._row_keys: List[set] = [set() for _ in range(self.rows)]
+        self._row_used = [-1] * self.rows              # -1 = free
+
+    def _digest(self, prompt: List[int], n: int) -> bytes:
+        return hashlib.blake2b(
+            np.asarray(prompt[:n], np.int64).tobytes(),
+            digest_size=16).digest()
+
+    def eligible(self, prompt: List[int]) -> bool:
+        # The shortest cacheable prefix is one block, and at least one
+        # suffix token must remain to produce the first-token logits.
+        return len(prompt) > self.block
+
+    def lookup(self, prompt: List[int]) -> Optional[Tuple[int, int]]:
+        """Longest resident chunk-aligned proper prefix of ``prompt``;
+        returns (row, cached_len) and bumps the row's LRU stamp."""
+        for k in range((len(prompt) - 1) // self.block, 0, -1):
+            ent = self._keys.get(self._digest(prompt, k * self.block))
+            if ent is not None:
+                self._tick += 1
+                self._row_used[ent[0]] = self._tick
+                return ent
+        return None
+
+    def acquire_row(self) -> Tuple[int, bool]:
+        """A free row, or the LRU row evicted (its keys dropped).
+        Returns (row, evicted)."""
+        evicted = False
+        free = [r for r in range(self.rows) if self._row_used[r] < 0]
+        if free:
+            row = free[0]
+        else:
+            row = min(range(self.rows), key=lambda r: self._row_used[r])
+            for key in self._row_keys[row]:
+                del self._keys[key]
+            self._row_keys[row] = set()
+            evicted = True
+        self._tick += 1
+        self._row_used[row] = self._tick
+        return row, evicted
+
+    def register(self, prompt: List[int], n_tokens: int,
+                 row: int) -> None:
+        """Point every not-yet-resident chunk multiple <= n_tokens at
+        ``row`` (shorter multiples already resident keep their row —
+        both copies hold identical bytes)."""
+        for k in range(1, n_tokens // self.block + 1):
+            d = self._digest(prompt, k * self.block)
+            if d not in self._keys:
+                self._keys[d] = (row, k * self.block)
+                self._row_keys[row].add(d)
+
+
+@dataclasses.dataclass
+class _ChunkState:
+    """A request mid-chunked-prefill: slot claimed, rows [0, pos)
+    resident (reused prefix and/or completed chunks), first token not
+    yet produced."""
+    req: Request
+    pos: int            # next row offset to prefill
+    total: int          # len(req.prompt)
 
 
 class InferenceEngine:
@@ -121,12 +254,37 @@ class InferenceEngine:
                  eos_id: Optional[int] = None, seed: int = 0,
                  kv_int8: bool = False, weights_int8: bool = False,
                  qweights=None, max_wave: Optional[int] = None,
-                 pad_waves: bool = False, mesh=None, shard_rules=None):
+                 pad_waves: bool = False, mesh=None, shard_rules=None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_pool: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
         self.max_len = max_len
         self.buckets = tuple(b for b in prompt_buckets if b <= max_len)
+        # Chunked prefill: prompts longer than ``prefill_chunk`` are
+        # prefilled in fixed-size chunks interleaved with decode bursts
+        # (one compiled chunk program for every bucket and offset)
+        # instead of one per-bucket O(S^2) monolith that stalls every
+        # decode slot for the whole prompt. 0 disables. Budget knob:
+        # SKYTPU_PREFILL_CHUNK (ctor arg wins).
+        if prefill_chunk is None:
+            prefill_chunk = int(
+                os.environ.get("SKYTPU_PREFILL_CHUNK", "512") or 0)
+        self.prefill_chunk = (prefill_chunk
+                              if prefill_chunk and prefill_chunk > 0
+                              else None)
+        # Prefix KV reuse: ``prefix_pool`` reserved rows (a SEPARATE
+        # tensor — decode never pays for them) hold prompt prefixes at
+        # chunk granularity; a request whose prompt shares a resident
+        # prefix copies the rows on-device and prefills only the
+        # suffix. Requires chunking (the suffix runs through the chunk
+        # program). Budget knob: SKYTPU_PREFIX_POOL. 0 disables.
+        if prefix_pool is None:
+            prefix_pool = int(
+                os.environ.get("SKYTPU_PREFIX_POOL", "0") or 0)
+        self.prefix_pool = (max(prefix_pool, 0)
+                            if self.prefill_chunk else 0)
         # Admission wave cap: a burst of N requests prefills as
         # ceil(N/max_wave) device calls instead of one. Each wave's
         # first tokens can then stream out (step_burst's on_wave hook)
@@ -149,6 +307,12 @@ class InferenceEngine:
         # compiled program serves every wave size.
         self.cache = kvcache.init_cache(cfg, n_slots + 1, max_len,
                                         kv_int8=kv_int8)
+        self.pool = (kvcache.init_prefix_pool(cfg, self.prefix_pool,
+                                              max_len, kv_int8=kv_int8)
+                     if self.prefix_pool else None)
+        self._prefix_index = (PrefixIndex(self.prefix_pool,
+                                          self.prefill_chunk)
+                              if self.prefix_pool else None)
         # w8a8 serving: int8 weights for BOTH prefill and decode, so no
         # fp copy of the seven block matrices (or the head) is kept —
         # the memory halving that fits an 8B-class model on a 16 GB
@@ -188,11 +352,16 @@ class InferenceEngine:
             self.cache = sh.shard_tree_subset(
                 self.cache, kvcache.cache_logical_axes(self.cache),
                 mesh, rules)
+            if self.pool is not None:
+                self.pool = sh.shard_tree_subset(
+                    self.pool, kvcache.pool_logical_axes(self.pool),
+                    mesh, rules)
         self.rng = jax.random.key(seed)
 
         self.free_slots = list(range(n_slots))
         self.slot_req: Dict[int, Request] = {}
-        self.waiting: List[Request] = []
+        self.waiting: Deque[Request] = collections.deque()
+        self.chunking: Deque[_ChunkState] = collections.deque()
         self.finished: List[Request] = []
         self._next_rid = 0
         # Tokens dispatched to the device but not yet committed
@@ -270,9 +439,38 @@ class InferenceEngine:
                 params, cache, rng, active, k, cfg, sp,
                 qweights=qweights)
 
+        # Chunked-prefill programs: ONE chunk program (two traces: the
+        # ``final`` variant samples the first token and splits the RNG)
+        # serves every bucket and every suffix offset; the claim/copy
+        # programs are trivial gathers/scatters.
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("final",))
+        def _prefill_chunk(params, cache, tokens_c, start, n_valid,
+                           slot, new_len, rng, *, final,
+                           qweights=None):
+            return kvcache.prefill_chunk(
+                params, cache, tokens_c, start, n_valid, slot, new_len,
+                rng, cfg, sp, final=final, qweights=qweights)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _claim(cache, slot, claim_len):
+            return kvcache.claim_slot(cache, slot, claim_len)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _pool_load(cache, pool, row, slot, claim_len):
+            return kvcache.pool_load(cache, pool, row, slot, claim_len)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _pool_store(pool, cache, slot, row):
+            return kvcache.pool_store(pool, cache, slot, row)
+
         self._admit_wave_fn = _admit_wave
         self._decode_fn = _decode
         self._decode_burst_fn = _decode_burst
+        self._prefill_chunk_fn = _prefill_chunk
+        self._claim_fn = _claim
+        self._pool_load_fn = _pool_load
+        self._pool_store_fn = _pool_store
 
     # -- admission ---------------------------------------------------------
 
@@ -344,6 +542,13 @@ class InferenceEngine:
         while self.waiting and self.free_slots:
             dispatched = []
             while self.waiting and self.free_slots:
+                # Chunk-path requests (prompt longer than the chunk —
+                # which also covers every possible prefix-cache hit)
+                # claim a slot and join the chunk queue; they never
+                # ride a bucketed wave.
+                if self._use_chunked(self.waiting[0]):
+                    self._claim_chunked(self.waiting.popleft())
+                    continue
                 bucket = _bucket(len(self.waiting[0].prompt),
                                  self.buckets)
                 wave: List[Request] = []
@@ -352,30 +557,163 @@ class InferenceEngine:
                 while self.waiting and self.free_slots and \
                         (self.max_wave is None
                          or len(wave) < self.max_wave):
-                    req = self.waiting.pop(0)
-                    if _bucket(len(req.prompt), self.buckets) == bucket:
+                    req = self.waiting.popleft()
+                    if self._use_chunked(req):
+                        self._claim_chunked(req)
+                    elif _bucket(len(req.prompt),
+                                 self.buckets) == bucket:
                         wave.append(req)
                         slots.append(self.free_slots.pop(0))
                     else:
                         rest.append(req)
-                self.waiting = rest + self.waiting
-                dispatched.append(
-                    (wave, slots, bucket) + self._dispatch_wave(
-                        wave, slots, bucket))
-            for wave, slots, bucket, first_dev, span in dispatched:
-                self._complete_wave(wave, slots, first_dev, span, bucket)
+                self.waiting.extendleft(reversed(rest))
+                if wave:
+                    dispatched.append(
+                        (wave, slots, bucket) + self._dispatch_wave(
+                            wave, slots, bucket))
+            for wave, slots, bucket, first_dev, span, stall in \
+                    dispatched:
+                self._complete_wave(wave, slots, first_dev, span,
+                                    bucket, stall)
                 if on_wave is not None:
                     on_wave()
             # on_wave may have drained fresh arrivals into ``waiting``
             # — the outer loop admits them while slots remain.
 
+    def _use_chunked(self, req: Request) -> bool:
+        return (self.prefill_chunk is not None
+                and len(req.prompt) > self.prefill_chunk)
+
+    def _claim_chunked(self, req: Request) -> None:
+        """Claim a slot for an incremental prefill: look up the prefix
+        cache, copy a hit's rows on-device (suffix-only prefill), and
+        queue the remaining chunks. The claim stamps the slot's cache
+        length to max_len so interleaved decode bursts' garbage writes
+        for this (inactive) slot land out of bounds and are dropped —
+        they must never corrupt rows a finished chunk already wrote."""
+        idx = self._prefix_index
+        hit = idx.lookup(req.prompt) if idx is not None else None
+        slot = self.free_slots.pop(0)
+        req.slot = slot
+        req.prefill_begin_s = time.time()
+        tracing.record_span(
+            "engine.queue_wait", req.submit_s, req.prefill_begin_s,
+            parent=req.span_ctx, attrs={"rid": req.rid})
+        claim_len = jnp.asarray(self.max_len, jnp.int32)
+        if hit is not None:
+            row, cached = hit
+            req.cached_len = cached
+            PREFIX_HITS.inc()
+            self.cache = self._pool_load_fn(
+                self.cache, self.pool, jnp.asarray(row, jnp.int32),
+                jnp.asarray(slot, jnp.int32), claim_len)
+        else:
+            if idx is not None and idx.eligible(req.prompt):
+                PREFIX_MISSES.inc()
+            self.cache = self._claim_fn(
+                self.cache, jnp.asarray(slot, jnp.int32), claim_len)
+        self.chunking.append(_ChunkState(req=req, pos=req.cached_len,
+                                         total=len(req.prompt)))
+        # The request left ``waiting``; without this the queue-depth
+        # gauge overreports by one per claim for the whole (possibly
+        # multi-second) chunked prefill.
+        self._update_gauges()
+
+    def prefill_chunk_step(self) -> bool:
+        """Run ONE chunk of the head chunked prefill (host-synced: the
+        scheduler deliberately alternates chunk -> decode burst, so the
+        chunk's device time is the decode stall it causes — recorded
+        into skytpu_decode_stall_seconds when slots were decoding).
+        Returns True if a chunk ran."""
+        if not self.chunking:
+            return False
+        st = self.chunking[0]
+        req = st.req
+        C = self.prefill_chunk
+        start = st.pos
+        n_valid = min(C, st.total - start)
+        final = start + n_valid >= st.total
+        chunk = np.zeros((C,), np.int32)
+        chunk[:n_valid] = req.prompt[start:start + n_valid]
+        new_len = st.total if final else self.max_len
+        decode_active = bool(self.slot_req)
+        t0 = time.time()
+        self.cache, self.rng, tok_dev = self._prefill_chunk_fn(
+            self.params, self.cache, jnp.asarray(chunk),
+            jnp.asarray(start, jnp.int32),
+            jnp.asarray(n_valid, jnp.int32),
+            jnp.asarray(req.slot, jnp.int32),
+            jnp.asarray(new_len, jnp.int32), self.rng,
+            final=final, qweights=self.qweights)
+        tok = int(tok_dev)               # host sync (garbage unless final)
+        dt = time.time() - t0
+        PREFILL_CHUNKS.inc()
+        req.n_chunks += 1
+        if decode_active:
+            DECODE_STALL_SECONDS.observe(dt)
+        st.pos += n_valid
+        if not final:
+            return True
+        self.chunking.popleft()
+        now = time.time()
+        tracing.record_span(
+            "engine.prefill", req.prefill_begin_s, now,
+            parent=req.span_ctx,
+            attrs={"rid": req.rid, "bucket": "chunked",
+                   "cached_len": req.cached_len,
+                   "chunks": req.n_chunks})
+        req.tokens.append(tok)
+        req.first_token_s = now
+        PREFILL_SECONDS.labels(bucket="chunked").observe(
+            max(now - req.prefill_begin_s, 0.0))
+        PREFILL_REQUESTS.labels(bucket="chunked").inc()
+        TTFT_SECONDS.observe(max(now - req.submit_s, 0.0))
+        self.slot_req[req.slot] = req
+        self._maybe_store_prefix(req)
+        if self._req_finished(req, tok):
+            self._retire(req)
+        self._update_gauges()
+        return True
+
+    def _maybe_store_prefix(self, req: Request) -> None:
+        """Install this request's chunk-aligned prompt prefix into the
+        pool (slot -> pool-row copy) unless it is already resident.
+        Only chunk-path prompts are stored: their rows came from the
+        chunk program, so a later cached run replays bit-identical
+        state (the parity guarantee)."""
+        idx = self._prefix_index
+        if idx is None or req.slot is None:
+            return
+        n = (len(req.prompt) // idx.block) * idx.block
+        if n < idx.block:
+            return
+        covered = idx.lookup(req.prompt)
+        if covered is not None and covered[1] >= n:
+            return
+        row, evicted = idx.acquire_row()
+        if evicted:
+            PREFIX_EVICTIONS.inc()
+        self.pool = self._pool_store_fn(
+            self.pool, self.cache, jnp.asarray(req.slot, jnp.int32),
+            jnp.asarray(row, jnp.int32))
+        idx.register(req.prompt, n, row)
+
+    def clear_prefix_cache(self) -> None:
+        """Drop every resident prefix (host index only; the device rows
+        become unreachable). Benchmarks use this to measure a cold
+        pass against a warm one on the same engine."""
+        if self._prefix_index is not None:
+            self._prefix_index.clear()
+
     def _dispatch_wave(self, wave: List["Request"], slots: List[int],
-                       bucket: int) -> Tuple[jax.Array, timeline.Event]:
+                       bucket: int
+                       ) -> Tuple[jax.Array, timeline.Event, bool]:
         """Enqueue one wave's prefill+insert program; returns the
-        (device) first-token array without forcing a host sync, plus
-        the open prefill span (closed at completion — the span covers
+        (device) first-token array without forcing a host sync, the
+        open prefill span (closed at completion — the span covers
         dispatch THROUGH first-token fetch, the latency a request
-        actually experiences)."""
+        actually experiences), and whether decode slots were active at
+        dispatch (the wave then also counts as decode stall)."""
         WAVE_SIZE.observe(len(wave))
         span = timeline.Event(
             "skytpu_prefill_seconds",
@@ -397,25 +735,29 @@ class InferenceEngine:
             tokens_b[i, :len(req.prompt)] = req.prompt
             true_lens[i] = len(req.prompt)
             slot_ids[i] = slot
+        decode_active = bool(self.slot_req)
         self.cache, self.rng, first = self._admit_wave_fn(
             self.params, self.cache, jnp.asarray(tokens_b),
             jnp.asarray(true_lens), jnp.asarray(slot_ids), self.rng,
             bucket=bucket, qweights=self.qweights)
-        return first, span
+        return first, span, decode_active
 
     def _complete_wave(self, wave: List["Request"], slots: List[int],
                        first_dev: jax.Array, span: timeline.Event,
-                       bucket: int) -> None:
+                       bucket: int, decode_active: bool = False) -> None:
         first = np.asarray(first_dev)          # host sync for THIS wave
         span.end()
         now = time.time()
+        if decode_active:
+            DECODE_STALL_SECONDS.observe(max(now - span.begin_s, 0.0))
         for req in wave:
             # The latency the request experienced: dispatch through
             # first-token fetch (same window as the histogram span).
             tracing.record_span(
                 "engine.prefill", span.begin_s, now,
                 parent=req.span_ctx,
-                attrs={"rid": req.rid, "bucket": bucket})
+                attrs={"rid": req.rid, "bucket": bucket,
+                       "cached_len": 0, "chunks": 0})
         for i, (req, slot) in enumerate(zip(wave, slots)):
             tok = int(first[i])
             req.slot = slot
@@ -478,11 +820,15 @@ class InferenceEngine:
         SLOTS_ACTIVE.set(len(self.slot_req))
 
     def step(self) -> Dict[int, int]:
-        """Admit waiting requests, decode one token per active slot.
+        """Admit waiting requests (draining any chunked prefills to
+        completion — single-step callers want classic semantics),
+        decode one token per active slot.
 
         Returns {rid: token} emitted this step.
         """
         self._admit()
+        while self.chunking:
+            self.prefill_chunk_step()
         return self.step_decode_once()
 
     def admit(self, on_wave=None) -> None:
@@ -498,21 +844,30 @@ class InferenceEngine:
         poisoned slots — stale waiting/slot_req would re-raise the same
         error for every future request (advisor r3)."""
         self.waiting.clear()
+        self.chunking.clear()
         self.finished.clear()
         self.slot_req.clear()
         self.free_slots = list(range(self.n_slots))
         self._inflight_tokens = 0
         self.cache["length"] = jnp.zeros_like(self.cache["length"])
+        # A mid-copy/mid-chunk failure may have left pool rows in an
+        # unknown state; drop the index rather than serve them.
+        self.clear_prefix_cache()
         self._update_gauges()
 
     def step_burst(self, max_burst: int = 8,
                    on_wave=None) -> Dict[int, List[int]]:
-        """Admit, then decode up to ``max_burst`` tokens per slot in one
-        device call. Tokens past a request's EOS/limit are discarded
-        host-side (their cache rows die with the slot). Returns
-        {rid: [tokens...]} emitted this call. ``on_wave`` fires after
-        each admission wave (streaming flush hook)."""
+        """Admit, run ONE prefill chunk if any are queued (chunk ->
+        decode-burst alternation: long prompts prefill without stalling
+        decode for their whole length), then decode up to ``max_burst``
+        tokens per slot in one device call. Tokens past a request's
+        EOS/limit are discarded host-side (their cache rows die with
+        the slot). Returns {rid: [tokens...]} emitted this call.
+        ``on_wave`` fires after each admission wave (streaming flush
+        hook)."""
         self._admit(on_wave)
+        if self.chunking:
+            self.prefill_chunk_step()
         return self.decode_burst(max_burst)
 
     def decode_burst(self, max_burst: int = 8) -> Dict[int, List[int]]:
@@ -627,7 +982,7 @@ class InferenceEngine:
 
     def run_to_completion(self, max_burst: int = 8) -> List[Request]:
         """Drain all waiting + active requests; returns finished list."""
-        while self.waiting or self.slot_req:
+        while self.waiting or self.chunking or self.slot_req:
             self.step_burst(max_burst)
         return self.finished
 

@@ -390,6 +390,255 @@ def insert(cache: Cache, prefix: Cache, slot: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Prefix KV pool + chunked prefill
+# ---------------------------------------------------------------------------
+# Prefix reuse: a reserved pool of K/V rows holds prompt prefixes (one
+# row = one prefix, full max_len rows) in a SEPARATE tensor from the
+# decode cache, so decode programs never pay compute or scatter traffic
+# for pool rows. Host-side bookkeeping (which prefix lives in which
+# row, LRU) stays in the engine; the device side is two gather/scatter
+# copy programs (slot->row to store, row->slot to load) plus the
+# chunked-prefill program below, which prefills ONLY the suffix after a
+# prefix hit — the same program that chunks long cold prompts.
+
+
+def init_prefix_pool(cfg: llama.LlamaConfig, rows: int, max_len: int,
+                     kv_int8: bool = False) -> Cache:
+    """K/V rows reserved for the prefix cache (``rows`` resident
+    prefixes). Same per-row layout (and int8 scales) as the decode
+    cache so a row copy is a pure gather/scatter — no requantization,
+    which is what makes cached-vs-cold generation bit-identical."""
+    L, G, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    pool: Cache = {}
+    if kv_int8:
+        pool["k"] = jnp.zeros((L, rows, max_len, G, hd), jnp.int8)
+        pool["v"] = jnp.zeros((L, rows, max_len, G, hd), jnp.int8)
+        pool["k_scale"] = jnp.zeros((L, rows, G, max_len), jnp.bfloat16)
+        pool["v_scale"] = jnp.zeros((L, rows, G, max_len), jnp.bfloat16)
+    else:
+        pool["k"] = jnp.zeros((L, rows, max_len, G, hd), cfg.dtype)
+        pool["v"] = jnp.zeros((L, rows, max_len, G, hd), cfg.dtype)
+    return pool
+
+
+def pool_logical_axes(pool: Cache) -> Dict[str, Tuple]:
+    """Sharding axes for the prefix pool: identical names to the decode
+    cache's (row dim = "batch") so ONE TP rule set shards both and the
+    row-copy programs stay layout-compatible under a mesh."""
+    axes = {
+        "k": ("layer", "batch", "seq_cache", "kv_heads", "head_dim"),
+        "v": ("layer", "batch", "seq_cache", "kv_heads", "head_dim"),
+    }
+    if "k_scale" in pool:
+        axes["k_scale"] = ("layer", "batch", "kv_heads", "seq_cache")
+        axes["v_scale"] = ("layer", "batch", "kv_heads", "seq_cache")
+    return axes
+
+
+def pool_store(pool: Cache, cache: Cache, slot: jax.Array,
+               row: jax.Array) -> Cache:
+    """Copy a slot's K/V rows (all max_len of them — static shape) into
+    a pool row. Rows past the prompt are garbage but harmless: the host
+    index records the cached prefix length and a load's suffix prefill
+    overwrites everything past it before decode can read it."""
+    out = dict(pool)
+    for name in pool:
+        src = lax.dynamic_index_in_dim(cache[name], slot, 1,
+                                       keepdims=False)
+        out[name] = lax.dynamic_update_index_in_dim(pool[name], src,
+                                                    row, 1)
+    return out
+
+
+def pool_load(cache: Cache, pool: Cache, row: jax.Array,
+              slot: jax.Array, claim_len: jax.Array) -> Cache:
+    """Copy a pool row into a decode slot AND claim the slot for an
+    in-progress chunked prefill: length is stamped to ``claim_len``
+    (= max_len) so interleaved decode bursts — which scatter a garbage
+    row for EVERY slot at index ``length``, active or not — write out
+    of bounds and get dropped instead of corrupting rows a finished
+    chunk already wrote (see the engine's chunk scheduler)."""
+    out = dict(cache)
+    for name in pool:
+        src = lax.dynamic_index_in_dim(pool[name], row, 1,
+                                       keepdims=False)
+        out[name] = lax.dynamic_update_index_in_dim(cache[name], src,
+                                                    slot, 1)
+    out["length"] = cache["length"].at[slot].set(claim_len)
+    return out
+
+
+def claim_slot(cache: Cache, slot: jax.Array,
+               claim_len: jax.Array) -> Cache:
+    """Claim a slot for a cold chunked prefill (no pool row to copy):
+    same length stamp as :func:`pool_load`, same reason."""
+    return dict(cache,
+                length=cache["length"].at[slot].set(claim_len))
+
+
+def prefill_chunk(params: llama.Params, cache: Cache,
+                  tokens_c: jax.Array, start: jax.Array,
+                  n_valid: jax.Array, slot: jax.Array,
+                  new_len: jax.Array, rng: jax.Array,
+                  cfg: llama.LlamaConfig, sp, *, final: bool,
+                  qweights=None) -> Tuple[Cache, jax.Array, jax.Array]:
+    """One chunk of an incremental prefill into a decode slot.
+
+    tokens_c: [C] int32 right-padded chunk; start: row offset of this
+    chunk in the slot's sequence (rows < start — a reused prefix and/or
+    earlier chunks — are already in the cache); n_valid: real tokens in
+    this chunk; new_len: length to stamp (max_len mid-prefill, the true
+    total on the final chunk — see :func:`pool_load`). ``final`` is
+    static: the final variant samples the request's first token from
+    the last valid position (and is the only one that splits the RNG,
+    so cached and cold paths consume identical RNG streams).
+
+    Chunk attention = big-cache dot over the slot's rows masked to
+    ``col < start`` ++ causal intra-chunk dot — the decode_burst_staged
+    formulation at C query rows. ONE compiled program (two with
+    ``final``) serves every bucket and every suffix offset, replacing
+    the per-bucket O(S^2) prefill monoliths above the chunk size.
+    Numerics match the monolithic prefill up to summation order (same
+    score set, softmaxed with the chunk block concatenated after the
+    cache block); cached-vs-cold CHUNKED runs are bit-identical because
+    both read/write the same rows with the same program. int8 KV path
+    included: chunk rows quantize exactly as ``insert`` would.
+
+    Returns (cache', rng', first_token — 0 unless ``final``).
+    """
+    C = tokens_c.shape[0]
+    M = cache["k"].shape[2]
+    G, hd = cfg.n_kv_heads, cfg.head_dim
+    rep = cfg.n_heads // G
+    scale = hd ** -0.5
+    neg = jnp.asarray(-1e30, jnp.float32)
+    quant = "k_scale" in cache
+    wq8 = qweights is not None
+    sdt = cache["k_scale"].dtype if quant else None
+    kdt = cache["k"].dtype
+
+    x = params["embed"].astype(cfg.dtype)[tokens_c][None]   # [1, C, D]
+    positions = start + jnp.arange(C)
+    cos, sin = llama.rope_frequencies(cfg, positions)
+    col = jnp.arange(M)
+    j = jnp.arange(C)
+    # Padding columns (>= n_valid) are masked out of the intra-chunk
+    # scores; padding ROWS compute garbage that lands past the prompt's
+    # true length, where decode's validity mask never reads.
+    intra_mask = (j[None, :] <= j[:, None]) & (j[None, :] < n_valid)
+
+    def body(carry, layer_q):
+        x, i = carry
+        if wq8:
+            layer, qlayer = layer_q
+        else:
+            layer, qlayer = layer_q, None
+        h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
+        q = proj("bsd,dhk->bshk", h, layer, qlayer, "wq", 1, cfg.dtype)
+        k = proj("bsd,dhk->bshk", h, layer, qlayer, "wk", 1, cfg.dtype)
+        v = proj("bsd,dhk->bshk", h, layer, qlayer, "wv", 1, cfg.dtype)
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+        kr, vr = k[0], v[0]                       # [C, G, hd]
+        if quant:
+            kq, ksc = quantize_rows(kr)
+            vq, vsc = quantize_rows(vr)
+            ys = (kq, vq, ksc.astype(sdt), vsc.astype(sdt))
+        else:
+            ys = (kr.astype(kdt), vr.astype(kdt))
+        ck = lax.dynamic_index_in_dim(cache["k"], i, 0, keepdims=False)
+        ck = lax.dynamic_index_in_dim(ck, slot, 0, keepdims=False)
+        cv = lax.dynamic_index_in_dim(cache["v"], i, 0, keepdims=False)
+        cv = lax.dynamic_index_in_dim(cv, slot, 0, keepdims=False)
+        # bf16 dots, fp32 accumulation — int8 converts to bf16 exactly
+        # (see decode_step's note).
+        qh = q[0].reshape(C, G, rep, hd).astype(jnp.bfloat16)
+        sm = jnp.einsum("cgrk,mgk->cgrm", qh, ck.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32) * scale
+        ss = jnp.einsum("cgrk,jgk->cgrj", qh, kr.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32) * scale
+        if quant:
+            cks = lax.dynamic_index_in_dim(cache["k_scale"], i, 0,
+                                           keepdims=False)
+            cks = lax.dynamic_index_in_dim(cks, slot, 0, keepdims=False)
+            cvs = lax.dynamic_index_in_dim(cache["v_scale"], i, 0,
+                                           keepdims=False)
+            cvs = lax.dynamic_index_in_dim(cvs, slot, 0, keepdims=False)
+            sm = sm * cks[None, :, None, :]
+        sm = jnp.where(col[None, None, None, :] < start, sm, neg)
+        ss = jnp.where(intra_mask[:, None, None, :], ss, neg)
+        w = jax.nn.softmax(jnp.concatenate([sm, ss], axis=-1), axis=-1)
+        wm, ws = w[..., :M], w[..., M:]
+        if quant:
+            wm = wm * cvs[None, :, None, :]
+        o = jnp.einsum("cgrm,mgk->cgrk", wm.astype(jnp.bfloat16),
+                       cv.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+        o = o + jnp.einsum("cgrj,jgk->cgrk", ws.astype(jnp.bfloat16),
+                           vr.astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32)
+        o = o.reshape(1, C, cfg.n_heads, hd).astype(cfg.dtype)
+        o = proj("bshk,hkd->bsd", o, layer, qlayer, "wo", 2, cfg.dtype)
+        x = x + o
+        h = llama.rms_norm(x, layer["ln2"], cfg.norm_eps)
+        if wq8 and not hasattr(cfg, "n_experts"):
+            g = proj("bsd,df->bsf", h, layer, qlayer, "w_gate", 1,
+                     cfg.dtype)
+            u = proj("bsd,df->bsf", h, layer, qlayer, "w_up", 1,
+                     cfg.dtype)
+            x = x + proj("bsf,fd->bsd", jax.nn.silu(g) * u, layer,
+                         qlayer, "w_down", 1, cfg.dtype)
+        else:
+            x = x + _ffn(cfg, h, layer)
+        return (x, i + 1), ys
+
+    xs = ((params["blocks"], qweights["blocks"]) if wq8
+          else params["blocks"])
+    (x, _), ys = lax.scan(body, (x, jnp.int32(0)), xs)
+
+    if final:
+        x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+        last = lax.dynamic_index_in_dim(x[0], n_valid - 1, 0,
+                                        keepdims=False)      # [D]
+        if wq8:
+            logits = qeinsum("d,dv->v", last, qweights["head"], 1,
+                             jnp.float32)
+        else:
+            head = (params["embed"].T if cfg.tie_embeddings
+                    else params["lm_head"])
+            logits = (last @ head.astype(cfg.dtype)).astype(jnp.float32)
+        rng, sub = jax.random.split(rng)
+        tok = sampling_mod.sample(logits, sub, sp)
+    else:
+        tok = jnp.zeros((), jnp.int32)
+
+    # Chunk rows land at [slot, start:start+C]. Scatter (not
+    # dynamic_update_slice): a final partial chunk's window may poke
+    # past max_len, and scatter DROPS out-of-bounds indices instead of
+    # clamping the whole window backwards over valid rows.
+    idx = start + jnp.arange(C)
+    out = dict(cache)
+    if quant:
+        kq_l, vq_l, ks_l, vs_l = ys       # [L,C,G,hd] / [L,C,G]
+        out["k"] = cache["k"].at[:, slot, idx].set(kq_l)
+        out["v"] = cache["v"].at[:, slot, idx].set(vq_l)
+        # Non-adjacent advanced indices put the broadcast dim first:
+        # update shape is [C, L, G].
+        out["k_scale"] = cache["k_scale"].at[:, slot, :, idx].set(
+            ks_l.transpose(1, 0, 2))
+        out["v_scale"] = cache["v_scale"].at[:, slot, :, idx].set(
+            vs_l.transpose(1, 0, 2))
+    else:
+        k_l, v_l = ys
+        out["k"] = cache["k"].at[:, slot, idx].set(k_l)
+        out["v"] = cache["v"].at[:, slot, idx].set(v_l)
+    out["length"] = cache["length"].at[slot].set(new_len)
+    if final:
+        out["last_token"] = cache["last_token"].at[slot].set(tok)
+    return out, rng, tok
+
+
+# ---------------------------------------------------------------------------
 # Decode
 # ---------------------------------------------------------------------------
 

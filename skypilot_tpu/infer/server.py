@@ -306,7 +306,9 @@ class ModelServer:
     def _step(self) -> bool:
         self._drain_inbox()
         eng = self.engine
-        if not (eng.waiting or eng.slot_req or self._burst is not None):
+        chunking = getattr(eng, "chunking", None)
+        if not (eng.waiting or eng.slot_req or chunking
+                or self._burst is not None):
             return False
         # Coalesce a filling wave: more arrivals are in flight when the
         # last one is only milliseconds old. Never waits when the wave
@@ -331,10 +333,25 @@ class ModelServer:
             if eng.waiting and eng.free_slots:
                 eng.admit(on_wave=self._on_wave)
                 self._flush_streams()
+        if chunking:
+            # Interference scheduler: land the outstanding burst, run
+            # ONE prefill chunk, then fall through to dispatch the next
+            # decode burst — chunk -> decode alternation, so a long
+            # prompt's prefill never stalls decode slots for more than
+            # one chunk and TPOT stops spiking during admission waves.
+            self._complete_burst()
+            eng.prefill_chunk_step()
+            self._flush_streams()   # final chunk emits a first token
         if eng.slot_req:
             quiet = (time.monotonic() - self._last_arrival
                      > self.open_window_s)
-            k = (self.max_burst if not eng.free_slots or quiet
+            # While a chunked prefill is in flight, bursts stay short
+            # regardless of slot pressure: the alternation granularity
+            # IS the chunked-prefill TTFT bound. ``chunking`` is the
+            # engine's live deque — its truthiness reflects claims made
+            # by the admit call above.
+            k = (self.max_burst
+                 if (not eng.free_slots or quiet) and not chunking
                  else self.open_burst)
             if self._async_decode:
                 # Dispatch the NEXT burst before fetching the previous
@@ -354,13 +371,21 @@ class ModelServer:
             ttft = ((req.first_token_s - req.submit_s) * 1e3
                     if req.first_token_s is not None else None)
             ttft = round(ttft, 2) if ttft is not None else None
+            cached = getattr(req, "cached_len", 0)
             p.result = {
                 "tokens": req.tokens,
                 "ttft_ms": ttft,
+                # Per-request prefix-cache stats (the response
+                # trailer): how much prefill this request skipped.
+                "cache_hit": bool(cached),
+                "cached_tokens": cached,
+                "prefill_chunks": getattr(req, "n_chunks", 0),
             }
             if p.stream:
                 p.chunks.put({"done": True, "ttft_ms": ttft,
-                              "n_tokens": len(req.tokens)})
+                              "n_tokens": len(req.tokens),
+                              "cache_hit": bool(cached),
+                              "cached_tokens": cached})
             p.event.set()
         if self.engine.finished:
             PENDING_REQUESTS.set(len(self._pending))
@@ -475,17 +500,25 @@ def make_handler(model: ModelServer):
                 return self._json(400, {"error": f"bad request: {e}"})
             trace_ctx = tracing.parse_traceparent(
                 self.headers.get("traceparent"))
+            # Client errors carry a typed body when the engine minted
+            # one (PromptTooLongError.typed_error — a prompt past the
+            # largest bucket is the caller's fault, never a 500).
+            def _bad_request(e):
+                return self._json(
+                    400,
+                    {"error": getattr(e, "typed_error", None) or str(e)})
+
             if stream:
                 try:
                     chunks = model.submit_stream(tokens, max_new,
                                                  trace_ctx=trace_ctx)
                 except ValueError as e:  # oversized prompt etc.
-                    return self._json(400, {"error": str(e)})
+                    return _bad_request(e)
                 return self._stream(chunks)
             try:
                 out = model.submit(tokens, max_new, trace_ctx=trace_ctx)
             except ValueError as e:      # oversized prompt etc.
-                return self._json(400, {"error": str(e)})
+                return _bad_request(e)
             if "error" in out:
                 return self._json(500, out)
             return self._json(200, out)
@@ -539,6 +572,16 @@ def main() -> None:
                     help="seconds to wait for a filling admission wave "
                          "when the newest arrival is fresher than this "
                          "(prevents 1-row padded waves on bursts)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked prefill: prompts longer than this "
+                         "prefill in fixed chunks interleaved with "
+                         "decode bursts (0 disables; default env "
+                         "SKYTPU_PREFILL_CHUNK or 512)")
+    ap.add_argument("--prefix-pool", type=int, default=None,
+                    help="prefix KV cache: reserved rows holding "
+                         "prompt prefixes for suffix-only prefill on "
+                         "shared system prompts (0 disables; default "
+                         "env SKYTPU_PREFIX_POOL or 8)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel degree: shard weights + KV "
                          "cache over the first N local devices "
@@ -589,6 +632,14 @@ def main() -> None:
             temperature=args.temperature),
         kv_int8=args.kv_int8, weights_int8=args.weights_int8,
         max_wave=args.admit_wave,
+        prefill_chunk=args.prefill_chunk,
+        # Serving default: prefix reuse ON (repeated system prompts are
+        # the common serving workload); the engine-level default stays
+        # 0 so library users opt in.
+        prefix_pool=(args.prefix_pool
+                     if args.prefix_pool is not None
+                     else int(os.environ.get("SKYTPU_PREFIX_POOL",
+                                             "8") or 0)),
         # One compiled prefill program per bucket: an odd wave size
         # must never hit a mid-traffic XLA compile on a live replica.
         pad_waves=True)

@@ -88,6 +88,61 @@ def test_bad_requests(model_server):
     assert code == 400  # prompt exceeds the largest bucket
 
 
+def test_prompt_too_long_typed_400(model_server):
+    """A prompt past the largest bucket is a CLIENT error: HTTP 400
+    with a typed error body (never a 500), on both the blocking and
+    the streaming path."""
+    url, _, _ = model_server
+    for payload in ({"tokens": list(range(99)), "max_new_tokens": 2},
+                    {"tokens": list(range(99)), "max_new_tokens": 2,
+                     "stream": True}):
+        code, out = _post(f"{url}/generate", payload)
+        assert code == 400
+        err = out["error"]
+        assert err["type"] == "prompt_too_long"
+        assert err["prompt_len"] == 99 and err["max_prompt_len"] == 16
+        assert "message" in err
+
+
+def test_response_carries_cache_stats(model_server):
+    """The response trailer reports per-request prefix-cache stats
+    (this server runs without a pool: miss, zero cached tokens)."""
+    url, _, _ = model_server
+    code, out = _post(f"{url}/generate",
+                      {"tokens": [4, 8, 15], "max_new_tokens": 3})
+    assert code == 200
+    assert out["cache_hit"] is False
+    assert out["cached_tokens"] == 0
+    assert out["prefill_chunks"] == 0
+
+
+def test_server_loop_drives_chunked_prefill():
+    """End to end through the serving loop: a prompt longer than the
+    chunk admits via the chunk queue (interleaved with decode), the
+    trailer reports the hit on a repeat, and tokens are identical
+    warm vs cold."""
+    cfg = llama.CONFIGS["llama3-tiny"]
+    params = llama.init_params(jax.random.key(0), cfg)
+    engine = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                                 prompt_buckets=(32,),
+                                 prefill_chunk=8, prefix_pool=2)
+    model = srv.ModelServer(engine, max_burst=4, open_burst=2)
+    try:
+        assert model._ready.wait(timeout=300)
+        prompt = list(range(1, 13))              # 12 tokens, 2 chunks
+        cold = model.submit(prompt, 4)
+        assert "error" not in cold
+        assert cold["cache_hit"] is False
+        assert cold["prefill_chunks"] == 2
+        warm = model.submit(prompt, 4)
+        assert warm["cache_hit"] is True
+        assert warm["cached_tokens"] == 8        # chunk-aligned prefix
+        assert warm["prefill_chunks"] == 1       # suffix only
+        assert warm["tokens"] == cold["tokens"]
+    finally:
+        model.shutdown()
+
+
 def _post_stream(url, payload, timeout=300):
     """POST with stream:true; returns [(arrival_time, chunk_dict)]."""
     import time

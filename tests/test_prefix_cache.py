@@ -1,0 +1,195 @@
+"""Prefix KV-cache reuse + chunked prefill: parity, staleness, LRU.
+
+Tier-1 guards for the serving engine's two interference killers:
+(1) prefix reuse — cached-prefix generation must be token-identical to
+the cold path (greedy), and (2) chunked prefill — the chunk program
+must match the per-bucket monolith and the oracle. Plus the slot-reuse
+staleness invariant the `_retire` comment promises, and the host-side
+LRU index semantics.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import engine as eng
+from skypilot_tpu.infer import kvcache
+from skypilot_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.CONFIGS["llama3-tiny"]
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(jax.random.key(0), cfg)
+
+
+def _engine(params, cfg, chunk=8, pool=4, slots=4, max_len=64,
+            buckets=(48,), **kw):
+    return eng.InferenceEngine(params, cfg, n_slots=slots,
+                               max_len=max_len, prompt_buckets=buckets,
+                               prefill_chunk=chunk, prefix_pool=pool,
+                               **kw)
+
+
+def test_cached_prefix_token_identical_to_cold(cfg, params):
+    """The headline parity guarantee: a request whose prompt shares a
+    resident prefix (suffix-only prefill over copied KV rows) generates
+    EXACTLY the cold chunked path's tokens — and both match the
+    monolithic engine and the full-forward oracle (greedy)."""
+    e = _engine(params, cfg)
+    system = list(range(5, 21))                 # 16 tokens = 2 chunks
+    pa = system + [31, 32, 33, 34]
+    pb = system + [41, 42, 43]
+
+    # Oracle + monolith reference for the chunk program itself.
+    mono = _engine(params, cfg, chunk=0, pool=0)
+    want_a = mono.generate([pa], max_new_tokens=6)[0]
+    logits_ref = llama.forward(params,
+                               np.asarray([pa], np.int32), cfg)[0, -1]
+    assert want_a[0] == int(np.argmax(np.asarray(logits_ref)))
+
+    got_a = e.generate([pa], max_new_tokens=6)[0]   # cold, stores prefix
+    assert got_a == want_a
+    e.finished.clear()
+
+    warm_b = e.generate([pb], max_new_tokens=6)[0]  # prefix hit
+    (req_b,) = e.finished
+    assert req_b.cached_len == 16                   # suffix-only prefill
+    assert req_b.n_chunks == 1
+    e.finished.clear()
+
+    e.clear_prefix_cache()
+    cold_b = e.generate([pb], max_new_tokens=6)[0]
+    assert warm_b == cold_b
+    assert cold_b == mono.generate([pb], max_new_tokens=6)[0]
+
+
+def test_cached_prefix_parity_kv_int8(cfg, params):
+    """Same guarantee over the int8 KV cache: pool rows copy the
+    already-quantized bytes, so warm == cold bit-for-bit."""
+    e = _engine(params, cfg, slots=2, pool=2, kv_int8=True)
+    system = list(range(5, 21))
+    pa, pb = system + [31, 32], system + [41, 42, 43]
+    e.generate([pa], max_new_tokens=4)
+    e.finished.clear()
+    warm = e.generate([pb], max_new_tokens=6)[0]
+    assert e.finished[0].cached_len == 16
+    e.finished.clear()
+    e.clear_prefix_cache()
+    assert warm == e.generate([pb], max_new_tokens=6)[0]
+
+
+def test_chunked_prefill_interleaves_with_decode(cfg, params):
+    """The chunk scheduler: a long prompt admitted while another
+    request decodes must not change either request's tokens, and the
+    decode slot keeps emitting between chunks."""
+    e = _engine(params, cfg, pool=0)
+    short, long_p = [3, 1, 4], list(range(1, 29))   # 28 -> 4 chunks
+    solo = _engine(params, cfg, pool=0)
+    want_short = solo.generate([short], max_new_tokens=10)[0]
+    want_long = solo.generate([long_p], max_new_tokens=4)[0]
+
+    e.add_request(short, max_new_tokens=10)
+    e.step_burst(max_burst=2)                 # short active, decoding
+    e.add_request(long_p, max_new_tokens=4)
+    e.run_to_completion(max_burst=2)
+    by_prompt = {tuple(r.prompt): r.tokens for r in e.finished}
+    assert by_prompt[tuple(short)] == want_short
+    assert by_prompt[tuple(long_p)] == want_long
+
+
+def test_slot_reuse_never_reads_dead_rows(cfg, params):
+    """Satellite: retire a slot mid-sequence, re-admit a shorter
+    prompt into it, and decode attention must never read the dead
+    occupant's rows (the `_retire` no-cache-scrub invariant)."""
+    e = eng.InferenceEngine(params, cfg, n_slots=1, max_len=64,
+                            prompt_buckets=(32,))
+    e.add_request(list(range(1, 29)), max_new_tokens=64)
+    e.step()
+    e.step()                                  # rows grow past 30
+    (req,) = e.slot_req.values()
+    e._retire(req)                            # mid-sequence retirement
+    e.finished.clear()
+
+    short = [3, 1, 4]
+    got = e.generate([short], max_new_tokens=6)[0]
+    fresh = eng.InferenceEngine(params, cfg, n_slots=1, max_len=64,
+                                prompt_buckets=(32,))
+    want = fresh.generate([short], max_new_tokens=6)[0]
+    assert got == want
+    # Stronger than token equality: the next decode's logits over the
+    # reused cache match a never-dirtied cache bit-for-bit (a leaked
+    # dead row would perturb attention before it flips an argmax).
+    _, l_reused = kvcache.decode_step(e.params, e.cache, cfg)
+    _, l_fresh = kvcache.decode_step(fresh.params, fresh.cache, cfg)
+    assert np.array_equal(np.asarray(l_reused[0]), np.asarray(l_fresh[0]))
+
+
+def test_prefix_index_lru_eviction():
+    idx = eng.PrefixIndex(rows=2, block=4)
+    a = list(range(100, 120))
+    b = list(range(200, 220))
+    c = list(range(300, 320))
+    r0, ev = idx.acquire_row()
+    assert (r0, ev) == (0, False)
+    idx.register(a, 8, r0)
+    r1, ev = idx.acquire_row()
+    assert (r1, ev) == (1, False)
+    idx.register(b, 8, r1)
+    assert idx.lookup(a) == (0, 8)        # bumps row 0; row 1 is LRU
+    r2, ev = idx.acquire_row()
+    assert ev and r2 == 1                 # b evicted
+    idx.register(c, 8, r2)
+    assert idx.lookup(b) is None
+    assert idx.lookup(c) == (1, 8)
+    assert idx.lookup(a) == (0, 8)
+    # Longest-aligned-prefix semantics: a prompt sharing only a's
+    # first block hits at 4 tokens, not 8.
+    assert idx.lookup(a[:4] + [9] * 5) == (0, 4)
+    # At least one suffix token must remain: an exact-length prompt
+    # can only hit a strictly shorter prefix.
+    assert idx.lookup(a[:8]) == (0, 4)
+    idx.clear()
+    assert idx.lookup(a) is None
+
+
+def test_budget_knobs_from_env(monkeypatch, cfg, params):
+    monkeypatch.setenv("SKYTPU_PREFILL_CHUNK", "16")
+    monkeypatch.setenv("SKYTPU_PREFIX_POOL", "3")
+    e = eng.InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                            prompt_buckets=(16,))
+    assert e.prefill_chunk == 16 and e.prefix_pool == 3
+    assert e.pool is not None and e.pool["k"].shape[1] == 3
+    # Chunking off forces the pool off too (no suffix program to use
+    # a hit with), regardless of SKYTPU_PREFIX_POOL.
+    monkeypatch.setenv("SKYTPU_PREFILL_CHUNK", "0")
+    e2 = eng.InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                             prompt_buckets=(16,))
+    assert e2.prefill_chunk is None and e2.prefix_pool == 0
+    assert e2.pool is None
+
+
+def test_bench_serve_smoke_guard():
+    """Satellite: `bench_serve --smoke` — the fast regression guard for
+    the interference scheduler. Parity and prefix hits are asserted on
+    every CI run; the chunk scheduler must actually have alternated
+    (one admission burst per chunk, not one monolithic stall)."""
+    from skypilot_tpu.infer import bench_serve
+
+    r = bench_serve.run_smoke()
+    assert r["parity_ok"]
+    assert r["prefix_hits"] >= 1 and r["hit_rate"] > 0
+    assert r["cold_hits"] == 0
+    # Structural, not wall-clock (host timing noise at tiny-model scale
+    # made a warm<cold ms assertion flaky): the warm pass must have
+    # prefilled suffixes only — strictly fewer chunk programs.
+    assert r["warm_chunks"] < r["cold_chunks"]
+    assert r["warm_chunks"] == r["requests"]      # 1 suffix chunk each
+    inter = r["interference"]
+    # 2 long prompts x ceil(30/8)=4 chunks -> >= 8 alternation bursts.
+    assert inter["admission_bursts"] >= 8
+    assert inter["decode_stall_p99_ms"] > 0
