@@ -1,0 +1,27 @@
+"""Repo-native static analysis: the standing correctness gate.
+
+The engine's performance story rests on properties only visible in the
+source: static shapes inside jitted code, no hidden host syncs in the
+decode loop, lock discipline across the engine/watchdog/server threads,
+typed errors on request paths, and event/metric hygiene. ``skytpu
+lint`` (and the tier-1 ``tests/test_static_analysis.py`` gate) checks
+all of them on every change, against a checked-in baseline of
+grandfathered findings (``lint_baseline.json``, every entry justified).
+
+Layout:
+  core.py      — Checker base + registry, FileContext, the runner
+  findings.py  — typed Finding objects (file:line, severity, fix hint)
+  cache.py     — per-file mtime+hash result cache (warm --changed < 2s)
+  baseline.py  — baseline load/save/compare (counts + justifications)
+  checkers/    — the checkers themselves (docs/analysis.md catalog)
+"""
+
+from skypilot_tpu.analysis.core import (AnalysisResult, Checker,
+                                        FileContext, all_checkers,
+                                        get_checker, register, run)
+from skypilot_tpu.analysis.findings import Finding
+
+__all__ = [
+    "AnalysisResult", "Checker", "FileContext", "Finding",
+    "all_checkers", "get_checker", "register", "run",
+]

@@ -1,0 +1,105 @@
+"""The grandfathered-findings baseline (``lint_baseline.json``).
+
+Schema::
+
+    {"version": 1,
+     "entries": {
+       "<checker>::<path>::<ident>": {
+         "count": N,
+         "justification": "one line on why this is intentional"}}}
+
+Budget semantics: up to ``count`` findings with that key are
+suppressed; the count+1th fails. Keys carry no line numbers, so the
+baseline survives unrelated edits. An entry whose key matches nothing
+is *stale* — the finding was fixed or the file renamed — and full-tree
+runs fail until the entry is removed (a rotted budget would silently
+cover a future regression, the exact failure mode the old per-file
+allowlists guarded against with their entries-still-exist tests).
+
+``--baseline-update`` rewrites the file from current findings,
+preserving existing justifications; new entries get a ``TODO`` marker
+the tier-1 gate rejects, so a human must write the one-line reason
+before it can land.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from skypilot_tpu.analysis.findings import Finding
+
+TODO_JUSTIFICATION = "TODO: justify this baseline entry"
+_SCHEMA_VERSION = 1
+
+
+def default_path(root: str) -> str:
+    return os.path.join(root, "lint_baseline.json")
+
+
+def load(path: str) -> Dict[str, dict]:
+    """key -> {"count": int, "justification": str}. Missing file =>
+    empty baseline (a fresh tree starts at zero)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != _SCHEMA_VERSION:
+        raise ValueError(
+            f"{path}: unsupported baseline version "
+            f"{data.get('version')!r} (expected {_SCHEMA_VERSION})")
+    entries = data.get("entries", {})
+    out = {}
+    for key, ent in entries.items():
+        out[key] = {"count": int(ent.get("count", 1)),
+                    "justification": str(ent.get("justification", ""))}
+    return out
+
+
+def save(path: str, entries: Dict[str, dict]) -> None:
+    data = {"version": _SCHEMA_VERSION,
+            "entries": {k: entries[k] for k in sorted(entries)}}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def compare(findings: List[Finding], entries: Dict[str, dict]
+            ) -> Tuple[List[Finding], List[str], List[str]]:
+    """-> (new_findings, stale_keys, unjustified_keys).
+
+    Suppression is per key with a count budget: findings beyond the
+    budget surface in file/line order (the latest additions read as
+    the new ones)."""
+    by_key: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.key, []).append(f)
+    new: List[Finding] = []
+    for key, group in by_key.items():
+        budget = entries.get(key, {}).get("count", 0)
+        if len(group) > budget:
+            group = sorted(group, key=lambda f: (f.line, f.col))
+            new.extend(group[budget:])
+    stale = [k for k in entries if not by_key.get(k)]
+    unjustified = [
+        k for k in entries
+        if not entries[k]["justification"].strip()
+        or entries[k]["justification"].startswith("TODO")]
+    return new, sorted(stale), sorted(unjustified)
+
+
+def updated(findings: List[Finding], old: Dict[str, dict]
+            ) -> Dict[str, dict]:
+    """The baseline that makes ``findings`` exactly clean: one entry
+    per key with the observed count; justifications carried over from
+    ``old``, new keys marked TODO."""
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    out = {}
+    for key, n in counts.items():
+        just = old.get(key, {}).get("justification",
+                                    TODO_JUSTIFICATION)
+        out[key] = {"count": n, "justification": just}
+    return out

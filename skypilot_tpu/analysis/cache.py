@@ -1,0 +1,157 @@
+"""Per-file result cache for file-scope checkers.
+
+One JSON file under ``$SKYPILOT_TPU_HOME`` (so tests with a tmp home
+get an isolated cache), keyed by repo-relative path:
+
+    {"schema": 1, "checkers": "<versions digest>",
+     "files": {rel: {"mtime": f, "size": n, "sha": hex,
+                     "findings": [...]}}}
+
+Validation is two-tier: matching (mtime, size) short-circuits without
+reading the file; on mismatch the content hash decides (a ``touch``
+must not bust the cache, an edit preserving mtime must). Any change to
+the checker set or any checker's ``version`` invalidates everything —
+the digest covers both. Corrupt or unreadable cache files degrade to
+a cold run, never an error: the cache is an accelerator, not state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+from skypilot_tpu.analysis.findings import Finding
+
+_SCHEMA = 1
+
+
+def default_path() -> str:
+    from skypilot_tpu.utils import paths
+    return os.path.join(paths.home(), "lint_cache.json")
+
+
+def _checkers_digest(checkers: Sequence) -> str:
+    blob = ";".join(f"{c.name}={c.version}"
+                    for c in sorted(checkers, key=lambda c: c.name))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _sha(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 16), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+class Cache:
+    def __init__(self, path: Optional[str], digest: str,
+                 files: Dict[str, dict]):
+        self._path = path
+        self._digest = digest
+        self._files = files
+        self._dirty = False
+
+    @staticmethod
+    def load(path: Optional[str], checkers: Sequence) -> "Cache":
+        path = path or default_path()
+        digest = _checkers_digest(
+            [c for c in checkers if c.scope == "file"])
+        files: Dict[str, dict] = {}
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            if (data.get("schema") == _SCHEMA
+                    and data.get("checkers") == digest):
+                files = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+        return Cache(path, digest, files)
+
+    @staticmethod
+    def disabled() -> "Cache":
+        return Cache(None, "", {})
+
+    def get(self, rel: str, path: str) -> Optional[List[Finding]]:
+        """Cached findings for ``rel``, or None on miss/stale."""
+        if self._path is None:
+            return None
+        ent = self._files.get(rel)
+        if ent is None:
+            return None
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        if (st.st_mtime != ent["mtime"] or st.st_size != ent["size"]):
+            # Slow path: the content decides. Refresh the stat key on a
+            # content match so the next run short-circuits again.
+            try:
+                if _sha(path) != ent["sha"]:
+                    return None
+            except OSError:
+                return None
+            ent["mtime"], ent["size"] = st.st_mtime, st.st_size
+            self._dirty = True
+        try:
+            return [Finding.from_dict(d) for d in ent["findings"]]
+        except (KeyError, TypeError):
+            return None
+
+    # Project-scope result cache: one entry keyed by a digest over
+    # EVERY scanned file's content (plus the checkers' other inputs,
+    # e.g. the docs catalog) — any edit anywhere invalidates it, which
+    # is exactly the cross-file semantics per-file caching can't give.
+
+    def project_get(self, digest: str) -> Optional[List[Finding]]:
+        if self._path is None:
+            return None
+        ent = self._files.get("//project")
+        if not isinstance(ent, dict) or ent.get("digest") != digest:
+            return None
+        try:
+            return [Finding.from_dict(d) for d in ent["findings"]]
+        except (KeyError, TypeError):
+            return None
+
+    def project_put(self, digest: str,
+                    findings: List[Finding]) -> None:
+        if self._path is None:
+            return
+        self._files["//project"] = {
+            "digest": digest,
+            "findings": [f.to_dict() for f in findings]}
+        self._dirty = True
+
+    def put(self, rel: str, path: str,
+            findings: List[Finding]) -> None:
+        if self._path is None:
+            return
+        try:
+            st = os.stat(path)
+            sha = _sha(path)
+        except OSError:
+            return
+        self._files[rel] = {
+            "mtime": st.st_mtime, "size": st.st_size, "sha": sha,
+            "findings": [f.to_dict() for f in findings]}
+        self._dirty = True
+
+    def save(self) -> None:
+        if self._path is None or not self._dirty:
+            return
+        data = {"schema": _SCHEMA, "checkers": self._digest,
+                "files": self._files}
+        d = os.path.dirname(self._path) or "."
+        try:
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=d, prefix=os.path.basename(self._path) + ".")
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(data, f)
+            os.replace(tmp, self._path)
+        except OSError:
+            pass   # best-effort: an unwritable cache just stays cold

@@ -1,0 +1,21 @@
+"""Checker modules — importing this package registers all of them.
+
+Catalog (docs/analysis.md has the operator-facing version):
+
+  retrace-safety   — trace-incompatible Python inside jit-reachable code
+  host-sync        — hidden device syncs in the engine/trainer hot loops
+  lock-discipline  — guarded-by mutations outside their lock; blocking
+                     calls while holding a lock
+  typed-errors     — generic raises on server/RPC/LB request paths
+  bare-print       — daemon diagnostics bypassing the structured log
+  adhoc-retry      — hand-rolled retry loops / broad except-pass
+  metric-catalog   — metric naming + docs-catalog drift
+"""
+
+from skypilot_tpu.analysis.checkers import (adhoc_retry, bare_print,
+                                            host_sync, locks,
+                                            metric_catalog, retrace,
+                                            typed_errors)
+
+__all__ = ["adhoc_retry", "bare_print", "host_sync", "locks",
+           "metric_catalog", "retrace", "typed_errors"]

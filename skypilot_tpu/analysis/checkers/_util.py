@@ -1,0 +1,69 @@
+"""Shared AST helpers for checkers."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted(node.func)
+
+
+def last_attr(node: ast.AST) -> Optional[str]:
+    """The final attribute/name segment of an expression (``c`` for
+    ``a.b.c``, ``x`` for ``x``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def is_constant_expr(node: ast.AST) -> bool:
+    """Literal-only subtree: constants, containers of constants, unary
+    minus, and arithmetic on constants."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(is_constant_expr(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return is_constant_expr(node.operand)
+    if isinstance(node, ast.BinOp):
+        return (is_constant_expr(node.left)
+                and is_constant_expr(node.right))
+    return False
+
+
+def body_walk(func: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's body (the function node itself excluded).
+    A Lambda's body is a single expression, not a statement list."""
+    body = getattr(func, "body", [])
+    if isinstance(body, ast.AST):
+        yield from ast.walk(body)
+        return
+    for stmt in body:
+        yield from ast.walk(stmt)
+
+
+def func_params(func: ast.AST) -> List[str]:
+    a = func.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names

@@ -1,0 +1,98 @@
+"""adhoc-retry: all retrying goes through ``utils/retry.py``.
+
+Two rules, migrated from the pre-framework ``test_no_adhoc_retry``
+lint (same AST logic; the fixed allowlists became baseline entries):
+
+``sleep-in-except`` — ``*.sleep(...)`` lexically inside an ``except``
+handler that sits inside a loop: the signature of a hand-rolled
+retry/backoff loop. Each one reinvents backoff math and deadline
+handling — exactly what made recovery behavior untestable before the
+chaos layer. Route through ``retry.call`` / ``retry.pause``.
+
+``except-pass`` — ``except Exception:`` (or bare ``except:``) whose
+body is only ``pass``: silently eats the failures the chaos harness
+injects. Catch the narrow type, or record a typed event.
+
+``utils/retry.py`` itself is the allowed sleeper.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skypilot_tpu.analysis.core import Checker, FileContext, register
+from skypilot_tpu.analysis.findings import Finding
+
+
+@register
+class AdhocRetryChecker(Checker):
+    name = "adhoc-retry"
+    description = ("hand-rolled retry loops (sleep inside "
+                   "except-in-loop) and broad except-pass swallows")
+    scope = "file"
+    version = 1
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        skip_sleeps = ctx.rel == "skypilot_tpu/utils/retry.py"
+
+        def handler_sleeps(handler: ast.ExceptHandler):
+            for sub in ast.walk(handler):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "sleep"):
+                    yield sub
+
+        def walk(node: ast.AST, loop_depth: int):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.For, ast.While,
+                                      ast.AsyncFor)):
+                    walk(child, loop_depth + 1)
+                    continue
+                if isinstance(child, ast.ExceptHandler):
+                    broad = child.type is None or (
+                        isinstance(child.type, ast.Name)
+                        and child.type.id in ("Exception",
+                                              "BaseException"))
+                    if broad and all(isinstance(s, ast.Pass)
+                                     for s in child.body):
+                        out.append(Finding(
+                            checker=self.name, rule="except-pass",
+                            path=ctx.rel, line=child.lineno,
+                            col=child.col_offset,
+                            message="broad except-pass swallows "
+                                    "failures (including injected "
+                                    "chaos faults)",
+                            ident="except-pass",
+                            hint="catch the narrow exception type, "
+                                 "or record a typed event before "
+                                 "continuing"))
+                    if loop_depth > 0 and not skip_sleeps:
+                        for call in handler_sleeps(child):
+                            out.append(Finding(
+                                checker=self.name,
+                                rule="sleep-in-except",
+                                path=ctx.rel, line=call.lineno,
+                                col=call.col_offset,
+                                message="sleep inside an except "
+                                        "handler inside a loop — a "
+                                        "hand-rolled retry",
+                                ident="sleep-in-except",
+                                hint="use skypilot_tpu.utils.retry "
+                                     "(retry.call / retry.pause) so "
+                                     "backoff, deadlines and "
+                                     "telemetry stay uniform"))
+                        continue   # handler fully scanned above
+                # A nested def/lambda resets loop context: a sleep in
+                # a callback defined within a loop is not this loop's
+                # retry.
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    walk(child, 0)
+                else:
+                    walk(child, loop_depth)
+
+        walk(ctx.tree, 0)
+        return out

@@ -1,0 +1,55 @@
+"""bare-print: daemon diagnostics must reach the structured log.
+
+A ``print()`` in a daemon/server-side module is invisible to ``skytpu
+trace`` and unparseable by anything downstream; the structured
+replacement is ``tracing.add_event(..., echo=True)`` (one JSON line to
+stderr AND the flight recorder). Migrated from the pre-framework
+``test_no_bare_print`` lint; its fixed per-file allowlist became
+baseline entries, and the scope grew to cover the serving layer
+(``infer/``, ``serve/``) whose daemons predated the rule.
+
+Bench files are exempt: their stdout IS the artifact.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from skypilot_tpu.analysis.core import Checker, FileContext, register
+from skypilot_tpu.analysis.findings import Finding
+
+_SCOPE_DIRS = ("skypilot_tpu/runtime/", "skypilot_tpu/server/",
+               "skypilot_tpu/jobs/", "skypilot_tpu/infer/",
+               "skypilot_tpu/serve/")
+
+
+@register
+class BarePrintChecker(Checker):
+    name = "bare-print"
+    description = ("bare print() in daemon/server modules instead of "
+                   "tracing.add_event(..., echo=True)")
+    scope = "file"
+    version = 1
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        if not ctx.rel.startswith(_SCOPE_DIRS):
+            return []
+        if "bench" in os.path.basename(ctx.rel):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                out.append(Finding(
+                    checker=self.name, rule="bare-print",
+                    path=ctx.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message="bare print() in a daemon/server module",
+                    ident="print",
+                    hint="use tracing.add_event(..., echo=True) so "
+                         "the message reaches the structured event "
+                         "log (and stderr)"))
+        return out

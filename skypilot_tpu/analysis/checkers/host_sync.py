@@ -1,0 +1,120 @@
+"""host-sync: hidden device syncs in the serving/training hot loops.
+
+The engine's throughput model assumes the step/burst/chunk loops only
+*dispatch* device programs; every host fetch (``int(tok)``,
+``np.asarray``, ``.item()``, ``.block_until_ready()``) is a full
+dispatch-pipeline drain — the exact stall the async burst double-
+buffering exists to avoid. A sync that belongs there (the completion
+fetch IS the sync point) is baselined with a justification; a new one
+fails the gate so it gets argued about in review instead of shipped.
+
+Scope is the hot loops only — bench files and tests measure by
+syncing, that is their job.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from skypilot_tpu.analysis.checkers import _util
+from skypilot_tpu.analysis.core import Checker, FileContext, register
+from skypilot_tpu.analysis.findings import Finding
+
+# rel path -> function/method *leaf* names forming the hot loop.
+# (Admission, chunking, decode and completion paths in the engine; the
+# serving loop in the server; the per-step wrapper in the trainer.)
+_SCOPES: Dict[str, Set[str]] = {
+    "skypilot_tpu/infer/engine.py": {
+        "step", "step_burst", "step_decode_once", "decode_burst",
+        "dispatch_decode_burst", "complete_decode_burst",
+        "prefill_chunk_step", "run_to_completion", "_admit", "admit",
+        "_dispatch_wave", "_complete_wave", "_claim_chunked",
+        "_maybe_store_prefix",
+    },
+    "skypilot_tpu/infer/server.py": {
+        "_loop", "_step", "_drain_inbox", "_flush_streams",
+        "_complete_burst", "_on_wave",
+    },
+    "skypilot_tpu/train/trainer.py": {
+        "_instrument_step", "observe_loss",
+    },
+}
+
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+               "numpy.array", "jax.device_get"}
+
+
+@register
+class HostSyncChecker(Checker):
+    name = "host-sync"
+    description = ("host syncs (.block_until_ready, np.asarray, "
+                   ".item, int()/float() fetches) inside the engine "
+                   "step/burst/chunk loops and the trainer step path")
+    scope = "file"
+    version = 1
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        scoped = _SCOPES.get(ctx.rel)
+        if not scoped:
+            return []
+        out: List[Finding] = []
+        for qual, _cls, func in ctx.functions:
+            leaf = qual.split(".")[-1]
+            if leaf not in scoped:
+                continue
+            out.extend(self._check_func(ctx, qual, func))
+        return out
+
+    def _check_func(self, ctx: FileContext, qual: str,
+                    func: ast.AST) -> List[Finding]:
+        out: List[Finding] = []
+
+        def finding(node, pattern, message):
+            out.append(Finding(
+                checker=self.name, rule="host-sync", path=ctx.rel,
+                line=node.lineno, col=node.col_offset,
+                message=f"in hot loop `{qual}`: {message}",
+                ident=f"{qual}:{pattern}",
+                hint="a host fetch drains the dispatch pipeline; "
+                     "move it to the completion path or keep the "
+                     "value on device (baseline deliberate sync "
+                     "points with a justification)"))
+
+        for node in _util.body_walk(func):
+            # A nested def is its own scope when listed; skip bodies of
+            # nested helpers NOT in the scope set? They run inline —
+            # keep them: the loop calls them synchronously.
+            if not isinstance(node, ast.Call):
+                continue
+            name = _util.call_name(node) or ""
+            leaf = name.split(".")[-1]
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None)
+            if attr == "block_until_ready":
+                finding(node, "block_until_ready",
+                        "`.block_until_ready()` stalls the loop "
+                        "thread on the device")
+            elif attr == "item" and not node.args:
+                finding(node, "item", "`.item()` is a device fetch")
+            elif name in _SYNC_CALLS and node.args \
+                    and not _util.is_constant_expr(node.args[0]):
+                finding(node, leaf,
+                        f"`{name}(...)` fetches the array to the host")
+            elif name in {"int", "float"} and len(node.args) == 1 \
+                    and not _util.is_constant_expr(node.args[0]) \
+                    and not self._host_cast(node.args[0]):
+                finding(node, leaf,
+                        f"`{leaf}(...)` on a device value is a "
+                        f"blocking fetch")
+        return out
+
+    def _host_cast(self, arg: ast.AST) -> bool:
+        """Casts that can't touch the device: len(), time values,
+        environment reads, pure-host attributes."""
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Call):
+                name = _util.call_name(node) or ""
+                if name == "len" or name.startswith(("os.", "time.")):
+                    return True
+        return False

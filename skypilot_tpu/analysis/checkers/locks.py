@@ -1,0 +1,285 @@
+"""lock-discipline: guarded state and what happens while locked.
+
+Two rules:
+
+``guarded-mutation`` — a ``# guarded-by: <lock>`` comment on an
+attribute's declaration line (class ``__init__`` or module level)
+declares it shared between threads::
+
+    self._inbox: list = []          # guarded-by: _inbox_lock
+    _records: List[dict] = []       # guarded-by: _lock
+
+Every *mutation* of that attribute (assignment, augmented assignment,
+``del``, subscript store, or a mutating method call: append/pop/
+update/clear/...) outside a ``with <lock>:`` block is a finding.
+``__init__`` and module top-level are construction time — exempt.
+Reads are not checked (too many benign racy reads of scalars; the
+writes are where corruption comes from).
+
+``blocking-under-lock`` — a blocking call (sleep, retry.call/pause,
+file/socket I/O, subprocess, ``json.dumps`` of who-knows-how-big a
+ring) lexically inside a ``with`` holding anything lock-named. This
+is the bug class PR 2 fixed by hand when the event-log flush
+serialized O(ring) JSON inside the recorder lock: every recording
+thread stalled behind one writer. Locks that exist *to* serialize
+I/O (flush locks) baseline their findings with that justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis.checkers import _util
+from skypilot_tpu.analysis.core import Checker, FileContext, register
+from skypilot_tpu.analysis.findings import Finding
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_LOCK_NAME_RE = re.compile(r"lock", re.IGNORECASE)
+
+_MUTATORS = {"append", "extend", "insert", "pop", "popleft",
+             "appendleft", "extendleft", "remove", "clear", "update",
+             "add", "discard", "setdefault", "sort", "reverse"}
+
+_BLOCKING_CALLS = {
+    "retry.call", "retry.pause", "json.dump", "json.dumps", "open",
+    "tempfile.mkstemp", "mkstemp", "os.replace", "os.makedirs",
+    "os.fsync", "subprocess.run", "subprocess.Popen",
+    "subprocess.check_output", "subprocess.check_call", "urlopen",
+    "requests.get", "requests.post",
+}
+_BLOCKING_ATTRS = {"sleep", "connect", "send", "sendall", "recv",
+                   "accept", "wait"}
+
+
+def _decl_targets(node: ast.AST) -> List[Tuple[Optional[str], str]]:
+    """(owner, attr) pairs declared by an assignment statement:
+    owner "self" for ``self.x = ...``, None for module-level ``x``."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets = [node.target]
+    out = []
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.append((None, t.id))
+        elif isinstance(t, ast.Attribute) \
+                and isinstance(t.value, ast.Name):
+            out.append((t.value.id, t.attr))
+    return out
+
+
+def _lock_names_held(withs: List[ast.AST]) -> Set[str]:
+    names = set()
+    for w in withs:
+        for item in getattr(w, "items", []):
+            leaf = _util.last_attr(item.context_expr)
+            if leaf:
+                names.add(leaf)
+    return names
+
+
+@register
+class LockDisciplineChecker(Checker):
+    name = "lock-discipline"
+    description = ("mutations of `# guarded-by:` attributes outside "
+                   "their lock; blocking calls while holding a lock")
+    scope = "file"
+    version = 1
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        guarded = self._guarded_decls(ctx)
+        out: List[Finding] = []
+        out.extend(self._check_mutations(ctx, guarded))
+        out.extend(self._check_blocking(ctx))
+        return out
+
+    # -- guarded-by declarations -------------------------------------------
+
+    def _guarded_decls(self, ctx: FileContext
+                       ) -> Dict[Tuple[Optional[str], str], str]:
+        """(class_or_None, attr) -> lock name."""
+        # The annotation rides the declaration line, or a comment-only
+        # line directly above it (multi-line declarations).
+        lock_by_line: Dict[int, str] = {}
+        for i, line in enumerate(ctx.lines, start=1):
+            m = _GUARDED_RE.search(line)
+            if m:
+                lock_by_line[i] = m.group(1)
+                if line.lstrip().startswith("#"):
+                    lock_by_line[i + 1] = m.group(1)
+        if not lock_by_line:
+            return {}
+        decls: Dict[Tuple[Optional[str], str], str] = {}
+
+        def visit(node: ast.AST, cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                    continue
+                if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                    lock = lock_by_line.get(child.lineno)
+                    if lock:
+                        for owner, attr in _decl_targets(child):
+                            if owner in ("self", None):
+                                decls[(cls, attr)] = lock
+                visit(child, cls)
+
+        visit(ctx.tree, None)
+        return decls
+
+    # -- rule: guarded-mutation --------------------------------------------
+
+    def _check_mutations(self, ctx: FileContext,
+                         guarded: Dict[Tuple[Optional[str], str], str]
+                         ) -> List[Finding]:
+        if not guarded:
+            return []
+        out: List[Finding] = []
+
+        def mutated_refs(node: ast.AST) -> List[Tuple[Optional[str],
+                                                      str, ast.AST]]:
+            """(owner, attr, node) mutated by this statement/expr."""
+            refs = []
+            if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                # `a, self.x = ..., ...` unpacking counts per element.
+                flat = []
+                for t in targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        flat.extend(t.elts)
+                    else:
+                        flat.append(t)
+                for t in flat:
+                    base = t
+                    while isinstance(base, (ast.Subscript, ast.Starred)):
+                        base = base.value
+                    if isinstance(base, ast.Name):
+                        refs.append((None, base.id, t))
+                    elif isinstance(base, ast.Attribute) \
+                            and isinstance(base.value, ast.Name):
+                        refs.append((base.value.id, base.attr, t))
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    base = t
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if isinstance(base, ast.Name):
+                        refs.append((None, base.id, t))
+                    elif isinstance(base, ast.Attribute) \
+                            and isinstance(base.value, ast.Name):
+                        refs.append((base.value.id, base.attr, t))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                base = node.func.value
+                if isinstance(base, ast.Name):
+                    refs.append((None, base.id, node))
+                elif isinstance(base, ast.Attribute) \
+                        and isinstance(base.value, ast.Name):
+                    refs.append((base.value.id, base.attr, node))
+            return refs
+
+        def walk(node: ast.AST, cls: Optional[str], func: Optional[str],
+                 withs: List[ast.AST]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name, None, [])
+                    continue
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # Construction is single-threaded by convention.
+                    if child.name in ("__init__", "__new__") \
+                            and func is None:
+                        continue
+                    walk(child, cls, child.name, [])
+                    continue
+                if func is not None:
+                    held = _lock_names_held(withs)
+                    for owner, attr, ref in mutated_refs(child):
+                        key = ((cls, attr) if owner == "self"
+                               else (None, attr) if owner is None
+                               else None)
+                        lock = guarded.get(key) if key else None
+                        if lock and lock not in held:
+                            disp = (f"self.{attr}"
+                                    if owner == "self" else attr)
+                            out.append(Finding(
+                                checker=self.name,
+                                rule="guarded-mutation",
+                                path=ctx.rel, line=child.lineno,
+                                col=child.col_offset,
+                                message=(
+                                    f"`{disp}` is declared guarded-by "
+                                    f"`{lock}` but is mutated in "
+                                    f"`{func}` without holding it"),
+                                ident=f"{func}:{disp}",
+                                hint=f"wrap the mutation in "
+                                     f"`with {lock}:` (or move it "
+                                     f"into a locked section)"))
+                nwiths = (withs + [child]
+                          if isinstance(child, (ast.With,
+                                                ast.AsyncWith))
+                          else withs)
+                walk(child, cls, func, nwiths)
+
+        walk(ctx.tree, None, None, [])
+        return out
+
+    # -- rule: blocking-under-lock -----------------------------------------
+
+    def _check_blocking(self, ctx: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+
+        def walk(node: ast.AST, func: Optional[str],
+                 locks: List[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # A callback defined under a lock doesn't RUN
+                    # under it.
+                    walk(child, child.name, [])
+                    continue
+                if isinstance(child, ast.Lambda):
+                    continue
+                held = list(locks)
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        leaf = _util.last_attr(item.context_expr)
+                        if leaf and _LOCK_NAME_RE.search(leaf):
+                            held.append(leaf)
+                if held and isinstance(child, ast.Call):
+                    name = _util.call_name(child) or ""
+                    attr = (child.func.attr
+                            if isinstance(child.func, ast.Attribute)
+                            else None)
+                    blocking = (name in _BLOCKING_CALLS
+                                or name.split(".")[-1] == "sleep"
+                                or attr in _BLOCKING_ATTRS)
+                    if blocking:
+                        out.append(Finding(
+                            checker=self.name,
+                            rule="blocking-under-lock",
+                            path=ctx.rel, line=child.lineno,
+                            col=child.col_offset,
+                            message=(
+                                f"blocking call "
+                                f"`{name or attr}(...)` while "
+                                f"holding `{held[-1]}`"
+                                + (f" in `{func}`" if func else "")),
+                            ident=(f"{func or '<module>'}:"
+                                   f"{name or attr}"),
+                            hint="snapshot under the lock, do the "
+                                 "slow work outside it (the PR 2 "
+                                 "flush pattern); locks whose job is "
+                                 "serializing I/O baseline this with "
+                                 "that justification"))
+                walk(child, func, held)
+
+        walk(ctx.tree, None, [])
+        return out

@@ -1,0 +1,114 @@
+"""metric-catalog: metric naming and docs-catalog drift.
+
+Every metric the framework registers must carry the ``skytpu_`` prefix
+AND appear in the ``docs/observability.md`` catalog — drift between
+the code's registry and the operator-facing catalog means the fleet
+dashboard lies by omission. Migrated from the pre-framework
+``test_metric_catalog`` lint.
+
+Scope: literal-name declarations through the module-level sugar
+(``metrics.counter/gauge/histogram(...)`` and the ``obs_metrics`` /
+``metrics_lib`` aliases). Dynamic names and per-test registries are
+out of scope by construction. Families the federation tier
+synthesizes at render time (no declaration to scan) are held to the
+same documentation contract. A scan that suddenly sees almost no
+declarations is itself a finding — a refactor of the declaration
+idiom must not let the catalog rot vacuously.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Sequence
+
+from skypilot_tpu.analysis.core import (Checker, FileContext,
+                                        register)
+from skypilot_tpu.analysis.findings import Finding
+
+_FACTORY_ATTRS = {"counter", "gauge", "histogram"}
+_RECEIVERS = {"metrics", "obs_metrics", "metrics_lib"}
+_SYNTHESIZED = {"skytpu_fleet_scrape_up", "skytpu_fleet_merge_errors"}
+_MIN_DECLARATIONS = 30
+_METRICS_MODULE = "skypilot_tpu/observability/metrics.py"
+_DOC_REL = os.path.join("docs", "observability.md")
+
+
+@register
+class MetricCatalogChecker(Checker):
+    name = "metric-catalog"
+    description = ("metric names must be skytpu_-prefixed and "
+                   "documented in docs/observability.md")
+    scope = "project"
+    version = 1
+
+    def extra_inputs(self, root: str) -> List[str]:
+        # Editing the catalog must invalidate cached project results.
+        return [os.path.join(root, _DOC_REL)]
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      root: str) -> List[Finding]:
+        doc_path = os.path.join(root, _DOC_REL)
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                doc = f.read()
+        except OSError:
+            doc = ""
+        declared = []
+        for ctx in ctxs:
+            if ctx.rel == _METRICS_MODULE:
+                continue   # the factories themselves
+            for node in ctx.nodes:
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _FACTORY_ATTRS
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in _RECEIVERS
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    declared.append((ctx.rel, node.lineno,
+                                     node.args[0].value))
+        out: List[Finding] = []
+        if len(declared) < _MIN_DECLARATIONS:
+            out.append(Finding(
+                checker=self.name, rule="scan-degenerate",
+                path=_METRICS_MODULE, line=1,
+                message=(f"metric declaration scan found only "
+                         f"{len(declared)} sites (expected >= "
+                         f"{_MIN_DECLARATIONS}) — did the "
+                         f"declaration idiom change?"),
+                ident="scan-degenerate",
+                hint="update metric_catalog.py's factory/receiver "
+                     "sets to match the new idiom"))
+        for rel, lineno, name in declared:
+            if not name.startswith("skytpu_"):
+                out.append(Finding(
+                    checker=self.name, rule="bad-prefix", path=rel,
+                    line=lineno,
+                    message=f"metric `{name}` lacks the skytpu_ "
+                            f"prefix",
+                    ident=f"bad-prefix:{name}",
+                    hint="rename to skytpu_<subsystem>_<what>_"
+                         "<unit>"))
+            if name not in doc:
+                out.append(Finding(
+                    checker=self.name, rule="undocumented", path=rel,
+                    line=lineno,
+                    message=f"metric `{name}` is missing from the "
+                            f"docs/observability.md catalog",
+                    ident=f"undocumented:{name}",
+                    hint="add a catalog row (the fleet dashboard "
+                         "lies by omission otherwise)"))
+        for name in sorted(_SYNTHESIZED):
+            if name not in doc:
+                out.append(Finding(
+                    checker=self.name, rule="undocumented",
+                    path=_DOC_REL.replace(os.sep, "/"), line=1,
+                    message=f"synthesized metric `{name}` is missing "
+                            f"from the docs catalog",
+                    ident=f"undocumented:{name}",
+                    hint="the federation tier renders this family "
+                         "at scrape time; document it like any "
+                         "other"))
+        return out

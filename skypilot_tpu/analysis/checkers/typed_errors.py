@@ -1,0 +1,75 @@
+"""typed-errors: no generic raises on request paths.
+
+A ``raise Exception(...)`` / ``raise RuntimeError(...)`` in the API
+server, RPC layer, load balancer, or model server surfaces to clients
+as an opaque 500. The repo's contract (PR 5's ``prompt_too_long``
+pattern) is typed errors: an ``exceptions.SkyTpuError`` subclass — or
+a client-error class carrying a ``typed_error`` body the HTTP layer
+can serialize — so callers can branch on the type and operators can
+grep the event log for it.
+
+Narrow builtins (``ValueError`` on malformed input, ``ConnectionError``
+as LB failover control flow) are deliberate and stay allowed; only the
+catch-nothing generics are flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skypilot_tpu.analysis.checkers import _util
+from skypilot_tpu.analysis.core import Checker, FileContext, register
+from skypilot_tpu.analysis.findings import Finding
+
+_SCOPE_PREFIXES = ("skypilot_tpu/server/",)
+_SCOPE_FILES = {
+    "skypilot_tpu/runtime/rpc.py",
+    "skypilot_tpu/runtime/rpc_client.py",
+    "skypilot_tpu/serve/load_balancer.py",
+    "skypilot_tpu/infer/server.py",
+}
+_GENERIC = {"Exception", "RuntimeError", "BaseException"}
+
+
+@register
+class TypedErrorsChecker(Checker):
+    name = "typed-errors"
+    description = ("bare Exception/RuntimeError raises on server/RPC/"
+                   "LB request paths instead of typed error classes")
+    scope = "file"
+    version = 1
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        if not (ctx.rel.startswith(_SCOPE_PREFIXES)
+                or ctx.rel in _SCOPE_FILES):
+            return []
+        out: List[Finding] = []
+        func_of = {}
+        for qual, _cls, node in ctx.functions:
+            for sub in _util.body_walk(node):
+                func_of[id(sub)] = qual
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call):
+                name = _util.dotted(exc.func)
+            else:
+                name = _util.dotted(exc)
+            if name in _GENERIC:
+                qual = func_of.get(id(node), "<module>")
+                out.append(Finding(
+                    checker=self.name, rule="generic-raise",
+                    path=ctx.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=(f"`raise {name}` on a request path "
+                             f"(in `{qual}`) — clients see an opaque "
+                             f"500"),
+                    ident=f"{qual}:{name}",
+                    hint="raise an exceptions.SkyTpuError subclass, "
+                         "or a client-error class with a "
+                         "`typed_error` body (see "
+                         "PromptTooLongError)"))
+        return out

@@ -1,0 +1,411 @@
+"""Checker framework: registry, file contexts, and the runner.
+
+Checkers declare a ``scope``:
+
+  * ``"file"``     — findings depend only on one file's contents.
+    Results are cached per (file digest, checker version) in
+    ``cache.py``; a warm ``--changed`` run re-checks only edits.
+  * ``"project"``  — findings depend on cross-file state (the jit
+    reachability graph, the docs metric catalog). These re-run every
+    time over the parsed tree; they are cheap once parsing is done,
+    and caching them per-file would be wrong (editing file A can
+    change file B's findings).
+
+The runner owns the walk (``skypilot_tpu/`` only — fixtures and tests
+are out of scope by construction), the cache, and the baseline
+comparison. ``run()`` is the single entry point the CLI and the tier-1
+gate share.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from skypilot_tpu.analysis import baseline as baseline_mod
+from skypilot_tpu.analysis import cache as cache_mod
+from skypilot_tpu.analysis.findings import Finding
+
+
+def repo_root() -> str:
+    """The repository root (the directory holding ``skypilot_tpu/``)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+class FileContext:
+    """One source file: path, text, and a lazily parsed AST shared by
+    every checker (parsing once per file is what keeps a full-tree run
+    fast)."""
+
+    def __init__(self, path: str, rel: str,
+                 source: Optional[str] = None):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self._source = source
+        self._tree: Optional[ast.Module] = None
+        self._lines: Optional[List[str]] = None
+        self._nodes: Optional[List[ast.AST]] = None
+        self._functions = None
+        self._aliases: Optional[Dict[str, str]] = None
+
+    @property
+    def source(self) -> str:
+        if self._source is None:
+            with open(self.path, encoding="utf-8") as f:
+                self._source = f.read()
+        return self._source
+
+    @property
+    def lines(self) -> List[str]:
+        if self._lines is None:
+            self._lines = self.source.splitlines()
+        return self._lines
+
+    @property
+    def tree(self) -> ast.Module:
+        if self._tree is None:
+            self._tree = ast.parse(self.source, filename=self.path)
+        return self._tree
+
+    def line(self, lineno: int) -> str:
+        """1-based source line ('' past EOF)."""
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    # Shared single-pass indexes: project-scope checkers iterate the
+    # whole tree every run; walking each file once and letting every
+    # checker reuse the result is what keeps `--changed` under its 2s
+    # budget.
+
+    @property
+    def nodes(self) -> List[ast.AST]:
+        """Flat ``ast.walk`` of the module, computed once."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    @property
+    def functions(self):
+        """[(qualname, class_name, funcdef)] for every def/async def,
+        nested included; qualname dot-joins classes and enclosing
+        functions."""
+        if self._functions is None:
+            self._functions = walk_functions(self.tree)
+        return self._functions
+
+    @property
+    def import_aliases(self) -> Dict[str, str]:
+        """local name -> dotted target for every import statement in
+        the file (lazy in-function imports included)."""
+        if self._aliases is None:
+            out: Dict[str, str] = {}
+            for node in self.nodes:
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        out[a.asname or a.name.split(".")[0]] = a.name
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for a in node.names:
+                        out[a.asname or a.name] = \
+                            f"{node.module}.{a.name}"
+            self._aliases = out
+        return self._aliases
+
+
+def walk_functions(tree: ast.Module):
+    """The canonical function traversal (``FileContext.functions``
+    caches its result; ``checkers._util.walk_functions`` delegates
+    here): [(qualname, class_name, funcdef)] for every def/async def,
+    nested included."""
+    out = []
+
+    def visit(node, prefix, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((q, cls, child))
+                visit(child, q + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", child.name)
+            else:
+                visit(child, prefix, cls)
+
+    visit(tree, "", None)
+    return out
+
+
+class Checker:
+    """Base class. Subclasses set the class attrs and implement the
+    method matching their ``scope``."""
+
+    name: str = ""
+    description: str = ""
+    scope: str = "file"            # "file" | "project"
+    version: int = 1               # bump to invalidate cached results
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        return []
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      root: str) -> List[Finding]:
+        return []
+
+    def extra_inputs(self, root: str) -> List[str]:
+        """Paths outside the scanned tree this checker reads (they
+        join the project digest, so editing them invalidates cached
+        project results)."""
+        return []
+
+
+_REGISTRY: Dict[str, Checker] = {}
+
+
+def register(cls):
+    """Class decorator adding one instance to the global registry."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"{cls.__name__} has no name")
+    if inst.name in _REGISTRY:
+        raise ValueError(f"duplicate checker {inst.name!r}")
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def _ensure_loaded() -> None:
+    # Import for the registration side effect (idempotent).
+    from skypilot_tpu.analysis import checkers  # noqa: F401
+
+
+def all_checkers() -> List[Checker]:
+    _ensure_loaded()
+    return [_REGISTRY[n] for n in sorted(_REGISTRY)]
+
+
+def get_checker(name: str) -> Checker:
+    _ensure_loaded()
+    return _REGISTRY[name]
+
+
+def default_files(root: Optional[str] = None) -> List[str]:
+    """Every ``skypilot_tpu/**/*.py`` under ``root``, repo-relative."""
+    root = root or repo_root()
+    pkg = os.path.join(root, "skypilot_tpu")
+    out = []
+    for dirpath, dirnames, names in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(names):
+            if name.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, name), root)
+                out.append(rel.replace(os.sep, "/"))
+    return sorted(out)
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[Finding]            # every raw finding
+    new: List[Finding]                 # beyond the baseline's budget
+    stale: List[str]                   # baseline keys no finding matches
+    unjustified: List[str]             # baseline keys without a reason
+    files_scanned: int = 0
+    files_from_cache: int = 0
+    partial: bool = False              # subset run: stale not computed
+
+    @property
+    def clean(self) -> bool:
+        """Gate verdict: no new findings, no rotted baseline."""
+        if self.partial:
+            return not (self.new or self.unjustified)
+        return not (self.new or self.stale or self.unjustified)
+
+
+def _project_digest(root: str, all_rel: List[str],
+                    project_checkers: Sequence[Checker]) -> str:
+    """Content digest of everything the project checkers can see."""
+    import hashlib
+    h = hashlib.sha256()
+    for c in sorted(project_checkers, key=lambda c: c.name):
+        h.update(f"{c.name}={c.version};".encode())
+        for extra in c.extra_inputs(root):
+            h.update(extra.encode())
+            try:
+                with open(extra, "rb") as f:
+                    h.update(hashlib.sha256(f.read()).digest())
+            except OSError:
+                h.update(b"<missing>")
+    for rel in all_rel:
+        h.update(rel.encode())
+        try:
+            with open(os.path.join(root, rel), "rb") as f:
+                h.update(hashlib.sha256(f.read()).digest())
+        except OSError:
+            h.update(b"<missing>")
+    return h.hexdigest()
+
+
+def _parse_error_finding(rel: str, exc: SyntaxError) -> Finding:
+    return Finding(checker="framework", rule="parse-error", path=rel,
+                   line=exc.lineno or 1,
+                   message=f"file does not parse: {exc.msg}",
+                   ident="parse-error",
+                   hint="fix the syntax error; nothing else was checked")
+
+
+def run(root: Optional[str] = None,
+        files: Optional[Iterable[str]] = None,
+        checkers: Optional[Sequence[str]] = None,
+        use_cache: bool = True,
+        baseline_path: Optional[str] = None,
+        cache_path: Optional[str] = None) -> AnalysisResult:
+    """Run the suite.
+
+    ``files``: repo-relative paths restricting the scan (``--changed``).
+    When given, the run is *partial*: project-scope checkers still scan
+    the whole tree (their findings are filtered to the subset) and
+    stale-baseline detection is skipped — a subset can't prove an
+    entry dead.
+    """
+    root = root or repo_root()
+    selected = all_checkers()
+    if checkers is not None:
+        want = set(checkers)
+        unknown = want - {c.name for c in selected}
+        if unknown:
+            raise ValueError(f"unknown checker(s): {sorted(unknown)}")
+        selected = [c for c in selected if c.name in want]
+        # A checker-subset run must not touch the shared cache: its
+        # digest covers only the selected checkers, so saving would
+        # clobber the full run's warm state (and a later full run
+        # would clobber it back — neither ever warm).
+        use_cache = False
+
+    all_rel = default_files(root)
+    if files is not None:
+        subset = {f.replace(os.sep, "/") for f in files}
+        target_rel = [r for r in all_rel if r in subset]
+        partial = True
+    else:
+        target_rel = all_rel
+        partial = False
+
+    ctxs: Dict[str, FileContext] = {}
+
+    def ctx_for(rel: str) -> FileContext:
+        if rel not in ctxs:
+            ctxs[rel] = FileContext(os.path.join(root, rel), rel)
+        return ctxs[rel]
+
+    file_checkers = [c for c in selected if c.scope == "file"]
+    project_checkers = [c for c in selected if c.scope == "project"]
+
+    cache = (cache_mod.Cache.load(cache_path, selected)
+             if use_cache else cache_mod.Cache.disabled())
+
+    findings: List[Finding] = []
+    files_from_cache = 0
+    broken: set = set()                # files that failed to parse
+
+    for rel in target_rel:
+        path = os.path.join(root, rel)
+        cached = cache.get(rel, path) if file_checkers else None
+        if cached is not None:
+            files_from_cache += 1
+            findings.extend(cached)
+            continue
+        ctx = ctx_for(rel)
+        try:
+            ctx.tree
+        except SyntaxError as e:
+            findings.append(_parse_error_finding(rel, e))
+            broken.add(rel)
+            continue
+        file_findings: List[Finding] = []
+        for checker in file_checkers:
+            file_findings.extend(checker.check_file(ctx))
+        cache.put(rel, path, file_findings)
+        findings.extend(file_findings)
+
+    if project_checkers:
+        # Cross-file results are cached under ONE digest over every
+        # scanned file's content (+ the checkers' extra inputs) — the
+        # only per-file key that is correct when editing file A can
+        # change file B's findings. A warm `--changed` run with a
+        # matching digest skips parsing the rest of the tree entirely.
+        digest = _project_digest(root, all_rel, project_checkers)
+        cached_project = cache.project_get(digest)
+        keep = set(target_rel)
+        if cached_project is not None:
+            project_findings = cached_project
+        else:
+            # Project checkers always see the full tree (minus files
+            # that don't parse) so reachability and catalogs stay
+            # whole.
+            project_ctxs = []
+            for rel in all_rel:
+                if rel in broken:
+                    continue
+                ctx = ctx_for(rel)
+                try:
+                    ctx.tree
+                except SyntaxError as e:
+                    if rel in target_rel:
+                        findings.append(_parse_error_finding(rel, e))
+                    broken.add(rel)
+                    continue
+                project_ctxs.append(ctx)
+            project_findings = []
+            for checker in project_checkers:
+                project_findings.extend(
+                    checker.check_project(project_ctxs, root))
+            cache.project_put(digest, project_findings)
+        for f in project_findings:
+            if f.path in keep or not partial:
+                findings.append(f)
+
+    cache.save()
+
+    base = baseline_mod.load(baseline_path
+                             or baseline_mod.default_path(root))
+    if checkers is not None:
+        # A subset run must not read the other checkers' baseline
+        # entries as stale — only they can prove themselves dead.
+        prefixes = tuple(f"{c.name}::" for c in selected)
+        base = {k: v for k, v in base.items()
+                if k.startswith(prefixes)}
+    new, stale, unjustified = baseline_mod.compare(findings, base)
+    if partial:
+        # Only STALE detection needs the full tree (a subset can't
+        # prove an entry dead); justification checks are
+        # subset-independent and stay on.
+        stale = []
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.rule))
+    new.sort(key=lambda f: (f.path, f.line, f.checker, f.rule))
+    return AnalysisResult(findings=findings, new=new, stale=stale,
+                          unjustified=unjustified,
+                          files_scanned=len(target_rel),
+                          files_from_cache=files_from_cache,
+                          partial=partial)
+
+
+def changed_files(root: Optional[str] = None) -> List[str]:
+    """Repo-relative paths of files changed vs HEAD (staged, unstaged,
+    and untracked) — the ``--changed`` file set. Falls back to the full
+    tree when git is unavailable."""
+    import subprocess
+    root = root or repo_root()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+            check=True).stdout.splitlines()
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+            check=True).stdout.splitlines()
+    except (OSError, subprocess.SubprocessError):
+        return default_files(root)
+    return sorted({p.strip() for p in diff + untracked
+                   if p.strip().endswith(".py")})

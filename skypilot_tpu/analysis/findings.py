@@ -1,0 +1,51 @@
+"""Typed finding objects.
+
+A finding's *identity* (``key``) deliberately excludes the line number:
+baselines must survive unrelated edits shifting code up or down. The
+key is ``checker::path::ident`` where ``ident`` is a checker-chosen
+stable token (enclosing function + pattern, attribute name, ...).
+Multiple findings can share a key — the baseline stores a *count* per
+key, the same budget semantics the pre-framework lints used — so a
+file may carry N grandfathered hits and the N+1th still fails.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    checker: str          # checker name (kebab-case)
+    rule: str             # rule id within the checker
+    path: str             # repo-relative posix path
+    line: int             # 1-based
+    message: str
+    ident: str            # stable identity token (no line numbers!)
+    hint: str = ""        # how to fix it
+    severity: str = ERROR
+    col: int = 0          # 0-based, best effort
+
+    @property
+    def key(self) -> str:
+        return f"{self.checker}::{self.path}::{self.ident}"
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: [{self.checker}/{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["key"] = self.key
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Finding":
+        d = {k: v for k, v in d.items() if k != "key"}
+        return Finding(**d)

@@ -83,9 +83,9 @@ class Injector:
         self.plan = Plan(seed=plan.seed, rules=[
             dataclasses.replace(r, hits=0, fired=0) for r in plan.rules])
         self.rng = random.Random(plan.seed)
-        self.fired: List[Dict[str, Any]] = []
-        self.observed: Dict[str, int] = {}
-        self.observations: List[Dict[str, Any]] = []
+        self.fired: List[Dict[str, Any]] = []         # guarded-by: _lock
+        self.observed: Dict[str, int] = {}            # guarded-by: _lock
+        self.observations: List[Dict[str, Any]] = []  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def point(self, name: str, ctx: Dict[str, Any]) -> None:

@@ -17,8 +17,10 @@ import yaml
 
 import skypilot_tpu as sky
 from skypilot_tpu import exceptions
-from skypilot_tpu.resources import Resources
-from skypilot_tpu.task import Task
+
+# NOTE: skypilot_tpu.task / .resources pull the catalog layer (pandas,
+# ~3s) — imported lazily inside the commands that build Tasks so
+# metadata commands (lint, status, top, trace) start fast.
 
 
 @click.group()
@@ -47,7 +49,8 @@ def _resource_overrides(accelerators: Optional[str],
 def _load_task(yaml_path: Optional[str], command: Optional[str],
                accelerators: Optional[str], cloud: Optional[str],
                num_nodes: Optional[int], use_spot: bool,
-               name: Optional[str]) -> Task:
+               name: Optional[str]) -> "sky.Task":
+    from skypilot_tpu.task import Task
     if yaml_path:
         task = Task.from_yaml(yaml_path)
     else:
@@ -692,6 +695,7 @@ def jobs_launch(yaml_or_command, name, accelerators, cloud, use_spot,
     from skypilot_tpu.jobs import core as jobs_core
     is_yaml = yaml_or_command.endswith((".yaml", ".yml")) or os.path.exists(
         yaml_or_command)
+    from skypilot_tpu.task import Task
     tasks = (Task.from_yaml_all(yaml_or_command) if is_yaml
              else [Task(run=yaml_or_command)])
     over = _resource_overrides(accelerators, cloud, use_spot, recovery)
@@ -763,6 +767,7 @@ def serve():
 def serve_up(yaml_path, service_name, lb_port):
     """Bring up a service from a task YAML with a service: section."""
     from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.task import Task
     task = Task.from_yaml(yaml_path)
     info = serve_core.up(task, service_name, lb_port=lb_port)
     click.echo(f"Service {service_name!r} starting; endpoint "
@@ -808,6 +813,7 @@ def serve_status(service_name):
 def serve_update(yaml_path, service_name):
     """Rolling-update a running service to a new task/spec version."""
     from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.task import Task
     task = Task.from_yaml(yaml_path)
     info = serve_core.update(task, service_name)
     click.echo(f"Service {service_name!r} updating to "
@@ -1166,6 +1172,130 @@ def chaos_points():
     click.echo(fmt.format("POINT", "WHERE / CONTEXT"))
     for name in sorted(chaos_lib.KNOWN_POINTS):
         click.echo(fmt.format(name, chaos_lib.KNOWN_POINTS[name]))
+
+
+@cli.command(name="lint")
+@click.argument("paths", nargs=-1)
+@click.option("--changed", is_flag=True, default=False,
+              help="Only files changed vs HEAD (plus untracked). "
+                   "Skips stale-baseline detection; <2s on a warm "
+                   "cache.")
+@click.option("--baseline-update", "baseline_update", is_flag=True,
+              default=False,
+              help="Rewrite lint_baseline.json so the current tree is "
+                   "exactly clean. Existing justifications are kept; "
+                   "new entries get a TODO the tier-1 gate rejects "
+                   "until a human writes the one-line reason.")
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="Machine-readable findings (one JSON object).")
+@click.option("--checker", "checker_names", multiple=True,
+              help="Run only these checkers (repeatable; see "
+                   "docs/analysis.md for the catalog).")
+@click.option("--no-cache", is_flag=True, default=False,
+              help="Ignore and don't write the per-file result cache.")
+def lint(paths, changed, baseline_update, as_json, checker_names,
+         no_cache):
+    """Static-analysis suite: retrace-safety, host-sync,
+    lock-discipline, typed-errors, event/metric hygiene.
+
+    Clean exit (0) means no findings beyond the checked-in baseline
+    and no rotted baseline entries. See docs/analysis.md.
+    """
+    import json as json_lib
+
+    from skypilot_tpu import analysis
+    from skypilot_tpu.analysis import baseline as baseline_lib
+    from skypilot_tpu.analysis import core as analysis_core
+
+    root = analysis_core.repo_root()
+    files = None
+    if changed and paths:
+        raise click.ClickException(
+            "pass --changed or explicit paths, not both")
+    if baseline_update and (changed or paths or checker_names):
+        # A subset run sees a subset of findings; regenerating the
+        # baseline from it would silently delete every other entry
+        # (and its hand-written justification).
+        raise click.ClickException(
+            "--baseline-update requires a full run (no --changed, "
+            "paths, or --checker)")
+    if changed:
+        files = analysis_core.changed_files(root)
+        if not files:
+            click.echo("lint: no changed files.")
+            return
+    elif paths:
+        files = []
+        for p in paths:
+            ap = os.path.abspath(p)
+            if os.path.isdir(ap):
+                for dirpath, dirnames, names in os.walk(ap):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"]
+                    files.extend(
+                        os.path.relpath(os.path.join(dirpath, n), root)
+                        for n in names if n.endswith(".py"))
+            else:
+                files.append(os.path.relpath(ap, root))
+    try:
+        res = analysis.run(root=root, files=files,
+                           checkers=list(checker_names) or None,
+                           use_cache=not no_cache)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    if paths and res.files_scanned == 0:
+        # A typo'd path in a hook must not make the gate pass
+        # vacuously forever.
+        raise click.ClickException(
+            "none of the given paths resolve to lintable files "
+            "(the suite scans skypilot_tpu/**/*.py)")
+
+    if baseline_update:
+        bp = baseline_lib.default_path(root)
+        old = baseline_lib.load(bp)
+        entries = baseline_lib.updated(res.findings, old)
+        baseline_lib.save(bp, entries)
+        todo = [k for k, e in entries.items()
+                if e["justification"].startswith("TODO")]
+        click.echo(f"lint: baseline rewritten with {len(entries)} "
+                   f"entr{'y' if len(entries) == 1 else 'ies'} "
+                   f"({len(res.findings)} findings).")
+        if todo:
+            click.echo("lint: entries needing a justification "
+                       "(the tier-1 gate rejects TODOs):")
+            for k in todo:
+                click.echo(f"  {k}")
+        return
+
+    if as_json:
+        click.echo(json_lib.dumps({
+            "findings": [f.to_dict() for f in res.new],
+            "baselined": len(res.findings) - len(res.new),
+            "stale_baseline": res.stale,
+            "unjustified_baseline": res.unjustified,
+            "files_scanned": res.files_scanned,
+            "files_from_cache": res.files_from_cache,
+            "clean": res.clean,
+        }, indent=1))
+    else:
+        for f in res.new:
+            click.echo(f.format())
+        for k in res.stale:
+            click.echo(f"stale baseline entry (finding fixed or file "
+                       f"renamed — remove it): {k}")
+        for k in res.unjustified:
+            click.echo(f"baseline entry without a justification: {k}")
+        n_base = len(res.findings) - len(res.new)
+        click.echo(f"lint: {res.files_scanned} files "
+                   f"({res.files_from_cache} cached), "
+                   f"{len(res.new)} finding"
+                   f"{'' if len(res.new) == 1 else 's'}, "
+                   f"{n_base} baselined"
+                   + (f", {len(res.stale)} stale baseline"
+                      if res.stale else "")
+                   + (" [partial run]" if res.partial else ""))
+    if not res.clean:
+        sys.exit(1)
 
 
 def main():

@@ -33,8 +33,8 @@ import yaml
 from skypilot_tpu.utils import paths
 
 _lock = threading.Lock()
-_loaded: Optional[Dict[str, Any]] = None
-_loaded_path: Optional[str] = None
+_loaded: Optional[Dict[str, Any]] = None   # guarded-by: _lock
+_loaded_path: Optional[str] = None         # guarded-by: _lock
 _overrides = threading.local()
 
 

@@ -31,7 +31,6 @@ import argparse
 import json
 import os
 import queue
-import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
@@ -122,7 +121,7 @@ class ModelServer:
         self.open_window_s = open_window_s
         # Monotonic: an NTP step must not pin the window open (short
         # bursts forever) or spuriously slam it shut.
-        self._last_arrival = 0.0
+        self._last_arrival = 0.0     # guarded-by: _inbox_lock
         # Double-buffered decode (engines exposing the async pair):
         # burst k+1 is dispatched BEFORE burst k's tokens are fetched
         # and streamed, so the TPU decodes k+1 while this thread does
@@ -136,7 +135,7 @@ class ModelServer:
         # apart — one recovers by waiting, one needs replacement).
         self.health_reason = "warming"
         self._inbox_lock = threading.Lock()
-        self._inbox: list = []
+        self._inbox: list = []        # guarded-by: _inbox_lock
         self._pending: Dict[int, _Pending] = {}   # loop-thread only
         self._ready = threading.Event()
         self._stop = threading.Event()
@@ -208,7 +207,8 @@ class ModelServer:
                 self.engine.generate([[1]], max_new_tokens=2)
             self.engine.finished.clear()
         except Exception as e:  # noqa: BLE001
-            print(f"model server warmup failed: {e}", file=sys.stderr)
+            tracing.add_event("server.warmup_failed",
+                              {"error": str(e)}, echo=True)
         self.health_reason = ""
         self._ready.set()
         while not self._stop.is_set():
@@ -229,8 +229,8 @@ class ModelServer:
                 try:
                     self.engine.reset()
                 except Exception as e2:  # noqa: BLE001
-                    print(f"engine reset failed, marking unhealthy: "
-                          f"{e2}", file=sys.stderr)
+                    tracing.add_event("server.engine_reset_failed",
+                                      {"error": str(e2)}, echo=True)
                     self.health_reason = "engine reset failed"
                     self._ready.clear()
                 for p in self._pending.values():
@@ -652,7 +652,8 @@ def main() -> None:
                          open_burst=args.open_burst,
                          open_window_s=args.open_window,
                          coalesce_s=args.coalesce)
-    print(f"serving on :{args.port}", file=sys.stderr, flush=True)
+    tracing.add_event("server.listening", {"port": args.port},
+                      echo=True)
     try:
         httpd.serve_forever()
     finally:

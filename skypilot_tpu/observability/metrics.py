@@ -200,7 +200,7 @@ class Metric:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
-        self._children: Dict[Tuple[str, ...], _Child] = {}
+        self._children: Dict[Tuple[str, ...], _Child] = {}  # guarded-by: _lock
         if not self.labelnames:
             # Unlabeled metric: one implicit child so .inc()/.set()/
             # .observe() work directly on the family.
@@ -362,7 +362,7 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, Metric] = {}
+        self._metrics: Dict[str, Metric] = {}  # guarded-by: _lock
 
     def register(self, metric: Metric) -> Metric:
         with self._lock:

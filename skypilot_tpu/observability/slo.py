@@ -288,8 +288,8 @@ class Watchdog:
         self.interval_s = interval_s
         self._snapshot_fn = snapshot_fn or self._default_snapshot
         self._history_s = history_s
-        self._history: List[Snapshot] = []
-        self._active: Dict[str, Dict[str, Any]] = {}
+        self._history: List[Snapshot] = []            # guarded-by: _lock
+        self._active: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -144,12 +144,13 @@ def process_name() -> str:
 
 _lock = threading.Lock()
 _flush_lock = threading.Lock()       # serializes writers of the log file
-_records: List[Dict[str, Any]] = []
-_seq = 0
-_flushed_seq = 0
-_last_flush_s = 0.0
-_registered = False
-_log_name: Optional[str] = None      # stable per process incarnation
+_records: List[Dict[str, Any]] = []  # guarded-by: _lock
+_seq = 0                             # guarded-by: _lock
+_flushed_seq = 0                     # guarded-by: _lock
+_last_flush_s = 0.0                  # guarded-by: _lock
+_registered = False                  # guarded-by: _lock
+# Stable per process incarnation.    # guarded-by: _lock
+_log_name: Optional[str] = None
 
 
 def enabled() -> bool:
@@ -241,7 +242,7 @@ def flush_periodic(min_new_records: int = 128,
     flush()
 
 
-_flush_thread: Optional[threading.Thread] = None
+_flush_thread: Optional[threading.Thread] = None  # guarded-by: _lock
 
 
 def ensure_flush_thread(interval_s: float = 5.0) -> None:

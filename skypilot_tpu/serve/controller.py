@@ -15,7 +15,7 @@ import subprocess
 import sys
 import time
 
-from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.serve import autoscalers, replica_managers, serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
@@ -47,7 +47,8 @@ def _publish_metrics(service_name: str) -> None:
 def run(service_name: str) -> int:
     rec = serve_state.get_service(service_name)
     if rec is None:
-        print(f"no service {service_name}", file=sys.stderr)
+        tracing.add_event("serve.controller_no_service",
+                          {"service": service_name}, echo=True)
         return 1
     spec = SkyServiceSpec.from_yaml_config(rec["spec"])
     manager = replica_managers.ReplicaManager(
@@ -105,8 +106,10 @@ def run(service_name: str) -> int:
                 autoscaler = autoscalers.Autoscaler.from_spec(spec)
                 manager.apply_update(spec, rec["task_config"],
                                      rec["version"])
-                print(f"rolling update to version {rec['version']}",
-                      flush=True)
+                tracing.add_event(
+                    "serve.rolling_update",
+                    {"service": service_name,
+                     "version": rec["version"]}, echo=True)
             manager.probe_all()
             replicas = serve_state.list_replicas(service_name)
             ready = [r for r in replicas

@@ -18,7 +18,7 @@ from typing import Dict, List, Optional
 from skypilot_tpu import chaos, exceptions, execution
 from skypilot_tpu import state as cluster_state
 from skypilot_tpu.backend import ClusterHandle, TpuVmBackend
-from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
@@ -90,7 +90,7 @@ class ReplicaManager:
             or [0])
         self._probe_failures: Dict[int, int] = {}
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
-        self._launching: Dict[int, bool] = {}   # rid -> is_spot
+        self._launching: Dict[int, bool] = {}   # rid -> is_spot; guarded-by: _lock
         self._lock = threading.Lock()
         # With a mixed-fleet autoscaler the controller owns replacement
         # decisions (preempted spot may come back as on-demand); the
@@ -215,7 +215,10 @@ class ReplicaManager:
                                        version=version,
                                        is_spot=bool(use_spot))
         except Exception as e:  # noqa: BLE001 — replica failure is a state
-            print(f"replica {rid} launch failed: {e}", flush=True)
+            tracing.add_event(
+                "serve.replica_launch_failed",
+                {"service": self.service, "replica": rid,
+                 "error": str(e)}, echo=True)
             serve_state.upsert_replica(self.service, rid, cluster,
                                        ReplicaStatus.FAILED, None,
                                        version=version,

@@ -43,6 +43,7 @@ class SchemaVersionError(RuntimeError):
 # suites churn through tmp homes and must not leak fds.
 _MAX_KEEPERS = 8
 _keeper_lock = threading.Lock()
+# guarded-by: _keeper_lock
 _keepers: "collections.OrderedDict[str, sqlite3.Connection]" = \
     collections.OrderedDict()
 

@@ -136,8 +136,8 @@ class CircuitBreaker:
         self.name = name
         self.failure_threshold = failure_threshold
         self.reset_after_s = reset_after_s
-        self._failures = 0
-        self._opened_at: Optional[float] = None
+        self._failures = 0                    # guarded-by: _lock
+        self._opened_at: Optional[float] = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def allow(self) -> bool:

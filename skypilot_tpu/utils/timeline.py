@@ -29,14 +29,14 @@ from typing import Any, Callable, Dict, List, Optional
 
 ENV_VAR = "SKYTPU_TIMELINE_FILE_PATH"
 
-_events: List[Dict[str, Any]] = []
+_events: List[Dict[str, Any]] = []   # guarded-by: _lock
 _lock = threading.Lock()
 _flush_lock = threading.Lock()   # serializes writers of the trace file
 _registered = False
-_named_tids: Dict[int, str] = {}   # tid -> last emitted thread name
-_seq = 0                   # bumped per append; lets _save skip clean buffers
-_flushed_seq = 0
-_last_flush_s = 0.0        # monotonic time of the last successful flush
+_named_tids: Dict[int, str] = {}     # guarded-by: _lock
+_seq = 0                             # guarded-by: _lock
+_flushed_seq = 0                     # guarded-by: _lock
+_last_flush_s = 0.0                  # guarded-by: _lock
 # Long-lived daemons flush every tick; without a cap the buffer (and
 # each flush's serialization cost) grows for the life of the process.
 _MAX_EVENTS = 200_000

@@ -1,0 +1,14 @@
+# Golden fixture: hand-rolled retry + broad swallow. Never imported.
+import time
+
+
+def flaky(op):
+    for _ in range(3):
+        try:
+            return op()
+        except OSError:
+            time.sleep(1.0)               # expect: sleep-in-except
+    try:
+        op.cleanup()
+    except Exception:                     # expect: except-pass
+        pass

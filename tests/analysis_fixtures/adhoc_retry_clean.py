@@ -1,0 +1,19 @@
+# Clean twin: retry through the policy module; narrow catches.
+from skypilot_tpu.utils import retry
+
+
+def flaky(op):
+    policy = retry.RetryPolicy(max_attempts=3, retry_on=(OSError,))
+    try:
+        return retry.call(op, policy=policy, name="fixture")
+    except OSError as e:
+        return {"error": str(e)}
+
+
+def cleanup(op):
+    with_lock = None
+    try:
+        op.cleanup()
+    except OSError:
+        pass   # narrow type: allowed
+    return with_lock

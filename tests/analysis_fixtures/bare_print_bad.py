@@ -1,0 +1,8 @@
+# Golden fixture: daemon prints (checked as if in skypilot_tpu/
+# runtime/). Never imported.
+import sys
+
+
+def tick(err):
+    print(f"heartbeat failed: {err}")        # expect: bare-print
+    print("retrying", file=sys.stderr)       # expect: bare-print

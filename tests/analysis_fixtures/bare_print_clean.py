@@ -1,0 +1,7 @@
+# Clean twin: structured events reach the recorder AND stderr.
+from skypilot_tpu.observability import tracing
+
+
+def tick(err):
+    tracing.add_event("skylet.heartbeat_failed",
+                      {"error": str(err)}, echo=True)

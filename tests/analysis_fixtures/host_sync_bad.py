@@ -1,0 +1,19 @@
+# Golden fixture: seeded host-sync violations. Checked as if it were
+# skypilot_tpu/infer/engine.py (the hot-loop scope). Never imported.
+import jax
+import numpy as np
+
+
+class InferenceEngine:
+    def step_burst(self, max_burst=8):
+        toks = self._decode_fn()
+        toks.block_until_ready()          # expect: host-sync
+        vals = np.asarray(toks)           # expect: host-sync
+        first = int(toks[0])              # expect: host-sync
+        loss = toks.item()                # expect: host-sync
+        got = jax.device_get(toks)        # expect: host-sync
+        return vals, first, loss, got
+
+    def unscoped_helper(self, x):
+        # Not a hot-loop method: fetches are allowed here.
+        return np.asarray(x)

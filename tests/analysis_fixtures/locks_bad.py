@@ -1,0 +1,39 @@
+# Golden fixture: seeded lock-discipline violations. Never imported.
+import json
+import threading
+import time
+
+_lock = threading.Lock()
+_ring = []                              # guarded-by: _lock
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []                  # guarded-by: _lock
+        self._buf.append("init ok")     # __init__ is construction
+
+    def ok(self, rec):
+        with self._lock:
+            self._buf.append(rec)
+
+    def bad_append(self, rec):
+        self._buf.append(rec)           # expect: guarded-mutation
+
+    def bad_swap(self, rec):
+        out, self._buf = self._buf, []  # expect: guarded-mutation
+        return out
+
+    def bad_flush(self):
+        with self._lock:
+            return json.dumps(self._buf)  # expect: blocking-under-lock
+
+
+def record(rec):
+    _ring.append(rec)                   # expect: guarded-mutation
+
+
+def drain_slowly():
+    with _lock:
+        time.sleep(0.1)                 # expect: blocking-under-lock
+        del _ring[:]

@@ -1,0 +1,34 @@
+# Clean twin: mutations under the declared lock; slow work outside it.
+import json
+import threading
+
+_lock = threading.Lock()
+_ring = []                              # guarded-by: _lock
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []                  # guarded-by: _lock
+
+    def ok(self, rec):
+        with self._lock:
+            self._buf.append(rec)
+
+    def flush(self):
+        with self._lock:
+            snapshot = list(self._buf)  # reads are free
+        return json.dumps(snapshot)     # serialization OUTSIDE
+
+
+def record(rec):
+    with _lock:
+        _ring.append(rec)
+
+
+def on_callback():
+    with _lock:
+        # A callback DEFINED under a lock does not run under it.
+        def later():
+            json.dumps({"a": 1})
+        _ring.append(later)

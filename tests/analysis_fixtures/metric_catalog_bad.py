@@ -1,0 +1,9 @@
+# Golden fixture: metric naming/catalog drift. Checked against a
+# synthetic docs catalog that documents only skytpu_documented_total.
+from skypilot_tpu.observability import metrics
+
+OK = metrics.counter("skytpu_documented_total", "in the catalog")
+BAD_PREFIX = metrics.counter(  # expect: bad-prefix, undocumented
+    "prefixless_total", "x")
+BAD_DOC = metrics.gauge(       # expect: undocumented
+    "skytpu_not_in_docs", "x")

@@ -1,0 +1,5 @@
+# Clean twin: prefixed and documented.
+from skypilot_tpu.observability import metrics
+
+OK = metrics.counter("skytpu_documented_total", "in the catalog")
+ALSO_OK = metrics.histogram("skytpu_documented_seconds", "also there")

@@ -1,0 +1,27 @@
+# Golden fixture: seeded retrace-safety violations. Checked as if it
+# lived at skypilot_tpu/infer/ (a jit-root directory). Never imported.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def decode(cache, toks, *, k):
+    if (toks > 0).any():                  # expect: traced-branch
+        toks = toks + 1
+    n = int(toks[0])                      # expect: concretize
+    host = np.asarray(toks)               # expect: host-transfer
+    pad = jnp.zeros(jnp.sum(toks))        # expect: dynamic-shape
+    return _helper(cache, toks), n, host, pad
+
+
+def _helper(cache, toks):
+    # Reached from the jitted root through the call graph.
+    return toks.item()                    # expect: concretize
+
+
+def never_jitted(x):
+    # Unreachable from any root: host code may concretize freely.
+    return int(x[0])

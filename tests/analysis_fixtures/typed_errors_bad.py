@@ -1,0 +1,12 @@
+# Golden fixture: generic raises on a request path (checked as if in
+# skypilot_tpu/server/). Never imported.
+
+
+def handle(req):
+    if req is None:
+        raise RuntimeError("no request")     # expect: generic-raise
+    if req == "boom":
+        raise Exception("opaque")            # expect: generic-raise
+    if not isinstance(req, dict):
+        raise ValueError("narrow builtins stay allowed")
+    return req

@@ -1,0 +1,16 @@
+# Clean twin: typed errors with a typed_error body.
+
+
+class PromptTooLongError(ValueError):
+    def __init__(self, n, cap):
+        super().__init__(f"{n} > {cap}")
+        self.typed_error = {"type": "prompt_too_long",
+                            "prompt_len": n, "max_prompt_len": cap}
+
+
+def handle(req):
+    if req is None:
+        raise ValueError("no request")
+    if len(req) > 128:
+        raise PromptTooLongError(len(req), 128)
+    return req

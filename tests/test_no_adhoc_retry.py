@@ -1,134 +1,65 @@
 """Lint: all retrying goes through ``utils/retry.py``.
 
-Two patterns are rejected anywhere under ``skypilot_tpu/``:
+Thin wrapper over the ``adhoc-retry`` checker in
+``skypilot_tpu/analysis`` (see docs/analysis.md). Rejected patterns
+are unchanged from the original standalone lint:
 
-1. ``time.sleep`` (any ``*.sleep(...)`` call) lexically inside an
-   ``except`` handler that sits inside a loop — the signature of a
-   hand-rolled retry/backoff loop. Those loops each reinvent backoff
-   math and deadline handling, which is exactly what made recovery
-   behavior untestable before the chaos layer; route them through
-   ``retry.call`` / ``retry.pause`` instead.
-2. Broad swallow-and-continue: ``except Exception:`` (or a bare
-   ``except:``) whose body is only ``pass`` — it silently eats the
-   failures the chaos harness injects. Catch the narrow type, or
-   record a typed event before continuing.
+1. ``time.sleep`` inside an ``except`` handler inside a loop — a
+   hand-rolled retry/backoff loop; route through ``retry.call`` /
+   ``retry.pause``.
+2. Broad ``except Exception:``/bare ``except:`` whose body is only
+   ``pass`` — silently eats the failures the chaos harness injects.
 
-A fixed allowlist grandfathers pre-policy call sites; do NOT add
-entries — new code starts at zero.
+The fixed allowlists became ``lint_baseline.json`` entries with the
+same budgets; stale-baseline detection replaces the old
+entries-still-exist test.
 """
 
-import ast
 import os
 
-import pytest
+from skypilot_tpu import analysis
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "skypilot_tpu")
-
-# path (relative to skypilot_tpu/) -> max allowed hits.
-SLEEP_ALLOWLIST = {
-    # `skytpu top`'s DOWN-frame render loop: the "retry" is the live
-    # monitoring view itself surviving an API-server outage.
-    "client/cli.py": 1,
-    # The flock acquisition poll inside the lock primitive — the
-    # bottom of the stack the retry module itself sits on.
-    "utils/timeline.py": 1,
-}
-EXCEPT_PASS_ALLOWLIST = {
-    "benchmark/benchmark_utils.py": 1,
-    "runtime/driver.py": 1,
-    "observability/aggregate.py": 1,
-    "observability/health.py": 1,
-    "usage/usage_lib.py": 1,
-    "provision/gcp_auth.py": 2,
-}
 
 
-def _scan(path):
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    sleeps, passes = [], []
-
-    def in_handler_sleeps(handler):
-        for sub in ast.walk(handler):
-            if (isinstance(sub, ast.Call)
-                    and isinstance(sub.func, ast.Attribute)
-                    and sub.func.attr == "sleep"):
-                yield sub.lineno
-
-    def walk(node, loop_depth):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
-                walk(child, loop_depth + 1)
-                continue
-            if isinstance(child, ast.ExceptHandler):
-                broad = child.type is None or (
-                    isinstance(child.type, ast.Name)
-                    and child.type.id in ("Exception", "BaseException"))
-                if broad and all(isinstance(s, ast.Pass)
-                                 for s in child.body):
-                    passes.append(child.lineno)
-                if loop_depth > 0:
-                    sleeps.extend(in_handler_sleeps(child))
-                    continue   # already scanned the whole handler
-            # A nested def/lambda resets loop context: a sleep inside a
-            # callback defined within a loop is not this loop's retry.
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda)):
-                walk(child, 0)
-            else:
-                walk(child, loop_depth)
-
-    walk(tree, 0)
-    return sleeps, passes
+def _run():
+    return analysis.run(root=REPO, checkers=["adhoc-retry"],
+                        use_cache=False)
 
 
-def _files():
-    for dirpath, _, names in os.walk(PKG):
-        if "__pycache__" in dirpath:
-            continue
-        for name in sorted(names):
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
+def test_no_adhoc_retry_or_broad_swallow():
+    res = _run()
+    assert not res.new, (
+        "ad-hoc retry loop or broad except-pass — use "
+        "skypilot_tpu.utils.retry (retry.call / retry.pause) and "
+        "narrow catches:\n  "
+        + "\n  ".join(f.format() for f in res.new))
 
 
-def test_no_sleep_in_except_retry_loops():
-    violations = []
-    for path in _files():
-        rel = os.path.relpath(path, PKG)
-        if rel == os.path.join("utils", "retry.py"):
-            continue   # the policy module IS the allowed sleeper
-        sleeps, _ = _scan(path)
-        if len(sleeps) > SLEEP_ALLOWLIST.get(rel, 0):
-            violations.append(f"{rel}: sleep inside except at lines "
-                              f"{sleeps} (allowed: "
-                              f"{SLEEP_ALLOWLIST.get(rel, 0)})")
-    assert not violations, (
-        "ad-hoc retry loop (time.sleep inside an except handler inside "
-        "a loop) — use skypilot_tpu.utils.retry (retry.call / "
-        "retry.pause) so backoff, deadlines, and telemetry stay "
-        "uniform:\n  " + "\n  ".join(violations))
+def test_grandfathered_budgets_not_rotted():
+    res = _run()
+    assert not res.stale, (
+        "stale adhoc-retry baseline entries (remove them from "
+        f"lint_baseline.json): {res.stale}")
+    assert not res.unjustified, (
+        f"adhoc-retry baseline entries lack justification: "
+        f"{res.unjustified}")
 
 
-def test_no_broad_except_pass():
-    violations = []
-    for path in _files():
-        rel = os.path.relpath(path, PKG)
-        _, passes = _scan(path)
-        if len(passes) > EXCEPT_PASS_ALLOWLIST.get(rel, 0):
-            violations.append(f"{rel}: broad except-pass at lines "
-                              f"{passes} (allowed: "
-                              f"{EXCEPT_PASS_ALLOWLIST.get(rel, 0)})")
-    assert not violations, (
-        "`except Exception: pass` swallows the failures the chaos "
-        "harness injects — catch the narrow type or record a typed "
-        "event:\n  " + "\n  ".join(violations))
-
-
-@pytest.mark.parametrize("rel", sorted({**SLEEP_ALLOWLIST,
-                                        **EXCEPT_PASS_ALLOWLIST}))
-def test_allowlist_entries_still_exist(rel):
-    """A renamed/cleaned-up file must drop its allowlist entry, or the
-    budget silently covers a future regression elsewhere."""
-    assert os.path.exists(os.path.join(PKG, rel)), (
-        f"{rel} gone — remove its allowlist entry")
+def test_retry_module_is_the_allowed_sleeper():
+    """utils/retry.py IS the policy module: its sleeps never flag."""
+    from skypilot_tpu.analysis.core import FileContext, get_checker
+    src = ("import time\n"
+           "def call(op):\n"
+           "    for _ in range(3):\n"
+           "        try:\n"
+           "            return op()\n"
+           "        except OSError:\n"
+           "            time.sleep(1)\n")
+    checker = get_checker("adhoc-retry")
+    inside = checker.check_file(FileContext(
+        "<fixture>", "skypilot_tpu/utils/retry.py", source=src))
+    assert not inside
+    outside = checker.check_file(FileContext(
+        "<fixture>", "skypilot_tpu/utils/other.py", source=src))
+    assert [f.rule for f in outside] == ["sleep-in-except"]
