@@ -349,6 +349,32 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"prefix-share bench failed: {e}")
             out["serve_prefix_error"] = str(e)[:200]
+        # Paged KV-cache occupancy phase: max concurrent slots at the
+        # SAME KV HBM bytes, paged vs contiguous, with greedy parity —
+        # the >=4x-slots-at-equal-HBM claim tracked release over
+        # release (plus blocks/token so allocator efficiency is too).
+        try:
+            from skypilot_tpu.infer import bench_serve as _bs
+            oc = _bs.run_occupancy(config=serve_cfg, weights_int8=big,
+                                   kv_int8=big)
+            out["serve_kv_hbm_bytes"] = oc["kv_hbm_bytes"]
+            out["serve_slots"] = oc["paged_slots"]
+            out["serve_slots_contiguous"] = oc["contiguous_slots"]
+            out["serve_blocks_per_token"] = oc["blocks_per_token"]
+            out["serve_kv_block"] = oc["kv_block"]
+            out["serve_occupancy_x"] = oc["occupancy_x"]
+            out["serve_paged_parity_ok"] = oc["parity_ok"]
+            # Gate: >=4x slots at equal HBM, bit-equal greedy output.
+            out["serve_occupancy_regressed"] = oc["occupancy_regressed"]
+            if oc["occupancy_regressed"]:
+                log("SERVE OCCUPANCY REGRESSION: "
+                    f"{oc['paged_slots']} paged vs "
+                    f"{oc['contiguous_slots']} contiguous slots "
+                    f"(x{oc['occupancy_x']}, "
+                    f"parity_ok={oc['parity_ok']})")
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"occupancy bench failed: {e}")
+            out["serve_occupancy_error"] = str(e)[:200]
     if args.emit_metrics:
         from skypilot_tpu.observability import metrics as obs_metrics
         # Only families something actually recorded into: a bench run

@@ -31,6 +31,11 @@ _SCOPES: Dict[str, Set[str]] = {
         "prefill_chunk_step", "run_to_completion", "_admit", "admit",
         "_dispatch_wave", "_complete_wave", "_claim_chunked",
         "_maybe_store_prefix",
+        # Paged-KV block management (PR 7): all host-side numpy/list
+        # bookkeeping — a device fetch here would drain the dispatch
+        # pipeline once per claim/retire.
+        "table_device", "_alloc_blocks", "_wave_claim",
+        "_free_slot_blocks", "_need_blocks",
     },
     "skypilot_tpu/infer/server.py": {
         "_loop", "_step", "_drain_inbox", "_flush_streams",
@@ -52,7 +57,8 @@ class HostSyncChecker(Checker):
                    ".item, int()/float() fetches) inside the engine "
                    "step/burst/chunk loops and the trainer step path")
     scope = "file"
-    version = 1
+    # v2: paged-KV block-management methods joined the engine scope.
+    version = 2
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

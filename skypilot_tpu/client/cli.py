@@ -366,15 +366,19 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
                                             "skytpu_ttft_seconds", 0.95)
         slots = gauge("skytpu_slots_active")
         slots_total = gauge("skytpu_slots_total")
-        lines.append(
-            f"serve   req {f_rate(rate('skytpu_http_requests_total'))}"
-            f"  5xx {f_rate(rate_prefix('skytpu_http_requests_total', 'code', '5'))}"
-            f"  ttft p95 {f_ms(ttft)}"
-            f"  slots {slots:.0f}/{slots_total:.0f}"
-            if slots is not None and slots_total else
+        line = (
             f"serve   req {f_rate(rate('skytpu_http_requests_total'))}"
             f"  5xx {f_rate(rate_prefix('skytpu_http_requests_total', 'code', '5'))}"
             f"  ttft p95 {f_ms(ttft)}")
+        if slots is not None and slots_total:
+            line += f"  slots {slots:.0f}/{slots_total:.0f}"
+        # Paged KV-cache block occupancy (docs/serving.md): how full
+        # the shared block pool is across the fleet's engines.
+        kv_used = gauge("skytpu_kv_blocks_used")
+        kv_total = gauge("skytpu_kv_blocks_total")
+        if kv_used is not None and kv_total:
+            line += f"  kv {kv_used:.0f}/{kv_total:.0f}"
+        lines.append(line)
     if "skytpu_lb_proxied_total" in have:
         lines.append(
             f"lb      proxied {f_rate(rate('skytpu_lb_proxied_total'))}"

@@ -706,6 +706,125 @@ def run_smoke() -> dict:
     return run_prefix_share(smoke=True)
 
 
+def run_occupancy(config=None, smoke=False, kv_int8=False,
+                  weights_int8=False, factor=8, max_burst=4) -> dict:
+    """High-occupancy decode sweep: max concurrent decode slots at the
+    SAME KV HBM bytes, paged block-table cache vs the contiguous
+    layout.
+
+    The workload is the shape paging exists for: requests needing
+    max_len/8 rows each (prompt + full token budget) against an engine
+    sized for max_len worst cases. The contiguous engine's slot count
+    is pinned by HBM/max_len; the paged engine gets the IDENTICAL pool
+    bytes ((slots+1) * max_len rows worth of blocks) and ``factor`` x
+    the slots — admission itself proves the blocks suffice, and the
+    greedy outputs must match the contiguous engine token-for-token
+    (the paged-vs-contiguous parity gate, at full occupancy).
+    ``serve_blocks_per_token`` reports allocated-block rows per
+    resident token at peak (eager allocation: the over-reservation a
+    lazy allocator would shave).
+    """
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.infer import engine as eng
+    from skypilot_tpu.models import llama
+
+    on_cpu = jax.default_backend() == "cpu"
+    if config is None:
+        config = "llama3-tiny" if on_cpu else "llama3-400m"
+    small = smoke or on_cpu
+    cfg = llama.CONFIGS[config]
+    max_len = 64 if small else 4096
+    kv_block = 8 if small else 256
+    plen = 4 if small else 256
+    new_tokens = max_len // 8 - plen
+    slots_c = 2 if small else 8
+    requests = slots_c * factor
+    log(f"occupancy bench: {config} max_len={max_len} "
+        f"block={kv_block} need={plen + new_tokens} rows/req")
+
+    if weights_int8:
+        from skypilot_tpu.infer import kvcache
+        params, qw = kvcache.random_quantized_params(cfg)
+    else:
+        params, qw = llama.init_params(jax.random.key(0), cfg), None
+    kw = dict(max_len=max_len, prompt_buckets=(plen,),
+              kv_int8=kv_int8, qweights=qw, prefill_chunk=0,
+              prefix_pool=0, max_wave=8, pad_waves=True)
+    nb = max_len // kv_block
+    e_paged = eng.InferenceEngine(params, cfg,
+                                  n_slots=slots_c * factor,
+                                  kv_block=kv_block,
+                                  kv_blocks=(slots_c + 1) * nb, **kw)
+    e_contig = eng.InferenceEngine(params, cfg, n_slots=slots_c,
+                                   kv_block=0, **kw)
+
+    def kv_bytes(e):
+        return sum(int(e.cache[n].nbytes)
+                   for n in ("k", "v", "k_scale", "v_scale")
+                   if n in e.cache)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, plen).tolist()
+               for _ in range(requests)]
+
+    def drive(e):
+        ids = [e.add_request(p, max_new_tokens=new_tokens)
+               for p in prompts]
+        peak, bpt = 0, None
+        while e.waiting or e.chunking or e.slot_req:
+            # Occupancy is sampled right after admission — before the
+            # decode burst can retire short requests — so the peak is
+            # the number of requests the cache actually held at once.
+            e.admit()
+            while e.chunking:
+                e.prefill_chunk_step()
+            occ = len(e.slot_req)
+            if occ >= peak:
+                peak = occ
+                if e.paged:
+                    toks = sum(len(r.prompt) + len(r.tokens)
+                               for r in e.slot_req.values())
+                    bpt = (e.blocks_used * e.kv_block
+                           / max(toks, 1))
+            e.decode_burst(max_burst=max_burst)
+        by_rid = {r.rid: r.tokens for r in e.finished}
+        e.finished.clear()
+        return [by_rid[i] for i in ids], peak, bpt
+
+    out_c, peak_c, _ = drive(e_contig)
+    out_p, peak_p, bpt = drive(e_paged)
+    parity_ok = out_p == out_c
+    leak_free = e_paged.blocks_used == 0
+    bytes_p, bytes_c = kv_bytes(e_paged), kv_bytes(e_contig)
+    occupancy_x = peak_p / max(peak_c, 1)
+    log(f"occupancy: contiguous {peak_c} slots vs paged {peak_p} "
+        f"at {bytes_p / 1e6:.1f} MB KV ({occupancy_x:.1f}x, "
+        f"parity={parity_ok})")
+    return {
+        "kv_hbm_bytes": bytes_p,
+        "kv_hbm_bytes_contiguous": bytes_c,
+        "same_hbm": bool(bytes_p == bytes_c),
+        "paged_slots": peak_p,
+        "contiguous_slots": peak_c,
+        "occupancy_x": round(occupancy_x, 2),
+        "blocks_per_token": round(bpt, 3) if bpt else None,
+        "kv_block": kv_block,
+        "parity_ok": bool(parity_ok),
+        "leak_free": bool(leak_free),
+        # Acceptance bar: >= 4x concurrent slots at equal KV HBM.
+        "occupancy_regressed": bool(occupancy_x < 4 or not parity_ok
+                                    or bytes_p != bytes_c),
+        "requests": requests,
+        "max_len": max_len,
+        "new_tokens": new_tokens,
+        "config": config,
+        "kv_int8": kv_int8,
+        "weights_int8": weights_int8,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None)
@@ -742,7 +861,24 @@ def main() -> None:
     ap.add_argument("--smoke", action="store_true",
                     help="CI-sized prefix-share pass (tier-1 "
                          "regression guard for the chunk scheduler)")
+    ap.add_argument("--occupancy", action="store_true",
+                    help="high-occupancy sweep: max concurrent slots "
+                         "at equal KV HBM, paged vs contiguous, with "
+                         "greedy parity (the paged-cache headline)")
     args = ap.parse_args()
+    if args.occupancy:
+        r = run_occupancy(config=args.config, kv_int8=args.kv_int8,
+                          weights_int8=args.weights_int8)
+        print(json.dumps({
+            "metric": "serve_occupancy_x",
+            "value": r["occupancy_x"],
+            "unit": "x_slots_at_equal_hbm",
+            **{k: r[k] for k in (
+                "kv_hbm_bytes", "paged_slots", "contiguous_slots",
+                "blocks_per_token", "kv_block", "parity_ok",
+                "occupancy_regressed", "config")},
+        }))
+        return
     if args.smoke or args.prefix_share:
         if args.smoke:
             r = run_smoke()

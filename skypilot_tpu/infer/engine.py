@@ -92,6 +92,18 @@ DECODE_STALL_SECONDS = metrics.histogram(
     "bounds",
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5, 5.0))
+KV_BLOCKS_TOTAL = metrics.gauge(
+    "skytpu_kv_blocks_total",
+    "Paged KV cache: physical blocks in the pool (0 when the engine "
+    "runs the contiguous layout)")
+KV_BLOCKS_USED = metrics.gauge(
+    "skytpu_kv_blocks_used",
+    "Paged KV cache: blocks currently referenced by decode slots "
+    "and/or resident prefix-cache entries")
+KV_COW_COPIES = metrics.counter(
+    "skytpu_kv_cow_copies_total",
+    "Paged KV cache copy-on-write block copies (partial shared blocks "
+    "duplicated on prefix store/hit before a writer touches them)")
 
 
 @dataclasses.dataclass
@@ -157,16 +169,23 @@ def _bucket(n: int, buckets) -> int:
 
 
 class PrefixIndex:
-    """Host-side index over the prefix-pool rows.
+    """Host-side index over resident prompt prefixes.
 
     Hash granularity is the prefill chunk: a prompt's prefix is
     cacheable at every multiple of ``block`` tokens, keyed by a
     blake2b-128 digest of the token bytes (content-addressed — a
     Python ``hash`` collision would silently serve the wrong prefix).
-    One pool row holds one stored prefix; every chunk-multiple key of
-    that prefix points at the row, so a shorter shared prefix hits the
-    same row. Eviction is LRU over rows (a hit or a store bumps the
-    row); evicting a row drops all of its keys.
+    One ENTRY holds one stored prefix; every chunk-multiple key of
+    that prefix points at the entry, so a shorter shared prefix hits
+    it too. Eviction is LRU over entries (a hit or a store bumps the
+    entry); evicting drops all of its keys.
+
+    An entry's *payload* is storage-layout specific: the contiguous
+    engine stores a pool ROW id (int, allocated via ``acquire_row``);
+    the paged engine stores a TUPLE of ref-counted block ids
+    (``insert_entry`` — the caller owns the ref-count bookkeeping and
+    decrefs whatever ``evict_lru``/``clear``/``insert_entry`` report
+    as evicted). ``rows`` caps resident entries either way.
     """
 
     def __init__(self, rows: int, block: int):
@@ -176,9 +195,9 @@ class PrefixIndex:
 
     def clear(self) -> None:
         self._tick = 0
-        self._keys: Dict[bytes, Tuple[int, int]] = {}  # -> (row, n_tok)
-        self._row_keys: List[set] = [set() for _ in range(self.rows)]
-        self._row_used = [-1] * self.rows              # -1 = free
+        self._keys: Dict[bytes, Tuple[Any, int]] = {}  # -> (payload, n)
+        self._ent_keys: Dict[Any, set] = {}
+        self._ent_used: Dict[Any, int] = {}            # payload -> LRU
 
     def _digest(self, prompt: List[int], n: int) -> bytes:
         return hashlib.blake2b(
@@ -190,44 +209,84 @@ class PrefixIndex:
         # suffix token must remain to produce the first-token logits.
         return len(prompt) > self.block
 
-    def lookup(self, prompt: List[int]) -> Optional[Tuple[int, int]]:
+    def payloads(self) -> List[Any]:
+        return list(self._ent_used)
+
+    def lookup(self, prompt: List[int]) -> Optional[Tuple[Any, int]]:
         """Longest resident chunk-aligned proper prefix of ``prompt``;
-        returns (row, cached_len) and bumps the row's LRU stamp."""
+        returns (payload, cached_len) and bumps the entry's LRU
+        stamp."""
         for k in range((len(prompt) - 1) // self.block, 0, -1):
             ent = self._keys.get(self._digest(prompt, k * self.block))
             if ent is not None:
                 self._tick += 1
-                self._row_used[ent[0]] = self._tick
+                self._ent_used[ent[0]] = self._tick
                 return ent
         return None
 
+    def _drop(self, payload) -> None:
+        for key in self._ent_keys.pop(payload, ()):
+            del self._keys[key]
+        self._ent_used.pop(payload, None)
+
+    def evict_lru(self) -> Optional[Any]:
+        """Drop the least-recently-used entry; returns its payload (the
+        caller releases the storage) or None when empty."""
+        if not self._ent_used:
+            return None
+        payload = min(self._ent_used, key=self._ent_used.get)
+        self._drop(payload)
+        return payload
+
+    def payloads_lru(self) -> List[Any]:
+        """Resident payloads, least-recently-used first."""
+        return sorted(self._ent_used, key=self._ent_used.get)
+
+    def evict_entry(self, payload) -> None:
+        """Drop one specific entry (the caller releases its storage)."""
+        self._drop(payload)
+
     def acquire_row(self) -> Tuple[int, bool]:
-        """A free row, or the LRU row evicted (its keys dropped).
-        Returns (row, evicted)."""
+        """Contiguous-pool payloads: a free row in [0, rows), or the
+        LRU row evicted (its keys dropped). Returns (row, evicted)."""
         evicted = False
-        free = [r for r in range(self.rows) if self._row_used[r] < 0]
+        free = [r for r in range(self.rows) if r not in self._ent_used]
         if free:
             row = free[0]
         else:
-            row = min(range(self.rows), key=lambda r: self._row_used[r])
-            for key in self._row_keys[row]:
-                del self._keys[key]
-            self._row_keys[row] = set()
+            row = min(self._ent_used, key=self._ent_used.get)
+            self._drop(row)
             evicted = True
         self._tick += 1
-        self._row_used[row] = self._tick
+        self._ent_used[row] = self._tick
         return row, evicted
 
+    def insert_entry(self, prompt: List[int], n_tokens: int,
+                     payload) -> List[Any]:
+        """Paged payloads: admit a new entry, evicting LRU entries past
+        the ``rows`` cap. Returns the evicted payloads (caller decrefs
+        their blocks)."""
+        evicted: List[Any] = []
+        while len(self._ent_used) >= self.rows:
+            p = self.evict_lru()
+            if p is None:
+                break
+            evicted.append(p)
+        self._tick += 1
+        self._ent_used[payload] = self._tick
+        self.register(prompt, n_tokens, payload)
+        return evicted
+
     def register(self, prompt: List[int], n_tokens: int,
-                 row: int) -> None:
+                 payload) -> None:
         """Point every not-yet-resident chunk multiple <= n_tokens at
-        ``row`` (shorter multiples already resident keep their row —
-        both copies hold identical bytes)."""
+        ``payload`` (shorter multiples already resident keep their
+        entry — both copies hold identical bytes)."""
         for k in range(1, n_tokens // self.block + 1):
             d = self._digest(prompt, k * self.block)
             if d not in self._keys:
-                self._keys[d] = (row, k * self.block)
-                self._row_keys[row].add(d)
+                self._keys[d] = (payload, k * self.block)
+                self._ent_keys.setdefault(payload, set()).add(d)
 
 
 @dataclasses.dataclass
@@ -256,7 +315,9 @@ class InferenceEngine:
                  qweights=None, max_wave: Optional[int] = None,
                  pad_waves: bool = False, mesh=None, shard_rules=None,
                  prefill_chunk: Optional[int] = None,
-                 prefix_pool: Optional[int] = None):
+                 prefix_pool: Optional[int] = None,
+                 kv_block: Optional[int] = None,
+                 kv_blocks: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -274,12 +335,14 @@ class InferenceEngine:
         self.prefill_chunk = (prefill_chunk
                               if prefill_chunk and prefill_chunk > 0
                               else None)
-        # Prefix KV reuse: ``prefix_pool`` reserved rows (a SEPARATE
-        # tensor — decode never pays for them) hold prompt prefixes at
-        # chunk granularity; a request whose prompt shares a resident
-        # prefix copies the rows on-device and prefills only the
-        # suffix. Requires chunking (the suffix runs through the chunk
-        # program). Budget knob: SKYTPU_PREFIX_POOL. 0 disables.
+        # Prefix KV reuse: up to ``prefix_pool`` resident prompt
+        # prefixes at chunk granularity; a request whose prompt shares
+        # a resident prefix prefills only the suffix. Paged engines
+        # store a prefix as ref-counted shared blocks (near-zero cost);
+        # the contiguous layout reserves ``prefix_pool`` pool rows in a
+        # separate tensor and copies rows on store/hit. Requires
+        # chunking (the suffix runs through the chunk program). Budget
+        # knob: SKYTPU_PREFIX_POOL. 0 disables.
         if prefix_pool is None:
             prefix_pool = int(
                 os.environ.get("SKYTPU_PREFIX_POOL", "0") or 0)
@@ -302,17 +365,77 @@ class InferenceEngine:
         self.pad_waves = bool(pad_waves and self.max_wave)
         self.sampling_params = sampling_params
         self.eos_id = eos_id
+        # Paged KV cache: the default storage layout. Fixed-size blocks
+        # from one shared pool + a per-slot block table decouple slot
+        # count from worst-case length — a slot's HBM rent is its
+        # ACTUAL rows (rounded up to a block), not max_len, so slot
+        # count grows ~max_len/need x at the same HBM. Knobs:
+        # SKYTPU_KV_BLOCK (block length, default 256; 0 = contiguous
+        # layout) and SKYTPU_KV_BLOCKS (pool size in blocks, default
+        # the contiguous-equivalent HBM: (slots+1) * max_len / block).
+        if kv_block is None:
+            kv_block = int(os.environ.get("SKYTPU_KV_BLOCK", "256")
+                           or 0)
+        self.paged = kv_block > 0
+        if self.paged:
+            # Largest divisor of max_len <= the requested block: the
+            # block axis must tile max_len exactly for the logical->
+            # physical row map to stay a static reshape.
+            b = min(kv_block, max_len)
+            while max_len % b:
+                b -= 1
+            self.kv_block = b
+            nb = max_len // b
+            if kv_blocks is None:
+                kv_blocks = int(
+                    os.environ.get("SKYTPU_KV_BLOCKS", "0") or 0)
+            self.n_kv_blocks = kv_blocks if kv_blocks > 0 \
+                else (n_slots + 1) * nb
+            if self.n_kv_blocks < nb:
+                raise ValueError(
+                    f"kv_blocks={self.n_kv_blocks} cannot hold one "
+                    f"max_len request ({nb} blocks of {b})")
+            self.blocks_per_slot = nb
+            self.allocator = kvcache.BlockAllocator(self.n_kv_blocks)
+            # Per-slot block table (+ spare). One extra column pinned
+            # to the sentinel (== n_kv_blocks): logical rows past the
+            # slot's allocation scatter out of bounds and drop. Host
+            # numpy is authoritative; a cached device copy rides into
+            # every program (_table_device).
+            self.block_table = np.full(
+                (n_slots + 1, nb + 1), self.n_kv_blocks, np.int32)
+            self._table_dev = None
+            self._table_dirty = True
+        else:
+            self.kv_block = None
+            self.n_kv_blocks = 0
+            self.blocks_per_slot = 0
+            self.allocator = None
+            self.block_table = None
+            self._table_dev = None
+            self._table_dirty = False
         # One hidden spare slot (index n_slots): batched admission pads
         # its wave with dummy prefills targeting the spare, so one
-        # compiled program serves every wave size.
-        self.cache = kvcache.init_cache(cfg, n_slots + 1, max_len,
-                                        kv_int8=kv_int8)
+        # compiled program serves every wave size. (Paged: the spare's
+        # table row stays all-sentinel — dummy writes drop, zero block
+        # cost.)
+        if self.paged:
+            self.cache = kvcache.init_paged_cache(
+                cfg, n_slots + 1, self.n_kv_blocks, self.kv_block,
+                kv_int8=kv_int8)
+        else:
+            self.cache = kvcache.init_cache(cfg, n_slots + 1, max_len,
+                                            kv_int8=kv_int8)
+        # Contiguous layout only: the separate prefix-pool tensor.
+        # Paged engines need no pool — a stored prefix is just shared
+        # ref-counted blocks mapped into the new slot's table.
         self.pool = (kvcache.init_prefix_pool(cfg, self.prefix_pool,
                                               max_len, kv_int8=kv_int8)
-                     if self.prefix_pool else None)
+                     if self.prefix_pool and not self.paged else None)
         self._prefix_index = (PrefixIndex(self.prefix_pool,
                                           self.prefill_chunk)
                               if self.prefix_pool else None)
+        KV_BLOCKS_TOTAL.set(self.n_kv_blocks)
         # w8a8 serving: int8 weights for BOTH prefill and decode, so no
         # fp copy of the seven block matrices (or the head) is kept —
         # the memory halving that fits an 8B-class model on a 16 GB
@@ -392,7 +515,7 @@ class InferenceEngine:
         @functools.partial(jax.jit, donate_argnums=(1, 5),
                            static_argnames=("bucket",))
         def _admit_wave(params, cache, tokens_b, true_lens, slots, rng,
-                        *, bucket, qweights=None):
+                        table=None, *, bucket, qweights=None):
             del bucket
             from jax import lax as _lax
             rng, sub = jax.random.split(rng)
@@ -406,7 +529,7 @@ class InferenceEngine:
                 pv = _lax.dynamic_index_in_dim(prefix["v"], w, 1,
                                                keepdims=False)
                 c = kvcache.insert(c, {"k": pk, "v": pv}, slots[w],
-                                   true_lens[w], first[w])
+                                   true_lens[w], first[w], table=table)
                 return c, None
 
             cache, _ = _lax.scan(ins, cache,
@@ -415,10 +538,12 @@ class InferenceEngine:
             return cache, rng, first
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def _decode(params, cache, rng, active, qweights=None):
+        def _decode(params, cache, rng, active, table=None,
+                    qweights=None):
             rng, sub = jax.random.split(rng)
             cache, logits = kvcache.decode_step(params, cache, cfg,
-                                                qweights=qweights)
+                                                qweights=qweights,
+                                                table=table)
             toks = sampling.sample(logits, sub, sp)
             cache = kvcache.commit_tokens(cache, toks, active)
             return cache, rng, toks
@@ -433,11 +558,11 @@ class InferenceEngine:
         # per-step cache updates on an 8B model).
         @functools.partial(jax.jit, donate_argnums=(1, 2),
                            static_argnames=("k",))
-        def _decode_burst(params, cache, rng, active, *, k,
+        def _decode_burst(params, cache, rng, active, table=None, *, k,
                           qweights=None):
             return kvcache.decode_burst_staged(
                 params, cache, rng, active, k, cfg, sp,
-                qweights=qweights)
+                qweights=qweights, table=table)
 
         # Chunked-prefill programs: ONE chunk program (two traces: the
         # ``final`` variant samples the first token and splits the RNG)
@@ -446,11 +571,12 @@ class InferenceEngine:
         @functools.partial(jax.jit, donate_argnums=(1,),
                            static_argnames=("final",))
         def _prefill_chunk(params, cache, tokens_c, start, n_valid,
-                           slot, new_len, rng, *, final,
+                           slot, new_len, rng, table=None, *, final,
                            qweights=None):
             return kvcache.prefill_chunk(
                 params, cache, tokens_c, start, n_valid, slot, new_len,
-                rng, cfg, sp, final=final, qweights=qweights)
+                rng, cfg, sp, final=final, qweights=qweights,
+                table=table)
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _claim(cache, slot, claim_len):
@@ -464,6 +590,10 @@ class InferenceEngine:
         def _pool_store(pool, cache, slot, row):
             return kvcache.pool_store(pool, cache, slot, row)
 
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _copy_block(cache, src, dst):
+            return kvcache.copy_block(cache, src, dst)
+
         self._admit_wave_fn = _admit_wave
         self._decode_fn = _decode
         self._decode_burst_fn = _decode_burst
@@ -471,6 +601,7 @@ class InferenceEngine:
         self._claim_fn = _claim
         self._pool_load_fn = _pool_load
         self._pool_store_fn = _pool_store
+        self._copy_block_fn = _copy_block
 
     # -- admission ---------------------------------------------------------
 
@@ -520,6 +651,98 @@ class InferenceEngine:
     def _update_gauges(self) -> None:
         SLOTS_ACTIVE.set(len(self.slot_req))
         ENGINE_WAITING.set(len(self.waiting))
+        if self.paged:
+            KV_BLOCKS_USED.set(self.allocator.used)
+
+    # -- paged block management --------------------------------------------
+
+    @property
+    def blocks_used(self) -> int:
+        """Physical blocks currently referenced (0 when contiguous)."""
+        return self.allocator.used if self.paged else 0
+
+    def table_device(self):
+        """The block table as a device array (None when contiguous).
+        Cached between calls — claims/retires mark it dirty — so a
+        steady decode stream pays no per-burst host->device copy."""
+        if not self.paged:
+            return None
+        if self._table_dirty or self._table_dev is None:
+            self._table_dev = jnp.asarray(self.block_table)
+            self._table_dirty = False
+        return self._table_dev
+
+    def _need_blocks(self, req: Request) -> int:
+        """Worst-case blocks this request can ever write: prompt plus
+        its full token budget, capped by max_len (allocation is eager
+        at admission, so decode can never run out of backing mid-
+        flight — the pool, not a mid-decode fault path, is the
+        admission limiter)."""
+        need = min(len(req.prompt) + req.max_new_tokens, self.max_len)
+        return -(-need // self.kv_block)
+
+    def _alloc_blocks(self, n: int) -> Optional[List[int]]:
+        """n fresh blocks, evicting LRU prefix-cache entries on a dry
+        pool (their blocks free unless still shared with live slots).
+        None when the pool stays too dry — the caller leaves the
+        request queued; retirements free blocks and admission retries
+        next pass."""
+        alloc = self.allocator
+        idx = self._prefix_index
+        while alloc.available < n and idx is not None:
+            # Evict the LRU entry that would actually FREE blocks.
+            # Entries whose blocks are all still shared with live
+            # slots (or pinned by the claim in progress) free nothing
+            # — dropping them would wipe the warm cache for zero
+            # capacity, turning one transient dry-pool moment into a
+            # fleet-wide cold-prefill regression.
+            victim = None
+            for p in idx.payloads_lru():
+                if any(alloc.ref(b) == 1 for b in p):
+                    victim = p
+                    break
+            if victim is None:
+                break
+            idx.evict_entry(victim)
+            PREFIX_EVICTIONS.inc()
+            for b in victim:
+                alloc.decref(b)
+        if alloc.available < n:
+            return None
+        return [alloc.alloc() for _ in range(n)]
+
+    def _wave_claim(self, req: Request) -> Optional[int]:
+        """Claim a slot (+ its KV blocks when paged) for a wave-path
+        request. Returns the slot, or None when the block pool is too
+        dry (the caller re-queues the request)."""
+        if not self.paged:
+            return self.free_slots.pop(0)
+        blocks = self._alloc_blocks(self._need_blocks(req))
+        if blocks is None:
+            return None
+        slot = self.free_slots.pop(0)
+        row = self.block_table[slot]
+        row[:] = self.n_kv_blocks
+        row[:len(blocks)] = blocks
+        self._table_dirty = True
+        return slot
+
+    def _free_slot_blocks(self, slot: int) -> None:
+        """Release a slot's block references and clear its table row to
+        the sentinel: bursts dispatched after the retirement drop their
+        garbage writes for the dead slot. A burst already in flight
+        rode the OLD device table and still writes the old blocks —
+        safely: device programs execute in dispatch order, so a re-
+        allocated block's every readable row is overwritten by its new
+        owner's (later-dispatched) prefill/decode writes before the
+        owner's length ever exposes it."""
+        if not self.paged:
+            return
+        row = self.block_table[slot]
+        for b in row[row < self.n_kv_blocks].tolist():
+            self.allocator.decref(b)
+        row[:] = self.n_kv_blocks
+        self._table_dirty = True
 
     def _admit(self, on_wave=None) -> None:
         # Waves are grouped by prompt bucket (prefill is O(S^2): one
@@ -539,15 +762,21 @@ class InferenceEngine:
         # would serialize a full host round trip per wave — measured
         # ~200 ms fixed cost per wave on a relayed chip, the dominant
         # TTFT term for every wave after the first.
-        while self.waiting and self.free_slots:
+        stalled = False
+        while self.waiting and self.free_slots and not stalled:
             dispatched = []
-            while self.waiting and self.free_slots:
+            while self.waiting and self.free_slots and not stalled:
                 # Chunk-path requests (prompt longer than the chunk —
                 # which also covers every possible prefix-cache hit)
                 # claim a slot and join the chunk queue; they never
-                # ride a bucketed wave.
+                # ride a bucketed wave. A False return means the paged
+                # block pool is dry: the request went back to the queue
+                # head and admission stops until retirements free
+                # blocks (the pool, not the slot count, is then the
+                # admission limiter).
                 if self._use_chunked(self.waiting[0]):
-                    self._claim_chunked(self.waiting.popleft())
+                    if not self._claim_chunked(self.waiting.popleft()):
+                        stalled = True
                     continue
                 bucket = _bucket(len(self.waiting[0].prompt),
                                  self.buckets)
@@ -555,15 +784,22 @@ class InferenceEngine:
                 slots: List[int] = []
                 rest: List[Request] = []
                 while self.waiting and self.free_slots and \
+                        not stalled and \
                         (self.max_wave is None
                          or len(wave) < self.max_wave):
                     req = self.waiting.popleft()
                     if self._use_chunked(req):
-                        self._claim_chunked(req)
+                        if not self._claim_chunked(req):
+                            stalled = True
                     elif _bucket(len(req.prompt),
                                  self.buckets) == bucket:
-                        wave.append(req)
-                        slots.append(self.free_slots.pop(0))
+                        slot = self._wave_claim(req)
+                        if slot is None:          # block pool dry
+                            self.waiting.appendleft(req)
+                            stalled = True
+                        else:
+                            wave.append(req)
+                            slots.append(slot)
                     else:
                         rest.append(req)
                 self.waiting.extendleft(reversed(rest))
@@ -584,15 +820,48 @@ class InferenceEngine:
         return (self.prefill_chunk is not None
                 and len(req.prompt) > self.prefill_chunk)
 
-    def _claim_chunked(self, req: Request) -> None:
+    def _claim_chunked(self, req: Request) -> bool:
         """Claim a slot for an incremental prefill: look up the prefix
-        cache, copy a hit's rows on-device (suffix-only prefill), and
-        queue the remaining chunks. The claim stamps the slot's cache
-        length to max_len so interleaved decode bursts' garbage writes
-        for this (inactive) slot land out of bounds and are dropped —
-        they must never corrupt rows a finished chunk already wrote."""
+        cache, reuse a hit's rows (suffix-only prefill), and queue the
+        remaining chunks. The claim stamps the slot's cache length to
+        max_len so interleaved decode bursts' garbage writes for this
+        (inactive) slot land out of bounds and are dropped — they must
+        never corrupt rows a finished chunk already wrote.
+
+        Paged: a hit maps the stored prefix's ref-counted blocks into
+        the slot's table — NO row copies. A partially-filled shared
+        block (block_len not dividing the cached length) is copied on
+        write first (`skytpu_kv_cow_copies_total`): this slot's suffix
+        prefill writes into it at offset cached%block. Contiguous: the
+        hit copies the pool row on-device as before. Returns False
+        (request re-queued at the head) when the paged pool is dry.
+        """
         idx = self._prefix_index
         hit = idx.lookup(req.prompt) if idx is not None else None
+        payload = cached = None
+        n_shared = partial = 0
+        shared: List[int] = []
+        new_blocks: Optional[List[int]] = None
+        if self.paged:
+            if hit is not None:
+                payload, cached = hit
+                n_shared, partial = divmod(cached, self.kv_block)
+                # PIN the shared blocks BEFORE any dry-pool eviction:
+                # _alloc_blocks may evict the hit's own entry, and an
+                # unpinned payload block could be freed and handed
+                # straight back as a fresh block — one physical block
+                # aliased at two table positions, silently corrupting
+                # the cached prefix the request is about to read.
+                shared = list(payload[:n_shared])
+                for b in shared:
+                    self.allocator.incref(b)
+            new_blocks = self._alloc_blocks(
+                self._need_blocks(req) - n_shared)
+            if new_blocks is None:
+                for b in shared:          # unpin; retry next pass
+                    self.allocator.decref(b)
+                self.waiting.appendleft(req)
+                return False
         slot = self.free_slots.pop(0)
         req.slot = slot
         req.prefill_begin_s = time.time()
@@ -600,12 +869,34 @@ class InferenceEngine:
             "engine.queue_wait", req.submit_s, req.prefill_begin_s,
             parent=req.span_ctx, attrs={"rid": req.rid})
         claim_len = jnp.asarray(self.max_len, jnp.int32)
-        if hit is not None:
-            row, cached = hit
+        if self.paged:
+            row = self.block_table[slot]
+            row[:] = self.n_kv_blocks
+            if hit is not None:
+                req.cached_len = cached
+                PREFIX_HITS.inc()
+                row[:n_shared] = shared   # pinned above
+                if partial:
+                    # COW the partial shared block BEFORE the suffix
+                    # prefill writes into it (its owner keeps ref > 1,
+                    # so nothing else may scatter there).
+                    self.cache = self._copy_block_fn(
+                        self.cache,
+                        jnp.asarray(payload[n_shared], jnp.int32),
+                        jnp.asarray(new_blocks[0], jnp.int32))
+                    KV_COW_COPIES.inc()
+            elif idx is not None and idx.eligible(req.prompt):
+                PREFIX_MISSES.inc()
+            row[n_shared:n_shared + len(new_blocks)] = new_blocks
+            self._table_dirty = True
+            self.cache = self._claim_fn(
+                self.cache, jnp.asarray(slot, jnp.int32), claim_len)
+        elif hit is not None:
+            payload, cached = hit
             req.cached_len = cached
             PREFIX_HITS.inc()
             self.cache = self._pool_load_fn(
-                self.cache, self.pool, jnp.asarray(row, jnp.int32),
+                self.cache, self.pool, jnp.asarray(payload, jnp.int32),
                 jnp.asarray(slot, jnp.int32), claim_len)
         else:
             if idx is not None and idx.eligible(req.prompt):
@@ -618,6 +909,7 @@ class InferenceEngine:
         # gauge overreports by one per claim for the whole (possibly
         # multi-second) chunked prefill.
         self._update_gauges()
+        return True
 
     def prefill_chunk_step(self) -> bool:
         """Run ONE chunk of the head chunked prefill (host-synced: the
@@ -644,7 +936,7 @@ class InferenceEngine:
             jnp.asarray(n_valid, jnp.int32),
             jnp.asarray(req.slot, jnp.int32),
             jnp.asarray(new_len, jnp.int32), self.rng,
-            final=final, qweights=self.qweights)
+            self.table_device(), final=final, qweights=self.qweights)
         tok = int(tok_dev)               # host sync (garbage unless final)
         dt = time.time() - t0
         PREFILL_CHUNKS.inc()
@@ -677,10 +969,17 @@ class InferenceEngine:
 
     def _maybe_store_prefix(self, req: Request) -> None:
         """Install this request's chunk-aligned prompt prefix into the
-        pool (slot -> pool-row copy) unless it is already resident.
-        Only chunk-path prompts are stored: their rows came from the
-        chunk program, so a later cached run replays bit-identical
-        state (the parity guarantee)."""
+        prefix cache unless it is already resident. Only chunk-path
+        prompts are stored: their rows came from the chunk program, so
+        a later cached run replays bit-identical state (the parity
+        guarantee).
+
+        Paged: storing is (mostly) FREE — the slot's full blocks over
+        the prefix are increfed and recorded as the entry's payload, no
+        row copies. A trailing partial block is copied-on-share (the
+        donor slot keeps writing into its own copy past the prefix;
+        `skytpu_kv_cow_copies_total`). Contiguous: the slot's rows copy
+        into a pool row as before."""
         idx = self._prefix_index
         if idx is None or req.slot is None:
             return
@@ -689,6 +988,29 @@ class InferenceEngine:
             return
         covered = idx.lookup(req.prompt)
         if covered is not None and covered[1] >= n:
+            return
+        if self.paged:
+            n_full, partial = divmod(n, self.kv_block)
+            blocks = self.block_table[req.slot, :n_full].tolist()
+            if partial:
+                cow = self._alloc_blocks(1)
+                if cow is None:      # pool dry: skip storing
+                    return
+                self.cache = self._copy_block_fn(
+                    self.cache,
+                    jnp.asarray(self.block_table[req.slot, n_full],
+                                jnp.int32),
+                    jnp.asarray(cow[0], jnp.int32))
+                KV_COW_COPIES.inc()
+                blocks.append(cow[0])
+            for b in blocks[:n_full]:
+                self.allocator.incref(b)
+            for payload in idx.insert_entry(req.prompt, n,
+                                            tuple(blocks)):
+                PREFIX_EVICTIONS.inc()
+                for b in payload:
+                    self.allocator.decref(b)
+            self._update_gauges()
             return
         row, evicted = idx.acquire_row()
         if evicted:
@@ -699,11 +1021,20 @@ class InferenceEngine:
         idx.register(req.prompt, n, row)
 
     def clear_prefix_cache(self) -> None:
-        """Drop every resident prefix (host index only; the device rows
-        become unreachable). Benchmarks use this to measure a cold
-        pass against a warm one on the same engine."""
-        if self._prefix_index is not None:
-            self._prefix_index.clear()
+        """Drop every resident prefix. Paged: the entries' block refs
+        are released (blocks still mapped into live slots stay until
+        those retire). Contiguous: host index only — the pool rows
+        become unreachable. Benchmarks use this to measure a cold pass
+        against a warm one on the same engine."""
+        idx = self._prefix_index
+        if idx is None:
+            return
+        if self.paged:
+            for payload in idx.payloads():
+                for b in payload:
+                    self.allocator.decref(b)
+        idx.clear()
+        self._update_gauges()
 
     def _dispatch_wave(self, wave: List["Request"], slots: List[int],
                        bucket: int
@@ -739,7 +1070,7 @@ class InferenceEngine:
         self.cache, self.rng, first = self._admit_wave_fn(
             self.params, self.cache, jnp.asarray(tokens_b),
             jnp.asarray(true_lens), jnp.asarray(slot_ids), self.rng,
-            bucket=bucket, qweights=self.qweights)
+            self.table_device(), bucket=bucket, qweights=self.qweights)
         return first, span, decode_active
 
     def _complete_wave(self, wave: List["Request"], slots: List[int],
@@ -816,8 +1147,11 @@ class InferenceEngine:
         if req.slot is not None:
             self.slot_req.pop(req.slot, None)
             self.free_slots.append(req.slot)
+            self._free_slot_blocks(req.slot)
             req.slot = None
         SLOTS_ACTIVE.set(len(self.slot_req))
+        if self.paged:
+            KV_BLOCKS_USED.set(self.allocator.used)
 
     def step(self) -> Dict[int, int]:
         """Admit waiting requests (draining any chunked prefills to
@@ -850,9 +1184,21 @@ class InferenceEngine:
         self.free_slots = list(range(self.n_slots))
         self._inflight_tokens = 0
         self.cache["length"] = jnp.zeros_like(self.cache["length"])
-        # A mid-copy/mid-chunk failure may have left pool rows in an
-        # unknown state; drop the index rather than serve them.
-        self.clear_prefix_cache()
+        # A mid-copy/mid-chunk failure may have left pool rows (or
+        # block refcounts) in an unknown state; drop the index rather
+        # than serve them.
+        if self.paged:
+            # The index entries' refs die with the wholesale pool
+            # reset below — clear WITHOUT per-block decrefs (a failure
+            # mid-claim may have left counts inconsistent; decref
+            # could double-free).
+            if self._prefix_index is not None:
+                self._prefix_index.clear()
+            self.allocator.reset()
+            self.block_table[:] = self.n_kv_blocks
+            self._table_dirty = True
+        else:
+            self.clear_prefix_cache()
         self._update_gauges()
 
     def step_burst(self, max_burst: int = 8,
@@ -922,8 +1268,8 @@ class InferenceEngine:
                               histogram=DECODE_STEP_SECONDS)
         span.begin()
         self.cache, self.rng, toks = self._decode_burst_fn(
-            self.params, self.cache, self.rng, jnp.asarray(active), k=k,
-            qweights=self.qweights)
+            self.params, self.cache, self.rng, jnp.asarray(active),
+            self.table_device(), k=k, qweights=self.qweights)
         self._inflight_tokens += k
         return BurstHandle(toks=toks, k=k, slot_req=dict(self.slot_req),
                            span=span)
@@ -968,7 +1314,7 @@ class InferenceEngine:
                             histogram=DECODE_STEP_SECONDS):
             self.cache, self.rng, toks = self._decode_fn(
                 self.params, self.cache, self.rng, jnp.asarray(active),
-                qweights=self.qweights)
+                self.table_device(), qweights=self.qweights)
             toks = np.asarray(toks)
         out: Dict[int, int] = {}
         for slot, req in list(self.slot_req.items()):

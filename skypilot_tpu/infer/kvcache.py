@@ -16,6 +16,13 @@ TPU-first design notes
   is the JetStream-style generate step — MXU-batched across requests.
 * Sharding composes with serving TP: cache kv-head dim maps to ``tp``,
   slot dim to (``dp``, ``fsdp``) via the standard rule table.
+* Two storage layouts share ONE implementation of every program:
+  the original contiguous ``[L, slots, max_len, ...]`` cache, and the
+  **paged** block pool (``[L, n_blocks, block_len, ...]`` + a per-slot
+  block table — see the "Paged block-pool layout" section) that decouples
+  slot count from worst-case length. Each program takes an optional
+  ``table``; reads/writes route through it, so paged-vs-contiguous
+  outputs are bit-identical by construction.
 
 Reference parity: the reference serves LLMs only through external
 engines (reference: llm/vllm/serve.yaml, examples/tpu/v6e/README.md
@@ -266,7 +273,10 @@ def dequantize_rows(q: jax.Array, scale: jax.Array,
 
 def cache_logical_axes(cache: Cache | None = None) -> Dict[str, Tuple]:
     """Axes for the given cache's keys (quantization is derived from the
-    cache itself, like insert/decode_step do; None = fp layout)."""
+    cache itself, like insert/decode_step do; None = fp layout). The
+    paged layout reuses the same names: its block dim takes "batch" and
+    its block_len dim takes "seq_cache", so one TP rule set shards both
+    layouts (kv_heads is dim 3 either way)."""
     axes = {
         "k": ("layer", "batch", "seq_cache", "kv_heads", "head_dim"),
         "v": ("layer", "batch", "seq_cache", "kv_heads", "head_dim"),
@@ -277,6 +287,208 @@ def cache_logical_axes(cache: Cache | None = None) -> Dict[str, Tuple]:
         axes["k_scale"] = ("layer", "batch", "kv_heads", "seq_cache")
         axes["v_scale"] = ("layer", "batch", "kv_heads", "seq_cache")
     return axes
+
+
+# ---------------------------------------------------------------------------
+# Paged block-pool layout
+# ---------------------------------------------------------------------------
+# The contiguous layout above charges every slot max_len rows of HBM
+# rent regardless of actual length. The paged layout allocates
+# fixed-size BLOCKS from one shared pool ([L, n_blocks, block_len, ...]
+# per tensor) and gives each slot a BLOCK TABLE mapping logical block
+# j -> physical block id. Shapes stay fully static — attention gathers
+# a slot's blocks in logical order (same row ordering, same masked
+# score set as the contiguous read, so the softmax sums are identical)
+# and writes scatter through the table. The table carries one EXTRA
+# column pinned to the sentinel (== n_blocks): any logical row past the
+# slot's allocation maps there, and JAX scatter DROPS out-of-bounds
+# updates — the same garbage-write safety net the contiguous layout
+# gets from row indices >= max_len (gathers CLAMP, but clamped garbage
+# rows are masked by `length` exactly as contiguous garbage rows are).
+#
+# Host-side bookkeeping (which blocks a slot owns, ref counts for
+# prefix sharing) lives in BlockAllocator + the engine; a stored prefix
+# is just ref-counted shared blocks mapped into a new slot's table —
+# no row copies. Copy-on-write happens only when a shared block is
+# PARTIAL (block_len does not divide the stored prefix length): the
+# writer gets a fresh copy (`copy_block`) before its first write.
+
+
+def init_paged_cache(cfg: llama.LlamaConfig, n_slots: int,
+                     n_blocks: int, block_len: int,
+                     kv_int8: bool = False) -> Cache:
+    """Block-pool decode state: ``n_blocks`` physical blocks of
+    ``block_len`` rows shared by ``n_slots`` slots. Per-slot
+    length/last_token bookkeeping is identical to the contiguous
+    layout; only the K/V storage is pooled."""
+    L, G, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    cache: Cache = {
+        "length": jnp.zeros((n_slots,), jnp.int32),
+        "last_token": jnp.zeros((n_slots,), jnp.int32),
+    }
+    if kv_int8:
+        cache["k"] = jnp.zeros((L, n_blocks, block_len, G, hd), jnp.int8)
+        cache["v"] = jnp.zeros((L, n_blocks, block_len, G, hd), jnp.int8)
+        # Same minormost-row-dim trade as init_cache's scales.
+        cache["k_scale"] = jnp.zeros((L, n_blocks, G, block_len),
+                                     jnp.bfloat16)
+        cache["v_scale"] = jnp.zeros((L, n_blocks, G, block_len),
+                                     jnp.bfloat16)
+    else:
+        cache["k"] = jnp.zeros((L, n_blocks, block_len, G, hd),
+                               cfg.dtype)
+        cache["v"] = jnp.zeros((L, n_blocks, block_len, G, hd),
+                               cfg.dtype)
+    return cache
+
+
+class BlockAllocator:
+    """Host-side ref-counted allocator over the paged block pool.
+
+    Pure bookkeeping — no device state. Invariants (property-tested in
+    tests/test_paged_kv.py): a block is FREE xor referenced; alloc
+    hands out ref==1 blocks in ascending id order (deterministic);
+    incref requires a live block; decref of a free block raises
+    (double-free guard); a block is writable only at ref==1 — the
+    engine must COW before writing a shared block.
+    """
+
+    def __init__(self, n_blocks: int):
+        self.n_blocks = n_blocks
+        self.reset()
+
+    def reset(self) -> None:
+        # Popped from the end: blocks hand out in ascending id order.
+        self._free = list(range(self.n_blocks - 1, -1, -1))
+        self._ref = [0] * self.n_blocks
+
+    @property
+    def used(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError("KV block pool exhausted")
+        b = self._free.pop()
+        self._ref[b] = 1
+        return b
+
+    def incref(self, block: int) -> None:
+        if self._ref[block] <= 0:
+            raise RuntimeError(f"incref of free block {block}")
+        self._ref[block] += 1
+
+    def decref(self, block: int) -> None:
+        if self._ref[block] <= 0:
+            raise RuntimeError(f"double free of block {block}")
+        self._ref[block] -= 1
+        if self._ref[block] == 0:
+            self._free.append(block)
+
+    def ref(self, block: int) -> int:
+        return self._ref[block]
+
+    def writable(self, block: int) -> bool:
+        """Safe to scatter into: exactly one owner."""
+        return self._ref[block] == 1
+
+
+def copy_block(cache: Cache, src: jax.Array, dst: jax.Array) -> Cache:
+    """Copy-on-write: duplicate one physical block's rows (and scales)
+    into a freshly allocated block. All ``block_len`` rows copy (static
+    shape); rows past the shared prefix are garbage in BOTH blocks and
+    stay unreadable until the new owner overwrites them."""
+    out = dict(cache)
+    for name in ("k", "v", "k_scale", "v_scale"):
+        if name not in cache:
+            continue
+        rows = lax.dynamic_index_in_dim(cache[name], src, 1,
+                                        keepdims=False)
+        out[name] = lax.dynamic_update_index_in_dim(cache[name], rows,
+                                                    dst, 1)
+    return out
+
+
+def _logical_rows(cache: Cache, table) -> int:
+    """Rows a slot's attention spans: max_len (contiguous) or
+    blocks_per_slot * block_len (paged; the table's last column is the
+    sentinel and holds no rows)."""
+    if table is None:
+        return cache["k"].shape[2]
+    return (table.shape[1] - 1) * cache["k"].shape[2]
+
+
+def _phys(cache: Cache, table, slots, idx):
+    """(slot, logical row) -> scatter coordinates on the cache's two
+    row-addressing dims: identity for contiguous, block-table lookup
+    for paged. Overflow logical rows index the table's sentinel column
+    (gathers clamp into it), resolving to block id == n_blocks, where
+    scatter drops the write."""
+    if table is None:
+        return slots, idx
+    bl = cache["k"].shape[2]
+    return table[slots, idx // bl], idx % bl
+
+
+def _gather_kv_layer(cache: Cache, i, table):
+    """Layer ``i``'s K/V (+ scales when int8) arranged per slot:
+    k/v [B, M, G, hd], scales [B, G, M]. Contiguous reads the
+    slot-major layout directly; paged gathers each slot's blocks in
+    logical order — identical row ordering, so the attention sums
+    match the contiguous layout bit-for-bit."""
+    ck = lax.dynamic_index_in_dim(cache["k"], i, 0, keepdims=False)
+    cv = lax.dynamic_index_in_dim(cache["v"], i, 0, keepdims=False)
+    cks = cvs = None
+    if "k_scale" in cache:
+        cks = lax.dynamic_index_in_dim(cache["k_scale"], i, 0,
+                                       keepdims=False)
+        cvs = lax.dynamic_index_in_dim(cache["v_scale"], i, 0,
+                                       keepdims=False)
+    if table is not None:
+        tbl = table[:, :-1]                  # sentinel column: no rows
+        B, nb = tbl.shape
+        bl = ck.shape[1]
+        G = ck.shape[2]
+        ck = ck[tbl].reshape(B, nb * bl, *ck.shape[2:])
+        cv = cv[tbl].reshape(B, nb * bl, *cv.shape[2:])
+        if cks is not None:
+            cks = cks[tbl].transpose(0, 2, 1, 3).reshape(B, G, nb * bl)
+            cvs = cvs[tbl].transpose(0, 2, 1, 3).reshape(B, G, nb * bl)
+    return ck, cv, cks, cvs
+
+
+def _gather_slot_kv_layer(cache: Cache, i, slot, table):
+    """One slot's rows for layer ``i``: k/v [M, G, hd], scales [G, M]
+    (the prefill_chunk read path)."""
+    ck = lax.dynamic_index_in_dim(cache["k"], i, 0, keepdims=False)
+    cv = lax.dynamic_index_in_dim(cache["v"], i, 0, keepdims=False)
+    cks = cvs = None
+    if "k_scale" in cache:
+        cks = lax.dynamic_index_in_dim(cache["k_scale"], i, 0,
+                                       keepdims=False)
+        cvs = lax.dynamic_index_in_dim(cache["v_scale"], i, 0,
+                                       keepdims=False)
+    if table is None:
+        ck = lax.dynamic_index_in_dim(ck, slot, 0, keepdims=False)
+        cv = lax.dynamic_index_in_dim(cv, slot, 0, keepdims=False)
+        if cks is not None:
+            cks = lax.dynamic_index_in_dim(cks, slot, 0, keepdims=False)
+            cvs = lax.dynamic_index_in_dim(cvs, slot, 0, keepdims=False)
+        return ck, cv, cks, cvs
+    tblk = table[slot, :-1]                  # [nb]
+    nb = tblk.shape[0]
+    bl = ck.shape[1]
+    G = ck.shape[2]
+    ck = ck[tblk].reshape(nb * bl, *ck.shape[2:])
+    cv = cv[tblk].reshape(nb * bl, *cv.shape[2:])
+    if cks is not None:
+        cks = cks[tblk].transpose(1, 0, 2).reshape(G, nb * bl)
+        cvs = cvs[tblk].transpose(1, 0, 2).reshape(G, nb * bl)
+    return ck, cv, cks, cvs
 
 
 # ---------------------------------------------------------------------------
@@ -362,28 +574,49 @@ def prefill_batch(params: llama.Params, tokens: jax.Array,
 
 
 def insert(cache: Cache, prefix: Cache, slot: jax.Array,
-           true_len: jax.Array, first_token: jax.Array) -> Cache:
+           true_len: jax.Array, first_token: jax.Array,
+           table=None) -> Cache:
     """Install a prefilled prompt into a decode slot.
 
     prefix k/v: [L, S_bucket, G, hd]; rows >= true_len are padding but
-    harmless — decode masks by ``length``.
+    harmless — decode masks by ``length``. With a block ``table`` the
+    rows scatter through the slot's table instead (values identical to
+    the contiguous write, which is what makes paged-vs-contiguous
+    generation bit-identical); the spare slot's all-sentinel row drops
+    dummy-wave writes entirely.
     """
     out = dict(cache)
     pk, pv = prefix["k"], prefix["v"]
-    if "k_scale" in cache:
+    quant = "k_scale" in cache
+    if quant:
         pk, ks = quantize_rows(pk)          # ks/vs: [L, S, G]
         pv, vs = quantize_rows(pv)
         sdt = cache["k_scale"].dtype
-        out["k_scale"] = lax.dynamic_update_slice(
-            cache["k_scale"], ks.transpose(0, 2, 1)[:, None].astype(sdt),
-            (0, slot, 0, 0))
-        out["v_scale"] = lax.dynamic_update_slice(
-            cache["v_scale"], vs.transpose(0, 2, 1)[:, None].astype(sdt),
-            (0, slot, 0, 0))
-    out["k"] = lax.dynamic_update_slice(
-        cache["k"], pk[:, None], (0, slot, 0, 0, 0))
-    out["v"] = lax.dynamic_update_slice(
-        cache["v"], pv[:, None], (0, slot, 0, 0, 0))
+        ks, vs = ks.astype(sdt), vs.astype(sdt)
+    if table is None:
+        if quant:
+            out["k_scale"] = lax.dynamic_update_slice(
+                cache["k_scale"], ks.transpose(0, 2, 1)[:, None],
+                (0, slot, 0, 0))
+            out["v_scale"] = lax.dynamic_update_slice(
+                cache["v_scale"], vs.transpose(0, 2, 1)[:, None],
+                (0, slot, 0, 0))
+        out["k"] = lax.dynamic_update_slice(
+            cache["k"], pk[:, None], (0, slot, 0, 0, 0))
+        out["v"] = lax.dynamic_update_slice(
+            cache["v"], pv[:, None], (0, slot, 0, 0, 0))
+    else:
+        S = pk.shape[1]
+        blk, off = _phys(cache, table, slot, jnp.arange(S))
+        out["k"] = cache["k"].at[:, blk, off].set(pk)
+        out["v"] = cache["v"].at[:, blk, off].set(pv)
+        if quant:
+            # Non-adjacent advanced indices put the broadcast dim
+            # first: update shape is [S, L, G].
+            out["k_scale"] = cache["k_scale"].at[:, blk, :, off].set(
+                ks.transpose(1, 0, 2))
+            out["v_scale"] = cache["v_scale"].at[:, blk, :, off].set(
+                vs.transpose(1, 0, 2))
     out["length"] = cache["length"].at[slot].set(true_len)
     out["last_token"] = cache["last_token"].at[slot].set(first_token)
     return out
@@ -481,7 +714,8 @@ def prefill_chunk(params: llama.Params, cache: Cache,
                   n_valid: jax.Array, slot: jax.Array,
                   new_len: jax.Array, rng: jax.Array,
                   cfg: llama.LlamaConfig, sp, *, final: bool,
-                  qweights=None) -> Tuple[Cache, jax.Array, jax.Array]:
+                  qweights=None, table=None
+                  ) -> Tuple[Cache, jax.Array, jax.Array]:
     """One chunk of an incremental prefill into a decode slot.
 
     tokens_c: [C] int32 right-padded chunk; start: row offset of this
@@ -504,10 +738,15 @@ def prefill_chunk(params: llama.Params, cache: Cache,
     both read/write the same rows with the same program. int8 KV path
     included: chunk rows quantize exactly as ``insert`` would.
 
+    With ``table`` the slot's rows live in pool blocks: reads gather
+    the blocks in logical order (same score set, same summation order
+    as the contiguous read) and writes scatter through the table —
+    paged-vs-contiguous chunk prefills are bit-identical.
+
     Returns (cache', rng', first_token — 0 unless ``final``).
     """
     C = tokens_c.shape[0]
-    M = cache["k"].shape[2]
+    M = _logical_rows(cache, table)
     G, hd = cfg.n_kv_heads, cfg.head_dim
     rep = cfg.n_heads // G
     scale = hd ** -0.5
@@ -546,10 +785,7 @@ def prefill_chunk(params: llama.Params, cache: Cache,
             ys = (kq, vq, ksc.astype(sdt), vsc.astype(sdt))
         else:
             ys = (kr.astype(kdt), vr.astype(kdt))
-        ck = lax.dynamic_index_in_dim(cache["k"], i, 0, keepdims=False)
-        ck = lax.dynamic_index_in_dim(ck, slot, 0, keepdims=False)
-        cv = lax.dynamic_index_in_dim(cache["v"], i, 0, keepdims=False)
-        cv = lax.dynamic_index_in_dim(cv, slot, 0, keepdims=False)
+        ck, cv, cks, cvs = _gather_slot_kv_layer(cache, i, slot, table)
         # bf16 dots, fp32 accumulation — int8 converts to bf16 exactly
         # (see decode_step's note).
         qh = q[0].reshape(C, G, rep, hd).astype(jnp.bfloat16)
@@ -558,12 +794,6 @@ def prefill_chunk(params: llama.Params, cache: Cache,
         ss = jnp.einsum("cgrk,jgk->cgrj", qh, kr.astype(jnp.bfloat16),
                         preferred_element_type=jnp.float32) * scale
         if quant:
-            cks = lax.dynamic_index_in_dim(cache["k_scale"], i, 0,
-                                           keepdims=False)
-            cks = lax.dynamic_index_in_dim(cks, slot, 0, keepdims=False)
-            cvs = lax.dynamic_index_in_dim(cache["v_scale"], i, 0,
-                                           keepdims=False)
-            cvs = lax.dynamic_index_in_dim(cvs, slot, 0, keepdims=False)
             sm = sm * cks[None, :, None, :]
         sm = jnp.where(col[None, None, None, :] < start, sm, neg)
         ss = jnp.where(intra_mask[:, None, None, :], ss, neg)
@@ -612,26 +842,28 @@ def prefill_chunk(params: llama.Params, cache: Cache,
     else:
         tok = jnp.zeros((), jnp.int32)
 
-    # Chunk rows land at [slot, start:start+C]. Scatter (not
+    # Chunk rows land at logical [slot, start:start+C]. Scatter (not
     # dynamic_update_slice): a final partial chunk's window may poke
     # past max_len, and scatter DROPS out-of-bounds indices instead of
-    # clamping the whole window backwards over valid rows.
+    # clamping the whole window backwards over valid rows (paged: the
+    # overflow maps to the sentinel block, dropped the same way).
     idx = start + jnp.arange(C)
+    blk, off = _phys(cache, table, slot, idx)
     out = dict(cache)
     if quant:
         kq_l, vq_l, ks_l, vs_l = ys       # [L,C,G,hd] / [L,C,G]
-        out["k"] = cache["k"].at[:, slot, idx].set(kq_l)
-        out["v"] = cache["v"].at[:, slot, idx].set(vq_l)
+        out["k"] = cache["k"].at[:, blk, off].set(kq_l)
+        out["v"] = cache["v"].at[:, blk, off].set(vq_l)
         # Non-adjacent advanced indices put the broadcast dim first:
         # update shape is [C, L, G].
-        out["k_scale"] = cache["k_scale"].at[:, slot, :, idx].set(
+        out["k_scale"] = cache["k_scale"].at[:, blk, :, off].set(
             ks_l.transpose(1, 0, 2))
-        out["v_scale"] = cache["v_scale"].at[:, slot, :, idx].set(
+        out["v_scale"] = cache["v_scale"].at[:, blk, :, off].set(
             vs_l.transpose(1, 0, 2))
     else:
         k_l, v_l = ys
-        out["k"] = cache["k"].at[:, slot, idx].set(k_l)
-        out["v"] = cache["v"].at[:, slot, idx].set(v_l)
+        out["k"] = cache["k"].at[:, blk, off].set(k_l)
+        out["v"] = cache["v"].at[:, blk, off].set(v_l)
     out["length"] = cache["length"].at[slot].set(new_len)
     if final:
         out["last_token"] = cache["last_token"].at[slot].set(tok)
@@ -688,17 +920,21 @@ def _decode_head(cfg, params, qweights, x):
 
 def decode_step(params: llama.Params, cache: Cache,
                 cfg: llama.LlamaConfig,
-                constrain=None, qweights=None) -> Tuple[Cache, jax.Array]:
+                constrain=None, qweights=None,
+                table=None) -> Tuple[Cache, jax.Array]:
     """One token for every slot. Returns (cache', logits [slots, vocab]).
 
     ``qweights`` (from ``quantize_block_weights``/``quantize_head``):
     run the seven block matmuls + the LM head as w8a8 int8 — half the
     weight HBM reads and the 2x int8 MXU path, the decode bottleneck.
+    ``table`` ([slots, blocks_per_slot + 1] int32): paged layout —
+    reads gather each slot's blocks in logical order, the pending-row
+    scatter maps through the table (sentinel -> dropped).
     """
     if constrain is None:
         constrain = lambda x, axes: x
     B = cache["length"].shape[0]
-    M = cache["k"].shape[2]
+    M = _logical_rows(cache, table)
     G, hd = cfg.n_kv_heads, cfg.head_dim
     rep = cfg.n_heads // G
 
@@ -752,8 +988,7 @@ def decode_step(params: llama.Params, cache: Cache,
             k_new = kq.astype(jnp.bfloat16)
             v_new = vq.astype(jnp.float32)
             ys = (kq, vq)
-        ck = lax.dynamic_index_in_dim(cache["k"], i, 0, keepdims=False)
-        cv = lax.dynamic_index_in_dim(cache["v"], i, 0, keepdims=False)
+        ck, cv, cks, cvs = _gather_kv_layer(cache, i, table)
         # The attention dots run in bf16 with fp32 ACCUMULATION. The
         # int8 cache converts to bf16 EXACTLY (integers <= 127 carry no
         # rounding in an 8-bit mantissa) and each bf16xbf16 product is
@@ -769,10 +1004,6 @@ def decode_step(params: llama.Params, cache: Cache,
         s_self = jnp.einsum("bgrk,bgk->bgr", qh, k_new,
                             preferred_element_type=jnp.float32) * scale
         if quant:
-            cks = lax.dynamic_index_in_dim(cache["k_scale"], i, 0,
-                                           keepdims=False)
-            cvs = lax.dynamic_index_in_dim(cache["v_scale"], i, 0,
-                                           keepdims=False)
             s = s * cks[:, :, None, :]
             s_self = s_self * ks.astype(jnp.float32)[:, :, None]
         s = jnp.where(valid[:, None, None, :], s, neg)
@@ -793,23 +1024,25 @@ def decode_step(params: llama.Params, cache: Cache,
     (x, _), ys = lax.scan(body, (x, jnp.int32(0)), xs)
     logits = _decode_head(cfg, params, qweights, x)
     # One batched scatter per cache array: every layer's pending row
-    # lands at [l, b, pos[b]] (the ys stacks are megabyte-scale next to
-    # the gigabyte-scale cache, and the donated cache aliases through).
+    # lands at logical [l, b, pos[b]] (the ys stacks are megabyte-scale
+    # next to the gigabyte-scale cache, and the donated cache aliases
+    # through).
+    blk, off = _phys(cache, table, batch_ix, pos)
     out = dict(cache)
     if quant:
         kq_l, vq_l, ks_l, vs_l = ys           # [L,B,G,hd] / [L,B,G]
-        out["k"] = cache["k"].at[:, batch_ix, pos].set(kq_l)
-        out["v"] = cache["v"].at[:, batch_ix, pos].set(vq_l)
+        out["k"] = cache["k"].at[:, blk, off].set(kq_l)
+        out["v"] = cache["v"].at[:, blk, off].set(vq_l)
         # Non-adjacent advanced indices put the broadcast dim first:
         # update shape is [B, L, G].
-        out["k_scale"] = cache["k_scale"].at[:, batch_ix, :, pos].set(
+        out["k_scale"] = cache["k_scale"].at[:, blk, :, off].set(
             ks_l.transpose(1, 0, 2))
-        out["v_scale"] = cache["v_scale"].at[:, batch_ix, :, pos].set(
+        out["v_scale"] = cache["v_scale"].at[:, blk, :, off].set(
             vs_l.transpose(1, 0, 2))
     else:
         k_l, v_l = ys
-        out["k"] = cache["k"].at[:, batch_ix, pos].set(k_l)
-        out["v"] = cache["v"].at[:, batch_ix, pos].set(v_l)
+        out["k"] = cache["k"].at[:, blk, off].set(k_l)
+        out["v"] = cache["v"].at[:, blk, off].set(v_l)
     return out, logits
 
 
@@ -825,8 +1058,8 @@ def commit_tokens(cache: Cache, tokens: jax.Array,
 def decode_burst_staged(params: llama.Params, cache: Cache,
                         rng: jax.Array, active: jax.Array, k: int,
                         cfg: llama.LlamaConfig, sp,
-                        qweights=None) -> Tuple[Cache, jax.Array,
-                                                jax.Array]:
+                        qweights=None, table=None
+                        ) -> Tuple[Cache, jax.Array, jax.Array]:
     """k decode steps with a per-BURST cache flush (the engine's burst
     program; trace under jit with cache+rng donated).
 
@@ -850,10 +1083,13 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
     Dead slots (inactive, or retired mid-burst) write rows past their
     logical end; flush indices beyond the buffer are DROPPED by JAX
     scatter OOB semantics, and reused slots are fully re-stamped by
-    ``insert``. Returns (cache', rng', toks [k, slots]).
+    ``insert``. With a block ``table``, cache reads gather each slot's
+    blocks in logical order and the flush scatters through the table
+    (cleared/dead slot rows map to the sentinel block and drop).
+    Returns (cache', rng', toks [k, slots]).
     """
     B = cache["length"].shape[0]
-    M = cache["k"].shape[2]
+    M = _logical_rows(cache, table)
     G, hd = cfg.n_kv_heads, cfg.head_dim
     rep = cfg.n_heads // G
     L = cfg.n_layers
@@ -903,8 +1139,7 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
             else:
                 sk = sk.at[i, batch_ix, s].set(kk[:, 0].astype(kdt))
                 sv = sv.at[i, batch_ix, s].set(v[:, 0].astype(kdt))
-            ck = lax.dynamic_index_in_dim(cache["k"], i, 0, False)
-            cv = lax.dynamic_index_in_dim(cache["v"], i, 0, False)
+            ck, cv, cks, cvs = _gather_kv_layer(cache, i, table)
             lk = lax.dynamic_index_in_dim(sk, i, 0, False)
             lv = lax.dynamic_index_in_dim(sv, i, 0, False)
             # bf16 dots, fp32 accumulation — int8 converts to bf16
@@ -917,10 +1152,6 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
                             lk.astype(jnp.bfloat16),
                             preferred_element_type=jnp.float32) * scale
             if quant:
-                cks = lax.dynamic_index_in_dim(cache["k_scale"], i, 0,
-                                               False)
-                cvs = lax.dynamic_index_in_dim(cache["v_scale"], i, 0,
-                                               False)
                 lks = lax.dynamic_index_in_dim(sks, i, 0, False)
                 lvs = lax.dynamic_index_in_dim(svs, i, 0, False)
                 sm = sm * cks[:, :, None, :]
@@ -957,16 +1188,17 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
         step, init, (keys, jnp.arange(k)))
 
     idx = pos0[:, None] + jnp.arange(k)[None, :]          # [B, k]
+    blk, off = _phys(cache, table, batch_ix[:, None], idx)
     out = dict(cache)
-    out["k"] = cache["k"].at[:, batch_ix[:, None], idx].set(sk)
-    out["v"] = cache["v"].at[:, batch_ix[:, None], idx].set(sv)
+    out["k"] = cache["k"].at[:, blk, off].set(sk)
+    out["v"] = cache["v"].at[:, blk, off].set(sv)
     if quant:
         # Non-adjacent advanced indices lead with the broadcast [B, k]
         # dims: updates are [B, k, L, G].
         out["k_scale"] = cache["k_scale"].at[
-            :, batch_ix[:, None], :, idx].set(sks.transpose(1, 2, 0, 3))
+            :, blk, :, off].set(sks.transpose(1, 2, 0, 3))
         out["v_scale"] = cache["v_scale"].at[
-            :, batch_ix[:, None], :, idx].set(svs.transpose(1, 2, 0, 3))
+            :, blk, :, off].set(svs.transpose(1, 2, 0, 3))
     out["length"] = cache["length"] + k * active.astype(jnp.int32)
     out["last_token"] = last
     return out, rng, toks

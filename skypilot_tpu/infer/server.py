@@ -578,10 +578,22 @@ def main() -> None:
                          "decode bursts (0 disables; default env "
                          "SKYTPU_PREFILL_CHUNK or 512)")
     ap.add_argument("--prefix-pool", type=int, default=None,
-                    help="prefix KV cache: reserved rows holding "
-                         "prompt prefixes for suffix-only prefill on "
-                         "shared system prompts (0 disables; default "
-                         "env SKYTPU_PREFIX_POOL or 8)")
+                    help="prefix KV cache: resident prompt prefixes "
+                         "for suffix-only prefill on shared system "
+                         "prompts (paged: ref-counted shared blocks; "
+                         "contiguous: reserved pool rows; 0 disables; "
+                         "default env SKYTPU_PREFIX_POOL or 8)")
+    ap.add_argument("--kv-block", type=int, default=None,
+                    help="paged KV cache block length: slots rent "
+                         "blocks for rows they actually use instead "
+                         "of a contiguous max-len row, so slot count "
+                         "is bounded by tokens, not worst-case length "
+                         "(0 = contiguous layout; default env "
+                         "SKYTPU_KV_BLOCK or 256)")
+    ap.add_argument("--kv-blocks", type=int, default=None,
+                    help="paged KV pool size in blocks (default env "
+                         "SKYTPU_KV_BLOCKS, or the contiguous-"
+                         "equivalent HBM: (slots+1)*max_len/block)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel degree: shard weights + KV "
                          "cache over the first N local devices "
@@ -633,6 +645,7 @@ def main() -> None:
         kv_int8=args.kv_int8, weights_int8=args.weights_int8,
         max_wave=args.admit_wave,
         prefill_chunk=args.prefill_chunk,
+        kv_block=args.kv_block, kv_blocks=args.kv_blocks,
         # Serving default: prefix reuse ON (repeated system prompts are
         # the common serving workload); the engine-level default stays
         # 0 so library users opt in.

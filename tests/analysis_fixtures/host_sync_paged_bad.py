@@ -1,0 +1,14 @@
+# Golden fixture: seeded host-sync violations around the paged block
+# table. Checked as if it were skypilot_tpu/infer/engine.py (the
+# hot-loop scope). Never imported.
+import numpy as np
+
+
+class InferenceEngine:
+    def dispatch_decode_burst(self, max_burst=8):
+        table = self.table_device()
+        first_block = int(table[0, 0])        # expect: host-sync
+        host = np.asarray(table)              # expect: host-sync
+        table.block_until_ready()             # expect: host-sync
+        used = self.cache["length"].item()    # expect: host-sync
+        return first_block, host, used

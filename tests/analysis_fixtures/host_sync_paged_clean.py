@@ -1,0 +1,16 @@
+# Clean twin: block-table bookkeeping without device fetches — the
+# authoritative table is host numpy; device programs only get
+# dispatched. Never imported.
+
+
+class InferenceEngine:
+    def dispatch_decode_burst(self, max_burst=8):
+        # Host-side numpy table ops: slicing, masking, tolist — none
+        # of these touch the device.
+        row = self.block_table[0]
+        shared = row[row < self.n_kv_blocks].tolist()
+        need = len(shared)
+        self.cache, self.rng, toks = self._decode_burst_fn(
+            self.params, self.cache, self.rng, self.table_device(),
+            k=max_burst)
+        return need, toks

@@ -1,0 +1,18 @@
+# Golden fixture: seeded retrace-safety violations in the paged
+# block-gather attention shape. Checked as if it lived at
+# skypilot_tpu/infer/ (a jit-root directory). Never imported.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def paged_attend(cache, table, length):
+    nb = table.shape[1] - 1
+    pages = cache[table[:, :nb]]              # gather: fine
+    if (table >= 0).any():                    # expect: traced-branch
+        pages = pages * 2
+    first = int(table[0, 0])                  # expect: concretize
+    host_tbl = np.asarray(table)              # expect: host-transfer
+    live = jnp.zeros(jnp.sum(length))         # expect: dynamic-shape
+    return pages, first, host_tbl, live

@@ -309,7 +309,8 @@ def test_staged_burst_cache_matches_oracle(family, cfg, params,
     assert list(out.values())[0] == req.tokens[1:]
 
     # Logits for the NEXT position via the burst-flushed cache...
-    _, logits = kvcache.decode_step(e.params, e.cache, mcfg)
+    _, logits = kvcache.decode_step(e.params, e.cache, mcfg,
+                                    table=e.table_device())
     got = np.asarray(logits[req.slot])
     # ...vs the from-scratch oracle over prompt + generated tokens.
     want = np.asarray(fwd(prompt + req.tokens))

@@ -1,0 +1,357 @@
+"""Paged block-table KV cache: allocator invariants, paged-vs-
+contiguous bit parity, COW prefix sharing, leak audits, occupancy.
+
+Tier-1 guards for the PR-7 scale refactor (ROADMAP item 1):
+
+* The host-side ``BlockAllocator`` preserves ref-count invariants
+  under randomized alloc/incref/decref sequences (no double-free, no
+  two-writer blocks) — pure host, no device needed.
+* Paged-vs-contiguous greedy generation is BIT-identical (fp32 and
+  int8), warm-vs-cold prefix hits included: the paged programs gather
+  the same values in the same order, so this is the PR-5 parity
+  guarantee extended across storage layouts.
+* ``reset()`` / ``clear_prefix_cache()`` free every block — a full
+  admit/retire cycle ends at ``blocks_used == 0``.
+* The occupancy smoke bench shows >= 4x concurrent slots at equal KV
+  HBM bytes.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import engine as eng
+from skypilot_tpu.infer import kvcache
+from skypilot_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.CONFIGS["llama3-tiny"]
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(jax.random.key(0), cfg)
+
+
+def _pair(params, cfg, *, kv_block, chunk=8, pool=4, slots=4,
+          max_len=64, buckets=(16, 48), **kw):
+    """(paged engine, contiguous twin) with otherwise identical knobs."""
+    mk = lambda blk: eng.InferenceEngine(
+        params, cfg, n_slots=slots, max_len=max_len,
+        prompt_buckets=buckets, prefill_chunk=chunk, prefix_pool=pool,
+        kv_block=blk, **kw)
+    return mk(kv_block), mk(0)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: allocator property/fuzz (host-only).
+
+def test_block_allocator_invariants_fuzz():
+    """Random alloc/incref/decref sequences preserve the invariants:
+    ref counts match a model, freed blocks recycle, a block never has
+    two writers (ref > 1 => not writable), and double-free raises."""
+    rng = random.Random(0)
+    n = 16
+    for _ in range(50):
+        a = kvcache.BlockAllocator(n)
+        model = {}                      # block -> refcount
+        for _ in range(400):
+            op = rng.random()
+            if op < 0.45 and a.available:
+                b = a.alloc()
+                assert b not in model, "alloc handed out a live block"
+                assert 0 <= b < n
+                model[b] = 1
+            elif op < 0.65 and model:
+                b = rng.choice(list(model))
+                a.incref(b)
+                model[b] += 1
+            elif model:
+                b = rng.choice(list(model))
+                a.decref(b)
+                model[b] -= 1
+                if model[b] == 0:
+                    del model[b]
+            # Invariants after every op.
+            assert a.used == len(model)
+            assert a.available == n - len(model)
+            for b, refs in model.items():
+                assert a.ref(b) == refs
+                assert a.writable(b) == (refs == 1)
+        # Double-free of anything not live must raise, never corrupt.
+        dead = next((b for b in range(n) if b not in model), None)
+        if dead is not None:
+            with pytest.raises(RuntimeError):
+                a.decref(dead)
+            assert a.used == len(model)
+        # Drain: everything returns to the pool.
+        for b, refs in list(model.items()):
+            for _ in range(refs):
+                a.decref(b)
+        assert a.used == 0 and a.available == n
+
+
+def test_block_allocator_exhaustion_and_reset():
+    a = kvcache.BlockAllocator(2)
+    a.alloc(), a.alloc()
+    with pytest.raises(RuntimeError):
+        a.alloc()
+    a.reset()
+    assert a.available == 2 and a.used == 0
+    with pytest.raises(RuntimeError):
+        a.incref(0)                     # free block: no phantom refs
+
+
+# ---------------------------------------------------------------------------
+# Paged-vs-contiguous parity (the acceptance bar).
+
+def test_paged_matches_contiguous_greedy_fp32(cfg, params):
+    """Mixed wave-path and chunk-path prompts generate token-identical
+    output on the paged engine and its contiguous twin, and the
+    decode logits over the final caches agree bit-for-bit."""
+    e_p, e_c = _pair(params, cfg, kv_block=8)
+    prompts = [[3, 17, 42, 7, 99],                 # wave path
+               list(range(1, 29)),                 # 28 toks: chunked
+               [5, 9, 31],
+               list(range(40, 60))]                # chunked
+    got = e_p.generate(prompts, max_new_tokens=6)
+    want = e_c.generate(prompts, max_new_tokens=6)
+    assert got == want
+
+
+def test_paged_matches_contiguous_greedy_int8(cfg, params):
+    e_p, e_c = _pair(params, cfg, kv_block=8, kv_int8=True)
+    prompts = [list(range(1, 25)), [3, 1, 4], list(range(30, 48))]
+    assert (e_p.generate(prompts, max_new_tokens=8)
+            == e_c.generate(prompts, max_new_tokens=8))
+
+
+def test_paged_warm_vs_cold_prefix_parity(cfg, params):
+    """The PR-5 guarantee against the paged cache: a prefix hit (shared
+    blocks, zero copies when block | chunk) generates exactly the cold
+    path's tokens — which match the contiguous twin's."""
+    # kv_block=8 == chunk: stored prefixes are block-aligned, so the
+    # hit path is pure block sharing (no COW).
+    e_p, e_c = _pair(params, cfg, kv_block=8)
+    system = list(range(5, 21))                    # 16 = 2 chunks
+    pa, pb = system + [31, 32, 33, 34], system + [41, 42, 43]
+
+    cold_a = e_c.generate([pa], max_new_tokens=6)[0]
+    assert e_p.generate([pa], max_new_tokens=6)[0] == cold_a
+    e_p.finished.clear()
+
+    cow_before = eng.KV_COW_COPIES._require_default().value
+    warm_b = e_p.generate([pb], max_new_tokens=6)[0]
+    (req_b,) = e_p.finished
+    assert req_b.cached_len == 16                  # suffix-only prefill
+    assert req_b.n_chunks == 1
+    # Block-aligned share: no copy-on-write happened.
+    assert eng.KV_COW_COPIES._require_default().value == cow_before
+    e_p.finished.clear()
+
+    e_p.clear_prefix_cache()
+    cold_b = e_p.generate([pb], max_new_tokens=6)[0]
+    assert warm_b == cold_b == e_c.generate([pb], max_new_tokens=6)[0]
+
+
+def test_paged_cow_partial_block_share(cfg, params):
+    """block_len NOT dividing the chunk: the stored prefix ends inside
+    a block, so the store copies-on-share and the hit copies-on-write —
+    and parity still holds exactly."""
+    # chunk=8, block=16 -> a 24-token prefix = 1 full block + 8 rows.
+    e_p, e_c = _pair(params, cfg, kv_block=16, chunk=8)
+    system = list(range(5, 29))                    # 24 tokens
+    pa, pb = system + [31, 32, 33], system + [41, 42]
+
+    cow0 = eng.KV_COW_COPIES._require_default().value
+    assert (e_p.generate([pa], max_new_tokens=6)[0]
+            == e_c.generate([pa], max_new_tokens=6)[0])
+    assert eng.KV_COW_COPIES._require_default().value == cow0 + 1     # copy-on-share
+    e_p.finished.clear()
+
+    warm = e_p.generate([pb], max_new_tokens=6)[0]
+    (req,) = e_p.finished
+    assert req.cached_len == 24
+    assert eng.KV_COW_COPIES._require_default().value >= cow0 + 2     # copy-on-write
+    e_p.finished.clear()
+    e_p.clear_prefix_cache()
+    assert warm == e_p.generate([pb], max_new_tokens=6)[0]
+    assert warm == e_c.generate([pb], max_new_tokens=6)[0]
+
+
+def test_paged_slot_churn_never_leaks_dead_rows(cfg, params):
+    """Freed blocks recycle across slot reuse without leaking a dead
+    occupant's rows into attention: generation over a churned engine
+    equals a fresh engine's, and blocks return to the pool."""
+    e = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                            prompt_buckets=(32,), kv_block=8,
+                            kv_blocks=10)     # tight pool: forced reuse
+    outs = e.generate([[1, 2, 3], [4, 5, 6], list(range(1, 29)),
+                       [7, 8]], max_new_tokens=4)
+    fresh = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                                prompt_buckets=(32,), kv_block=8)
+    assert outs == fresh.generate([[1, 2, 3], [4, 5, 6],
+                                   list(range(1, 29)), [7, 8]],
+                                  max_new_tokens=4)
+    assert e.blocks_used == 0
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: reset/clear audit + leak test.
+
+def test_no_block_leak_after_admit_retire_cycle(cfg, params):
+    """Full lifecycle: admit (wave + chunked + prefix store/hit),
+    decode, retire. Slots release their blocks at retirement; the only
+    survivors are prefix-cache refs, and clear_prefix_cache() drops
+    those -> blocks_used == 0."""
+    e_p, _ = _pair(params, cfg, kv_block=8)
+    system = list(range(5, 21))
+    e_p.generate([system + [31, 32], [3, 1, 4],
+                  system + [41, 42, 43]], max_new_tokens=5)
+    assert not e_p.slot_req and not e_p.chunking
+    held = e_p.blocks_used
+    assert held > 0                      # prefix entries hold blocks
+    e_p.clear_prefix_cache()
+    assert e_p.blocks_used == 0
+    # Gauges track the allocator.
+    assert eng.KV_BLOCKS_USED._require_default().value == 0
+
+
+def test_reset_frees_all_blocks_mid_flight(cfg, params):
+    """reset() with requests active, queued AND mid-chunk zeroes the
+    allocator, the table and the occupancy gauges — and the engine
+    still serves afterwards with full parity."""
+    e_p, e_c = _pair(params, cfg, kv_block=8, slots=2)
+    e_p.add_request([1, 2, 3], max_new_tokens=64)     # active
+    e_p.step()
+    e_p.add_request(list(range(1, 29)), max_new_tokens=4)  # chunked
+    e_p.admit()
+    assert e_p.chunking and e_p.blocks_used > 0
+    e_p.reset()
+    assert e_p.blocks_used == 0
+    assert eng.KV_BLOCKS_USED._require_default().value == 0
+    assert (e_p.block_table == e_p.n_kv_blocks).all()
+    assert not e_p.chunking and not e_p.slot_req and not e_p.waiting
+    assert (e_p.generate([[9, 8, 7]], max_new_tokens=4)
+            == e_c.generate([[9, 8, 7]], max_new_tokens=4))
+
+
+def test_pool_dry_stalls_admission_then_recovers(cfg, params):
+    """A pool too small for every request at once: admission stalls
+    (no crash, no corruption), retirements free blocks, everyone
+    completes, outputs match an unconstrained twin."""
+    prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+    e = eng.InferenceEngine(params, cfg, n_slots=6, max_len=64,
+                            prompt_buckets=(16,), kv_block=8,
+                            kv_blocks=8)    # 1 block/req, <6+spare
+    ref = eng.InferenceEngine(params, cfg, n_slots=6, max_len=64,
+                              prompt_buckets=(16,), kv_block=8)
+    got = e.generate(prompts, max_new_tokens=4)
+    assert got == ref.generate(prompts, max_new_tokens=4)
+    assert e.blocks_used == 0
+
+
+def test_prefix_eviction_on_dry_pool_frees_blocks(cfg, params):
+    """When admission needs blocks the prefix cache is hoarding, LRU
+    entries evict (counted) instead of stalling forever."""
+    system = list(range(5, 21))
+    e = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                            prompt_buckets=(48,), prefill_chunk=8,
+                            prefix_pool=4, kv_block=8, kv_blocks=9)
+    ev0 = eng.PREFIX_EVICTIONS._require_default().value
+    e.generate([system + [31, 32]], max_new_tokens=4)   # stores prefix
+    held = e.blocks_used
+    assert held > 0
+    # Pool: 9 blocks, the stored prefix holds 2. Two concurrent
+    # 40-token requests need 6 blocks each -> the second admission
+    # finds the pool dry, evicts the prefix entry, and (still short)
+    # stalls until the first retires — no deadlock, no corruption.
+    e.finished.clear()
+    e.generate([list(range(100, 140)), list(range(150, 190))],
+               max_new_tokens=4)
+    assert eng.PREFIX_EVICTIONS._require_default().value > ev0
+    assert not e.waiting and not e.chunking
+
+
+def test_prefix_hit_survives_dry_pool_admission(cfg, params):
+    """A hit admitted against a dry pool must not corrupt itself:
+    _alloc_blocks' eviction may reach the hit's own entry, and an
+    unpinned payload block could be freed and handed straight back as
+    a fresh block (one physical block aliased at two table positions).
+    The claim pins the shared blocks first, eviction skips entries
+    that would free nothing, and the request stalls until the hog
+    retires — warm, uncorrupted, token-identical to contiguous."""
+    system = list(range(5, 21))                     # 16 = 2 blocks
+    pb = system + [41, 42, 43, 44, 45, 46, 47, 48]  # 24 toks: hit
+    mk = lambda blk, **kw: eng.InferenceEngine(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=(48,),
+        prefill_chunk=8, prefix_pool=2, kv_block=blk, **kw)
+    e = mk(8, kv_blocks=9)
+    ref = mk(0)
+    e.generate([system + [31, 32]], max_new_tokens=4)   # store prefix
+    e.finished.clear()
+    assert e.blocks_used == 2                       # entry's 2 blocks
+    ev0 = eng.PREFIX_EVICTIONS._require_default().value
+    # Hog: 6 blocks -> pool at 8/9 used, 1 free < the hit's 2 fresh.
+    e.add_request([1, 2, 3, 4], max_new_tokens=44)
+    e.admit()
+    assert len(e.slot_req) == 1
+    e.add_request(pb, max_new_tokens=4)
+    e.run_to_completion(max_burst=4)
+    by_prompt = {tuple(r.prompt): r for r in e.finished}
+    req_b = by_prompt[tuple(pb)]
+    # Still a WARM hit (the entry was not futilely evicted) and still
+    # bit-parity with the contiguous twin.
+    assert req_b.cached_len == 16
+    assert eng.PREFIX_EVICTIONS._require_default().value == ev0
+    assert req_b.tokens == ref.generate([pb], max_new_tokens=4)[0]
+    e.clear_prefix_cache()
+    assert e.blocks_used == 0
+
+
+# ---------------------------------------------------------------------------
+# Knobs + occupancy.
+
+def test_kv_block_clamps_to_max_len_divisor(cfg, params):
+    # 256 > max_len=48 -> one block per slot; still paged.
+    e = eng.InferenceEngine(params, cfg, n_slots=1, max_len=48,
+                            prompt_buckets=(16,))
+    assert e.paged and e.kv_block == 48 and e.blocks_per_slot == 1
+    # Non-divisor request clamps down to the largest divisor.
+    e2 = eng.InferenceEngine(params, cfg, n_slots=1, max_len=48,
+                             prompt_buckets=(16,), kv_block=32)
+    assert e2.kv_block == 24
+    # A pool that cannot hold one max_len request is a config error.
+    with pytest.raises(ValueError):
+        eng.InferenceEngine(params, cfg, n_slots=1, max_len=48,
+                            prompt_buckets=(16,), kv_block=8,
+                            kv_blocks=3)
+
+
+def test_table_device_cache_invalidates_on_mutation(cfg, params):
+    e = eng.InferenceEngine(params, cfg, n_slots=2, max_len=32,
+                            prompt_buckets=(16,), kv_block=8)
+    t0 = e.table_device()
+    assert t0 is e.table_device()        # cached between calls
+    e.generate([[1, 2, 3]], max_new_tokens=2)
+    t1 = e.table_device()
+    assert t1 is not t0                  # claims/retires dirtied it
+    assert np.array_equal(np.asarray(t1), e.block_table)
+
+
+def test_bench_occupancy_smoke():
+    """Satellite: the >=4x-slots-at-equal-HBM claim, CI-sized. Equal
+    pool bytes, 8x the slots, greedy parity, zero leaked blocks."""
+    from skypilot_tpu.infer import bench_serve
+
+    r = bench_serve.run_occupancy(smoke=True)
+    assert r["same_hbm"]
+    assert r["parity_ok"]
+    assert r["leak_free"]
+    assert r["occupancy_x"] >= 4
+    assert not r["occupancy_regressed"]
+    assert r["blocks_per_token"] is not None

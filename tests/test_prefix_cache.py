@@ -124,8 +124,10 @@ def test_slot_reuse_never_reads_dead_rows(cfg, params):
     # Stronger than token equality: the next decode's logits over the
     # reused cache match a never-dirtied cache bit-for-bit (a leaked
     # dead row would perturb attention before it flips an argmax).
-    _, l_reused = kvcache.decode_step(e.params, e.cache, cfg)
-    _, l_fresh = kvcache.decode_step(fresh.params, fresh.cache, cfg)
+    _, l_reused = kvcache.decode_step(e.params, e.cache, cfg,
+                                      table=e.table_device())
+    _, l_fresh = kvcache.decode_step(fresh.params, fresh.cache, cfg,
+                                     table=fresh.table_device())
     assert np.array_equal(np.asarray(l_reused[0]), np.asarray(l_fresh[0]))
 
 
@@ -160,9 +162,12 @@ def test_prefix_index_lru_eviction():
 def test_budget_knobs_from_env(monkeypatch, cfg, params):
     monkeypatch.setenv("SKYTPU_PREFILL_CHUNK", "16")
     monkeypatch.setenv("SKYTPU_PREFIX_POOL", "3")
+    # Contiguous layout (paging off): the separate pool tensor exists.
+    monkeypatch.setenv("SKYTPU_KV_BLOCK", "0")
     e = eng.InferenceEngine(params, cfg, n_slots=1, max_len=32,
                             prompt_buckets=(16,))
     assert e.prefill_chunk == 16 and e.prefix_pool == 3
+    assert not e.paged
     assert e.pool is not None and e.pool["k"].shape[1] == 3
     # Chunking off forces the pool off too (no suffix program to use
     # a hit with), regardless of SKYTPU_PREFIX_POOL.
@@ -171,6 +176,23 @@ def test_budget_knobs_from_env(monkeypatch, cfg, params):
                              prompt_buckets=(16,))
     assert e2.prefill_chunk is None and e2.prefix_pool == 0
     assert e2.pool is None
+    # Paged (the default): no pool tensor — prefixes are shared
+    # blocks; SKYTPU_KV_BLOCK sizes the block, clamped to a divisor
+    # of max_len, and SKYTPU_KV_BLOCKS sizes the pool.
+    monkeypatch.setenv("SKYTPU_PREFILL_CHUNK", "16")
+    monkeypatch.setenv("SKYTPU_KV_BLOCK", "8")
+    monkeypatch.setenv("SKYTPU_KV_BLOCKS", "6")
+    e3 = eng.InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                             prompt_buckets=(16,))
+    assert e3.paged and e3.kv_block == 8 and e3.n_kv_blocks == 6
+    assert e3.pool is None and e3.prefix_pool == 3
+    assert e3.cache["k"].shape[1] == 6      # block pool, not slots
+    assert e3.block_table.shape == (2, 32 // 8 + 1)
+    monkeypatch.delenv("SKYTPU_KV_BLOCKS")
+    # Default pool size: the contiguous-equivalent HBM.
+    e4 = eng.InferenceEngine(params, cfg, n_slots=1, max_len=32,
+                             prompt_buckets=(16,))
+    assert e4.n_kv_blocks == 2 * (32 // 8)
 
 
 def test_bench_serve_smoke_guard():

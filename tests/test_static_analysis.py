@@ -118,7 +118,14 @@ def _assert_golden(checker_name, name, rel, root=None):
 _GOLDEN = [
     ("retrace-safety", "retrace_bad.py", "retrace_clean.py",
      "skypilot_tpu/infer/fixture_retrace.py"),
+    # Paged-KV shape: the block-gather attention pattern (PR 7) —
+    # proves the checker covers table gathers/scatters, not just the
+    # contiguous idiom.
+    ("retrace-safety", "retrace_paged_bad.py", "retrace_paged_clean.py",
+     "skypilot_tpu/infer/fixture_retrace_paged.py"),
     ("host-sync", "host_sync_bad.py", "host_sync_clean.py",
+     "skypilot_tpu/infer/engine.py"),
+    ("host-sync", "host_sync_paged_bad.py", "host_sync_paged_clean.py",
      "skypilot_tpu/infer/engine.py"),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      "skypilot_tpu/utils/fixture_locks.py"),
