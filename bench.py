@@ -375,6 +375,38 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"occupancy bench failed: {e}")
             out["serve_occupancy_error"] = str(e)[:200]
+        # Speculative-decoding phase: spec-on vs spec-off decode TPOT
+        # on the same engine (repetition-heavy workload) plus the
+        # oracle-draft ceiling — the raw-TPOT lever tracked release
+        # over release (ROADMAP item 2).
+        try:
+            from skypilot_tpu.infer import bench_serve as _bs
+            sp = _bs.run_spec(config=serve_cfg, weights_int8=big,
+                              kv_int8=big)
+            out["serve_spec_speedup"] = sp["speedup"]
+            out["serve_spec_accept_rate"] = sp["accept_rate"]
+            out["serve_spec_tpot_off_ms"] = sp["tpot_off_ms"]
+            out["serve_spec_tpot_ms"] = sp["tpot_spec_ms"]
+            out["serve_spec_oracle_speedup"] = sp["oracle_speedup"]
+            out["serve_spec_oracle_accept_rate"] = \
+                sp["oracle_accept_rate"]
+            out["serve_spec_parity_ok"] = bool(
+                sp["parity_ok"] and sp["oracle_parity_ok"])
+            # Gate: >= 1.5x decode tok/s on the repetition-heavy
+            # workload with bit-identical greedy output (the tentpole
+            # target is 2x; 1.5x is the regression floor).
+            out["serve_spec_regressed"] = bool(
+                sp["speedup"] < 1.5
+                or not out["serve_spec_parity_ok"])
+            if out["serve_spec_regressed"]:
+                log("SERVE SPEC REGRESSION: "
+                    f"x{sp['speedup']} (< 1.5) or parity broken "
+                    f"(ngram={sp['parity_ok']}, "
+                    f"oracle={sp['oracle_parity_ok']}, "
+                    f"accept={sp['accept_rate']})")
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"spec bench failed: {e}")
+            out["serve_spec_error"] = str(e)[:200]
     if args.emit_metrics:
         from skypilot_tpu.observability import metrics as obs_metrics
         # Only families something actually recorded into: a bench run

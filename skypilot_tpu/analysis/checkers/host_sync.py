@@ -36,6 +36,11 @@ _SCOPES: Dict[str, Set[str]] = {
         # pipeline once per claim/retire.
         "table_device", "_alloc_blocks", "_wave_claim",
         "_free_slot_blocks", "_need_blocks",
+        # Speculative decode (PR 8): drafting is pure host work (the
+        # n-gram index) and the verify burst's ONE deliberate fetch is
+        # its completion sync — anything else here stalls the verify/
+        # accept hot path once per burst.
+        "spec_decode_burst", "_draft_for",
     },
     "skypilot_tpu/infer/server.py": {
         "_loop", "_step", "_drain_inbox", "_flush_streams",
@@ -58,7 +63,8 @@ class HostSyncChecker(Checker):
                    "step/burst/chunk loops and the trainer step path")
     scope = "file"
     # v2: paged-KV block-management methods joined the engine scope.
-    version = 2
+    # v3: the speculative verify/accept path joined it.
+    version = 3
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

@@ -378,6 +378,18 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
         kv_total = gauge("skytpu_kv_blocks_total")
         if kv_used is not None and kv_total:
             line += f"  kv {kv_used:.0f}/{kv_total:.0f}"
+        # Speculative-decode acceptance (docs/serving.md): the window
+        # rate when drafting happened between frames, else the
+        # engines' lifetime gauge (first frame / --once / idle).
+        if "skytpu_spec_drafted_total" in have:
+            d_dr = rate("skytpu_spec_drafted_total")
+            d_ac = rate("skytpu_spec_accepted_total")
+            if d_dr:
+                line += f"  spec acc {(d_ac or 0) / d_dr:4.0%}"
+            else:
+                g = gauge("skytpu_spec_acceptance_rate", agg="max")
+                if g is not None:
+                    line += f"  spec acc {g:4.0%}"
         lines.append(line)
     if "skytpu_lb_proxied_total" in have:
         lines.append(

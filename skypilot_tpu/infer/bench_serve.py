@@ -706,6 +706,180 @@ def run_smoke() -> dict:
     return run_prefix_share(smoke=True)
 
 
+class _OracleDrafter:
+    """Replays a known-correct continuation as the draft — the
+    acceptance CEILING for the verify path: every burst accepts the
+    full draft, so the measured speedup is what the fixed-K verify
+    program delivers when drafts are right, independent of how
+    n-gram-predictable the (random-weight) bench model's output is."""
+
+    def __init__(self, out):
+        self.out = list(out)
+        self._gen = 0
+
+    def catch_up(self, prompt, generated):
+        self._gen = len(generated)
+
+    def draft(self, k):
+        return self.out[self._gen:self._gen + k]
+
+
+def run_spec(config=None, spec_k=4, requests=None, prompt_len=16,
+             new_tokens=None, max_burst=8, kv_int8=False,
+             weights_int8=False, smoke=False) -> dict:
+    """Speculative-decoding bench: spec-on vs spec-off decode TPOT on
+    the SAME engine (same weights, same compiled decode programs —
+    ``spec_k`` only routes decode_burst), greedy parity asserted, plus
+    the oracle-draft ceiling.
+
+    Workload: repetition-heavy synthetic serving. The bench model's
+    weights are random, so its greedy output is n-gram-predictable
+    only where generation enters a cycle; a small vocabulary makes the
+    random model's greedy trajectories cycle within a few dozen tokens
+    — the synthetic stand-in for the repeated spans (boilerplate,
+    quoted input, looping chains) that make prompt-lookup pay on real
+    models. Three decode passes on one engine:
+
+      1. spec-off     — baseline TPOT at ``max_burst`` plain bursts
+      2. spec-on      — n-gram drafter (the shipped default)
+      3. oracle-draft — drafts replay pass 1's tokens: 100% acceptance
+                        by construction, the verify-path ceiling
+
+    TTFT is out of scope by construction: speculation only replaces
+    decode bursts — admission, chunking and prefill are untouched (the
+    --prefix-share and full-load benches guard TTFT).
+
+    ``smoke=True``: CI-sized (tier-1 wiring in tests/test_spec_decode
+    .py) — asserts parity and acceptance structure, never wall-clock
+    (a compute-bound CPU cannot show a memory-bandwidth win).
+    """
+    import dataclasses
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.infer import engine as eng
+    from skypilot_tpu.models import llama
+
+    on_cpu = jax.default_backend() == "cpu"
+    if config is None:
+        config = "llama3-tiny" if on_cpu else "llama3-400m"
+    small = smoke or on_cpu
+    if requests is None:
+        requests = 4 if small else 8
+    if new_tokens is None:
+        new_tokens = 96 if small else 256
+    spec_k = max(int(spec_k), 1)
+    slots = requests
+    max_len = 128 if small else 512
+    assert prompt_len + new_tokens + spec_k + 1 <= max_len
+    # Small vocab => the random model's greedy decode cycles quickly
+    # (the repetition-heavy regime); block weights — the decode cost —
+    # keep the config's full size.
+    cfg = dataclasses.replace(llama.CONFIGS[config], vocab_size=16)
+    log(f"spec bench: {config} (vocab 16) K={spec_k} "
+        f"requests={requests} new_tokens={new_tokens}")
+    kw = dict(n_slots=slots, max_len=max_len,
+              prompt_buckets=(prompt_len,), kv_int8=kv_int8,
+              prefill_chunk=0, prefix_pool=0, max_wave=slots,
+              pad_waves=True, spec_k=spec_k)
+    if weights_int8:
+        from skypilot_tpu.infer import kvcache
+        params, qw = kvcache.random_quantized_params(cfg)
+        e = eng.InferenceEngine(params, cfg, qweights=qw, **kw)
+    else:
+        params = llama.init_params(jax.random.key(0), cfg)
+        e = eng.InferenceEngine(params, cfg, **kw)
+    ngram_factory = e._spec_drafter_factory
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(requests)]
+
+    def decode_pass(spec_on, factory=None):
+        """One admit-then-decode pass; TPOT measured over the decode
+        loop only (admission/prefill excluded — spec does not touch
+        them). Returns (outputs, tpot_s, drafted, accepted, bursts)."""
+        e.spec_k = spec_k if spec_on else 0
+        e._spec_drafter_factory = factory or ngram_factory
+        d0, a0 = e._spec_drafted_total, e._spec_accepted_total
+        ids = [e.add_request(p, max_new_tokens=new_tokens)
+               for p in prompts]
+        e.admit()
+        t0 = _time.time()
+        bursts = 0
+        while e.slot_req:
+            e.decode_burst(max_burst)
+            bursts += 1
+        float(e.cache["length"][0])     # honest host sync
+        wall = _time.time() - t0
+        by_rid = {r.rid: list(r.tokens) for r in e.finished}
+        outs = [by_rid[i] for i in ids]
+        e.finished.clear()
+        # First tokens came from admission; TPOT charges decode only.
+        dtoks = sum(len(o) for o in outs) - len(outs)
+        return (outs, wall / max(dtoks, 1),
+                e._spec_drafted_total - d0,
+                e._spec_accepted_total - a0, bursts)
+
+    # Warmup: compile the admission program, the plain burst at the
+    # measured size AND the verify program outside any timed window.
+    decode_pass(False)
+    decode_pass(True)
+
+    out_off, tpot_off, _, _, bursts_off = decode_pass(False)
+    out_on, tpot_on, drafted, accepted, bursts_on = decode_pass(True)
+    oracle = {tuple(p): o for p, o in zip(prompts, out_off)}
+    out_or, tpot_or, dr_or, ac_or, bursts_or = decode_pass(
+        True, factory=lambda req: _OracleDrafter(oracle[tuple(req.prompt)]))
+
+    parity_ok = out_on == out_off
+    oracle_parity_ok = out_or == out_off
+    rate = accepted / max(drafted, 1)
+    oracle_rate = ac_or / max(dr_or, 1)
+    dtoks = sum(len(o) for o in out_off) - len(out_off)
+    log(f"spec: off {tpot_off * 1e3:.2f}ms/tok ({bursts_off} bursts) "
+        f"ngram {tpot_on * 1e3:.2f}ms ({bursts_on} bursts, "
+        f"accept {rate:.2f}) oracle {tpot_or * 1e3:.2f}ms "
+        f"({bursts_or} bursts, accept {oracle_rate:.2f}) "
+        f"parity={parity_ok}/{oracle_parity_ok}")
+    return {
+        "tpot_off_ms": round(tpot_off * 1e3, 3),
+        "tpot_spec_ms": round(tpot_on * 1e3, 3),
+        "tpot_oracle_ms": round(tpot_or * 1e3, 3),
+        # Decode-throughput ratios (the gates read these): wall-clock,
+        # so only meaningful on hardware where decode is memory-bound
+        # — bench.py evaluates them from the TPU artifact.
+        "speedup": round(tpot_off / max(tpot_on, 1e-9), 3),
+        "oracle_speedup": round(tpot_off / max(tpot_or, 1e-9), 3),
+        "accept_rate": round(rate, 3),
+        "oracle_accept_rate": round(oracle_rate, 3),
+        "drafted": int(drafted),
+        "accepted": int(accepted),
+        "parity_ok": bool(parity_ok),
+        "oracle_parity_ok": bool(oracle_parity_ok),
+        # Structural (timing-free) evidence the verify path carried
+        # the decode: device dispatches per pass.
+        "bursts_off": int(bursts_off),
+        "bursts_spec": int(bursts_on),
+        "bursts_oracle": int(bursts_or),
+        "decode_tokens": int(dtoks),
+        "spec_k": spec_k,
+        "requests": requests,
+        "new_tokens": new_tokens,
+        "config": config,
+        "kv_int8": kv_int8,
+        "weights_int8": weights_int8,
+    }
+
+
+def run_spec_smoke() -> dict:
+    """CI-sized spec pass (tier-1 wiring: tests/test_spec_decode.py
+    asserts parity, oracle acceptance == 1.0 and burst-count
+    structure; wall-clock is reported, never gated, on CPU)."""
+    return run_spec(smoke=True)
+
+
 def run_occupancy(config=None, smoke=False, kv_int8=False,
                   weights_int8=False, factor=8, max_burst=4) -> dict:
     """High-occupancy decode sweep: max concurrent decode slots at the
@@ -865,7 +1039,30 @@ def main() -> None:
                     help="high-occupancy sweep: max concurrent slots "
                          "at equal KV HBM, paged vs contiguous, with "
                          "greedy parity (the paged-cache headline)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding bench: spec-on vs "
+                         "spec-off decode TPOT on the same engine "
+                         "(repetition-heavy workload + oracle-draft "
+                         "ceiling), greedy parity asserted (combine "
+                         "with --smoke for the CI-sized pass)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft length K for --spec")
     args = ap.parse_args()
+    if args.spec:
+        r = run_spec(config=args.config, spec_k=args.spec_k,
+                     kv_int8=args.kv_int8,
+                     weights_int8=args.weights_int8,
+                     smoke=args.smoke)
+        print(json.dumps({
+            "metric": "serve_spec_speedup",
+            "value": r["speedup"],
+            "unit": "x_decode_tok_s_vs_spec_off",
+            **{k: r[k] for k in (
+                "tpot_off_ms", "tpot_spec_ms", "tpot_oracle_ms",
+                "oracle_speedup", "accept_rate", "oracle_accept_rate",
+                "parity_ok", "oracle_parity_ok", "spec_k", "config")},
+        }))
+        return
     if args.occupancy:
         r = run_occupancy(config=args.config, kv_int8=args.kv_int8,
                           weights_int8=args.weights_int8)

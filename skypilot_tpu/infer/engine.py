@@ -104,6 +104,23 @@ KV_COW_COPIES = metrics.counter(
     "skytpu_kv_cow_copies_total",
     "Paged KV cache copy-on-write block copies (partial shared blocks "
     "duplicated on prefix store/hit before a writer touches them)")
+SPEC_DRAFTED = metrics.counter(
+    "skytpu_spec_drafted_total",
+    "Speculative-decode draft tokens proposed (n-gram/prompt-lookup) "
+    "and scored by a verify burst")
+SPEC_ACCEPTED = metrics.counter(
+    "skytpu_spec_accepted_total",
+    "Speculative-decode draft tokens accepted (matched the model's "
+    "greedy argmax and were committed)")
+SPEC_ROLLBACKS = metrics.counter(
+    "skytpu_spec_rollbacks_total",
+    "Speculative-decode draft tokens not committed — rejected by "
+    "verification, or discarded when the request retired mid-run "
+    "(their KV rows sit past the committed length and are never read)")
+SPEC_ACCEPT_RATE = metrics.gauge(
+    "skytpu_spec_acceptance_rate",
+    "Speculative-decode lifetime acceptance rate "
+    "(accepted / drafted; 0 until the first draft)")
 
 
 @dataclasses.dataclass
@@ -128,6 +145,15 @@ class Request:
     cached_len: int = 0
     n_chunks: int = 0
     prefill_begin_s: float = 0.0
+    # Speculative-decode stats (surfaced next to the cache stats in
+    # the response trailer) + per-request drafter state. ``spec_off``
+    # flips when this request's acceptance collapses — it keeps riding
+    # verify bursts with an empty draft (or plain bursts when every
+    # active request collapsed), never paying wasted verify compute.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    spec_off: bool = False
+    drafter: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -289,6 +315,78 @@ class PrefixIndex:
                 self._ent_keys.setdefault(payload, set()).add(d)
 
 
+class NGramDrafter:
+    """Prompt-lookup speculative drafter (host-side, zero device work).
+
+    The request's context (prompt + committed tokens) is indexed by
+    trailing n-gram: ``_index`` maps each n-gram to the START of its
+    most recent occurrence that already has a continuation. Drafting
+    looks up the context's last n tokens and proposes the up-to-k
+    tokens that followed that earlier occurrence — the prompt-lookup
+    heuristic: repeated spans (shared boilerplate, quoted input, a
+    generation that has entered a cycle) verify at near-full
+    acceptance, and a miss costs nothing (empty draft).
+
+    No second model, no trained weights: correctness never depends on
+    draft quality because verification is greedy-exact — a bad draft
+    only wastes the verify burst's spare positions.
+    """
+
+    def __init__(self, tokens: List[int], n: int = 2):
+        self.n = max(int(n), 1)
+        self.tokens: List[int] = []
+        self._index: Dict[Tuple[int, ...], int] = {}
+        self.extend(tokens)
+
+    def extend(self, toks) -> None:
+        """Append committed tokens, indexing each n-gram the moment it
+        gains a continuation (the trailing n-gram itself is never
+        indexed — it has nothing after it to draft)."""
+        for t in toks:
+            self.tokens.append(int(t))
+            j = len(self.tokens) - self.n - 1
+            if j >= 0:
+                self._index[tuple(self.tokens[j:j + self.n])] = j
+
+    def catch_up(self, prompt: List[int], generated: List[int]) -> None:
+        """Sync with the request after tokens committed through any
+        path (verify bursts, a plain-decode fallback burst, the
+        admission first token)."""
+        missing = len(prompt) + len(generated) - len(self.tokens)
+        if missing > 0:
+            self.extend(generated[len(generated) - missing:])
+
+    def draft(self, k: int) -> List[int]:
+        """Up to ``k`` proposed continuation tokens ([] on a miss or a
+        context shorter than one n-gram — degenerate prompts draft
+        nothing rather than guessing).
+
+        Self-extending: when the matched continuation runs into the
+        end of the context (the most recent occurrence is near the
+        tail — ALWAYS the case once generation enters a cycle), the
+        lookup continues from the draft's own tail n-gram, which by
+        construction re-matches an earlier occurrence. A tight loop
+        therefore drafts the full K instead of the 1-2 tokens left
+        after the nearest match."""
+        if k <= 0 or len(self.tokens) < self.n:
+            return []
+        out: List[int] = []
+        # Only the trailing n tokens ever feed the key — keep the
+        # lookup O(n + k), not O(context): drafting runs per slot per
+        # burst on the verify hot path.
+        tail = self.tokens[-self.n:]
+        while len(out) < k:
+            key = tuple((tail + out)[-self.n:])
+            j = self._index.get(key)
+            if j is None:
+                break
+            take = self.tokens[j + self.n:j + self.n + k - len(out)]
+            if not take:
+                break
+            out.extend(take)
+        return out
+
+
 @dataclasses.dataclass
 class _ChunkState:
     """A request mid-chunked-prefill: slot claimed, rows [0, pos)
@@ -317,7 +415,9 @@ class InferenceEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_pool: Optional[int] = None,
                  kv_block: Optional[int] = None,
-                 kv_blocks: Optional[int] = None):
+                 kv_blocks: Optional[int] = None,
+                 spec_k: Optional[int] = None,
+                 spec_drafter: Optional[Callable] = None):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -365,6 +465,39 @@ class InferenceEngine:
         self.pad_waves = bool(pad_waves and self.max_wave)
         self.sampling_params = sampling_params
         self.eos_id = eos_id
+        # Speculative decoding: a host-side drafter proposes up to K
+        # tokens per slot per burst and ONE compiled verify program
+        # scores the K+1 window positions in a single forward pass —
+        # K is static, so no new retrace surface. Greedy-exact: spec
+        # is forced off under temperature sampling (verification is
+        # only output-preserving for argmax, and the RNG stream must
+        # stay untouched). Budget knob: SKYTPU_SPEC_K (ctor arg wins;
+        # 0 = off — the library default; the server defaults to 4).
+        # Clamped to [0, 16]: each K compiles its own program and
+        # acceptance past a handful of tokens is workload fantasy.
+        if spec_k is None:
+            spec_k = int(os.environ.get("SKYTPU_SPEC_K", "0") or 0)
+        spec_k = max(0, min(int(spec_k), 16))
+        if sampling_params.temperature > 0.0:
+            spec_k = 0
+        self.spec_k = spec_k
+        # Pluggable drafter factory (request -> drafter with the
+        # NGramDrafter protocol: catch_up/draft). The seam a future
+        # draft-model drafter plugs into; default is prompt-lookup.
+        self._spec_drafter_factory = (
+            spec_drafter
+            if spec_drafter is not None
+            else (lambda req: NGramDrafter(req.prompt)))
+        # Per-request acceptance-collapse fallback: once a request has
+        # drafted >= spec_min_drafted tokens at an acceptance rate
+        # below spec_min_rate, it stops drafting (spec_off) — verify
+        # compute stops being wasted on a workload n-grams can't
+        # predict, and the burst degrades to plain decode when every
+        # active request has collapsed.
+        self.spec_min_drafted = 16
+        self.spec_min_rate = 0.2
+        self._spec_drafted_total = 0
+        self._spec_accepted_total = 0
         # Paged KV cache: the default storage layout. Fixed-size blocks
         # from one shared pool + a per-slot block table decouple slot
         # count from worst-case length — a slot's HBM rent is its
@@ -564,6 +697,19 @@ class InferenceEngine:
                 params, cache, rng, active, k, cfg, sp,
                 qweights=qweights, table=table)
 
+        # Speculative verify: the decode_burst_staged formulation with
+        # the sampled-token feedback replaced by the host's draft
+        # window and greedy argmax outputs + on-device acceptance. No
+        # RNG argument at all — the greedy stream stays untouched, so
+        # spec-on and spec-off runs consume identical RNG.
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("k",))
+        def _verify(params, cache, draft, n_draft, active, table=None,
+                    *, k, qweights=None):
+            return kvcache.verify_draft_staged(
+                params, cache, draft, n_draft, active, k, cfg,
+                qweights=qweights, table=table)
+
         # Chunked-prefill programs: ONE chunk program (two traces: the
         # ``final`` variant samples the first token and splits the RNG)
         # serves every bucket and every suffix offset; the claim/copy
@@ -597,6 +743,7 @@ class InferenceEngine:
         self._admit_wave_fn = _admit_wave
         self._decode_fn = _decode
         self._decode_burst_fn = _decode_burst
+        self._verify_fn = _verify
         self._prefill_chunk_fn = _prefill_chunk
         self._claim_fn = _claim
         self._pool_load_fn = _pool_load
@@ -1219,11 +1366,140 @@ class InferenceEngine:
     def decode_burst(self, max_burst: int = 8) -> Dict[int, List[int]]:
         """Decode up to ``max_burst`` tokens per active slot in one
         device call — NO admission (callers that interleave admission
-        and decode use :meth:`admit` + this)."""
+        and decode use :meth:`admit` + this).
+
+        With speculation enabled (``spec_k > 0``) a verify burst
+        REPLACES the plain decode burst: one device call scores K
+        drafted tokens + the correction position per slot and commits
+        the accepted run. Falls back to a plain burst only for the
+        rounds where NO active slot drafted (all missed, collapsed,
+        or out of row headroom — a tight slot alone just rides the
+        verify burst with an empty draft)."""
+        if self.spec_k:
+            out = self.spec_decode_burst()
+            if out is not None:
+                return out
         handle = self.dispatch_decode_burst(max_burst)
         if handle is None:
             return {}
         return self.complete_decode_burst(handle)
+
+    def _draft_for(self, req: Request) -> List[int]:
+        """This request's draft for the next verify burst (possibly
+        empty). Host-only: builds the drafter lazily, syncs it with
+        tokens committed through any path, and applies the
+        acceptance-collapse fallback."""
+        if req.spec_off:
+            return []
+        if (req.spec_drafted >= self.spec_min_drafted
+                and req.spec_accepted
+                < self.spec_min_rate * req.spec_drafted):
+            req.spec_off = True
+            return []
+        if req.drafter is None:
+            req.drafter = self._spec_drafter_factory(req)
+            if req.drafter is None:          # factory opted this one out
+                req.spec_off = True
+                return []
+        req.drafter.catch_up(req.prompt, req.tokens)
+        return req.drafter.draft(self.spec_k)
+
+    def spec_decode_burst(self) -> Optional[Dict[int, List[int]]]:
+        """One draft-and-verify burst for every active slot: the host
+        drafter proposes up to K tokens per slot, ONE compiled verify
+        program scores the K+1 window positions, and the accepted run
+        (+ the correction token) commits — up to K+1 tokens per slot
+        per device call instead of 1.
+
+        Synchronous by design (unlike the async plain-burst pair): the
+        NEXT draft depends on the tokens this burst commits, so there
+        is nothing to double-buffer — the fetch below IS the
+        completion fetch.
+
+        Returns None when the spec path can't run this round and the
+        caller should fall back to a plain decode burst: no active
+        slot produced a draft (all missed, collapsed, or out of row
+        headroom — a K+1-wide verify would then be strictly worse
+        than a plain burst).
+        """
+        K = self.spec_k
+        if not self.slot_req or K <= 0:
+            return None
+        draft = np.zeros((self.n_slots + 1, K), np.int32)
+        n_draft = np.zeros((self.n_slots + 1,), np.int32)
+        drafted = 0
+        for slot, req in self.slot_req.items():
+            # A slot within K+1 rows of max_len drafts NOTHING instead
+            # of disabling speculation engine-wide: its single
+            # correction row (at length <= max_len-1, guaranteed for
+            # any active request) is in bounds, its spare window rows
+            # past max_len drop via the same OOB-scatter net every
+            # dead-slot write rides, and every other slot keeps its
+            # draft. (Budget needs no check: an active request always
+            # has >= 1 token remaining — every commit path retires at
+            # the cap via _req_finished.)
+            if len(req.prompt) + len(req.tokens) + K + 1 > self.max_len:
+                continue
+            d = self._draft_for(req)
+            if d:
+                n_draft[slot] = len(d)
+                draft[slot, :len(d)] = d
+                drafted += len(d)
+        if not drafted:
+            return None
+        active = np.zeros((self.n_slots + 1,), bool)
+        for s in self.slot_req:
+            active[s] = True
+        span = timeline.Event("skytpu_decode_step_seconds",
+                              histogram=DECODE_STEP_SECONDS)
+        span.begin()
+        self.cache, toks_dev, commit_dev = self._verify_fn(
+            self.params, self.cache, jnp.asarray(draft),
+            jnp.asarray(n_draft), jnp.asarray(active),
+            self.table_device(), k=K, qweights=self.qweights)
+        # THE completion fetch: verify bursts are synchronous (the next
+        # draft depends on these tokens), so this is the one deliberate
+        # sync of the spec path — same role as complete_decode_burst's.
+        toks = np.asarray(toks_dev)                    # [B, K+1]
+        n_commit = np.asarray(commit_dev)              # [B]
+        span.end()
+        out: Dict[int, List[int]] = {}
+        n_emitted = accepted = 0
+        for slot, req in list(self.slot_req.items()):
+            nd = int(n_draft[slot])
+            nc = int(n_commit[slot])
+            emitted: List[int] = []
+            for i in range(nc):
+                tok = int(toks[slot, i])
+                emitted.append(tok)
+                req.tokens.append(tok)
+                if self._req_finished(req, tok):
+                    self._retire(req)
+                    break
+            # Accepted = matched draft tokens the request actually
+            # emitted: the first nc-1 outputs are the matched run, the
+            # nc-th the correction/bonus — an early EOS/budget retire
+            # discards the tail, and counting the full run would
+            # inflate the trailer stats and the acceptance gauge on
+            # EOS-heavy workloads.
+            acc = min(len(emitted), nc - 1)
+            req.spec_drafted += nd
+            req.spec_accepted += acc
+            accepted += acc
+            out[req.rid] = emitted
+            n_emitted += len(emitted)
+        SPEC_DRAFTED.inc(drafted)
+        if accepted:
+            SPEC_ACCEPTED.inc(accepted)
+        if drafted > accepted:
+            SPEC_ROLLBACKS.inc(drafted - accepted)
+        self._spec_drafted_total += drafted
+        self._spec_accepted_total += accepted
+        SPEC_ACCEPT_RATE.set(self._spec_accepted_total
+                             / self._spec_drafted_total)
+        if n_emitted:
+            DECODE_TOKENS.inc(n_emitted)
+        return out
 
     def dispatch_decode_burst(self, max_burst: int = 8
                               ) -> Optional["BurstHandle"]:

@@ -1055,6 +1055,98 @@ def commit_tokens(cache: Cache, tokens: jax.Array,
         last_token=jnp.where(active, tokens, cache["last_token"]))
 
 
+def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
+                       i, s, sk, sv, sks, svs, valid_cache,
+                       stage_valid, batch_ix):
+    """One decoder layer of a staged-burst step: the current step's
+    K/V rows land in the staging buffers, attention runs as big-cache
+    dot (rows masked by ``valid_cache``) ++ staged-columns dot
+    (columns masked by ``stage_valid``), and the big cache stays a
+    pure invariant. Shared VERBATIM by :func:`decode_burst_staged` and
+    :func:`verify_draft_staged` — the speculative parity guarantee is
+    precisely that both programs run THIS math, so an edit here can
+    never drift one without the other. Returns (x', sk, sv, sks, svs).
+    """
+    quant = "k_scale" in cache
+    wq8 = qlayer is not None
+    kdt = cache["k"].dtype
+    sdt = cache["k_scale"].dtype if quant else None
+    B = x.shape[0]
+    G, hd = cfg.n_kv_heads, cfg.head_dim
+    rep = cfg.n_heads // G
+    M = _logical_rows(cache, table)
+    scale = hd ** -0.5
+    neg = jnp.asarray(-1e30, jnp.float32)
+
+    q, kk, v = _decode_qkv(cfg, layer, qlayer, x, cos, sin)
+    if quant:
+        kq, ksc = quantize_rows(kk[:, 0])
+        vq, vsc = quantize_rows(v[:, 0])
+        ksc, vsc = ksc.astype(sdt), vsc.astype(sdt)
+        sk = sk.at[i, batch_ix, s].set(kq)
+        sv = sv.at[i, batch_ix, s].set(vq)
+        sks = sks.at[i, batch_ix, s].set(ksc)
+        svs = svs.at[i, batch_ix, s].set(vsc)
+    else:
+        sk = sk.at[i, batch_ix, s].set(kk[:, 0].astype(kdt))
+        sv = sv.at[i, batch_ix, s].set(v[:, 0].astype(kdt))
+    ck, cv, cks, cvs = _gather_kv_layer(cache, i, table)
+    lk = lax.dynamic_index_in_dim(sk, i, 0, False)
+    lv = lax.dynamic_index_in_dim(sv, i, 0, False)
+    # bf16 dots, fp32 accumulation — int8 converts to bf16 exactly
+    # (see decode_step's note).
+    qh = q[:, 0].reshape(B, G, rep, hd).astype(jnp.bfloat16)
+    sm = jnp.einsum("bgrk,bmgk->bgrm", qh,
+                    ck.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32) * scale
+    ss = jnp.einsum("bgrk,bjgk->bgrj", qh,
+                    lk.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32) * scale
+    if quant:
+        lks = lax.dynamic_index_in_dim(sks, i, 0, False)
+        lvs = lax.dynamic_index_in_dim(svs, i, 0, False)
+        sm = sm * cks[:, :, None, :]
+        ss = ss * lks.transpose(0, 2, 1)[:, :, None, :]
+    sm = jnp.where(valid_cache[:, None, None, :], sm, neg)
+    ss = jnp.where(stage_valid[:, None, None, :], ss, neg)
+    w = jax.nn.softmax(jnp.concatenate([sm, ss], axis=-1), axis=-1)
+    wm, ws = w[..., :M], w[..., M:]
+    if quant:
+        wm = wm * cvs[:, :, None, :]
+        ws = ws * lvs.transpose(0, 2, 1)[:, :, None, :]
+    o = jnp.einsum("bgrm,bmgk->bgrk", wm.astype(jnp.bfloat16),
+                   cv.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+    o = o + jnp.einsum("bgrj,bjgk->bgrk",
+                       ws.astype(jnp.bfloat16),
+                       lv.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+    x = _decode_out_ffn(cfg, layer, qlayer, wq8, x, o)
+    return x, sk, sv, sks, svs
+
+
+def _flush_staged_rows(cache: Cache, table, pos0, batch_ix,
+                       sk, sv, sks, svs) -> Cache:
+    """One batched scatter per cache array: every staged window row
+    lands at logical [b, pos0[b] + j] (through the block table when
+    paged — sentinel/overflow rows drop). Shared by the burst and
+    verify programs; the caller updates length/last_token."""
+    W = sk.shape[2]
+    idx = pos0[:, None] + jnp.arange(W)[None, :]           # [B, W]
+    blk, off = _phys(cache, table, batch_ix[:, None], idx)
+    out = dict(cache)
+    out["k"] = cache["k"].at[:, blk, off].set(sk)
+    out["v"] = cache["v"].at[:, blk, off].set(sv)
+    if "k_scale" in cache:
+        # Non-adjacent advanced indices lead with the broadcast [B, W]
+        # dims: updates are [B, W, L, G].
+        out["k_scale"] = cache["k_scale"].at[
+            :, blk, :, off].set(sks.transpose(1, 2, 0, 3))
+        out["v_scale"] = cache["v_scale"].at[
+            :, blk, :, off].set(svs.transpose(1, 2, 0, 3))
+    return out
+
+
 def decode_burst_staged(params: llama.Params, cache: Cache,
                         rng: jax.Array, active: jax.Array, k: int,
                         cfg: llama.LlamaConfig, sp,
@@ -1091,10 +1183,7 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
     B = cache["length"].shape[0]
     M = _logical_rows(cache, table)
     G, hd = cfg.n_kv_heads, cfg.head_dim
-    rep = cfg.n_heads // G
     L = cfg.n_layers
-    scale = hd ** -0.5
-    neg = jnp.asarray(-1e30, jnp.float32)
     quant = "k_scale" in cache
     wq8 = qweights is not None
     sdt = cache["k_scale"].dtype if quant else None
@@ -1127,51 +1216,9 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
                 layer, qlayer = layer_q
             else:
                 layer, qlayer = layer_q, None
-            q, kk, v = _decode_qkv(cfg, layer, qlayer, x, cos, sin)
-            if quant:
-                kq, ksc = quantize_rows(kk[:, 0])
-                vq, vsc = quantize_rows(v[:, 0])
-                ksc, vsc = ksc.astype(sdt), vsc.astype(sdt)
-                sk = sk.at[i, batch_ix, s].set(kq)
-                sv = sv.at[i, batch_ix, s].set(vq)
-                sks = sks.at[i, batch_ix, s].set(ksc)
-                svs = svs.at[i, batch_ix, s].set(vsc)
-            else:
-                sk = sk.at[i, batch_ix, s].set(kk[:, 0].astype(kdt))
-                sv = sv.at[i, batch_ix, s].set(v[:, 0].astype(kdt))
-            ck, cv, cks, cvs = _gather_kv_layer(cache, i, table)
-            lk = lax.dynamic_index_in_dim(sk, i, 0, False)
-            lv = lax.dynamic_index_in_dim(sv, i, 0, False)
-            # bf16 dots, fp32 accumulation — int8 converts to bf16
-            # exactly (see decode_step's note).
-            qh = q[:, 0].reshape(B, G, rep, hd).astype(jnp.bfloat16)
-            sm = jnp.einsum("bgrk,bmgk->bgrm", qh,
-                            ck.astype(jnp.bfloat16),
-                            preferred_element_type=jnp.float32) * scale
-            ss = jnp.einsum("bgrk,bjgk->bgrj", qh,
-                            lk.astype(jnp.bfloat16),
-                            preferred_element_type=jnp.float32) * scale
-            if quant:
-                lks = lax.dynamic_index_in_dim(sks, i, 0, False)
-                lvs = lax.dynamic_index_in_dim(svs, i, 0, False)
-                sm = sm * cks[:, :, None, :]
-                ss = ss * lks.transpose(0, 2, 1)[:, :, None, :]
-            sm = jnp.where(valid_cache[:, None, None, :], sm, neg)
-            ss = jnp.where(stage_valid[:, None, None, :], ss, neg)
-            w = jax.nn.softmax(jnp.concatenate([sm, ss], axis=-1),
-                               axis=-1)
-            wm, ws = w[..., :M], w[..., M:]
-            if quant:
-                wm = wm * cvs[:, :, None, :]
-                ws = ws * lvs.transpose(0, 2, 1)[:, :, None, :]
-            o = jnp.einsum("bgrm,bmgk->bgrk", wm.astype(jnp.bfloat16),
-                           cv.astype(jnp.bfloat16),
-                           preferred_element_type=jnp.float32)
-            o = o + jnp.einsum("bgrj,bjgk->bgrk",
-                               ws.astype(jnp.bfloat16),
-                               lv.astype(jnp.bfloat16),
-                               preferred_element_type=jnp.float32)
-            x = _decode_out_ffn(cfg, layer, qlayer, wq8, x, o)
+            x, sk, sv, sks, svs = _staged_attn_layer(
+                cfg, cache, table, layer, qlayer, x, cos, sin, i, s,
+                sk, sv, sks, svs, valid_cache, stage_valid, batch_ix)
             return (x, i + 1, sk, sv, sks, svs), None
 
         xs = ((params["blocks"], qweights["blocks"]) if wq8
@@ -1187,18 +1234,137 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
     (last, sk, sv, sks, svs), toks = lax.scan(
         step, init, (keys, jnp.arange(k)))
 
-    idx = pos0[:, None] + jnp.arange(k)[None, :]          # [B, k]
-    blk, off = _phys(cache, table, batch_ix[:, None], idx)
-    out = dict(cache)
-    out["k"] = cache["k"].at[:, blk, off].set(sk)
-    out["v"] = cache["v"].at[:, blk, off].set(sv)
-    if quant:
-        # Non-adjacent advanced indices lead with the broadcast [B, k]
-        # dims: updates are [B, k, L, G].
-        out["k_scale"] = cache["k_scale"].at[
-            :, blk, :, off].set(sks.transpose(1, 2, 0, 3))
-        out["v_scale"] = cache["v_scale"].at[
-            :, blk, :, off].set(svs.transpose(1, 2, 0, 3))
+    out = _flush_staged_rows(cache, table, pos0, batch_ix,
+                             sk, sv, sks, svs)
     out["length"] = cache["length"] + k * active.astype(jnp.int32)
     out["last_token"] = last
     return out, rng, toks
+
+
+def verify_draft_staged(params: llama.Params, cache: Cache,
+                        draft: jax.Array, n_draft: jax.Array,
+                        active: jax.Array, k: int,
+                        cfg: llama.LlamaConfig,
+                        qweights=None, table=None
+                        ) -> Tuple[Cache, jax.Array, jax.Array]:
+    """Speculative-decode verify: score ``k`` drafted tokens per slot
+    plus the correction position in ONE device call (the engine's
+    verify program; trace under jit with the cache donated, ``k``
+    static — one compiled program for the whole serving lifetime).
+
+    draft: [B, k] int32 host-proposed tokens per slot (n-gram /
+    prompt-lookup — the drafter never touches the device); n_draft:
+    [B] int32 real draft tokens per slot (slots that drafted fewer
+    than ``k`` pad and mask, exactly like a partial prefill chunk).
+
+    The window is ``k + 1`` positions: position 0 consumes the slot's
+    pending ``last_token`` (the same token a plain decode step would
+    consume) and positions 1..k consume the draft. Structurally this
+    is :func:`decode_burst_staged` with the sampled-token feedback
+    replaced by the given window tokens and greedy argmax outputs —
+    same big-cache dot over rows < the burst-start lengths, same
+    staged intra-window dot, same single per-burst flush — so an
+    ACCEPTED position's logits are computed from exactly the inputs
+    the plain decode path would have fed it.
+
+    Greedy-exact acceptance, ON DEVICE (no RNG anywhere — the greedy
+    path's stream must stay untouched): out[s] = argmax after
+    consuming window position s; the accepted prefix length is the
+    longest run of out[s] == draft[s] over real (< n_draft) draft
+    positions, and ``n_commit = n_match + 1`` committed tokens per
+    active slot — the matched draft tokens plus the first correction
+    (or bonus) token from the same pass. Committed outputs depend only
+    on real tokens: out[s] for s <= n_match attends to window columns
+    0..s, all of which are the pending token or MATCHED draft tokens.
+
+    Rollback is free by construction: all ``k + 1`` window rows are
+    scattered at logical rows length..length+k, but ``length`` only
+    advances by ``n_commit`` — rejected rows sit past the committed
+    length, invisible to the validity mask (contiguous) or sitting in
+    already-allocated blocks (paged: a block-table length decrement,
+    no block ever moves), and the next burst overwrites them. A slot
+    without k + 1 rows of headroom below max_len rides the burst with
+    an empty draft (the engine zeroes it): its correction row at
+    ``length`` is always in bounds for an active request, and spare
+    window rows past max_len drop via scatter-OOB (contiguous) or the
+    sentinel block (paged).
+
+    Returns (cache', toks [B, k+1] — the window's argmax outputs, the
+    first ``n_commit[b]`` of row b are the committed tokens —
+    n_commit [B] int32, 0 for inactive slots).
+    """
+    B = cache["length"].shape[0]
+    W = k + 1
+    M = _logical_rows(cache, table)
+    G, hd = cfg.n_kv_heads, cfg.head_dim
+    L = cfg.n_layers
+    quant = "k_scale" in cache
+    wq8 = qweights is not None
+    sdt = cache["k_scale"].dtype if quant else None
+    kdt = cache["k"].dtype
+
+    pos0 = cache["length"]                           # burst-start rows
+    valid_cache = jnp.arange(M)[None, :] < pos0[:, None]   # [B, M]
+    batch_ix = jnp.arange(B)
+
+    # Window tokens: the pending token then the draft — the exact
+    # sequence sequential decode would consume while every draft
+    # position matches.
+    window = jnp.concatenate(
+        [cache["last_token"][:, None], draft.astype(jnp.int32)],
+        axis=1)                                      # [B, W]
+
+    stage_k = jnp.zeros((L, B, W, G, hd), kdt)
+    stage_v = jnp.zeros((L, B, W, G, hd), kdt)
+    zero = jnp.zeros((), jnp.float32)
+    stage_ks = jnp.zeros((L, B, W, G), sdt) if quant else zero
+    stage_vs = jnp.zeros((L, B, W, G), sdt) if quant else zero
+
+    def step(carry, tok_s):
+        tok, s = tok_s
+        sk, sv, sks, svs = carry
+        x = params["embed"].astype(cfg.dtype)[tok[:, None]]
+        pos = pos0 + s
+        cos, sin = llama.rope_frequencies(cfg, pos[:, None])
+        stage_valid = jnp.arange(W)[None, :] <= s     # [1, W]
+
+        def body(carry2, layer_q):
+            x, i, sk, sv, sks, svs = carry2
+            if wq8:
+                layer, qlayer = layer_q
+            else:
+                layer, qlayer = layer_q, None
+            x, sk, sv, sks, svs = _staged_attn_layer(
+                cfg, cache, table, layer, qlayer, x, cos, sin, i, s,
+                sk, sv, sks, svs, valid_cache, stage_valid, batch_ix)
+            return (x, i + 1, sk, sv, sks, svs), None
+
+        xs = ((params["blocks"], qweights["blocks"]) if wq8
+              else params["blocks"])
+        (x, _, sk, sv, sks, svs), _ = lax.scan(
+            body, (x, jnp.int32(0), sk, sv, sks, svs), xs)
+        logits = _decode_head(cfg, params, qweights, x)
+        out_tok = sampling_mod.argmax_tokens(logits)
+        return (sk, sv, sks, svs), out_tok
+
+    init = (stage_k, stage_v, stage_ks, stage_vs)
+    (sk, sv, sks, svs), toks = lax.scan(
+        step, init, (window.T, jnp.arange(W)))
+    toks = toks.T                                     # [B, W]
+
+    # Accepted prefix: out[s] must reproduce draft position s, and
+    # padding positions (>= n_draft) never match — a pad token that
+    # happened to equal the argmax must not commit a token computed
+    # from garbage input.
+    match = ((toks[:, :k] == draft)
+             & (jnp.arange(k)[None, :] < n_draft[:, None]))
+    n_match = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                      axis=1)                          # [B]
+    n_commit = jnp.where(active, n_match + 1, 0).astype(jnp.int32)
+
+    out = _flush_staged_rows(cache, table, pos0, batch_ix,
+                             sk, sv, sks, svs)
+    out["length"] = cache["length"] + n_commit
+    out["last_token"] = jnp.where(active, toks[batch_ix, n_match],
+                                  cache["last_token"])
+    return out, toks, n_commit

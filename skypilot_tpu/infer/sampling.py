@@ -19,10 +19,21 @@ def sample(logits: jax.Array, rng: jax.Array,
            params: SamplingParams) -> jax.Array:
     """logits: [..., vocab] fp32 -> token ids [...]."""
     if params.temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return argmax_tokens(logits)
     logits = logits / params.temperature
     if params.top_k is not None and params.top_k > 0:
         top_vals, _ = jax.lax.top_k(logits, params.top_k)
         cutoff = top_vals[..., -1:]
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def argmax_tokens(logits: jax.Array) -> jax.Array:
+    """Greedy token choice: deterministic argmax over the vocab axis.
+
+    Speculative verification calls this directly (never ``sample``):
+    draft-and-verify is exactly output-preserving only under greedy
+    decoding, and the verify program must not consume RNG — the greedy
+    path's RNG stream has to stay identical spec-on vs spec-off so the
+    two are comparable token-for-token even in mixed workloads."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -127,8 +127,13 @@ class ModelServer:
         # and streamed, so the TPU decodes k+1 while this thread does
         # k's JSON framing + socket writes + LB hop. Fake/simple
         # engines without the pair fall back to sync decode_burst.
+        # Speculative engines (spec_k > 0) also run the sync path:
+        # verify bursts can't double-buffer — the next burst's draft
+        # depends on the tokens this one commits — and decode_burst
+        # itself routes to the verify program there.
         self._burst = None
-        self._async_decode = hasattr(engine, "dispatch_decode_burst")
+        self._async_decode = (hasattr(engine, "dispatch_decode_burst")
+                              and not getattr(engine, "spec_k", 0))
         # Component health detail behind GET /healthz: "" while
         # serving; a reason string while warming or after a failed
         # engine reset (the two _ready-unset states a probe must tell
@@ -380,12 +385,20 @@ class ModelServer:
                 "cache_hit": bool(cached),
                 "cached_tokens": cached,
                 "prefill_chunks": getattr(req, "n_chunks", 0),
+                # Speculative-decode stats: how much of the decode this
+                # request's drafts covered (accepted / drafted).
+                "spec_drafted": getattr(req, "spec_drafted", 0),
+                "spec_accepted": getattr(req, "spec_accepted", 0),
             }
             if p.stream:
                 p.chunks.put({"done": True, "ttft_ms": ttft,
                               "n_tokens": len(req.tokens),
                               "cache_hit": bool(cached),
-                              "cached_tokens": cached})
+                              "cached_tokens": cached,
+                              "spec_drafted":
+                                  getattr(req, "spec_drafted", 0),
+                              "spec_accepted":
+                                  getattr(req, "spec_accepted", 0)})
             p.event.set()
         if self.engine.finished:
             PENDING_REQUESTS.set(len(self._pending))
@@ -594,6 +607,14 @@ def main() -> None:
                     help="paged KV pool size in blocks (default env "
                          "SKYTPU_KV_BLOCKS, or the contiguous-"
                          "equivalent HBM: (slots+1)*max_len/block)")
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="speculative decoding: draft up to K tokens "
+                         "per slot per burst (n-gram prompt-lookup) "
+                         "and verify them in one device call — up to "
+                         "K+1 committed tokens per decode dispatch, "
+                         "greedy output bit-preserved (0 disables; "
+                         "forced off under --temperature > 0; default "
+                         "env SKYTPU_SPEC_K or 4)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel degree: shard weights + KV "
                          "cache over the first N local devices "
@@ -653,6 +674,12 @@ def main() -> None:
                      if args.prefix_pool is not None
                      else int(os.environ.get("SKYTPU_PREFIX_POOL",
                                              "8") or 0)),
+        # Serving default: speculation ON at K=4 (greedy serving is the
+        # common case and a missed draft costs one empty verify slot);
+        # the engine-level default stays 0 so library users opt in.
+        spec_k=(args.spec_k
+                if args.spec_k is not None
+                else int(os.environ.get("SKYTPU_SPEC_K", "4") or 0)),
         # One compiled prefill program per bucket: an odd wave size
         # must never hit a mid-traffic XLA compile on a live replica.
         pad_waves=True)

@@ -1,0 +1,21 @@
+# Golden fixture: seeded host-sync violations on the speculative
+# verify/accept path. Checked as if it were
+# skypilot_tpu/infer/engine.py (the hot-loop scope). Never imported.
+import numpy as np
+
+
+class InferenceEngine:
+    def _draft_for(self, req):
+        # Drafting must be pure host work (the n-gram index); peeking
+        # at device state per draft drains the pipeline every burst.
+        pending = int(self.cache["last_token"][req.slot])  # expect: host-sync
+        last = self.cache["length"].item()                 # expect: host-sync
+        return [pending, last]
+
+    def spec_decode_burst(self):
+        self.cache, toks, n_commit = self._verify_fn(
+            self.params, self.cache, self.draft, self.n_draft,
+            self.active, self.table_device(), k=4)
+        toks.block_until_ready()                           # expect: host-sync
+        probe = np.asarray(self.cache["length"])           # expect: host-sync
+        return probe

@@ -1,0 +1,27 @@
+# Clean twin: the speculative verify/accept path done right — the
+# drafter is pure host bookkeeping, the ONE completion fetch happens
+# on already-host data after the verify burst's deliberate sync point
+# (baselined in the real engine), and nothing else touches the
+# device. Never imported.
+import numpy as np
+
+
+class InferenceEngine:
+    def _draft_for(self, req):
+        # Pure host work: python lists + the n-gram index dict.
+        if req.spec_off:
+            return []
+        req.drafter.catch_up(req.prompt, req.tokens)
+        return req.drafter.draft(self.spec_k)
+
+    def spec_decode_burst(self):
+        draft = np.zeros((self.n_slots + 1, self.spec_k), np.int32)
+        n_draft = np.zeros((self.n_slots + 1,), np.int32)
+        for slot, req in self.slot_req.items():
+            d = self._draft_for(req)
+            n_draft[slot] = len(d)
+            draft[slot, :len(d)] = d
+        self.cache, toks, n_commit = self._verify_fn(
+            self.params, self.cache, draft, n_draft, self.active,
+            self.table_device(), k=self.spec_k)
+        return toks, n_commit

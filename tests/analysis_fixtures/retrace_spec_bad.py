@@ -1,0 +1,21 @@
+# Golden fixture: seeded retrace-safety violations in the K-position
+# speculative verify shape. Checked as if it lived at
+# skypilot_tpu/infer/ (a jit-root directory). Never imported.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def verify_accept(cache, draft, n_draft, toks):
+    k = draft.shape[1]
+    match = (toks[:, :k] == draft) & (
+        jnp.arange(k)[None, :] < n_draft[:, None])
+    if match.any():                           # expect: traced-branch
+        match = match & match
+    n_match = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                      axis=1)
+    first = int(n_match[0])                   # expect: concretize
+    host = np.asarray(n_match)                # expect: host-transfer
+    accepted = jnp.zeros(jnp.sum(n_match))    # expect: dynamic-shape
+    return n_match, first, host, accepted

@@ -1,0 +1,22 @@
+# Clean twin: the K-position verify shape done right — K is static
+# (one compiled program), acceptance is pure array math (masked match
+# + cumprod, no python branch on traced values), rollback is a
+# where() on the length vector, and the commit count stays on device.
+# Never imported.
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def verify_accept(cache, draft, n_draft, toks, active):
+    k = draft.shape[1]                        # static: draft is [B, k]
+    match = (toks[:, :k] == draft) & (
+        jnp.arange(k)[None, :] < n_draft[:, None])
+    n_match = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                      axis=1)
+    n_commit = jnp.where(active, n_match + 1, 0).astype(jnp.int32)
+    length = cache["length"] + n_commit       # rollback = no advance
+    batch = jnp.arange(draft.shape[0])
+    last = jnp.where(active, toks[batch, n_match],
+                     cache["last_token"])
+    return dict(cache, length=length, last_token=last), n_commit

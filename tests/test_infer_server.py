@@ -114,6 +114,60 @@ def test_response_carries_cache_stats(model_server):
     assert out["cache_hit"] is False
     assert out["cached_tokens"] == 0
     assert out["prefill_chunks"] == 0
+    # Spec stats ride the same trailer (this engine runs spec-off:
+    # both zero, but the fields are always present).
+    assert out["spec_drafted"] == 0
+    assert out["spec_accepted"] == 0
+
+
+def test_spec_trailer_on_blocking_and_stream_paths():
+    """A speculative engine's per-request drafted/accepted stats reach
+    the response trailer on BOTH the blocking result and the stream
+    ``done`` chunk, and the spec'd output matches a spec-off engine
+    token-for-token through the serving loop."""
+    cfg = llama.CONFIGS["llama3-tiny"]
+    params = llama.init_params(jax.random.key(0), cfg)
+    prompt = [7, 8, 9] * 4
+    plain = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                                prompt_buckets=(16,))
+    want = plain.generate([prompt], max_new_tokens=8)[0]
+
+    class AlwaysDraft:
+        """One fixed draft token per burst: spec_drafted is provably
+        nonzero end to end without depending on the random model's
+        n-gram structure (rejected drafts roll back; parity holds)."""
+
+        def __init__(self, req):
+            pass
+
+        def catch_up(self, prompt, generated):
+            pass
+
+        def draft(self, k):
+            return [0][:k]
+
+    engine = eng.InferenceEngine(params, cfg, n_slots=2, max_len=64,
+                                 prompt_buckets=(16,), spec_k=3,
+                                 spec_drafter=AlwaysDraft)
+    engine.spec_min_rate = 0.0
+    model = srv.ModelServer(engine, max_burst=4, open_burst=2)
+    try:
+        assert model._ready.wait(timeout=300)
+        out = model.submit(prompt, 8)
+        assert "error" not in out
+        assert out["tokens"] == want
+        assert out["spec_drafted"] > 0
+        assert 0 <= out["spec_accepted"] <= out["spec_drafted"]
+
+        chunks = list(model.submit_stream(prompt, 8))
+        done = chunks[-1]
+        assert "done" in done
+        streamed = [t for c in chunks for t in c.get("tokens", [])]
+        assert streamed == want
+        assert done["spec_drafted"] > 0
+        assert 0 <= done["spec_accepted"] <= done["spec_drafted"]
+    finally:
+        model.shutdown()
 
 
 def test_server_loop_drives_chunked_prefill():

@@ -127,6 +127,12 @@ _GOLDEN = [
      "skypilot_tpu/infer/engine.py"),
     ("host-sync", "host_sync_paged_bad.py", "host_sync_paged_clean.py",
      "skypilot_tpu/infer/engine.py"),
+    # Speculative-decode shape (PR 8): the K-position verify program
+    # and the draft/accept hot path are guarded like the paged gather.
+    ("retrace-safety", "retrace_spec_bad.py", "retrace_spec_clean.py",
+     "skypilot_tpu/infer/fixture_retrace_spec.py"),
+    ("host-sync", "host_sync_spec_bad.py", "host_sync_spec_clean.py",
+     "skypilot_tpu/infer/engine.py"),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      "skypilot_tpu/utils/fixture_locks.py"),
     ("typed-errors", "typed_errors_bad.py", "typed_errors_clean.py",
