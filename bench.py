@@ -407,6 +407,34 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"spec bench failed: {e}")
             out["serve_spec_error"] = str(e)[:200]
+        # Span-bucketed decode attention phase: decode TPOT with the
+        # span ladder vs the full-view read on the same engine, short
+        # active conversations on a long-max_len engine — the decode
+        # BANDWIDTH lever (the occupancy phase above covers capacity).
+        try:
+            from skypilot_tpu.infer import bench_serve as _bs
+            sa = _bs.run_span(config=serve_cfg, weights_int8=big,
+                              kv_int8=big)
+            out["serve_span_speedup"] = sa["speedup"]
+            out["serve_span_tpot_full_ms"] = sa["tpot_full_ms"]
+            out["serve_span_tpot_ms"] = sa["tpot_span_ms"]
+            out["serve_span_rows"] = sa["rows_span"]
+            out["serve_span_rows_full"] = sa["rows_full"]
+            out["serve_span_programs"] = sa["n_span_programs"]
+            out["serve_span_parity_ok"] = sa["parity_ok"]
+            # Gate: >= 1.5x decode tok/s for active lengths <=
+            # max_len/8 with bit-identical greedy output (the
+            # tentpole target is 2x; 1.5x is the regression floor).
+            out["serve_span_regressed"] = bool(
+                sa["speedup"] < 1.5 or not sa["parity_ok"])
+            if out["serve_span_regressed"]:
+                log("SERVE SPAN REGRESSION: "
+                    f"x{sa['speedup']} (< 1.5) or parity broken "
+                    f"(parity_ok={sa['parity_ok']}, "
+                    f"rows {sa['rows_span']}/{sa['rows_full']})")
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"span bench failed: {e}")
+            out["serve_span_error"] = str(e)[:200]
     if args.emit_metrics:
         from skypilot_tpu.observability import metrics as obs_metrics
         # Only families something actually recorded into: a bench run

@@ -41,6 +41,12 @@ _SCOPES: Dict[str, Set[str]] = {
         # its completion sync — anything else here stalls the verify/
         # accept hot path once per burst.
         "spec_decode_burst", "_draft_for",
+        # Span-bucketed attention + lazy growth (PR 9): bucket
+        # selection and block headroom run per burst from HOST state
+        # (request token lists, the numpy block table) — a device
+        # fetch to pick a span would stall every dispatch.
+        "_span_groups", "_span_for", "_span_arg", "_slot_rows",
+        "_ensure_headroom",
     },
     "skypilot_tpu/infer/server.py": {
         "_loop", "_step", "_drain_inbox", "_flush_streams",
@@ -64,7 +70,8 @@ class HostSyncChecker(Checker):
     scope = "file"
     # v2: paged-KV block-management methods joined the engine scope.
     # v3: the speculative verify/accept path joined it.
-    version = 3
+    # v4: span-selection + lazy-growth methods joined it.
+    version = 4
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

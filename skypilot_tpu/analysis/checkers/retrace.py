@@ -89,7 +89,11 @@ class RetraceSafetyChecker(Checker):
                    "host transfer, traced branches, dynamic shapes) "
                    "reachable from jax.jit/shard_map entry points")
     scope = "project"
-    version = 1
+    # v2: span-parameterized attention programs (static span args)
+    # joined the guarded surface — golden fixtures cover the
+    # span-gather shape; the bump invalidates warm caches so the new
+    # fixtures and the edited kvcache/engine hot path rescan cold.
+    version = 2
 
     def check_project(self, ctxs: Sequence[FileContext],
                       root: str) -> List[Finding]:

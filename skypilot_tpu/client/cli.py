@@ -378,6 +378,13 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
         kv_total = gauge("skytpu_kv_blocks_total")
         if kv_used is not None and kv_total:
             line += f"  kv {kv_used:.0f}/{kv_total:.0f}"
+        # Span-bucketed decode attention (docs/serving.md): median KV
+        # rows a decode/verify burst gathered between frames — decode
+        # bandwidth tracks this, not the engines' max_len.
+        span_rows = aggregate.histogram_quantile(
+            prev, fams, "skytpu_decode_attn_rows", 0.5)
+        if span_rows is not None:
+            line += f"  span p50 {span_rows:.0f}"
         # Speculative-decode acceptance (docs/serving.md): the window
         # rate when drafting happened between frames, else the
         # engines' lifetime gauge (first frame / --once / idle).

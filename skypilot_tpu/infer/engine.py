@@ -121,6 +121,18 @@ SPEC_ACCEPT_RATE = metrics.gauge(
     "skytpu_spec_acceptance_rate",
     "Speculative-decode lifetime acceptance rate "
     "(accepted / drafted; 0 until the first draft)")
+DECODE_ATTN_ROWS = metrics.histogram(
+    "skytpu_decode_attn_rows",
+    "Span bucket (logical KV rows gathered per slot) actually "
+    "dispatched for a decode/verify burst — decode attention "
+    "bandwidth tracks this, not max_len (the full-view fallback)",
+    buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384,
+             32768))
+KV_LAZY_GROWS = metrics.counter(
+    "skytpu_kv_lazy_grows_total",
+    "Paged KV blocks allocated by lazy per-burst growth "
+    "(SKYTPU_KV_LAZY=1: admission reserves prompt + one burst of "
+    "rows; the rest allocates at burst dispatch)")
 
 
 @dataclasses.dataclass
@@ -159,8 +171,11 @@ class Request:
 @dataclasses.dataclass
 class BurstHandle:
     """A dispatched-but-unfetched decode burst (see
-    :meth:`InferenceEngine.dispatch_decode_burst`)."""
-    toks: jax.Array                   # [k, slots+1] on device
+    :meth:`InferenceEngine.dispatch_decode_burst`). One handle covers
+    the whole burst round: span regrouping may split it over several
+    device programs — ``parts`` pairs each program's token array with
+    the slots it decoded for."""
+    parts: List[Tuple[jax.Array, List[int]]]  # [(toks [k, slots+1], slots)]
     k: int
     slot_req: Dict[int, "Request"]    # slot->request snapshot at dispatch
     # Span opened at dispatch, closed when the tokens are fetched —
@@ -192,6 +207,28 @@ def _bucket(n: int, buckets) -> int:
         if n <= b:
             return b
     raise PromptTooLongError(n, buckets[-1])
+
+
+def _span_ladder(buckets, max_len: int) -> Tuple[int, ...]:
+    """The span-bucket ladder: ascending rungs, largest always
+    ``max_len`` (the full view — also the only rung when bucketing is
+    disabled). ``buckets``: None -> the default power-of-two ladder
+    (max_len/8, /4, /2, max_len — same idiom as the prefill prompt
+    buckets); an explicit iterable -> its positive rungs clamped
+    below max_len; empty/0 -> disabled. Rungs need no block
+    alignment: the paged gather covers whole blocks and slices to
+    the span. Every decode/verify/chunk program compiles once per
+    rung it is dispatched at, so the ladder size bounds the compile
+    count."""
+    if buckets is None:
+        ladder = [max_len // d for d in (8, 4, 2)]
+    elif isinstance(buckets, int):
+        ladder = [buckets] if buckets > 0 else []    # 0 = disabled
+    else:
+        ladder = [int(b) for b in buckets if int(b) > 0]
+    rungs = {s for s in ladder if 0 < s < max_len}
+    rungs.add(max_len)
+    return tuple(sorted(rungs))
 
 
 class PrefixIndex:
@@ -417,7 +454,8 @@ class InferenceEngine:
                  kv_block: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
                  spec_k: Optional[int] = None,
-                 spec_drafter: Optional[Callable] = None):
+                 spec_drafter: Optional[Callable] = None,
+                 span_buckets=None, kv_lazy: Optional[bool] = None):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -547,6 +585,39 @@ class InferenceEngine:
             self.block_table = None
             self._table_dev = None
             self._table_dirty = False
+        # Span-bucketed decode attention: decode/verify/chunk programs
+        # compile per SPAN BUCKET (a power-of-two ladder whose largest
+        # rung is max_len — the full view) and gather only the first
+        # span logical rows, so decode KV bandwidth tracks the ACTIVE
+        # span of the burst, not the engine's worst-case length. The
+        # ladder is the entire new retrace surface: selection, and the
+        # regrouping that keeps one long slot from pinning everyone to
+        # its bucket, are host-side. Knob: SKYTPU_SPAN_BUCKETS (ctor
+        # arg wins) — a comma-separated explicit ladder, or 0 to
+        # disable (full view only).
+        if span_buckets is None:
+            env = os.environ.get("SKYTPU_SPAN_BUCKETS", "").strip()
+            if env:
+                span_buckets = [int(t) for t in
+                                env.replace(",", " ").split()]
+        self.span_ladder = _span_ladder(span_buckets, max_len)
+        # Decode-side program keys actually dispatched ((kind, width,
+        # span) tuples; span None = the full view): the retrace-
+        # discipline tests assert this stays bounded by the ladder —
+        # never one program per observed length.
+        self.decode_programs: set = set()
+        # Lazy per-burst block growth (paged only): admission reserves
+        # the prompt plus ONE burst of rows instead of the full
+        # max_new_tokens worst case; the rest allocates at burst
+        # dispatch through the same dry-pool evict/stall path
+        # admission uses. Eager stays the default: lazy trades the
+        # no-mid-flight-fault guarantee for tighter reservations (a
+        # slot the pool cannot grow sits a burst out until
+        # retirements free blocks). Knob: SKYTPU_KV_LAZY=1.
+        if kv_lazy is None:
+            kv_lazy = os.environ.get("SKYTPU_KV_LAZY", "") == "1"
+        self.kv_lazy = bool(kv_lazy) and self.paged
+        self._lazy_headroom = max(16, self.spec_k + 1)
         # One hidden spare slot (index n_slots): batched admission pads
         # its wave with dummy prefills targeting the spare, so one
         # compiled program serves every wave size. (Paged: the spare's
@@ -670,13 +741,14 @@ class InferenceEngine:
             cache["length"] = cache["length"].at[-1].set(0)  # spare
             return cache, rng, first
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        @functools.partial(jax.jit, donate_argnums=(1, 2),
+                           static_argnames=("span",))
         def _decode(params, cache, rng, active, table=None,
-                    qweights=None):
+                    qweights=None, *, span=None):
             rng, sub = jax.random.split(rng)
             cache, logits = kvcache.decode_step(params, cache, cfg,
                                                 qweights=qweights,
-                                                table=table)
+                                                table=table, span=span)
             toks = sampling.sample(logits, sub, sp)
             cache = kvcache.commit_tokens(cache, toks, active)
             return cache, rng, toks
@@ -690,12 +762,12 @@ class InferenceEngine:
         # kvcache.decode_burst_staged; ~25% faster than a scan of
         # per-step cache updates on an 8B model).
         @functools.partial(jax.jit, donate_argnums=(1, 2),
-                           static_argnames=("k",))
+                           static_argnames=("k", "span"))
         def _decode_burst(params, cache, rng, active, table=None, *, k,
-                          qweights=None):
+                          qweights=None, span=None):
             return kvcache.decode_burst_staged(
                 params, cache, rng, active, k, cfg, sp,
-                qweights=qweights, table=table)
+                qweights=qweights, table=table, span=span)
 
         # Speculative verify: the decode_burst_staged formulation with
         # the sampled-token feedback replaced by the host's draft
@@ -703,26 +775,26 @@ class InferenceEngine:
         # RNG argument at all — the greedy stream stays untouched, so
         # spec-on and spec-off runs consume identical RNG.
         @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnames=("k",))
+                           static_argnames=("k", "span"))
         def _verify(params, cache, draft, n_draft, active, table=None,
-                    *, k, qweights=None):
+                    *, k, qweights=None, span=None):
             return kvcache.verify_draft_staged(
                 params, cache, draft, n_draft, active, k, cfg,
-                qweights=qweights, table=table)
+                qweights=qweights, table=table, span=span)
 
         # Chunked-prefill programs: ONE chunk program (two traces: the
         # ``final`` variant samples the first token and splits the RNG)
         # serves every bucket and every suffix offset; the claim/copy
         # programs are trivial gathers/scatters.
         @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnames=("final",))
+                           static_argnames=("final", "span"))
         def _prefill_chunk(params, cache, tokens_c, start, n_valid,
                            slot, new_len, rng, table=None, *, final,
-                           qweights=None):
+                           qweights=None, span=None):
             return kvcache.prefill_chunk(
                 params, cache, tokens_c, start, n_valid, slot, new_len,
                 rng, cfg, sp, final=final, qweights=qweights,
-                table=table)
+                table=table, span=span)
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _claim(cache, slot, claim_len):
@@ -820,13 +892,92 @@ class InferenceEngine:
         return self._table_dev
 
     def _need_blocks(self, req: Request) -> int:
-        """Worst-case blocks this request can ever write: prompt plus
-        its full token budget, capped by max_len (allocation is eager
-        at admission, so decode can never run out of backing mid-
-        flight — the pool, not a mid-decode fault path, is the
-        admission limiter)."""
+        """Blocks to reserve at admission. Eager (default): the
+        worst case — prompt plus the full token budget, capped by
+        max_len — so decode can never run out of backing mid-flight;
+        the pool, not a mid-decode fault path, is the admission
+        limiter. Lazy (SKYTPU_KV_LAZY=1): just the prompt plus one
+        burst of headroom; the rest allocates per burst in
+        :meth:`_ensure_headroom` through the same dry-pool
+        evict/stall path."""
         need = min(len(req.prompt) + req.max_new_tokens, self.max_len)
+        if self.kv_lazy:
+            need = min(len(req.prompt) + self._lazy_headroom, need)
         return -(-need // self.kv_block)
+
+    def _ensure_headroom(self, slot: int, req: Request,
+                         need_rows: int) -> bool:
+        """Lazy mode: grow the slot's block allocation to back
+        ``need_rows`` cache rows before a burst writes them (eager
+        engines reserved the worst case at admission and always pass).
+        Growth rides admission's dry-pool path — LRU prefix entries
+        evict first, and a pool that stays dry returns False: the
+        slot sits this burst out and retries after retirements free
+        blocks."""
+        if not self.kv_lazy:
+            return True
+        cap = min(len(req.prompt) + req.max_new_tokens, self.max_len)
+        need_rows = min(need_rows, cap)
+        row = self.block_table[slot]
+        have = len(row[row < self.n_kv_blocks])
+        grow = -(-need_rows // self.kv_block) - have
+        if grow <= 0:
+            return True
+        blocks = self._alloc_blocks(grow)
+        if blocks is None:
+            return False
+        row[have:have + len(blocks)] = blocks
+        self._table_dirty = True
+        KV_LAZY_GROWS.inc(len(blocks))
+        return True
+
+    # -- span buckets ------------------------------------------------------
+
+    def _span_for(self, rows: int) -> int:
+        """Smallest ladder rung covering ``rows`` cache rows (the full
+        view for anything past the ladder — callers' row counts are
+        already capped by max_len)."""
+        for s in self.span_ladder:
+            if rows <= s:
+                return s
+        return self.span_ladder[-1]
+
+    def _span_arg(self, span: int) -> Optional[int]:
+        """The static ``span`` argument for a dispatch: None selects
+        the unsliced full-view program — the identical trace the
+        pre-span engine compiled, so a disabled ladder costs
+        nothing."""
+        return None if span >= self.max_len else span
+
+    def _slot_rows(self, req: Request) -> int:
+        """Cache rows the slot holds at the next burst's start as the
+        DEVICE will see it: host-committed tokens plus every token
+        still in flight (dispatched bursts commit on device before
+        the next program runs)."""
+        return (len(req.prompt) + len(req.tokens)
+                + self._inflight_tokens)
+
+    def _span_groups(self, width: int
+                     ) -> List[Tuple[int, List[int]]]:
+        """Active slots grouped by the span bucket covering their
+        rows — the REGROUPING step: one mixed-length burst would
+        otherwise ride the longest slot's bucket, so a single long
+        conversation would drag every short neighbor back to
+        worst-case reads. Each group dispatches its own burst at its
+        own span (programs chain on the donated cache; a group's
+        garbage writes for other groups' slots land past their
+        committed lengths and are overwritten before any read, the
+        standard dead-row net). ``width``: rows the burst will write
+        per slot — lazy growth must back them; a slot the pool cannot
+        grow is left out and retries once retirements free blocks.
+        Returns [(span, [slot, ...])], ascending spans."""
+        groups: Dict[int, List[int]] = {}
+        for slot, req in self.slot_req.items():
+            rows = self._slot_rows(req)
+            if not self._ensure_headroom(slot, req, rows + width):
+                continue
+            groups.setdefault(self._span_for(rows), []).append(slot)
+        return sorted(groups.items())
 
     def _alloc_blocks(self, n: int) -> Optional[List[int]]:
         """n fresh blocks, evicting LRU prefix-cache entries on a dry
@@ -1002,8 +1153,10 @@ class InferenceEngine:
                 shared = list(payload[:n_shared])
                 for b in shared:
                     self.allocator.incref(b)
+            # Lazy reservations can be SMALLER than the shared prefix
+            # rounds to; never ask for a negative count.
             new_blocks = self._alloc_blocks(
-                self._need_blocks(req) - n_shared)
+                max(self._need_blocks(req) - n_shared, 0))
             if new_blocks is None:
                 for b in shared:          # unpin; retry next pass
                     self.allocator.decref(b)
@@ -1076,6 +1229,13 @@ class InferenceEngine:
         chunk[:n_valid] = req.prompt[start:start + n_valid]
         new_len = st.total if final else self.max_len
         decode_active = bool(self.slot_req)
+        # The big-cache dot reads only rows below this chunk's offset:
+        # the span bucket covering ``start`` suffices, and because the
+        # span is a pure function of the offset, warm (suffix-only)
+        # and cold runs of the same chunk pick the same program —
+        # the cached-vs-cold parity guarantee extends to spans.
+        attn_span = self._span_arg(self._span_for(start))
+        self.decode_programs.add(("chunk", final, attn_span))
         t0 = time.time()
         self.cache, self.rng, tok_dev = self._prefill_chunk_fn(
             self.params, self.cache, jnp.asarray(chunk),
@@ -1083,7 +1243,8 @@ class InferenceEngine:
             jnp.asarray(n_valid, jnp.int32),
             jnp.asarray(req.slot, jnp.int32),
             jnp.asarray(new_len, jnp.int32), self.rng,
-            self.table_device(), final=final, qweights=self.qweights)
+            self.table_device(), final=final, qweights=self.qweights,
+            span=attn_span)
         tok = int(tok_dev)               # host sync (garbage unless final)
         dt = time.time() - t0
         PREFILL_CHUNKS.inc()
@@ -1427,7 +1588,7 @@ class InferenceEngine:
             return None
         draft = np.zeros((self.n_slots + 1, K), np.int32)
         n_draft = np.zeros((self.n_slots + 1,), np.int32)
-        drafted = 0
+        dlen: Dict[int, int] = {}
         for slot, req in self.slot_req.items():
             # A slot within K+1 rows of max_len drafts NOTHING instead
             # of disabling speculation engine-wide: its single
@@ -1444,50 +1605,73 @@ class InferenceEngine:
             if d:
                 n_draft[slot] = len(d)
                 draft[slot, :len(d)] = d
-                drafted += len(d)
-        if not drafted:
+                dlen[slot] = len(d)
+        if not dlen:
             return None
-        active = np.zeros((self.n_slots + 1,), bool)
-        for s in self.slot_req:
-            active[s] = True
+        # Span regrouping, exactly as the plain burst: one verify
+        # program per span bucket present among the active slots —
+        # a slot verifies at ITS group's span, so a long conversation
+        # never drags short neighbors back to worst-case reads.
+        groups = self._span_groups(K + 1)
+        drafted = sum(dlen.get(s, 0)
+                      for _, slots in groups for s in slots)
+        if not drafted:
+            # Every drafting slot was kept out (lazy dry pool): a
+            # K+1-wide verify for the rest would be strictly worse
+            # than the plain burst the caller falls back to.
+            return None
         span = timeline.Event("skytpu_decode_step_seconds",
                               histogram=DECODE_STEP_SECONDS)
         span.begin()
-        self.cache, toks_dev, commit_dev = self._verify_fn(
-            self.params, self.cache, jnp.asarray(draft),
-            jnp.asarray(n_draft), jnp.asarray(active),
-            self.table_device(), k=K, qweights=self.qweights)
+        parts = []
+        for attn_span, slots in groups:
+            active = np.zeros((self.n_slots + 1,), bool)
+            for s in slots:
+                active[s] = True
+            sarg = self._span_arg(attn_span)
+            self.decode_programs.add(("verify", K, sarg))
+            DECODE_ATTN_ROWS.observe(attn_span)
+            self.cache, toks_dev, commit_dev = self._verify_fn(
+                self.params, self.cache, jnp.asarray(draft),
+                jnp.asarray(n_draft), jnp.asarray(active),
+                self.table_device(), k=K, qweights=self.qweights,
+                span=sarg)
+            parts.append((slots, toks_dev, commit_dev))
         # THE completion fetch: verify bursts are synchronous (the next
         # draft depends on these tokens), so this is the one deliberate
         # sync of the spec path — same role as complete_decode_burst's.
-        toks = np.asarray(toks_dev)                    # [B, K+1]
-        n_commit = np.asarray(commit_dev)              # [B]
+        fetched = [(slots, np.asarray(t), np.asarray(c))
+                   for slots, t, c in parts]       # [B, K+1] / [B]
         span.end()
         out: Dict[int, List[int]] = {}
         n_emitted = accepted = 0
-        for slot, req in list(self.slot_req.items()):
-            nd = int(n_draft[slot])
-            nc = int(n_commit[slot])
-            emitted: List[int] = []
-            for i in range(nc):
-                tok = int(toks[slot, i])
-                emitted.append(tok)
-                req.tokens.append(tok)
-                if self._req_finished(req, tok):
-                    self._retire(req)
-                    break
-            # Accepted = matched draft tokens the request actually
-            # emitted: the first nc-1 outputs are the matched run, the
-            # nc-th the correction/bonus — an early EOS/budget retire
-            # discards the tail, and counting the full run would
-            # inflate the trailer stats and the acceptance gauge on
-            # EOS-heavy workloads.
-            acc = min(len(emitted), nc - 1)
-            req.spec_drafted += nd
-            req.spec_accepted += acc
-            accepted += acc
-            out[req.rid] = emitted
-            n_emitted += len(emitted)
+        for slots, toks, n_commit in fetched:
+            for slot in slots:
+                req = self.slot_req.get(slot)
+                if req is None or req.done:
+                    continue
+                nd = dlen.get(slot, 0)
+                nc = int(n_commit[slot])
+                emitted: List[int] = []
+                for i in range(nc):
+                    tok = int(toks[slot, i])
+                    emitted.append(tok)
+                    req.tokens.append(tok)
+                    if self._req_finished(req, tok):
+                        self._retire(req)
+                        break
+                # Accepted = matched draft tokens the request actually
+                # emitted: the first nc-1 outputs are the matched run,
+                # the nc-th the correction/bonus — an early EOS/budget
+                # retire discards the tail, and counting the full run
+                # would inflate the trailer stats and the acceptance
+                # gauge on EOS-heavy workloads.
+                acc = min(len(emitted), nc - 1)
+                req.spec_drafted += nd
+                req.spec_accepted += acc
+                accepted += acc
+                out[req.rid] = emitted
+                n_emitted += len(emitted)
         SPEC_DRAFTED.inc(drafted)
         if accepted:
             SPEC_ACCEPTED.inc(accepted)
@@ -1537,63 +1721,104 @@ class InferenceEngine:
         if k < 1 or need < 1:
             return None
         k = 1 << (k.bit_length() - 1)
-        active = np.zeros((self.n_slots + 1,), bool)
-        for s in self.slot_req:
-            active[s] = True
-        span = timeline.Event("skytpu_decode_step_seconds",
-                              histogram=DECODE_STEP_SECONDS)
-        span.begin()
-        self.cache, self.rng, toks = self._decode_burst_fn(
-            self.params, self.cache, self.rng, jnp.asarray(active),
-            self.table_device(), k=k, qweights=self.qweights)
+        # Span regrouping: one program per span bucket present among
+        # the active slots, so a single long conversation promotes
+        # only ITS group to the big gather (lazy mode also grows each
+        # slot's blocks here; unbackable slots sit the round out).
+        groups = self._span_groups(k)
+        if not groups:
+            return None            # lazy: pool dry — retry next round
+        ev = timeline.Event("skytpu_decode_step_seconds",
+                            histogram=DECODE_STEP_SECONDS)
+        ev.begin()
+        parts: List[Tuple[jax.Array, List[int]]] = []
+        for attn_span, slots in groups:
+            active = np.zeros((self.n_slots + 1,), bool)
+            for s in slots:
+                active[s] = True
+            sarg = self._span_arg(attn_span)
+            self.decode_programs.add(("burst", k, sarg))
+            DECODE_ATTN_ROWS.observe(attn_span)
+            self.cache, self.rng, toks = self._decode_burst_fn(
+                self.params, self.cache, self.rng, jnp.asarray(active),
+                self.table_device(), k=k, qweights=self.qweights,
+                span=sarg)
+            parts.append((toks, slots))
         self._inflight_tokens += k
-        return BurstHandle(toks=toks, k=k, slot_req=dict(self.slot_req),
-                           span=span)
+        return BurstHandle(parts=parts, k=k,
+                           slot_req=dict(self.slot_req), span=ev)
 
     def complete_decode_burst(self, handle: "BurstHandle"
                               ) -> Dict[int, List[int]]:
         """Fetch a dispatched burst's tokens (host sync) and do the
         bookkeeping: append/retire per request, using the slot->request
         snapshot taken at dispatch. Requests retired by an earlier
-        completion are skipped (their surplus tokens are discarded)."""
-        toks = np.asarray(handle.toks)             # [k, slots]
+        completion are skipped (their surplus tokens are discarded);
+        slots a lazy dry pool kept out of the burst simply have no
+        part and emit nothing this round."""
+        fetched = [(np.asarray(toks_dev), slots)
+                   for toks_dev, slots in handle.parts]
         if handle.span is not None:
             handle.span.end()
         self._inflight_tokens -= handle.k
         out: Dict[int, List[int]] = {}
         n_emitted = 0
-        for slot, req in handle.slot_req.items():
-            if req.done:
-                continue
-            emitted = []
-            for i in range(handle.k):
-                tok = int(toks[i, slot])
-                emitted.append(tok)
-                req.tokens.append(tok)
-                if self._req_finished(req, tok):
-                    self._retire(req)
-                    break
-            out[req.rid] = emitted
-            n_emitted += len(emitted)
+        for toks, slots in fetched:                # toks: [k, slots+1]
+            for slot in slots:
+                req = handle.slot_req.get(slot)
+                if req is None or req.done:
+                    continue
+                emitted = []
+                for i in range(handle.k):
+                    tok = int(toks[i, slot])
+                    emitted.append(tok)
+                    req.tokens.append(tok)
+                    if self._req_finished(req, tok):
+                        self._retire(req)
+                        break
+                out[req.rid] = emitted
+                n_emitted += len(emitted)
         if n_emitted:
             DECODE_TOKENS.inc(n_emitted)
         return out
 
     def step_decode_once(self) -> Dict[int, int]:
-        """One single-token decode for all active slots (no admission)."""
+        """One single-token decode for all active slots (no admission).
+        Runs at ONE span — the bucket covering the longest active slot
+        (the single-step path is the classic-semantics fallback; the
+        burst path is where regrouping pays)."""
         if not self.slot_req:
             return {}
         active = np.zeros((self.n_slots + 1,), bool)
-        for s in self.slot_req:
+        rows_max = 0
+        for s, req in self.slot_req.items():
+            if not self._ensure_headroom(s, req,
+                                         self._slot_rows(req) + 1):
+                continue            # lazy: pool dry — sits this out
             active[s] = True
+            rows_max = max(rows_max, self._slot_rows(req))
+        if not rows_max:
+            # Lazy mode only (eager slots always have headroom): the
+            # sync single-step path has no outstanding burst whose
+            # completion could free blocks, so an all-slots-unbackable
+            # round is a genuine wedge — raise like run_to_completion,
+            # never spin silently.
+            raise RuntimeError(
+                "KV block pool exhausted: lazy growth cannot back any "
+                "active slot — size SKYTPU_KV_BLOCKS for the live "
+                "working set or disable SKYTPU_KV_LAZY")
+        sarg = self._span_arg(self._span_for(rows_max))
+        self.decode_programs.add(("decode1", 1, sarg))
         with timeline.Event("skytpu_decode_step_seconds",
                             histogram=DECODE_STEP_SECONDS):
             self.cache, self.rng, toks = self._decode_fn(
                 self.params, self.cache, self.rng, jnp.asarray(active),
-                self.table_device(), qweights=self.qweights)
+                self.table_device(), qweights=self.qweights, span=sarg)
             toks = np.asarray(toks)
         out: Dict[int, int] = {}
         for slot, req in list(self.slot_req.items()):
+            if not active[slot]:
+                continue
             tok = int(toks[slot])
             req.tokens.append(tok)
             out[req.rid] = tok
@@ -1603,9 +1828,26 @@ class InferenceEngine:
         return out
 
     def run_to_completion(self, max_burst: int = 8) -> List[Request]:
-        """Drain all waiting + active requests; returns finished list."""
+        """Drain all waiting + active requests; returns finished list.
+
+        Lazy mode can genuinely wedge: every active slot needs blocks
+        the pool cannot grow and nothing is left to retire. Eager
+        admission makes that impossible by construction; here the
+        stall is detected and raised instead of spinning forever."""
+        stalled = 0
         while self.waiting or self.chunking or self.slot_req:
-            self.step_burst(max_burst)
+            had_chunks = bool(self.chunking)
+            before = len(self.finished)
+            out = self.step_burst(max_burst)
+            progress = (bool(out) or had_chunks
+                        or len(self.finished) > before)
+            stalled = 0 if progress else stalled + 1
+            if self.kv_lazy and self.slot_req and stalled > 2:
+                raise RuntimeError(
+                    "KV block pool exhausted: lazy growth cannot back "
+                    "any active slot and nothing can retire — size "
+                    "SKYTPU_KV_BLOCKS for the live working set or "
+                    "disable SKYTPU_KV_LAZY")
         return self.finished
 
     # -- convenience -------------------------------------------------------

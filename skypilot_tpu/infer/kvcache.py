@@ -434,12 +434,24 @@ def _phys(cache: Cache, table, slots, idx):
     return table[slots, idx // bl], idx % bl
 
 
-def _gather_kv_layer(cache: Cache, i, table):
+def _gather_kv_layer(cache: Cache, i, table, span=None):
     """Layer ``i``'s K/V (+ scales when int8) arranged per slot:
     k/v [B, M, G, hd], scales [B, G, M]. Contiguous reads the
     slot-major layout directly; paged gathers each slot's blocks in
     logical order — identical row ordering, so the attention sums
-    match the contiguous layout bit-for-bit."""
+    match the contiguous layout bit-for-bit.
+
+    ``span`` (static int): gather only the first ``span`` logical
+    rows — the span-bucketed read. The rows kept are a PREFIX of the
+    full view in the same order, and every row the caller's validity
+    mask admits lies below the span by construction (the engine picks
+    the bucket covering the longest active slot), so the masked score
+    set — and the attention output — is bit-identical to the full
+    gather while the materialized K/V transient (the decode-bandwidth
+    cost) shrinks from max_len to span rows per slot. Paged: the
+    gather covers ceil(span / block_len) whole blocks of the table
+    prefix, then slices to the span — sub-block spans still pay one
+    block of gather but only span rows of attention."""
     ck = lax.dynamic_index_in_dim(cache["k"], i, 0, keepdims=False)
     cv = lax.dynamic_index_in_dim(cache["v"], i, 0, keepdims=False)
     cks = cvs = None
@@ -449,21 +461,31 @@ def _gather_kv_layer(cache: Cache, i, table):
         cvs = lax.dynamic_index_in_dim(cache["v_scale"], i, 0,
                                        keepdims=False)
     if table is not None:
-        tbl = table[:, :-1]                  # sentinel column: no rows
-        B, nb = tbl.shape
         bl = ck.shape[1]
+        nb = table.shape[1] - 1              # sentinel column: no rows
+        if span is not None:
+            nb = -(-span // bl)              # block-table prefix
+        tbl = table[:, :nb]
+        B = tbl.shape[0]
         G = ck.shape[2]
         ck = ck[tbl].reshape(B, nb * bl, *ck.shape[2:])
         cv = cv[tbl].reshape(B, nb * bl, *cv.shape[2:])
         if cks is not None:
             cks = cks[tbl].transpose(0, 2, 1, 3).reshape(B, G, nb * bl)
             cvs = cvs[tbl].transpose(0, 2, 1, 3).reshape(B, G, nb * bl)
+    if span is not None:
+        ck = ck[:, :span]
+        cv = cv[:, :span]
+        if cks is not None:
+            cks = cks[..., :span]
+            cvs = cvs[..., :span]
     return ck, cv, cks, cvs
 
 
-def _gather_slot_kv_layer(cache: Cache, i, slot, table):
+def _gather_slot_kv_layer(cache: Cache, i, slot, table, span=None):
     """One slot's rows for layer ``i``: k/v [M, G, hd], scales [G, M]
-    (the prefill_chunk read path)."""
+    (the prefill_chunk read path). ``span``: first ``span`` logical
+    rows only — same prefix semantics as :func:`_gather_kv_layer`."""
     ck = lax.dynamic_index_in_dim(cache["k"], i, 0, keepdims=False)
     cv = lax.dynamic_index_in_dim(cache["v"], i, 0, keepdims=False)
     cks = cvs = None
@@ -478,16 +500,26 @@ def _gather_slot_kv_layer(cache: Cache, i, slot, table):
         if cks is not None:
             cks = lax.dynamic_index_in_dim(cks, slot, 0, keepdims=False)
             cvs = lax.dynamic_index_in_dim(cvs, slot, 0, keepdims=False)
+        if span is not None:
+            ck, cv = ck[:span], cv[:span]
+            if cks is not None:
+                cks, cvs = cks[:, :span], cvs[:, :span]
         return ck, cv, cks, cvs
-    tblk = table[slot, :-1]                  # [nb]
-    nb = tblk.shape[0]
     bl = ck.shape[1]
+    nb = table.shape[1] - 1                  # sentinel column: no rows
+    if span is not None:
+        nb = -(-span // bl)
+    tblk = table[slot, :nb]                  # [nb]
     G = ck.shape[2]
     ck = ck[tblk].reshape(nb * bl, *ck.shape[2:])
     cv = cv[tblk].reshape(nb * bl, *cv.shape[2:])
     if cks is not None:
         cks = cks[tblk].transpose(1, 0, 2).reshape(G, nb * bl)
         cvs = cvs[tblk].transpose(1, 0, 2).reshape(G, nb * bl)
+    if span is not None:
+        ck, cv = ck[:span], cv[:span]
+        if cks is not None:
+            cks, cvs = cks[:, :span], cvs[:, :span]
     return ck, cv, cks, cvs
 
 
@@ -714,7 +746,7 @@ def prefill_chunk(params: llama.Params, cache: Cache,
                   n_valid: jax.Array, slot: jax.Array,
                   new_len: jax.Array, rng: jax.Array,
                   cfg: llama.LlamaConfig, sp, *, final: bool,
-                  qweights=None, table=None
+                  qweights=None, table=None, span=None
                   ) -> Tuple[Cache, jax.Array, jax.Array]:
     """One chunk of an incremental prefill into a decode slot.
 
@@ -743,10 +775,17 @@ def prefill_chunk(params: llama.Params, cache: Cache,
     as the contiguous read) and writes scatter through the table —
     paged-vs-contiguous chunk prefills are bit-identical.
 
+    ``span`` (static): the big-cache dot reads only the first ``span``
+    logical rows — sufficient whenever span >= ``start`` (the mask
+    admits no row past ``start``), so the engine picks the span bucket
+    covering this chunk's offset and a long-max_len engine stops
+    paying max_len rows of reads per chunk. Same masked score set,
+    same summation order: bit-identical to the full-view chunk.
+
     Returns (cache', rng', first_token — 0 unless ``final``).
     """
     C = tokens_c.shape[0]
-    M = _logical_rows(cache, table)
+    M = span if span is not None else _logical_rows(cache, table)
     G, hd = cfg.n_kv_heads, cfg.head_dim
     rep = cfg.n_heads // G
     scale = hd ** -0.5
@@ -785,7 +824,8 @@ def prefill_chunk(params: llama.Params, cache: Cache,
             ys = (kq, vq, ksc.astype(sdt), vsc.astype(sdt))
         else:
             ys = (kr.astype(kdt), vr.astype(kdt))
-        ck, cv, cks, cvs = _gather_slot_kv_layer(cache, i, slot, table)
+        ck, cv, cks, cvs = _gather_slot_kv_layer(cache, i, slot, table,
+                                                 span)
         # bf16 dots, fp32 accumulation — int8 converts to bf16 exactly
         # (see decode_step's note).
         qh = q[0].reshape(C, G, rep, hd).astype(jnp.bfloat16)
@@ -921,7 +961,7 @@ def _decode_head(cfg, params, qweights, x):
 def decode_step(params: llama.Params, cache: Cache,
                 cfg: llama.LlamaConfig,
                 constrain=None, qweights=None,
-                table=None) -> Tuple[Cache, jax.Array]:
+                table=None, span=None) -> Tuple[Cache, jax.Array]:
     """One token for every slot. Returns (cache', logits [slots, vocab]).
 
     ``qweights`` (from ``quantize_block_weights``/``quantize_head``):
@@ -930,11 +970,17 @@ def decode_step(params: llama.Params, cache: Cache,
     ``table`` ([slots, blocks_per_slot + 1] int32): paged layout —
     reads gather each slot's blocks in logical order, the pending-row
     scatter maps through the table (sentinel -> dropped).
+    ``span`` (static): attention reads only the first ``span`` logical
+    rows — valid whenever every active slot's length <= span (the
+    engine's span-bucket selection guarantees it); the pending-row
+    scatter still routes through the FULL table, so writes are
+    untouched. Bit-identical to the full view: the rows dropped were
+    all masked to exact-zero softmax weight.
     """
     if constrain is None:
         constrain = lambda x, axes: x
     B = cache["length"].shape[0]
-    M = _logical_rows(cache, table)
+    M = span if span is not None else _logical_rows(cache, table)
     G, hd = cfg.n_kv_heads, cfg.head_dim
     rep = cfg.n_heads // G
 
@@ -988,7 +1034,7 @@ def decode_step(params: llama.Params, cache: Cache,
             k_new = kq.astype(jnp.bfloat16)
             v_new = vq.astype(jnp.float32)
             ys = (kq, vq)
-        ck, cv, cks, cvs = _gather_kv_layer(cache, i, table)
+        ck, cv, cks, cvs = _gather_kv_layer(cache, i, table, span)
         # The attention dots run in bf16 with fp32 ACCUMULATION. The
         # int8 cache converts to bf16 EXACTLY (integers <= 127 carry no
         # rounding in an 8-bit mantissa) and each bf16xbf16 product is
@@ -1057,7 +1103,7 @@ def commit_tokens(cache: Cache, tokens: jax.Array,
 
 def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
                        i, s, sk, sv, sks, svs, valid_cache,
-                       stage_valid, batch_ix):
+                       stage_valid, batch_ix, span=None):
     """One decoder layer of a staged-burst step: the current step's
     K/V rows land in the staging buffers, attention runs as big-cache
     dot (rows masked by ``valid_cache``) ++ staged-columns dot
@@ -1065,7 +1111,10 @@ def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
     pure invariant. Shared VERBATIM by :func:`decode_burst_staged` and
     :func:`verify_draft_staged` — the speculative parity guarantee is
     precisely that both programs run THIS math, so an edit here can
-    never drift one without the other. Returns (x', sk, sv, sks, svs).
+    never drift one without the other. ``span`` (static) bounds the
+    big-cache read to the first ``span`` logical rows; the caller's
+    ``valid_cache`` mask must already be span-shaped.
+    Returns (x', sk, sv, sks, svs).
     """
     quant = "k_scale" in cache
     wq8 = qlayer is not None
@@ -1074,7 +1123,7 @@ def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
     B = x.shape[0]
     G, hd = cfg.n_kv_heads, cfg.head_dim
     rep = cfg.n_heads // G
-    M = _logical_rows(cache, table)
+    M = span if span is not None else _logical_rows(cache, table)
     scale = hd ** -0.5
     neg = jnp.asarray(-1e30, jnp.float32)
 
@@ -1090,7 +1139,7 @@ def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
     else:
         sk = sk.at[i, batch_ix, s].set(kk[:, 0].astype(kdt))
         sv = sv.at[i, batch_ix, s].set(v[:, 0].astype(kdt))
-    ck, cv, cks, cvs = _gather_kv_layer(cache, i, table)
+    ck, cv, cks, cvs = _gather_kv_layer(cache, i, table, span)
     lk = lax.dynamic_index_in_dim(sk, i, 0, False)
     lv = lax.dynamic_index_in_dim(sv, i, 0, False)
     # bf16 dots, fp32 accumulation — int8 converts to bf16 exactly
@@ -1150,7 +1199,7 @@ def _flush_staged_rows(cache: Cache, table, pos0, batch_ix,
 def decode_burst_staged(params: llama.Params, cache: Cache,
                         rng: jax.Array, active: jax.Array, k: int,
                         cfg: llama.LlamaConfig, sp,
-                        qweights=None, table=None
+                        qweights=None, table=None, span=None
                         ) -> Tuple[Cache, jax.Array, jax.Array]:
     """k decode steps with a per-BURST cache flush (the engine's burst
     program; trace under jit with cache+rng donated).
@@ -1178,10 +1227,17 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
     ``insert``. With a block ``table``, cache reads gather each slot's
     blocks in logical order and the flush scatters through the table
     (cleared/dead slot rows map to the sentinel block and drop).
+
+    ``span`` (static): the big-cache read covers only the first
+    ``span`` logical rows. Correct whenever every ACTIVE slot's
+    burst-start length <= span (the engine's bucket selection); an
+    inactive slot whose length exceeds the span computes garbage that
+    is never committed, exactly like any other dead-slot row. The
+    flush scatters through the FULL table, so writes are unchanged.
     Returns (cache', rng', toks [k, slots]).
     """
     B = cache["length"].shape[0]
-    M = _logical_rows(cache, table)
+    M = span if span is not None else _logical_rows(cache, table)
     G, hd = cfg.n_kv_heads, cfg.head_dim
     L = cfg.n_layers
     quant = "k_scale" in cache
@@ -1218,7 +1274,8 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
                 layer, qlayer = layer_q, None
             x, sk, sv, sks, svs = _staged_attn_layer(
                 cfg, cache, table, layer, qlayer, x, cos, sin, i, s,
-                sk, sv, sks, svs, valid_cache, stage_valid, batch_ix)
+                sk, sv, sks, svs, valid_cache, stage_valid, batch_ix,
+                span)
             return (x, i + 1, sk, sv, sks, svs), None
 
         xs = ((params["blocks"], qweights["blocks"]) if wq8
@@ -1245,7 +1302,7 @@ def verify_draft_staged(params: llama.Params, cache: Cache,
                         draft: jax.Array, n_draft: jax.Array,
                         active: jax.Array, k: int,
                         cfg: llama.LlamaConfig,
-                        qweights=None, table=None
+                        qweights=None, table=None, span=None
                         ) -> Tuple[Cache, jax.Array, jax.Array]:
     """Speculative-decode verify: score ``k`` drafted tokens per slot
     plus the correction position in ONE device call (the engine's
@@ -1289,13 +1346,18 @@ def verify_draft_staged(params: llama.Params, cache: Cache,
     window rows past max_len drop via scatter-OOB (contiguous) or the
     sentinel block (paged).
 
+    ``span`` (static): same bounded big-cache read as
+    :func:`decode_burst_staged` — accepted positions see exactly the
+    score set the plain decode path at the same span would, so the
+    spec parity guarantee extends to every span bucket.
+
     Returns (cache', toks [B, k+1] — the window's argmax outputs, the
     first ``n_commit[b]`` of row b are the committed tokens —
     n_commit [B] int32, 0 for inactive slots).
     """
     B = cache["length"].shape[0]
     W = k + 1
-    M = _logical_rows(cache, table)
+    M = span if span is not None else _logical_rows(cache, table)
     G, hd = cfg.n_kv_heads, cfg.head_dim
     L = cfg.n_layers
     quant = "k_scale" in cache
@@ -1336,7 +1398,8 @@ def verify_draft_staged(params: llama.Params, cache: Cache,
                 layer, qlayer = layer_q, None
             x, sk, sv, sks, svs = _staged_attn_layer(
                 cfg, cache, table, layer, qlayer, x, cos, sin, i, s,
-                sk, sv, sks, svs, valid_cache, stage_valid, batch_ix)
+                sk, sv, sks, svs, valid_cache, stage_valid, batch_ix,
+                span)
             return (x, i + 1, sk, sv, sks, svs), None
 
         xs = ((params["blocks"], qweights["blocks"]) if wq8

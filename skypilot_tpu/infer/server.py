@@ -607,6 +607,25 @@ def main() -> None:
                     help="paged KV pool size in blocks (default env "
                          "SKYTPU_KV_BLOCKS, or the contiguous-"
                          "equivalent HBM: (slots+1)*max_len/block)")
+    ap.add_argument("--span-buckets", default=None,
+                    help="span-bucketed decode attention: comma-"
+                         "separated ladder of KV-row spans (each "
+                         "decode/verify/chunk program compiles per "
+                         "rung and reads only that many rows, so "
+                         "decode bandwidth tracks the active span, "
+                         "not --max-len). "
+                         "Default: max_len/8,/4,/2 ladder "
+                         "(env SKYTPU_SPAN_BUCKETS); 0 disables "
+                         "(full-view reads only)")
+    ap.add_argument("--kv-lazy", action="store_true",
+                    default=None,
+                    help="lazy paged-KV growth: admission reserves "
+                         "prompt + one burst of blocks instead of "
+                         "the full max_new_tokens worst case; the "
+                         "rest allocates at burst dispatch (dry pool "
+                         "= the slot sits a burst out). Default env "
+                         "SKYTPU_KV_LAZY; eager reservation is the "
+                         "default")
     ap.add_argument("--spec-k", type=int, default=None,
                     help="speculative decoding: draft up to K tokens "
                          "per slot per burst (n-gram prompt-lookup) "
@@ -656,6 +675,14 @@ def main() -> None:
         params = eng.InferenceEngine.sharded_init(cfg, mesh)
     else:
         params = llama.init_params(jax.random.key(0), cfg)
+    # "--span-buckets 0" disables bucketing; a comma list is an
+    # explicit ladder; unset falls through to the engine default /
+    # SKYTPU_SPAN_BUCKETS.
+    span_buckets = None
+    if args.span_buckets is not None:
+        rungs = [int(t) for t in
+                 args.span_buckets.replace(",", " ").split()]
+        span_buckets = [r for r in rungs if r > 0] or 0
     engine = eng.InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.max_len,
         mesh=mesh,
@@ -667,6 +694,7 @@ def main() -> None:
         max_wave=args.admit_wave,
         prefill_chunk=args.prefill_chunk,
         kv_block=args.kv_block, kv_blocks=args.kv_blocks,
+        span_buckets=span_buckets, kv_lazy=args.kv_lazy,
         # Serving default: prefix reuse ON (repeated system prompts are
         # the common serving workload); the engine-level default stays
         # 0 so library users opt in.

@@ -1,0 +1,24 @@
+# Clean twin: span selection and lazy growth done right — buckets
+# come from host-tracked request state (prompt/token list lengths plus
+# the in-flight count), headroom from the host numpy block table; the
+# device is never consulted. Never imported.
+
+
+class InferenceEngine:
+    def _slot_rows(self, req):
+        return (len(req.prompt) + len(req.tokens)
+                + self._inflight_tokens)
+
+    def _span_groups(self, width):
+        groups = {}
+        for slot, req in self.slot_req.items():
+            rows = self._slot_rows(req)
+            if not self._ensure_headroom(slot, req, rows + width):
+                continue
+            groups.setdefault(self._span_for(rows), []).append(slot)
+        return sorted(groups.items())
+
+    def _ensure_headroom(self, slot, req, need_rows):
+        row = self.block_table[slot]
+        have = len(row[row < self.n_kv_blocks])
+        return have * self.kv_block >= need_rows

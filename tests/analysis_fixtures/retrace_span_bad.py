@@ -1,0 +1,21 @@
+# Golden fixture: seeded retrace-safety violations in the
+# span-bucketed decode-attention shape — the exact mistakes span
+# bucketing invites: deriving the span from TRACED lengths inside the
+# program instead of taking it as a static argument (one compiled
+# program per ladder rung). Checked as if it lived at
+# skypilot_tpu/infer/ (a jit-root directory). Never imported.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def span_attn(cache, table, lengths):
+    span = int(jnp.max(lengths))                  # expect: concretize
+    host = np.asarray(lengths)                    # expect: host-transfer
+    if (lengths >= span).any():                   # expect: traced-branch
+        span = span + 1
+    rows = jnp.arange(jnp.max(lengths))           # expect: dynamic-shape
+    valid = rows[None, :] < lengths[:, None]
+    k = cache["k"][:, :span]
+    return k, valid, host

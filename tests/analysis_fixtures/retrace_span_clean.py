@@ -1,0 +1,19 @@
+# Clean twin: the span-bucketed gather done right — the span is a
+# STATIC argument (one compiled program per ladder rung, selected on
+# the host from host-tracked lengths), the block-table prefix is
+# sliced by static host math, and the validity mask is pure array
+# math against it. Never imported.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("span",))
+def span_attn(cache, table, lengths, *, span):
+    bl = cache["k"].shape[2]                      # static: block rows
+    nb = span // bl                               # static host math
+    tbl = table[:, :nb]                           # block-table prefix
+    k = jnp.take(cache["k"], tbl, axis=1)
+    valid = jnp.arange(span)[None, :] < lengths[:, None]
+    return k, valid

@@ -133,6 +133,13 @@ _GOLDEN = [
      "skypilot_tpu/infer/fixture_retrace_spec.py"),
     ("host-sync", "host_sync_spec_bad.py", "host_sync_spec_clean.py",
      "skypilot_tpu/infer/engine.py"),
+    # Span-bucketed attention (PR 9): the static-span gather and the
+    # host-side bucket/headroom selection are guarded like the paged
+    # and spec shapes before them.
+    ("retrace-safety", "retrace_span_bad.py", "retrace_span_clean.py",
+     "skypilot_tpu/infer/fixture_retrace_span.py"),
+    ("host-sync", "host_sync_span_bad.py", "host_sync_span_clean.py",
+     "skypilot_tpu/infer/engine.py"),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      "skypilot_tpu/utils/fixture_locks.py"),
     ("typed-errors", "typed_errors_bad.py", "typed_errors_clean.py",
