@@ -435,6 +435,36 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"span bench failed: {e}")
             out["serve_span_error"] = str(e)[:200]
+        # Flight recorder + compile watch phase: the introspection
+        # contract over the full mixed workload (chunked admission +
+        # spec decode + span regrouping, paged + contiguous). Gates:
+        # nothing may compile inside the timed serving window, every
+        # burst must carry a matching flight record, and the recorder
+        # must be a no-op guard when off (<1% TPOT).
+        try:
+            from skypilot_tpu.infer import bench_serve as _bs
+            fli = _bs.run_flight(config=serve_cfg, weights_int8=big,
+                                 kv_int8=big)
+            out["serve_warmup_compile_s"] = fli["warmup_compile_s"]
+            out["serve_unexpected_compiles"] = \
+                fli["unexpected_compiles"]
+            out["serve_flight_records"] = fli["n_records"]
+            out["serve_flight_overhead"] = fli["overhead_ratio"]
+            out["serve_flight_coverage_ok"] = fli["coverage_ok"]
+            out["serve_flight_parity_ok"] = fli["parity_ok"]
+            out["serve_flight_regressed"] = bool(
+                fli["unexpected_compiles"] != 0
+                or not fli["coverage_ok"] or not fli["parity_ok"]
+                or fli["overhead_ratio"] > 1.01)
+            if out["serve_flight_regressed"]:
+                log("SERVE FLIGHT REGRESSION: "
+                    f"unexpected={fli['unexpected_compiles']} "
+                    f"coverage={fli['coverage_ok']} "
+                    f"parity={fli['parity_ok']} "
+                    f"overhead=x{fli['overhead_ratio']} (> 1.01)")
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"flight bench failed: {e}")
+            out["serve_flight_error"] = str(e)[:200]
     if args.emit_metrics:
         from skypilot_tpu.observability import metrics as obs_metrics
         # Only families something actually recorded into: a bench run

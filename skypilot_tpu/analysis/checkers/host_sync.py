@@ -47,6 +47,17 @@ _SCOPES: Dict[str, Set[str]] = {
         # fetch to pick a span would stall every dispatch.
         "_span_groups", "_span_for", "_span_arg", "_slot_rows",
         "_ensure_headroom",
+        # Flight recorder (PR 10): the per-burst record is assembled
+        # from host bookkeeping inside the step/burst/chunk loops — a
+        # device fetch here would stall the very dispatch pipeline
+        # the recorder observes.
+        "_record_flight",
+    },
+    # Flight recorder + compile watch internals: record() runs once
+    # per burst on the engine loop and the watch wrapper rides EVERY
+    # jit dispatch — both must stay pure host work.
+    "skypilot_tpu/observability/flight.py": {
+        "record", "wrap", "tail", "since", "drain_new", "summary",
     },
     "skypilot_tpu/infer/server.py": {
         "_loop", "_step", "_drain_inbox", "_flush_streams",
@@ -71,7 +82,8 @@ class HostSyncChecker(Checker):
     # v2: paged-KV block-management methods joined the engine scope.
     # v3: the speculative verify/accept path joined it.
     # v4: span-selection + lazy-growth methods joined it.
-    version = 4
+    # v5: the flight-recorder record path + compile-watch wrapper.
+    version = 5
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

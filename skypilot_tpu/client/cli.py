@@ -227,6 +227,29 @@ def _health_lines(payload) -> list:
     return lines
 
 
+def _resolve_head_ip(cluster: str, refresh: bool = False) -> str:
+    """An UP cluster's head host IP (external when it has one).
+    Shared by `status --ip` and `skytpu flight <cluster>`; raises a
+    clear ClickException on unknown/stopped clusters instead of
+    letting callers time out against a stale handle."""
+    from skypilot_tpu import provision
+    records = sky.status([cluster], refresh=refresh)
+    if not records:
+        raise click.ClickException(f"no cluster {cluster!r}")
+    if records[0]["status"].value != "UP":
+        raise click.ClickException(
+            f"cluster {cluster!r} is "
+            f"{records[0]['status'].value}, not UP")
+    h = records[0]["handle"]
+    info = provision.get_cluster_info(h["provider"], cluster,
+                                      h.get("zone"))
+    if not info.hosts:
+        raise click.ClickException(
+            f"cluster {cluster!r} has no reachable hosts")
+    head = info.hosts[0]
+    return head.external_ip or head.internal_ip
+
+
 @cli.command()
 @click.option("--refresh", "-r", is_flag=True, default=False)
 @click.option("--ip", "show_ip", is_flag=True, default=False,
@@ -292,22 +315,7 @@ def status(refresh, show_ip, show_metrics, show_health, raw, clusters):
         # Reference parity: `sky status --ip` (sky/cli.py status).
         if len(clusters) != 1:
             raise click.UsageError("--ip requires exactly one cluster")
-        from skypilot_tpu import provision
-        records = sky.status(list(clusters), refresh=refresh)
-        if not records:
-            raise click.ClickException(f"no cluster {clusters[0]!r}")
-        if records[0]["status"].value != "UP":
-            raise click.ClickException(
-                f"cluster {clusters[0]!r} is "
-                f"{records[0]['status'].value}, not UP")
-        h = records[0]["handle"]
-        info = provision.get_cluster_info(h["provider"], clusters[0],
-                                          h.get("zone"))
-        if not info.hosts:
-            raise click.ClickException(
-                f"cluster {clusters[0]!r} has no reachable hosts")
-        head = info.hosts[0]
-        click.echo(head.external_ip or head.internal_ip)
+        click.echo(_resolve_head_ip(clusters[0], refresh=refresh))
         return
     records = sky.status(list(clusters) or None, refresh=refresh)
     if not records:
@@ -397,6 +405,29 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
                 g = gauge("skytpu_spec_acceptance_rate", agg="max")
                 if g is not None:
                     line += f"  spec acc {g:4.0%}"
+        # Fleet prefix-cache hit rate (ROADMAP item 3 slice): the
+        # federation already sums per-replica counters — the window
+        # rate when traffic flowed between frames, else the lifetime
+        # ratio (first frame / --once / idle).
+        if "skytpu_prefix_cache_hits_total" in have:
+            d_h = rate("skytpu_prefix_cache_hits_total")
+            d_m = rate("skytpu_prefix_cache_misses_total")
+            if d_h is not None and d_m is not None and (d_h + d_m) > 0:
+                line += f"  cache {d_h / (d_h + d_m):4.0%}"
+            else:
+                hits = gauge("skytpu_prefix_cache_hits_total")
+                misses = gauge("skytpu_prefix_cache_misses_total") or 0
+                if hits is not None and (hits + misses) > 0:
+                    line += f"  cache {hits / (hits + misses):4.0%}"
+        # Compile watch (docs/observability.md §Flight recorder):
+        # programs compiled fleet-wide, and — the alarm column — how
+        # many compiled AFTER an engine declared warmup complete.
+        comp = gauge("skytpu_programs_compiled_total")
+        if comp is not None:
+            unexp = gauge("skytpu_unexpected_compiles_total") or 0
+            line += f"  compiles {comp:.0f}"
+            line += (f" (! {unexp:.0f} unexpected)" if unexp
+                     else " (0 unexpected)")
         lines.append(line)
     if "skytpu_lb_proxied_total" in have:
         lines.append(
@@ -522,6 +553,77 @@ def trace_cmd(request_id, perfetto_path):
             json_lib.dump(trace_view.to_perfetto(records), f)
         click.echo(f"perfetto trace written to {perfetto_path}")
     click.echo(trace_view.render(records, trace_id))
+
+
+@cli.command(name="flight")
+@click.argument("target", required=False)
+@click.option("--local", "local", is_flag=True, default=False,
+              help="Read the flushed flight logs under this machine's "
+                   "events dir instead of querying a server.")
+@click.option("-n", "--last", type=int, default=32, show_default=True,
+              help="Burst records to show in the tail table.")
+@click.option("--port", type=int, default=8080, show_default=True,
+              help="Model-server port when TARGET is a cluster name.")
+@click.option("--perfetto", "perfetto_path", default=None,
+              help="Also write the burst records as Chrome "
+                   "trace-format JSON (Perfetto loadable) to this "
+                   "path.")
+def flight_cmd(target, local, last, port, perfetto_path):
+    """Engine flight recorder: the last-N bursts and program summary.
+
+    Burst-level serving introspection (docs/observability.md §Flight
+    recorder): which compiled program ran each admission wave, prefill
+    chunk, decode burst and speculative verify, with group
+    composition, host timing, spec acceptance and — when the compile
+    watch saw one — mid-traffic compiles.
+
+    TARGET is a model-server URL (http://host:port) or a cluster name
+    (resolved to its head IP); `--local` (or no target) reads the
+    flushed per-process logs under ~/.skypilot_tpu/events/ instead.
+    """
+    import json as json_lib
+    import urllib.request
+
+    from skypilot_tpu.observability import flight as flight_lib
+    from skypilot_tpu.observability import trace_view
+
+    programs = None
+    if target and not local:
+        if target.startswith(("http://", "https://")):
+            url = target.rstrip("/")
+        else:
+            # Cluster name -> head IP (the `status --ip` resolution,
+            # incl. its UP check — a stale handle would just time out).
+            url = f"http://{_resolve_head_ip(target)}:{port}"
+        # Fetch the whole ring (capped at its capacity), not just the
+        # tail table's -n: the per-program summary and the --perfetto
+        # export must cover the server's full history, exactly like
+        # --local does over the flushed logs. -n only trims the table.
+        try:
+            with urllib.request.urlopen(
+                    f"{url}/debug/flight?n={max(last, 8192)}",
+                    timeout=10) as resp:
+                payload = json_lib.loads(resp.read().decode())
+        except OSError as e:
+            raise click.ClickException(
+                f"GET {url}/debug/flight failed: {e}")
+        records = payload.get("records", [])
+        programs = payload.get("programs") or None
+        if not payload.get("enabled", True):
+            click.echo("note: the server's flight recorder is "
+                       "DISABLED (SKYTPU_FLIGHT=0)")
+        if payload.get("unexpected"):
+            click.echo(f"!! unexpected post-warmup compiles: "
+                       f"{payload['unexpected']}")
+    else:
+        records = flight_lib.load_records()
+    if perfetto_path:
+        with open(os.path.expanduser(perfetto_path), "w") as f:
+            json_lib.dump(
+                trace_view.to_perfetto(flight_lib.as_spans(records)),
+                f)
+        click.echo(f"perfetto trace written to {perfetto_path}")
+    click.echo(flight_lib.render_table(records, programs, last=last))
 
 
 @cli.command()

@@ -1133,6 +1133,184 @@ def run_span_smoke() -> dict:
     return run_span(smoke=True)
 
 
+def run_flight(config=None, requests=None, new_tokens=None,
+               max_burst=8, spec_k=4, kv_int8=False,
+               weights_int8=False, smoke=False) -> dict:
+    """Flight recorder + compile watch bench over the FULL mixed
+    workload: chunked admission with prefix reuse + speculative decode
+    + span regrouping, on a paged engine AND a contiguous twin.
+
+    Per layout:
+
+      1. ``warm_programs()`` sweeps the program grid, one untimed
+         workload pass covers anything workload-specific, then the
+         engine declares warmup complete — the production startup
+         sequence (`--warm-grid`).
+      2. The TIMED window runs the same mixed workload and asserts
+         the introspection contract: ``unexpected_compiles == 0``
+         (nothing compiled mid-traffic), and every decode/verify
+         program the engine selected (``decode_programs``) has flight
+         records whose program identity matches — and vice versa
+         (records never claim a program the engine didn't dispatch).
+      3. Recorder-on vs recorder-off passes measure the no-op-guard
+         overhead (``overhead_ratio``; greedy outputs must be
+         identical — recording can never perturb generation).
+
+    ``smoke=True``: CI-sized — structure and the zero-unexpected gate
+    are asserted in tier-1 (tests/test_flight.py); the <1% overhead
+    bound is gated only by bench.py on hardware (CPU wall-clock noise
+    swamps it).
+    """
+    import dataclasses
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.infer import engine as eng
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.observability import flight as flight_lib
+
+    on_cpu = jax.default_backend() == "cpu"
+    if config is None:
+        config = "llama3-tiny" if on_cpu else "llama3-400m"
+    small = smoke or on_cpu
+    if requests is None:
+        requests = 6 if small else 16
+    if new_tokens is None:
+        new_tokens = 24 if small else 128
+    max_len = 256 if small else 2048
+    chunk = 24 if small else 256
+    kv_block = 32 if small else 128   # not dividing chunk-aligned
+    #                                   prefixes cleanly -> COW runs
+    short_len, long_a, long_b = (12, 60, 72) if small \
+        else (96, 640, 768)
+    shared = 2 * chunk                # chunk-aligned shared prefix
+    slots = requests
+    # Small vocab: the random model's greedy decode cycles, so the
+    # n-gram drafter actually drafts (the run_spec regime).
+    cfg = dataclasses.replace(llama.CONFIGS[config], vocab_size=16)
+    rng = np.random.default_rng(0)
+    base = rng.integers(1, cfg.vocab_size, shared).tolist()
+    prompts = (
+        [rng.integers(1, cfg.vocab_size, short_len).tolist()
+         for _ in range(requests - 4)]
+        + [base + rng.integers(1, cfg.vocab_size,
+                               long_a - shared).tolist(),
+           base + rng.integers(1, cfg.vocab_size,
+                               long_b - shared).tolist()] * 2)
+    log(f"flight bench: {config} (vocab 16) max_len={max_len} "
+        f"chunk={chunk} block={kv_block} K={spec_k} "
+        f"requests={len(prompts)}")
+
+    def build(paged):
+        kw = dict(n_slots=slots, max_len=max_len,
+                  prompt_buckets=(16 if small else 128, max_len),
+                  kv_int8=kv_int8, prefill_chunk=chunk,
+                  prefix_pool=4, max_wave=slots, pad_waves=True,
+                  spec_k=spec_k, kv_block=kv_block if paged else 0,
+                  flight_recorder=flight_lib.FlightRecorder())
+        if weights_int8:
+            from skypilot_tpu.infer import kvcache
+            params, qw = kvcache.random_quantized_params(cfg)
+            return eng.InferenceEngine(params, cfg, qweights=qw, **kw)
+        params = llama.init_params(jax.random.key(0), cfg)
+        return eng.InferenceEngine(params, cfg, **kw)
+
+    def workload(e):
+        ids = [e.add_request(p, max_new_tokens=new_tokens)
+               for p in prompts]
+        t0 = _time.time()
+        e.run_to_completion(max_burst)
+        wall = _time.time() - t0
+        by_rid = {r.rid: list(r.tokens) for r in e.finished}
+        outs = [by_rid[i] for i in ids]
+        e.finished.clear()
+        toks = sum(len(o) for o in outs)
+        return outs, wall / max(toks, 1)
+
+    layouts = {}
+    for paged in (True, False):
+        e = build(paged)
+        rec = e.flight
+        # Production startup: grid sweep + one untimed workload pass,
+        # then arm the watch.
+        warmed = e.warm_programs(max_burst=max_burst)
+        workload(e)
+        warm_compile_s = e.compile_watch.total_compile_s()
+        e.declare_warmup_complete()
+        # Timed window.
+        e.decode_programs.clear()
+        seq0 = rec.seq()
+        out_on, tpot_on = workload(e)
+        window = rec.since(seq0)
+        unexpected = list(e.compile_watch.unexpected)
+        # Coverage: flight-record program identity <-> the programs
+        # the engine actually selected, both directions.
+        rec_dv = {(r["program"]["k"], r["program"]["span"])
+                  for r in window if r["burst"] in ("decode",
+                                                    "verify")}
+        eng_dv = {(k, s) for kind, k, s in e.decode_programs
+                  if kind in ("burst", "verify")}
+        n_chunks = sum(1 for r in window if r["burst"] == "chunk")
+        n_waves = sum(1 for r in window if r["burst"] == "wave")
+        coverage_ok = (rec_dv == eng_dv and n_chunks > 0
+                       and n_waves > 0)
+        # Recorder-off guard: same workload, recorder disabled —
+        # identical greedy output, best-of TPOT for the ratio.
+        rec.enabled = False
+        out_off, tpot_off = workload(e)
+        rec.enabled = True
+        _, tpot_on2 = workload(e)
+        rec.enabled = False
+        _, tpot_off2 = workload(e)
+        rec.enabled = True
+        tpot_on = min(tpot_on, tpot_on2)
+        tpot_off = min(tpot_off, tpot_off2)
+        layouts["paged" if paged else "contig"] = {
+            "programs_warmed": warmed,
+            "warmup_compile_s": round(warm_compile_s, 3),
+            "unexpected_compiles": len(unexpected),
+            "unexpected": unexpected,
+            "coverage_ok": bool(coverage_ok),
+            "parity_ok": bool(out_on == out_off),
+            "n_records": len(window),
+            "n_chunk_records": n_chunks,
+            "n_wave_records": n_waves,
+            "tpot_on_ms": round(tpot_on * 1e3, 3),
+            "tpot_off_ms": round(tpot_off * 1e3, 3),
+            "overhead_ratio": round(tpot_on / max(tpot_off, 1e-9), 4),
+        }
+        log(f"flight {'paged' if paged else 'contig'}: "
+            f"{layouts['paged' if paged else 'contig']}")
+    agg = {
+        "warmup_compile_s": round(
+            sum(v["warmup_compile_s"] for v in layouts.values()), 3),
+        "unexpected_compiles": sum(v["unexpected_compiles"]
+                                   for v in layouts.values()),
+        "coverage_ok": all(v["coverage_ok"] for v in layouts.values()),
+        "parity_ok": all(v["parity_ok"] for v in layouts.values()),
+        "n_records": sum(v["n_records"] for v in layouts.values()),
+        # Worst layout: the gate must catch a recorder change that
+        # slows only one of the two decode paths.
+        "overhead_ratio": max(v["overhead_ratio"]
+                              for v in layouts.values()),
+        "layouts": layouts,
+        "config": config,
+        "spec_k": spec_k,
+        "kv_int8": kv_int8,
+        "weights_int8": weights_int8,
+    }
+    return agg
+
+
+def run_flight_smoke() -> dict:
+    """CI-sized flight pass (tier-1 wiring: tests/test_flight.py
+    asserts the zero-unexpected + coverage structure; overhead is
+    reported, never gated, on CPU)."""
+    return run_flight(smoke=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None)
@@ -1188,7 +1366,29 @@ def main() -> None:
                          "a long-max_len engine), greedy parity "
                          "asserted (combine with --smoke for the "
                          "CI-sized pass)")
+    ap.add_argument("--flight", action="store_true",
+                    help="flight recorder + compile watch bench: the "
+                         "full mixed workload (chunked admission + "
+                         "spec decode + span regrouping, paged + "
+                         "contiguous) with warm-grid startup — gates "
+                         "zero unexpected compiles in the timed "
+                         "window, per-burst record coverage, and the "
+                         "recorder-off no-op guard (combine with "
+                         "--smoke for the CI-sized pass)")
     args = ap.parse_args()
+    if args.flight:
+        r = run_flight(config=args.config, kv_int8=args.kv_int8,
+                       weights_int8=args.weights_int8,
+                       smoke=args.smoke)
+        print(json.dumps({
+            "metric": "serve_unexpected_compiles",
+            "value": r["unexpected_compiles"],
+            "unit": "programs_compiled_in_timed_window",
+            **{k: r[k] for k in (
+                "warmup_compile_s", "coverage_ok", "parity_ok",
+                "n_records", "overhead_ratio", "layouts", "config")},
+        }))
+        return
     if args.span:
         r = run_span(config=args.config, kv_int8=args.kv_int8,
                      weights_int8=args.weights_int8,

@@ -29,6 +29,7 @@ import numpy as np
 
 from skypilot_tpu.infer import kvcache, sampling
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import flight as flight_lib
 from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.utils import timeline
 
@@ -181,6 +182,10 @@ class BurstHandle:
     # Span opened at dispatch, closed when the tokens are fetched —
     # double-records into skytpu_decode_step_seconds.
     span: Optional[timeline.Event] = None
+    # Per-part span rungs (parallel to ``parts``; None = full view):
+    # the flight record written at completion carries each part's
+    # program identity.
+    spans: List[Optional[int]] = dataclasses.field(default_factory=list)
 
 
 class PromptTooLongError(ValueError):
@@ -455,7 +460,9 @@ class InferenceEngine:
                  kv_blocks: Optional[int] = None,
                  spec_k: Optional[int] = None,
                  spec_drafter: Optional[Callable] = None,
-                 span_buckets=None, kv_lazy: Optional[bool] = None):
+                 span_buckets=None, kv_lazy: Optional[bool] = None,
+                 flight_recorder: Optional[
+                     flight_lib.FlightRecorder] = None):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -606,6 +613,22 @@ class InferenceEngine:
         # discipline tests assert this stays bounded by the ladder —
         # never one program per observed length.
         self.decode_programs: set = set()
+        # Flight recorder: one record per device burst (wave/chunk/
+        # decode/verify), program identity + group composition + host
+        # timing, zero device fetches. Injectable so tests/bench can
+        # observe an isolated window; None/disabled is a no-op guard.
+        self.flight = (flight_recorder if flight_recorder is not None
+                       else flight_lib.RECORDER)
+        # Compile watch: program registry over the jit entry points
+        # below — first-dispatch compile cost, and the mid-traffic
+        # unexpected-compile alarm once warmup is declared complete.
+        self.compile_watch = flight_lib.CompileWatch()
+        # Per-burst attribution accumulators for the flight record
+        # (loop-thread only): COW copies / prefix evictions / lazy
+        # grows since the previous record.
+        self._fl_cow = 0
+        self._fl_evictions = 0
+        self._fl_lazy_grows = 0
         # Lazy per-burst block growth (paged only): admission reserves
         # the prompt plus ONE burst of rows instead of the full
         # max_new_tokens worst case; the rest allocates at burst
@@ -812,15 +835,27 @@ class InferenceEngine:
         def _copy_block(cache, src, dst):
             return kvcache.copy_block(cache, src, dst)
 
-        self._admit_wave_fn = _admit_wave
-        self._decode_fn = _decode
-        self._decode_burst_fn = _decode_burst
-        self._verify_fn = _verify
-        self._prefill_chunk_fn = _prefill_chunk
-        self._claim_fn = _claim
-        self._pool_load_fn = _pool_load
-        self._pool_store_fn = _pool_store
-        self._copy_block_fn = _copy_block
+        # Every jit entry point rides the compile watch: a program key
+        # is (entry point, static args) — plus the wave's ROW COUNT,
+        # which is shape-derived identity jit recompiles on even under
+        # an unchanged static key. First dispatch records the compile
+        # wall; post-warmup new keys raise the unexpected-compile
+        # alarm. The wrappers are transparent pass-throughs (donation
+        # and async dispatch semantics unchanged).
+        watch = self.compile_watch.wrap
+        self._admit_wave_fn = watch(
+            "admit_wave", _admit_wave, ("bucket",),
+            key_fn=lambda a, kw: (("rows", a[2].shape[0]),))
+        self._decode_fn = watch("decode1", _decode, ("span",))
+        self._decode_burst_fn = watch("decode_burst", _decode_burst,
+                                      ("k", "span"))
+        self._verify_fn = watch("verify", _verify, ("k", "span"))
+        self._prefill_chunk_fn = watch("prefill_chunk", _prefill_chunk,
+                                       ("final", "span"))
+        self._claim_fn = watch("claim", _claim)
+        self._pool_load_fn = watch("pool_load", _pool_load)
+        self._pool_store_fn = watch("pool_store", _pool_store)
+        self._copy_block_fn = watch("copy_block", _copy_block)
 
     # -- admission ---------------------------------------------------------
 
@@ -872,6 +907,176 @@ class InferenceEngine:
         ENGINE_WAITING.set(len(self.waiting))
         if self.paged:
             KV_BLOCKS_USED.set(self.allocator.used)
+
+    # -- flight recorder + compile watch -----------------------------------
+
+    def _record_flight(self, burst: str, begin_s: float, end_s: float,
+                       program: Dict[str, Any], slots, reqs,
+                       toks: int, stall: bool = False,
+                       drafted: int = 0, accepted: int = 0) -> None:
+        """Append one burst record to the flight recorder. HOST
+        bookkeeping only — every value here already lives on the host
+        (request lists, ints, floats); a device fetch on this path
+        would stall the dispatch pipeline the recorder exists to
+        observe. COW/eviction/lazy-grow attribution: whatever
+        accumulated since the previous record rides this one (claims
+        run just before the wave/chunk record they belong to; lazy
+        growth happens inside the burst being recorded)."""
+        cow, self._fl_cow = self._fl_cow, 0
+        evs, self._fl_evictions = self._fl_evictions, 0
+        lazy, self._fl_lazy_grows = self._fl_lazy_grows, 0
+        compiled = self.compile_watch.drain_new()
+        fl = self.flight
+        if fl is None or not fl.enabled:
+            return
+        program = dict(program)
+        program["layout"] = "paged" if self.paged else "contig"
+        extra: Dict[str, Any] = {}
+        if stall:
+            extra["stall"] = True
+        if drafted:
+            extra["drafted"] = drafted
+            extra["accepted"] = accepted
+        if cow:
+            extra["cow"] = cow
+        if evs:
+            extra["evictions"] = evs
+        if lazy:
+            extra["lazy_grows"] = lazy
+        if compiled:
+            extra["compiled"] = compiled
+        fl.record(
+            burst, ts_s=begin_s, dur_s=max(end_s - begin_s, 0.0),
+            program=program, slots=list(slots),
+            rids=[r.rid for r in reqs],
+            traces=[r.span_ctx.trace_id for r in reqs
+                    if r.span_ctx is not None],
+            toks=toks, **extra)
+
+    def declare_warmup_complete(self) -> None:
+        """Arm the compile watch: every program the live workload can
+        reach is believed compiled, so any later compile is the
+        mid-traffic stall the static-shape design forbids — a typed
+        ``engine.unexpected_compile`` event plus
+        ``skytpu_unexpected_compiles_total`` (the SLO watchdog's
+        ``unexpected-compiles`` rule alarms on it)."""
+        self.compile_watch.declare_warm()
+
+    def warm_programs(self, max_burst: int = 8) -> int:
+        """Pre-compile the engine's reachable program grid so no XLA
+        compile can stall live traffic (call once at startup, then
+        :meth:`declare_warmup_complete`).
+
+        Every (kind, static-args) variant dispatches once against the
+        hidden SPARE slot, whose writes are garbage by construction
+        (paged: the spare's table row is all-sentinel so writes drop;
+        contiguous: they land in the spare's own dead rows), and the
+        length bookkeeping is zeroed afterwards. Greedy output is
+        unaffected — argmax sampling ignores the RNG stream this
+        consumes. Runs under ``metrics.suppress`` so the compile-
+        dominated sweep stays out of the serving histograms, then
+        republishes the sweep's compile metrics (skytpu_compile_
+        seconds / skytpu_programs_compiled_total) from the watch
+        registry — "programs compiled on this replica" must stay
+        truthful on warm-grid fleets. Returns the number of programs
+        compiled."""
+        before = self.compile_watch.count
+        pre_keys = set(self.compile_watch.summary())
+        spare = self.n_slots
+        active = np.zeros((self.n_slots + 1,), bool)
+        active[spare] = True
+        active_dev = jnp.asarray(active)
+        spans = [self._span_arg(s) for s in self.span_ladder]
+        with metrics.suppress():
+            for sarg in spans:
+                self.cache, self.rng, _ = self._decode_fn(
+                    self.params, self.cache, self.rng, active_dev,
+                    self.table_device(), qweights=self.qweights,
+                    span=sarg)
+                k = 1
+                while k <= max_burst:
+                    self.cache, self.rng, _ = self._decode_burst_fn(
+                        self.params, self.cache, self.rng, active_dev,
+                        self.table_device(), k=k,
+                        qweights=self.qweights, span=sarg)
+                    k *= 2
+                if self.spec_k:
+                    draft = jnp.zeros((self.n_slots + 1, self.spec_k),
+                                      jnp.int32)
+                    n_draft = jnp.zeros((self.n_slots + 1,), jnp.int32)
+                    self.cache, _, _ = self._verify_fn(
+                        self.params, self.cache, draft, n_draft,
+                        active_dev, self.table_device(), k=self.spec_k,
+                        qweights=self.qweights, span=sarg)
+                if self.prefill_chunk:
+                    chunk = jnp.zeros((self.prefill_chunk,), jnp.int32)
+                    for final in (False, True):
+                        self.cache, self.rng, _ = \
+                            self._prefill_chunk_fn(
+                                self.params, self.cache, chunk,
+                                jnp.asarray(0, jnp.int32),
+                                jnp.asarray(1, jnp.int32),
+                                jnp.asarray(spare, jnp.int32),
+                                jnp.asarray(self.max_len, jnp.int32),
+                                self.rng, self.table_device(),
+                                final=final, qweights=self.qweights,
+                                span=sarg)
+            # Admission waves: pad_waves pins every wave at max_wave
+            # rows, so one program per bucket suffices. Unpadded
+            # engines pad each wave to the next power of two of its
+            # size — warm that whole ladder, or declaring warmup
+            # complete would false-page on the first 2-row wave.
+            if self.pad_waves:
+                rows_ladder = [self.max_wave]
+            else:
+                cap = self.max_wave or self.n_slots
+                rows_ladder = [1]
+                r = 2
+                while r <= (1 << (cap - 1).bit_length()):
+                    rows_ladder.append(r)
+                    r <<= 1
+            for bucket in self.buckets:
+                for rows in rows_ladder:
+                    tokens_b = np.ones((rows, bucket), np.int32)
+                    true_lens = np.ones((rows,), np.int32)
+                    slot_ids = np.full((rows,), spare, np.int32)
+                    self.cache, self.rng, _ = self._admit_wave_fn(
+                        self.params, self.cache, jnp.asarray(tokens_b),
+                        jnp.asarray(true_lens),
+                        jnp.asarray(slot_ids), self.rng,
+                        self.table_device(), bucket=bucket,
+                        qweights=self.qweights)
+            # The admission path's small gather/scatter programs.
+            claim_len = jnp.asarray(self.max_len, jnp.int32)
+            self.cache = self._claim_fn(
+                self.cache, jnp.asarray(spare, jnp.int32), claim_len)
+            if self.pool is not None:
+                self.cache = self._pool_load_fn(
+                    self.cache, self.pool, jnp.asarray(0, jnp.int32),
+                    jnp.asarray(spare, jnp.int32), claim_len)
+                self.pool = self._pool_store_fn(
+                    self.pool, self.cache,
+                    jnp.asarray(spare, jnp.int32),
+                    jnp.asarray(0, jnp.int32))
+            if self.paged:
+                self.cache = self._copy_block_fn(
+                    self.cache, jnp.asarray(0, jnp.int32),
+                    jnp.asarray(0, jnp.int32))
+            # Scrub: zero the length bookkeeping — the sweep's data
+            # rows are dead without a length exposing them.
+            self.cache["length"] = jnp.zeros_like(self.cache["length"])
+        self.compile_watch.drain_new()   # not any burst's to claim
+        # Republish the sweep's compile metrics OUTSIDE suppress: the
+        # wrapper's increments were discarded inside it, but "programs
+        # compiled on this replica" must mirror the watch registry —
+        # or a warm-grid fleet would read `compiles 0` on skytpu top.
+        summ = self.compile_watch.summary()
+        for key in summ:
+            if key not in pre_keys:
+                flight_lib.COMPILE_SECONDS.labels(
+                    program=key).observe(summ[key])
+                flight_lib.PROGRAMS_COMPILED.inc()
+        return self.compile_watch.count - before
 
     # -- paged block management --------------------------------------------
 
@@ -929,6 +1134,7 @@ class InferenceEngine:
         row[have:have + len(blocks)] = blocks
         self._table_dirty = True
         KV_LAZY_GROWS.inc(len(blocks))
+        self._fl_lazy_grows += len(blocks)
         return True
 
     # -- span buckets ------------------------------------------------------
@@ -1003,6 +1209,7 @@ class InferenceEngine:
                 break
             idx.evict_entry(victim)
             PREFIX_EVICTIONS.inc()
+            self._fl_evictions += 1
             for b in victim:
                 alloc.decref(b)
         if alloc.available < n:
@@ -1185,6 +1392,7 @@ class InferenceEngine:
                         jnp.asarray(payload[n_shared], jnp.int32),
                         jnp.asarray(new_blocks[0], jnp.int32))
                     KV_COW_COPIES.inc()
+                    self._fl_cow += 1
             elif idx is not None and idx.eligible(req.prompt):
                 PREFIX_MISSES.inc()
             row[n_shared:n_shared + len(new_blocks)] = new_blocks
@@ -1251,6 +1459,11 @@ class InferenceEngine:
         req.n_chunks += 1
         if decode_active:
             DECODE_STALL_SECONDS.observe(dt)
+        self._record_flight(
+            "chunk", begin_s=t0, end_s=t0 + dt,
+            program={"span": attn_span, "final": final},
+            slots=[req.slot], reqs=[req], toks=1 if final else 0,
+            stall=decode_active)
         st.pos += n_valid
         if not final:
             return True
@@ -1310,12 +1523,14 @@ class InferenceEngine:
                                 jnp.int32),
                     jnp.asarray(cow[0], jnp.int32))
                 KV_COW_COPIES.inc()
+                self._fl_cow += 1
                 blocks.append(cow[0])
             for b in blocks[:n_full]:
                 self.allocator.incref(b)
             for payload in idx.insert_entry(req.prompt, n,
                                             tuple(blocks)):
                 PREFIX_EVICTIONS.inc()
+                self._fl_evictions += 1
                 for b in payload:
                     self.allocator.decref(b)
             self._update_gauges()
@@ -1323,6 +1538,7 @@ class InferenceEngine:
         row, evicted = idx.acquire_row()
         if evicted:
             PREFIX_EVICTIONS.inc()
+            self._fl_evictions += 1
         self.pool = self._pool_store_fn(
             self.pool, self.cache, jnp.asarray(req.slot, jnp.int32),
             jnp.asarray(row, jnp.int32))
@@ -1389,6 +1605,11 @@ class InferenceEngine:
         now = time.time()
         if decode_active:
             DECODE_STALL_SECONDS.observe(max(now - span.begin_s, 0.0))
+        self._record_flight(
+            "wave", begin_s=span.begin_s, end_s=now,
+            program={"bucket": bucket, "rows": first.shape[0]},
+            slots=slots, reqs=wave, toks=len(wave),
+            stall=decode_active)
         for req in wave:
             # The latency the request experienced: dispatch through
             # first-token fetch (same window as the histogram span).
@@ -1624,6 +1845,7 @@ class InferenceEngine:
                               histogram=DECODE_STEP_SECONDS)
         span.begin()
         parts = []
+        part_spans: List[Optional[int]] = []
         for attn_span, slots in groups:
             active = np.zeros((self.n_slots + 1,), bool)
             for s in slots:
@@ -1637,15 +1859,19 @@ class InferenceEngine:
                 self.table_device(), k=K, qweights=self.qweights,
                 span=sarg)
             parts.append((slots, toks_dev, commit_dev))
+            part_spans.append(sarg)
         # THE completion fetch: verify bursts are synchronous (the next
         # draft depends on these tokens), so this is the one deliberate
         # sync of the spec path — same role as complete_decode_burst's.
         fetched = [(slots, np.asarray(t), np.asarray(c))
                    for slots, t, c in parts]       # [B, K+1] / [B]
         span.end()
+        end_s = time.time()
         out: Dict[int, List[int]] = {}
         n_emitted = accepted = 0
-        for slots, toks, n_commit in fetched:
+        for (slots, toks, n_commit), sarg in zip(fetched, part_spans):
+            grp_emitted = grp_drafted = grp_accepted = 0
+            grp_reqs: List[Request] = []
             for slot in slots:
                 req = self.slot_req.get(slot)
                 if req is None or req.done:
@@ -1672,6 +1898,15 @@ class InferenceEngine:
                 accepted += acc
                 out[req.rid] = emitted
                 n_emitted += len(emitted)
+                grp_emitted += len(emitted)
+                grp_drafted += nd
+                grp_accepted += acc
+                grp_reqs.append(req)
+            self._record_flight(
+                "verify", begin_s=span.begin_s, end_s=end_s,
+                program={"k": K, "span": sarg},
+                slots=slots, reqs=grp_reqs, toks=grp_emitted,
+                drafted=grp_drafted, accepted=grp_accepted)
         SPEC_DRAFTED.inc(drafted)
         if accepted:
             SPEC_ACCEPTED.inc(accepted)
@@ -1732,6 +1967,7 @@ class InferenceEngine:
                             histogram=DECODE_STEP_SECONDS)
         ev.begin()
         parts: List[Tuple[jax.Array, List[int]]] = []
+        part_spans: List[Optional[int]] = []
         for attn_span, slots in groups:
             active = np.zeros((self.n_slots + 1,), bool)
             for s in slots:
@@ -1744,9 +1980,11 @@ class InferenceEngine:
                 self.table_device(), k=k, qweights=self.qweights,
                 span=sarg)
             parts.append((toks, slots))
+            part_spans.append(sarg)
         self._inflight_tokens += k
         return BurstHandle(parts=parts, k=k,
-                           slot_req=dict(self.slot_req), span=ev)
+                           slot_req=dict(self.slot_req), span=ev,
+                           spans=part_spans)
 
     def complete_decode_burst(self, handle: "BurstHandle"
                               ) -> Dict[int, List[int]]:
@@ -1760,10 +1998,16 @@ class InferenceEngine:
                    for toks_dev, slots in handle.parts]
         if handle.span is not None:
             handle.span.end()
+        end_s = time.time()
+        begin_s = (handle.span.begin_s if handle.span is not None
+                   else end_s)
         self._inflight_tokens -= handle.k
         out: Dict[int, List[int]] = {}
         n_emitted = 0
-        for toks, slots in fetched:                # toks: [k, slots+1]
+        for part_i, (toks, slots) in enumerate(fetched):
+            # toks: [k, slots+1]
+            part_emitted = 0
+            part_reqs: List[Request] = []
             for slot in slots:
                 req = handle.slot_req.get(slot)
                 if req is None or req.done:
@@ -1777,7 +2021,16 @@ class InferenceEngine:
                         self._retire(req)
                         break
                 out[req.rid] = emitted
-                n_emitted += len(emitted)
+                part_emitted += len(emitted)
+                part_reqs.append(req)
+            n_emitted += part_emitted
+            self._record_flight(
+                "decode", begin_s=begin_s, end_s=end_s,
+                program={"k": handle.k,
+                         "span": (handle.spans[part_i]
+                                  if part_i < len(handle.spans)
+                                  else None)},
+                slots=slots, reqs=part_reqs, toks=part_emitted)
         if n_emitted:
             DECODE_TOKENS.inc(n_emitted)
         return out
@@ -1809,22 +2062,32 @@ class InferenceEngine:
                 "working set or disable SKYTPU_KV_LAZY")
         sarg = self._span_arg(self._span_for(rows_max))
         self.decode_programs.add(("decode1", 1, sarg))
-        with timeline.Event("skytpu_decode_step_seconds",
-                            histogram=DECODE_STEP_SECONDS):
-            self.cache, self.rng, toks = self._decode_fn(
-                self.params, self.cache, self.rng, jnp.asarray(active),
-                self.table_device(), qweights=self.qweights, span=sarg)
-            toks = np.asarray(toks)
+        ev = timeline.Event("skytpu_decode_step_seconds",
+                            histogram=DECODE_STEP_SECONDS)
+        ev.begin()
+        self.cache, self.rng, toks = self._decode_fn(
+            self.params, self.cache, self.rng, jnp.asarray(active),
+            self.table_device(), qweights=self.qweights, span=sarg)
+        toks = np.asarray(toks)
+        ev.end()
         out: Dict[int, int] = {}
+        step_slots: List[int] = []
+        step_reqs: List[Request] = []
         for slot, req in list(self.slot_req.items()):
             if not active[slot]:
                 continue
             tok = int(toks[slot])
             req.tokens.append(tok)
             out[req.rid] = tok
+            step_slots.append(slot)
+            step_reqs.append(req)
             if self._req_finished(req, tok):
                 self._retire(req)
         DECODE_TOKENS.inc(len(out))
+        self._record_flight(
+            "decode1", begin_s=ev.begin_s, end_s=time.time(),
+            program={"k": 1, "span": sarg},
+            slots=step_slots, reqs=step_reqs, toks=len(out))
         return out
 
     def run_to_completion(self, max_burst: int = 8) -> List[Request]:

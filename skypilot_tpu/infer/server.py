@@ -37,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 from socketserver import ThreadingMixIn
 from typing import Dict, Optional
 
+from skypilot_tpu.observability import flight as flight_lib
 from skypilot_tpu.observability import health as health_lib
 from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.utils import timeline
@@ -147,8 +148,11 @@ class ModelServer:
         # Off-thread event-log heartbeat: engine spans become durable
         # (visible to a separate-process `skytpu trace`) within ~5s of
         # recording, and the O(ring) flush serialization never runs on
-        # the serving loop between decode waves.
+        # the serving loop between decode waves. The flight recorder
+        # gets the same durability heartbeat (visible to a separate-
+        # process `skytpu flight --local`).
         tracing.ensure_flush_thread()
+        flight_lib.ensure_flush_thread()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -418,7 +422,7 @@ class _Threading(ThreadingMixIn, HTTPServer):
 
 
 _KNOWN_ROUTES = frozenset({"/health", "/healthz", "/metrics",
-                           "/generate"})
+                           "/generate", "/debug/flight"})
 
 
 def make_handler(model: ModelServer):
@@ -465,6 +469,31 @@ def make_handler(model: ModelServer):
             if self.path == "/metrics":
                 metrics.write_exposition(self)
                 return self._observe(200)
+            if self.path.split("?", 1)[0] == "/debug/flight":
+                # Burst-level introspection: the engine's in-process
+                # flight ring + compile-watch registry (no flush
+                # needed — this reads live state). ?n= caps the
+                # record tail (default 128).
+                n = 128
+                if "?" in self.path:
+                    from urllib.parse import parse_qs
+                    qs = parse_qs(self.path.split("?", 1)[1])
+                    try:
+                        n = max(int(qs.get("n", ["128"])[0]), 1)
+                    except ValueError:
+                        pass
+                eng = model.engine
+                fl = getattr(eng, "flight", None)
+                watch = getattr(eng, "compile_watch", None)
+                return self._json(200, {
+                    "records": fl.tail(n) if fl is not None else [],
+                    "enabled": bool(fl is not None and fl.enabled),
+                    "programs": (watch.summary()
+                                 if watch is not None else {}),
+                    "warm": bool(watch is not None and watch.warm),
+                    "unexpected": (watch.unexpected
+                                   if watch is not None else []),
+                })
             return self._json(404, {"error": "not found"})
 
         def _stream(self, chunks):
@@ -639,6 +668,16 @@ def main() -> None:
                          "cache over the first N local devices "
                          "(Megatron head/mlp/vocab split — serves "
                          "models bigger than one chip's HBM)")
+    ap.add_argument("--warm-grid", action="store_true",
+                    default=os.environ.get("SKYTPU_WARM_GRID") == "1",
+                    help="pre-compile the engine's whole program grid "
+                         "at startup and arm the compile watch: any "
+                         "later XLA compile is a mid-traffic stall "
+                         "and raises the typed "
+                         "engine.unexpected_compile alarm + "
+                         "skytpu_unexpected_compiles_total (env "
+                         "SKYTPU_WARM_GRID=1). Off by default: "
+                         "startup pays the full compile sweep")
     args = ap.parse_args()
 
     # Long-lived serving daemon: sever any inherited trace root. A
@@ -715,6 +754,18 @@ def main() -> None:
     # reference too or the fp block weights stay resident for the whole
     # server lifetime and the memory halving never happens.
     del params
+    if args.warm_grid:
+        # Compile the whole program grid BEFORE /health can flip, then
+        # arm the compile watch: from here on, a new program compiling
+        # under live traffic is an alarm, not tens of silent seconds
+        # of TPOT (docs/observability.md §Flight recorder).
+        t0 = time.time()
+        n = engine.warm_programs(max_burst=args.max_burst)
+        engine.declare_warmup_complete()
+        tracing.add_event(
+            "server.programs_warmed",
+            {"programs": n,
+             "warm_s": round(time.time() - t0, 2)}, echo=True)
     model, httpd = serve(engine, port=args.port,
                          max_burst=args.max_burst,
                          open_burst=args.open_burst,

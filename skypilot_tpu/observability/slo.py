@@ -110,6 +110,12 @@ DEFAULT_RULES: List[SloRule] = [
             labels={"kind": "transport"}),
     SloRule("skylet-heartbeat", "heartbeat_staleness", threshold=120.0,
             metric="skytpu_skylet_last_tick_timestamp_seconds"),
+    # The runtime retrace guard: an engine that compiled ANY program
+    # after declaring warmup complete is stalling live requests on XLA
+    # (tens of seconds on an 8B model) — threshold 0 means one
+    # unexpected compile in both windows pages.
+    SloRule("unexpected-compiles", "rate", threshold=0.0,
+            metric="skytpu_unexpected_compiles_total"),
     SloRule("train-step-regression", "train_step_regression",
             threshold=1.5, metric="skytpu_train_step_seconds",
             baseline_metric="skytpu_train_step_median_seconds",

@@ -33,8 +33,11 @@ def search_dirs() -> List[str]:
 def load_trace(trace_id: str,
                dirs: Optional[List[str]] = None) -> List[Dict[str, Any]]:
     """All records of ``trace_id`` across every log file in ``dirs``.
-    Corrupt lines (a crash mid-line predates the atomic flush; foreign
-    files) are skipped, never fatal."""
+    Besides spans/events owned by the trace, flight-recorder burst
+    records (``kind="flight"``) whose ``traces`` list names the trace
+    match too — that is how ``skytpu trace <req>`` shows which engine
+    bursts the request rode. Corrupt lines (a crash mid-line predates
+    the atomic flush; foreign files) are skipped, never fatal."""
     records: List[Dict[str, Any]] = []
     for d in (dirs if dirs is not None else search_dirs()):
         for path in sorted(glob.glob(os.path.join(d, "*.jsonl"))):
@@ -52,8 +55,11 @@ def load_trace(trace_id: str,
                             rec = json.loads(line)
                         except ValueError:
                             continue
-                        if (isinstance(rec, dict)
-                                and rec.get("trace") == trace_id):
+                        if not isinstance(rec, dict):
+                            continue
+                        if (rec.get("trace") == trace_id
+                                or trace_id in (rec.get("traces")
+                                                or ())):
                             records.append(rec)
             except OSError:
                 continue
@@ -140,6 +146,33 @@ def render(records: List[Dict[str, Any]],
     roots = build_tree(records)
     for i, root in enumerate(roots):
         walk(root, "", i == len(roots) - 1)
+
+    # Flight-recorder bursts the trace's request(s) rode: not part of
+    # the span tree (one burst serves many requests), rendered as a
+    # timeline section under it instead.
+    flights = sorted((r for r in records if r.get("kind") == "flight"),
+                     key=lambda r: float(r.get("ts_s", 0.0)))
+    if flights:
+        from skypilot_tpu.observability import flight as flight_lib
+        t0 = float(flights[0].get("ts_s", 0.0))
+        lines.append("")
+        lines.append(f"bursts ridden ({len(flights)}):")
+        for r in flights:
+            where = f"[{r.get('proc', '?')}/{r.get('pid', '?')}]"
+            extra = []
+            if r.get("drafted"):
+                extra.append(f"spec {r.get('accepted', 0)}"
+                             f"/{r.get('drafted', 0)}")
+            if r.get("stall"):
+                extra.append("stall")
+            if r.get("compiled"):
+                extra.append(f"COMPILED={len(r['compiled'])}")
+            lines.append(
+                f"  +{(float(r.get('ts_s', t0)) - t0) * 1e3:8.1f}ms  "
+                f"{flight_lib.program_label(r):<36} "
+                f"slots={len(r.get('slots', ()))} "
+                f"toks={r.get('toks', 0)}"
+                f"{('  ' + ' '.join(extra)) if extra else ''}  {where}")
     return "\n".join(lines)
 
 
@@ -174,4 +207,16 @@ def to_perfetto(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "name": r["name"], "ph": "i",
                 "ts": float(r["ts_s"]) * 1e6,
                 "pid": pid, "tid": tid, "s": "p", "args": args})
+        elif r.get("kind") == "flight":
+            from skypilot_tpu.observability import flight as flight_lib
+            args = dict(args)
+            args.update({k: r[k] for k in ("toks", "rids", "drafted",
+                                           "accepted", "compiled")
+                         if r.get(k)})
+            args["slots"] = len(r.get("slots", ()))
+            events.append({
+                "name": flight_lib.program_label(r), "ph": "X",
+                "ts": float(r.get("ts_s", 0.0)) * 1e6,
+                "dur": max(float(r.get("dur_s", 0.0)), 0.0) * 1e6,
+                "pid": pid, "tid": tid, "args": args})
     return {"traceEvents": events, "displayTimeUnit": "ms"}

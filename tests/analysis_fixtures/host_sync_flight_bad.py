@@ -1,0 +1,27 @@
+# Golden fixture: seeded host-sync violations on the flight-recorder
+# path. A burst record is assembled from HOST bookkeeping (request
+# token lists, host timestamps, static program args) — fetching the
+# burst's device arrays to "enrich" the record would drain the
+# dispatch pipeline once per burst, turning the observer into the
+# stall it exists to diagnose. Checked as if it were
+# skypilot_tpu/observability/flight.py (the recorder scope). Never
+# imported.
+import numpy as np
+
+
+class FlightRecorder:
+    def record(self, burst, toks_dev=None, **fields):
+        toks = np.asarray(toks_dev)                # expect: host-sync
+        fields["toks"] = int(toks_dev.sum())       # expect: host-sync
+        with self._lock:
+            self._records.append({"burst": burst, **fields,
+                                  "n": len(toks)})
+
+
+class CompileWatch:
+    def wrap(self, name, fn, static_argnames=()):
+        def wrapped(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            out[0]["length"].block_until_ready()   # expect: host-sync
+            return out
+        return wrapped

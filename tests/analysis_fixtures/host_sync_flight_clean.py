@@ -1,0 +1,38 @@
+# Clean twin: the flight recorder done right — records are built from
+# values that already live on the host (ints, floats, lists the engine
+# bookkeeping maintains), the compile-watch wrapper only takes wall
+# timestamps around the dispatch, and the device is never consulted.
+# Never imported.
+import time
+
+
+class FlightRecorder:
+    def record(self, burst, **fields):
+        rec = {"kind": "flight", "burst": burst,
+               "ts_ms": int(time.time() * 1000)}
+        rec.update(fields)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._records.append(rec)
+
+    def tail(self, n=None):
+        with self._lock:
+            recs = list(self._records)
+        return recs[-n:] if n else recs
+
+
+class CompileWatch:
+    def wrap(self, name, fn, static_argnames=()):
+        def wrapped(*args, **kwargs):
+            key = name + str([kwargs.get(a) for a in static_argnames])
+            with self._lock:
+                hit = key in self._programs
+            if hit:
+                return fn(*args, **kwargs)
+            t0 = time.monotonic()
+            out = fn(*args, **kwargs)
+            with self._lock:
+                self._programs[key] = time.monotonic() - t0
+            return out
+        return wrapped

@@ -184,6 +184,9 @@ _SLOW_TESTS = {
     "tests/test_cli.py::test_launch_local_roundtrip",
     "tests/test_cli.py::test_launch_from_yaml",
     "tests/test_infer.py::test_slots_recycled",
+    "tests/test_flight.py::test_flight_smoke_bench_wiring",
+    "tests/test_flight.py::test_warm_programs_then_zero_unexpected",
+    "tests/test_flight.py::test_chunk_verify_interleave_consistency",
     "tests/test_infer_server.py::test_generate_greedy_matches_engine",
     "tests/test_api_server.py::test_failed_request_propagates_error",
     "tests/test_api_server.py::test_api_status_lists_requests",

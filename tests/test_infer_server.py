@@ -476,3 +476,30 @@ def test_metrics_endpoint_exposition(model_server):
     routes = {labels.get("route")
               for labels, _ in fams2["skytpu_http_requests_total"]["samples"]}
     assert "other" in routes and "/wp-login.php" not in routes
+
+
+def test_debug_flight_endpoint(model_server):
+    """GET /debug/flight returns the engine's live burst ring + the
+    compile-watch program registry (docs/observability.md §Flight
+    recorder); ?n= caps the tail."""
+    url, _, _ = model_server
+    code, _ = _post(f"{url}/generate",
+                    {"tokens": [4, 9, 2], "max_new_tokens": 3})
+    assert code == 200
+    with urllib.request.urlopen(f"{url}/debug/flight?n=5",
+                                timeout=30) as r:
+        assert r.status == 200
+        payload = json.loads(r.read())
+    assert payload["enabled"] is True
+    assert payload["warm"] is False        # no --warm-grid here
+    assert payload["unexpected"] == []
+    assert 0 < len(payload["records"]) <= 5
+    rec = payload["records"][-1]
+    assert rec["kind"] == "flight"
+    assert rec["burst"] in ("wave", "chunk", "decode", "verify",
+                            "decode1")
+    assert "layout" in rec["program"]
+    # The program registry saw the engine's jit entry points compile.
+    assert payload["programs"]
+    assert any(k.startswith(("decode_burst", "admit_wave"))
+               for k in payload["programs"])

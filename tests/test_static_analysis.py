@@ -140,6 +140,12 @@ _GOLDEN = [
      "skypilot_tpu/infer/fixture_retrace_span.py"),
     ("host-sync", "host_sync_span_bad.py", "host_sync_span_clean.py",
      "skypilot_tpu/infer/engine.py"),
+    # Flight recorder (PR 10): burst records and the compile-watch
+    # wrapper are host-only — a fetch on the record path stalls the
+    # pipeline the recorder observes.
+    ("host-sync", "host_sync_flight_bad.py",
+     "host_sync_flight_clean.py",
+     "skypilot_tpu/observability/flight.py"),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      "skypilot_tpu/utils/fixture_locks.py"),
     ("typed-errors", "typed_errors_bad.py", "typed_errors_clean.py",
