@@ -435,6 +435,35 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"span bench failed: {e}")
             out["serve_span_error"] = str(e)[:200]
+        # Multi-tenant QoS phase: background-tenant TPOT isolation
+        # under a hot tenant (WFQ + admission control) and
+        # preemption-by-eviction parity — the production-hardening
+        # gates (ROADMAP item 4).
+        try:
+            from skypilot_tpu.infer import bench_serve as _bs
+            qs = _bs.run_qos(config=serve_cfg, weights_int8=big,
+                             kv_int8=big)
+            out["serve_qos_fairness_ratio"] = qs["fairness_ratio"]
+            out["serve_qos_bg_ttft_wfq_ratio"] = \
+                qs["bg_ttft_wfq_ratio"]
+            out["serve_qos_bg_ttft_fifo_ratio"] = \
+                qs["bg_ttft_fifo_ratio"]
+            out["serve_qos_preemptions"] = qs["preemptions"]
+            out["serve_preempt_parity_ok"] = bool(
+                qs["preempt_parity_ok"] and qs["sched_parity_ok"])
+            # Gates: background TPOT p99 <= 1.3x idle under a hot
+            # tenant, preempted-request parity exact.
+            out["serve_qos_regressed"] = bool(
+                qs["fairness_ratio"] > 1.3
+                or not out["serve_preempt_parity_ok"])
+            if out["serve_qos_regressed"]:
+                log("SERVE QOS REGRESSION: fairness "
+                    f"x{qs['fairness_ratio']} (> 1.3) or parity "
+                    f"broken (preempt={qs['preempt_parity_ok']}, "
+                    f"sched={qs['sched_parity_ok']})")
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"qos bench failed: {e}")
+            out["serve_qos_error"] = str(e)[:200]
         # Flight recorder + compile watch phase: the introspection
         # contract over the full mixed workload (chunked admission +
         # spec decode + span regrouping, paged + contiguous). Gates:

@@ -30,7 +30,7 @@ _SCOPES: Dict[str, Set[str]] = {
         "dispatch_decode_burst", "complete_decode_burst",
         "prefill_chunk_step", "run_to_completion", "_admit", "admit",
         "_dispatch_wave", "_complete_wave", "_claim_chunked",
-        "_maybe_store_prefix",
+        "_store_prefix",
         # Paged-KV block management (PR 7): all host-side numpy/list
         # bookkeeping — a device fetch here would drain the dispatch
         # pipeline once per claim/retire.
@@ -52,6 +52,21 @@ _SCOPES: Dict[str, Set[str]] = {
         # device fetch here would stall the very dispatch pipeline
         # the recorder observes.
         "_record_flight",
+        # Multi-tenant QoS (PR 11): scheduling, re-queue and
+        # preemption-by-eviction run before every admission pass from
+        # HOST state (request token lists, the numpy block table,
+        # refcounts) — eviction is a table edit, and a device fetch to
+        # pick a victim would stall admission itself.
+        "_requeue", "_ctx", "_resumable", "preempt_slot",
+        "_preempt_for_waiting",
+    },
+    # QoS scheduler + admission control: the DRR reorder runs on the
+    # engine loop before every admission pass and the admission check
+    # runs per HTTP request — both are pure host bookkeeping over
+    # request lists and token buckets.
+    "skypilot_tpu/infer/qos.py": {
+        "reorder", "request_cost", "weight", "admit", "take",
+        "tenant_label",
     },
     # Flight recorder + compile watch internals: record() runs once
     # per burst on the engine loop and the watch wrapper rides EVERY
@@ -83,7 +98,9 @@ class HostSyncChecker(Checker):
     # v3: the speculative verify/accept path joined it.
     # v4: span-selection + lazy-growth methods joined it.
     # v5: the flight-recorder record path + compile-watch wrapper.
-    version = 5
+    # v6: QoS — the DRR scheduler/admission (infer/qos.py) and the
+    #     preemption-by-eviction path joined the scope.
+    version = 6
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

@@ -48,6 +48,10 @@ KNOWN_POINTS: Dict[str, str] = {
     "serve.lb.forward":
         "load-balancer forward attempt; a fault triggers replica "
         "failover (ctx: backend)",
+    "qos.shed":
+        "QoS admission decision at the model server and the load "
+        "balancer; a fault forces a typed 429 shed for the request "
+        "(ctx: tenant, where=server|lb)",
     "train.checkpoint_save":
         "checkpoint save dispatch (ctx: step)",
     "train.checkpoint_restore":

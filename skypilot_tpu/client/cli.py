@@ -429,6 +429,57 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
             line += (f" (! {unexp:.0f} unexpected)" if unexp
                      else " (0 unexpected)")
         lines.append(line)
+    # Per-tenant QoS columns (docs/serving.md §Multi-tenant QoS):
+    # top-N tenants by request rate, each with its shed rate, plus the
+    # fleet preemption rate — the hot-tenant story at a glance.
+    if "skytpu_qos_requests_total" in have or \
+            "skytpu_qos_shed_total" in have:
+        def _tenant_values(name, where=None):
+            # where="server" reads ONE admission tier: with QoS at
+            # both the LB and the replicas, a proxied request is
+            # admitted (and counted) twice — summing tiers would
+            # double the req/s column. Sheds stay summed: a request
+            # sheds at most once, at exactly one tier.
+            vals = {}
+            tiered = False
+            for labels, value in fams.get(
+                    name, {"samples": []})["samples"]:
+                t = labels.get("tenant")
+                if t is None or "__name__" in labels:
+                    continue
+                if where is not None and labels.get("where") == where:
+                    if not tiered:
+                        tiered, vals = True, {}
+                    vals[t] = vals.get(t, 0.0) + value
+                elif not tiered:
+                    vals[t] = vals.get(t, 0.0) + value
+            return vals
+
+        req_life = _tenant_values("skytpu_qos_requests_total",
+                                  where="server")
+        shed_life = _tenant_values("skytpu_qos_shed_total")
+        scored = []
+        for t in sorted(set(req_life) | set(shed_life)):
+            rr = rate("skytpu_qos_requests_total",
+                      match={"tenant": t, "where": "server"})
+            if rr is None:
+                rr = rate("skytpu_qos_requests_total",
+                          match={"tenant": t})
+            sr = rate("skytpu_qos_shed_total", match={"tenant": t})
+            score = rr if rr is not None else req_life.get(t, 0.0)
+            scored.append((-(score or 0.0), t, rr, sr))
+        scored.sort()
+        cols = "  ".join(
+            f"{t} {f_rate(rr).strip()} shed {f_rate(sr).strip()}"
+            for _, t, rr, sr in scored[:3])
+        pre = rate("skytpu_qos_preemptions_total")
+        if pre is None:
+            pre_life = gauge("skytpu_qos_preemptions_total")
+            pre_txt = (f"{pre_life:.0f} total"
+                       if pre_life is not None else "-")
+        else:
+            pre_txt = f_rate(pre).strip()
+        lines.append(f"qos     {cols}  preempt {pre_txt}")
     if "skytpu_lb_proxied_total" in have:
         lines.append(
             f"lb      proxied {f_rate(rate('skytpu_lb_proxied_total'))}"
@@ -468,12 +519,14 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
               help="Render a single frame and exit (scripting/tests; "
                    "rate columns need two frames and show '-').")
 def top(interval, once):
-    """Live fleet overview: component health, rates, latencies, alerts.
+    """Live fleet overview: health, rates, latencies, per-tenant QoS.
 
     Data comes from the API server's federation tier (`GET
     /metrics/fleet` + `/api/fleet/health`), so one terminal covers the
     API server, every model-server replica, the load balancers, serve
-    controllers, and local skylets.
+    controllers, and local skylets. With QoS enabled the `qos` line
+    shows the top tenants by request rate, each tenant's shed rate,
+    and the fleet preemption rate.
     """
     import time as time_mod
     prev, prev_ts = None, None

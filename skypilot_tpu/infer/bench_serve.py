@@ -1311,6 +1311,196 @@ def run_flight_smoke() -> dict:
     return run_flight(smoke=True)
 
 
+def run_qos(config=None, slots=None, bg_requests=None,
+            hot_requests=None, new_tokens=None, max_burst=8,
+            kv_int8=False, weights_int8=False, smoke=False) -> dict:
+    """Multi-tenant QoS bench: weighted-fair-queueing isolation under a
+    hot tenant, and preemption-by-eviction greedy parity.
+
+    Two phases on CI-sized engines (docs/serving.md §Multi-tenant
+    QoS):
+
+    1. **Fairness** — a background tenant's requests run (a) alone
+       (idle), (b) behind a hot tenant's flood under WFQ, and (c) the
+       same flood under plain FIFO (the control). Gates: background
+       TPOT p99 under contention <= 1.3x idle while the hot tenant
+       queues, and — the structural win — WFQ admits the background
+       tenant ahead of the flood while FIFO strands it
+       (``bg_ttft_fifo_ratio`` shows the damage WFQ undoes).
+
+    2. **Preemption parity** — a low-priority request is evicted
+       mid-decode by a high-priority arrival (1-slot engine: eviction
+       is the only way in), resumes warm from the prefix cache, and
+       must produce BIT-IDENTICAL greedy output to an unpreempted run
+       — across {fp32, int8 KV} x {spec-on, spec-off} on the paged
+       layout (``smoke=True`` runs the fp32 pair only; tests/test_qos
+       .py covers the full matrix). Zero leaked blocks after retire +
+       cache clear (allocator audit) is asserted, not reported.
+    """
+    import dataclasses
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.infer import engine as eng
+    from skypilot_tpu.infer import qos as qos_lib
+    from skypilot_tpu.models import llama
+
+    on_cpu = jax.default_backend() == "cpu"
+    if config is None:
+        config = "llama3-tiny" if on_cpu else "llama3-400m"
+    small = smoke or on_cpu
+    slots = slots or (4 if small else 8)
+    bg_requests = bg_requests or 2
+    hot_requests = hot_requests or (3 * slots)
+    new_tokens = new_tokens or (16 if small else 64)
+    prompt_len = 12
+    max_len = 64 if small else 256
+    cfg = llama.CONFIGS[config]
+    log(f"qos bench: {config} slots={slots} bg={bg_requests} "
+        f"hot={hot_requests} new_tokens={new_tokens}")
+
+    def build(n_slots, qos=None, spec_k=0, chunk=0, pool=0,
+              buckets=None, kv_int8=kv_int8):
+        kw = dict(n_slots=n_slots, max_len=max_len,
+                  prompt_buckets=buckets or (prompt_len,),
+                  kv_int8=kv_int8, prefill_chunk=chunk,
+                  prefix_pool=pool, max_wave=n_slots, pad_waves=True,
+                  spec_k=spec_k, qos=qos)
+        if weights_int8:
+            from skypilot_tpu.infer import kvcache
+            params, qw = kvcache.random_quantized_params(cfg)
+            return eng.InferenceEngine(params, cfg, qweights=qw, **kw)
+        params = llama.init_params(jax.random.key(0), cfg)
+        return eng.InferenceEngine(params, cfg, **kw)
+
+    rng = np.random.default_rng(0)
+    bg_prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+                  for _ in range(bg_requests)]
+    hot_prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+                   for _ in range(hot_requests)]
+
+    def fairness_pass(e, with_hot):
+        """Hot flood enqueued FIRST (worst case for the background
+        tenant), then background; per-background-request TTFT and
+        TPOT collected at retirement."""
+        ids = []
+        if with_hot:
+            for p in hot_prompts:
+                e.add_request(p, max_new_tokens=new_tokens,
+                              tenant="hot")
+        for p in bg_prompts:
+            ids.append(e.add_request(p, max_new_tokens=new_tokens,
+                                     tenant="background"))
+        done_s: dict = {}
+        while e.waiting or e.chunking or e.slot_req:
+            e.step_burst(max_burst)
+            now = _time.time()
+            for r in e.finished:
+                done_s.setdefault(r.rid, now)
+        by_rid = {r.rid: r for r in e.finished}
+        ttfts, tpots = [], []
+        for rid in ids:
+            r = by_rid[rid]
+            ttfts.append(r.first_token_s - r.submit_s)
+            if len(r.tokens) > 1:
+                tpots.append((done_s[rid] - r.first_token_s)
+                             / (len(r.tokens) - 1))
+        outs = [by_rid[i].tokens for i in ids]
+        e.finished.clear()
+        return ttfts, tpots, outs
+
+    # Warmup compiles, then idle / WFQ-contended / FIFO-contended on
+    # fresh schedulers (bucket state must not leak between passes).
+    e = build(slots, qos=qos_lib.FairScheduler())
+    fairness_pass(e, with_hot=False)
+    idle_ttft, idle_tpot, idle_out = fairness_pass(e, with_hot=False)
+    e.qos = qos_lib.FairScheduler()
+    wfq_ttft, wfq_tpot, wfq_out = fairness_pass(e, with_hot=True)
+    e.qos = None
+    fifo_ttft, _fifo_tpot, fifo_out = fairness_pass(e, with_hot=True)
+
+    # Scheduling must never change tokens: same engine, same greedy
+    # stream per request.
+    sched_parity = (idle_out == wfq_out == fifo_out)
+    fairness_ratio = _p99(wfq_tpot) / max(_p99(idle_tpot), 1e-9)
+    ttft_wfq_ratio = _p99(wfq_ttft) / max(_p99(idle_ttft), 1e-9)
+    ttft_fifo_ratio = _p99(fifo_ttft) / max(_p99(idle_ttft), 1e-9)
+    log(f"qos fairness: bg TPOT p99 x{fairness_ratio:.2f} vs idle "
+        f"(bg TTFT p99 x{ttft_wfq_ratio:.1f} wfq / "
+        f"x{ttft_fifo_ratio:.1f} fifo), sched parity={sched_parity}")
+
+    # Phase 2: preemption-by-eviction parity. 1-slot engine, chunked
+    # prefill + prefix cache on (the warm-resume path), high-priority
+    # arrival evicts the low-priority resident mid-decode.
+    # The full run sweeps the kv dtype too — {fp32, int8} x
+    # {spec-off, spec-on}, the acceptance matrix; smoke (and a run
+    # pinned by --kv-int8, whose fairness phase already chose its
+    # dtype) runs only that dtype's spec pair.
+    dtypes = [kv_int8] if (smoke or kv_int8) else [False, True]
+    combos = [(k, i8) for i8 in dtypes for k in (0, 4)]
+    parity_ok = True
+    preemptions = 0
+    resumed_rows = 0
+    low_prompt = list(range(5, 5 + prompt_len))
+    hi_prompt = [3, 1, 4]
+    for spec_k, i8 in combos:
+        ref = build(1, chunk=8, pool=4, spec_k=spec_k, kv_int8=i8,
+                    buckets=(prompt_len + new_tokens + 8,))
+        want = ref.generate([low_prompt],
+                            max_new_tokens=new_tokens)[0]
+        e2 = build(1, qos=qos_lib.FairScheduler(), chunk=8, pool=4,
+                   spec_k=spec_k, kv_int8=i8,
+                   buckets=(prompt_len + new_tokens + 8,))
+        rid_low = e2.add_request(low_prompt,
+                                 max_new_tokens=new_tokens,
+                                 priority=0)
+        while not e2.slot_req:
+            e2.step_burst(max_burst=2)
+        for _ in range(2):
+            e2.decode_burst(max_burst=2)
+        e2.add_request(hi_prompt, max_new_tokens=4, priority=1)
+        e2.run_to_completion(max_burst=2)
+        by_rid = {r.rid: r for r in e2.finished}
+        low = by_rid[rid_low]
+        parity_ok = parity_ok and (low.tokens == want
+                                   and low.preemptions >= 1)
+        preemptions += low.preemptions
+        resumed_rows += low.resumed_len
+        e2.clear_prefix_cache()
+        assert e2.allocator.used == 0, (
+            f"block leak after preemption cycle: {e2.allocator.used}")
+    log(f"qos preempt: parity={parity_ok} preemptions={preemptions} "
+        f"resumed_rows={resumed_rows}")
+
+    return {
+        "fairness_ratio": round(fairness_ratio, 3),
+        "bg_tpot_idle_p99_ms": round(_p99(idle_tpot) * 1e3, 3),
+        "bg_tpot_contended_p99_ms": round(_p99(wfq_tpot) * 1e3, 3),
+        "bg_ttft_wfq_ratio": round(ttft_wfq_ratio, 3),
+        "bg_ttft_fifo_ratio": round(ttft_fifo_ratio, 3),
+        "sched_parity_ok": bool(sched_parity),
+        "preempt_parity_ok": bool(parity_ok),
+        "preemptions": int(preemptions),
+        "preempt_resumed_rows": int(resumed_rows),
+        "slots": slots,
+        "bg_requests": bg_requests,
+        "hot_requests": hot_requests,
+        "new_tokens": new_tokens,
+        "config": config,
+        "kv_int8": kv_int8,
+        "weights_int8": weights_int8,
+    }
+
+
+def run_qos_smoke() -> dict:
+    """CI-sized QoS pass (tier-1 wiring: tests/test_qos.py asserts
+    scheduling + preemption parity and the fairness structure;
+    wall-clock ratios are reported, gated only on hardware)."""
+    return run_qos(smoke=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None)
@@ -1366,6 +1556,13 @@ def main() -> None:
                          "a long-max_len engine), greedy parity "
                          "asserted (combine with --smoke for the "
                          "CI-sized pass)")
+    ap.add_argument("--qos", action="store_true",
+                    help="multi-tenant QoS bench: background-tenant "
+                         "TPOT/TTFT isolation under a hot tenant "
+                         "(WFQ vs FIFO control) and preemption-by-"
+                         "eviction greedy parity with the allocator "
+                         "audit (combine with --smoke for the "
+                         "CI-sized pass)")
     ap.add_argument("--flight", action="store_true",
                     help="flight recorder + compile watch bench: the "
                          "full mixed workload (chunked admission + "
@@ -1376,6 +1573,20 @@ def main() -> None:
                          "recorder-off no-op guard (combine with "
                          "--smoke for the CI-sized pass)")
     args = ap.parse_args()
+    if args.qos:
+        r = run_qos(config=args.config, kv_int8=args.kv_int8,
+                    weights_int8=args.weights_int8, smoke=args.smoke)
+        print(json.dumps({
+            "metric": "serve_qos_fairness_ratio",
+            "value": r["fairness_ratio"],
+            "unit": "x_bg_tpot_p99_vs_idle",
+            **{k: r[k] for k in (
+                "bg_tpot_idle_p99_ms", "bg_tpot_contended_p99_ms",
+                "bg_ttft_wfq_ratio", "bg_ttft_fifo_ratio",
+                "sched_parity_ok", "preempt_parity_ok",
+                "preemptions", "preempt_resumed_rows", "config")},
+        }))
+        return
     if args.flight:
         r = run_flight(config=args.config, kv_int8=args.kv_int8,
                        weights_int8=args.weights_int8,

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.infer import kvcache, sampling
+from skypilot_tpu.infer import qos as qos_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.observability import flight as flight_lib
 from skypilot_tpu.observability import metrics, tracing
@@ -167,6 +168,16 @@ class Request:
     spec_accepted: int = 0
     spec_off: bool = False
     drafter: Optional[Any] = None
+    # Multi-tenant QoS (docs/serving.md §Multi-tenant QoS): tenant
+    # feeds the fair scheduler and flight attribution; priority picks
+    # the lane (higher preempts lower); ``preemptions`` counts how
+    # often this request was evicted mid-decode and resumed (surfaced
+    # in the response trailer); ``resumed_len`` is the KV rows the
+    # LAST resume reused warm from the prefix cache (0 = cold resume).
+    tenant: str = qos_lib.DEFAULT_TENANT
+    priority: int = 0
+    preemptions: int = 0
+    resumed_len: int = 0
 
 
 @dataclasses.dataclass
@@ -433,10 +444,14 @@ class NGramDrafter:
 class _ChunkState:
     """A request mid-chunked-prefill: slot claimed, rows [0, pos)
     resident (reused prefix and/or completed chunks), first token not
-    yet produced."""
+    yet produced (or, on a preemption resume, the NEXT token not yet
+    produced). ``ctx`` is the admission-time context snapshot — the
+    prompt for a fresh request, prompt + committed tokens for a
+    preempted request resuming."""
     req: Request
     pos: int            # next row offset to prefill
-    total: int          # len(req.prompt)
+    total: int          # len(ctx)
+    ctx: Optional[List[int]] = None
 
 
 class InferenceEngine:
@@ -462,8 +477,15 @@ class InferenceEngine:
                  spec_drafter: Optional[Callable] = None,
                  span_buckets=None, kv_lazy: Optional[bool] = None,
                  flight_recorder: Optional[
-                     flight_lib.FlightRecorder] = None):
+                     flight_lib.FlightRecorder] = None,
+                 qos: Optional[qos_lib.FairScheduler] = None):
         self.params = params
+        # Multi-tenant QoS: a FairScheduler reorders ``waiting`` into
+        # priority lanes + DRR interleave before each admission pass
+        # and arms priority preemption-by-eviction (preempt_slot).
+        # None (the default) is the zero-cost single-tenant path —
+        # admission order stays pure FIFO and nothing ever preempts.
+        self.qos = qos
         self.cfg = cfg
         self.n_slots = n_slots
         self.max_len = max_len
@@ -881,12 +903,14 @@ class InferenceEngine:
 
     def add_request(self, prompt: List[int],
                     max_new_tokens: int = 128,
-                    trace_ctx: Optional[tracing.SpanContext] = None
-                    ) -> int:
+                    trace_ctx: Optional[tracing.SpanContext] = None,
+                    tenant: str = qos_lib.DEFAULT_TENANT,
+                    priority: int = 0) -> int:
         _bucket(len(prompt), self.buckets)   # validate length up front
         req = Request(rid=self._next_rid, prompt=list(prompt),
                       max_new_tokens=max_new_tokens, submit_s=time.time(),
-                      eos_id=self.eos_id)
+                      eos_id=self.eos_id, tenant=tenant,
+                      priority=priority)
         # Per-request span identity, minted at submit so child spans
         # recorded before retirement can already parent to it. The
         # parent comes from the caller's explicit context (the HTTP
@@ -945,6 +969,20 @@ class InferenceEngine:
             extra["lazy_grows"] = lazy
         if compiled:
             extra["compiled"] = compiled
+        if self.qos is not None and reqs:
+            # Per-burst tenant/priority composition (host dict builds
+            # over the request list): the chaos fairness scenario and
+            # `skytpu flight` read group make-up straight off records.
+            tenants: Dict[str, int] = {}
+            for r in reqs:
+                tenants[r.tenant] = tenants.get(r.tenant, 0) + 1
+            extra["tenants"] = tenants
+            if any(r.priority for r in reqs):
+                prios: Dict[str, int] = {}
+                for r in reqs:
+                    key = str(r.priority)
+                    prios[key] = prios.get(key, 0) + 1
+                extra["priorities"] = prios
         fl.record(
             burst, ts_s=begin_s, dur_s=max(end_s - begin_s, 0.0),
             program=program, slots=list(slots),
@@ -1096,18 +1134,22 @@ class InferenceEngine:
             self._table_dirty = False
         return self._table_dev
 
-    def _need_blocks(self, req: Request) -> int:
+    def _need_blocks(self, req: Request,
+                     ctx_len: Optional[int] = None) -> int:
         """Blocks to reserve at admission. Eager (default): the
         worst case — prompt plus the full token budget, capped by
         max_len — so decode can never run out of backing mid-flight;
         the pool, not a mid-decode fault path, is the admission
-        limiter. Lazy (SKYTPU_KV_LAZY=1): just the prompt plus one
-        burst of headroom; the rest allocates per burst in
-        :meth:`_ensure_headroom` through the same dry-pool
+        limiter. (The formula is already total-shaped, so a preempted
+        request resuming with committed tokens reserves the identical
+        worst case.) Lazy (SKYTPU_KV_LAZY=1): just the admission
+        context plus one burst of headroom; the rest allocates per
+        burst in :meth:`_ensure_headroom` through the same dry-pool
         evict/stall path."""
         need = min(len(req.prompt) + req.max_new_tokens, self.max_len)
         if self.kv_lazy:
-            need = min(len(req.prompt) + self._lazy_headroom, need)
+            base = ctx_len if ctx_len is not None else len(req.prompt)
+            need = min(base + self._lazy_headroom, need)
         return -(-need // self.kv_block)
 
     def _ensure_headroom(self, slot: int, req: Request,
@@ -1222,7 +1264,8 @@ class InferenceEngine:
         dry (the caller re-queues the request)."""
         if not self.paged:
             return self.free_slots.pop(0)
-        blocks = self._alloc_blocks(self._need_blocks(req))
+        blocks = self._alloc_blocks(
+            self._need_blocks(req, self._ctx_len(req)))
         if blocks is None:
             return None
         slot = self.free_slots.pop(0)
@@ -1249,6 +1292,150 @@ class InferenceEngine:
         row[:] = self.n_kv_blocks
         self._table_dirty = True
 
+    # -- QoS: re-queue, fair scheduling, preemption-by-eviction ------------
+
+    def _requeue(self, req: Request) -> None:
+        """THE re-queue path: every request going back to the queue
+        head (dry-pool admission stall, chunk-claim stall, preemption
+        eviction) passes through here, so the queue-depth gauge
+        updates with the deque in one place and ``skytpu_engine_
+        waiting`` can never go stale on a re-queue branch."""
+        self.waiting.appendleft(req)
+        ENGINE_WAITING.set(len(self.waiting))
+
+    def _ctx(self, req: Request) -> List[int]:
+        """A queued request's admission context: its prompt, extended
+        by committed tokens when it was preempted mid-decode — the
+        resume prefills (or prefix-cache-reuses) the full committed
+        sequence and the final chunk's sample IS the next token the
+        unpreempted run would have decoded (greedy-exact)."""
+        if not req.tokens:
+            return req.prompt
+        return req.prompt + req.tokens
+
+    def _ctx_len(self, req: Request) -> int:
+        """``len(self._ctx(req))`` without materializing the concat —
+        the admission loop asks for queued requests' context lengths
+        every pass, and a long preempted conversation stuck behind a
+        dry pool must not re-build a multi-KB list each time."""
+        return len(req.prompt) + len(req.tokens)
+
+    def _resumable(self, ctx_len: int) -> bool:
+        """Whether a context of this length could be re-admitted after
+        eviction THROUGH THE CHUNK PATH — the only resume the
+        bit-identical parity matrix covers. A wave re-admission would
+        re-sample the victim's next token from the wave program's
+        logits where an unpreempted run used the decode program's;
+        rather than extend the parity surface across programs, a slot
+        whose context still fits a wave simply isn't preempted yet
+        (one more burst makes it eligible)."""
+        if ctx_len >= self.max_len:
+            return False
+        return (self.prefill_chunk is not None
+                and ctx_len > self.prefill_chunk)
+
+    def preempt_slot(self, slot: int) -> bool:
+        """Preemption-by-eviction of one decode slot — the priority
+        lanes' primitive (ROADMAP items 1/4, shared by items 3/5).
+
+        The victim's committed KV rows [0, prompt+tokens-1) are
+        exactly the bytes prefill/decode wrote; the chunk-aligned
+        prefix retires into the prefix cache as ref-counted shared
+        blocks (paged: increfs only — the dying slot never writes
+        again, so even a trailing partial block is shared without the
+        COW copy a live donor would need). The request re-queues with
+        its tokens intact and resumes through the ORDINARY prefix-hit
+        admission path over its extended context, re-prefilling only
+        the sub-chunk tail; greedy output is bit-identical to an
+        unpreempted run (tests/test_qos.py asserts it across
+        {fp32, int8} x {spec-on, spec-off}).
+
+        Refuses while a dispatched burst is un-fetched (its completion
+        would commit tokens into a request already back in the queue)
+        and for contexts the engine could not re-admit. Host-side
+        bookkeeping only — a block-table edit, never a device copy.
+        """
+        req = self.slot_req.get(slot)
+        if req is None or self._inflight_tokens:
+            return False
+        ctx = req.prompt + req.tokens
+        if not self._resumable(len(ctx)):
+            return False
+        retired_rows = 0
+        if (self.paged and self._prefix_index is not None
+                and req.n_chunks):
+            # Committed rows stop one short of the context: the last
+            # token's KV row is written by the burst that decodes its
+            # successor, which never ran. Only a CHUNK-admitted
+            # victim's rows may enter the shared cache — the cache
+            # promises chunk-origin bytes to every later sharer
+            # (_store_prefix's parity rule), and a wave-admitted
+            # victim's prompt rows came from the wave program. Such a
+            # victim still evicts; it just resumes cold.
+            self._store_prefix(ctx, slot, len(ctx) - 1,
+                               donor_live=False)
+            # The flight record reports what the RESUME will read
+            # warm: the cached rows covering the victim's context
+            # after the store (admission may have stored the prompt's
+            # prefix already — still warm; a dry-pool or sub-chunk
+            # skip with no prior entry — cold, 0). Never the raw
+            # context length.
+            covered = self._prefix_index.lookup(ctx)
+            if covered is not None:
+                retired_rows = covered[1]
+        self.slot_req.pop(slot, None)
+        self.free_slots.append(slot)
+        self._free_slot_blocks(slot)
+        req.slot = None
+        req.preemptions += 1
+        qos_lib.QOS_PREEMPTIONS.labels(
+            tenant=qos_lib.tenant_label(
+                req.tenant,
+                self.qos.cfg if self.qos is not None else None)).inc()
+        fl = self.flight
+        if fl is not None and fl.enabled:
+            fl.record(
+                "preempt", ts_s=time.time(), dur_s=0.0,
+                program={"layout": "paged" if self.paged else "contig"},
+                slots=[slot], rids=[req.rid], toks=0,
+                tenants={req.tenant: 1}, priority=req.priority,
+                retired_rows=retired_rows)
+        self._requeue(req)
+        self._update_gauges()
+        return True
+
+    def _preempt_for_waiting(self) -> bool:
+        """Give the priority lanes teeth: for each queued request that
+        outranks a running one and cannot get a free slot, evict the
+        lowest-priority active slot (ties: the youngest — least sunk
+        decode work). Runs before admission claims slots; the evicted
+        victims re-queue behind the high-priority lane on the next
+        reorder. Returns whether anything was evicted."""
+        if self._inflight_tokens or not self.slot_req:
+            return False
+        evicted_any = False
+        avail = len(self.free_slots)
+        for w in list(self.waiting)[:self.n_slots]:
+            if avail > 0:
+                avail -= 1          # a free slot already covers it
+                continue
+            # Outranked residents, best victim first (lowest priority,
+            # then youngest = least sunk decode). preempt_slot can
+            # refuse a candidate (un-resumable context) — fall through
+            # to the next one rather than strand an evictable victim
+            # in another slot behind the refusal.
+            candidates = sorted(
+                (r.priority, -r.rid, slot)
+                for slot, r in self.slot_req.items()
+                if r.priority < w.priority)
+            for _, _, slot in candidates:
+                if self.preempt_slot(slot):
+                    evicted_any = True
+                    break
+            else:
+                break               # nothing outranked (or evictable)
+        return evicted_any
+
     def _admit(self, on_wave=None) -> None:
         # Waves are grouped by prompt bucket (prefill is O(S^2): one
         # long prompt must not drag every co-admitted short prompt up
@@ -1267,6 +1454,22 @@ class InferenceEngine:
         # would serialize a full host round trip per wave — measured
         # ~200 ms fixed cost per wave on a relayed chip, the dominant
         # TTFT term for every wave after the first.
+        if self.qos is not None and self.waiting:
+            # WFQ + priority lanes: reorder the deque (DRR across
+            # per-tenant subqueues, high priority first), then evict
+            # outranked decode slots for queued high-priority work.
+            # Both are host bookkeeping; wave building below is
+            # unchanged and span regrouping downstream never sees
+            # tenants.
+            self.qos.reorder(self.waiting)
+            if self._preempt_for_waiting() and self.waiting:
+                # Evicted victims re-queued at the head; put them back
+                # behind the lanes that outrank them. Back-to-back
+                # reorders are otherwise idempotent — the DRR rotation
+                # advances only when a request actually LEAVES the
+                # queue, never per call, so a pass that admits nothing
+                # cannot shift which tenant owns the front.
+                self.qos.reorder(self.waiting)
         stalled = False
         while self.waiting and self.free_slots and not stalled:
             dispatched = []
@@ -1283,7 +1486,7 @@ class InferenceEngine:
                     if not self._claim_chunked(self.waiting.popleft()):
                         stalled = True
                     continue
-                bucket = _bucket(len(self.waiting[0].prompt),
+                bucket = _bucket(self._ctx_len(self.waiting[0]),
                                  self.buckets)
                 wave: List[Request] = []
                 slots: List[int] = []
@@ -1296,11 +1499,11 @@ class InferenceEngine:
                     if self._use_chunked(req):
                         if not self._claim_chunked(req):
                             stalled = True
-                    elif _bucket(len(req.prompt),
+                    elif _bucket(self._ctx_len(req),
                                  self.buckets) == bucket:
                         slot = self._wave_claim(req)
                         if slot is None:          # block pool dry
-                            self.waiting.appendleft(req)
+                            self._requeue(req)
                             stalled = True
                         else:
                             wave.append(req)
@@ -1323,7 +1526,7 @@ class InferenceEngine:
 
     def _use_chunked(self, req: Request) -> bool:
         return (self.prefill_chunk is not None
-                and len(req.prompt) > self.prefill_chunk)
+                and self._ctx_len(req) > self.prefill_chunk)
 
     def _claim_chunked(self, req: Request) -> bool:
         """Claim a slot for an incremental prefill: look up the prefix
@@ -1341,8 +1544,9 @@ class InferenceEngine:
         hit copies the pool row on-device as before. Returns False
         (request re-queued at the head) when the paged pool is dry.
         """
+        ctx = self._ctx(req)
         idx = self._prefix_index
-        hit = idx.lookup(req.prompt) if idx is not None else None
+        hit = idx.lookup(ctx) if idx is not None else None
         payload = cached = None
         n_shared = partial = 0
         shared: List[int] = []
@@ -1363,11 +1567,11 @@ class InferenceEngine:
             # Lazy reservations can be SMALLER than the shared prefix
             # rounds to; never ask for a negative count.
             new_blocks = self._alloc_blocks(
-                max(self._need_blocks(req) - n_shared, 0))
+                max(self._need_blocks(req, len(ctx)) - n_shared, 0))
             if new_blocks is None:
                 for b in shared:          # unpin; retry next pass
                     self.allocator.decref(b)
-                self.waiting.appendleft(req)
+                self._requeue(req)
                 return False
         slot = self.free_slots.pop(0)
         req.slot = slot
@@ -1376,11 +1580,12 @@ class InferenceEngine:
             "engine.queue_wait", req.submit_s, req.prefill_begin_s,
             parent=req.span_ctx, attrs={"rid": req.rid})
         claim_len = jnp.asarray(self.max_len, jnp.int32)
+        reused = 0
         if self.paged:
             row = self.block_table[slot]
             row[:] = self.n_kv_blocks
             if hit is not None:
-                req.cached_len = cached
+                reused = cached
                 PREFIX_HITS.inc()
                 row[:n_shared] = shared   # pinned above
                 if partial:
@@ -1393,7 +1598,7 @@ class InferenceEngine:
                         jnp.asarray(new_blocks[0], jnp.int32))
                     KV_COW_COPIES.inc()
                     self._fl_cow += 1
-            elif idx is not None and idx.eligible(req.prompt):
+            elif idx is not None and idx.eligible(ctx):
                 PREFIX_MISSES.inc()
             row[n_shared:n_shared + len(new_blocks)] = new_blocks
             self._table_dirty = True
@@ -1401,18 +1606,25 @@ class InferenceEngine:
                 self.cache, jnp.asarray(slot, jnp.int32), claim_len)
         elif hit is not None:
             payload, cached = hit
-            req.cached_len = cached
+            reused = cached
             PREFIX_HITS.inc()
             self.cache = self._pool_load_fn(
                 self.cache, self.pool, jnp.asarray(payload, jnp.int32),
                 jnp.asarray(slot, jnp.int32), claim_len)
         else:
-            if idx is not None and idx.eligible(req.prompt):
+            if idx is not None and idx.eligible(ctx):
                 PREFIX_MISSES.inc()
             self.cache = self._claim_fn(
                 self.cache, jnp.asarray(slot, jnp.int32), claim_len)
-        self.chunking.append(_ChunkState(req=req, pos=req.cached_len,
-                                         total=len(req.prompt)))
+        if req.tokens:
+            # Preemption resume: the trailer's cached_len keeps the
+            # ORIGINAL admission's prompt-prefix story; warm-resume
+            # reuse is its own stat.
+            req.resumed_len = reused
+        else:
+            req.cached_len = reused
+        self.chunking.append(_ChunkState(req=req, pos=reused,
+                                         total=len(ctx), ctx=ctx))
         # The request left ``waiting``; without this the queue-depth
         # gauge overreports by one per claim for the whole (possibly
         # multi-second) chunked prefill.
@@ -1429,12 +1641,13 @@ class InferenceEngine:
             return False
         st = self.chunking[0]
         req = st.req
+        ctx = st.ctx if st.ctx is not None else req.prompt
         C = self.prefill_chunk
         start = st.pos
         n_valid = min(C, st.total - start)
         final = start + n_valid >= st.total
         chunk = np.zeros((C,), np.int32)
-        chunk[:n_valid] = req.prompt[start:start + n_valid]
+        chunk[:n_valid] = ctx[start:start + n_valid]
         new_len = st.total if final else self.max_len
         decode_active = bool(self.slot_req)
         # The big-cache dot reads only rows below this chunk's offset:
@@ -1476,73 +1689,90 @@ class InferenceEngine:
                    "cached_len": req.cached_len,
                    "chunks": req.n_chunks})
         req.tokens.append(tok)
-        req.first_token_s = now
+        if req.first_token_s is None:
+            # A preemption resume already served its first token —
+            # TTFT is a once-per-request truth.
+            req.first_token_s = now
+            TTFT_SECONDS.observe(max(now - req.submit_s, 0.0))
         PREFILL_SECONDS.labels(bucket="chunked").observe(
             max(now - req.prefill_begin_s, 0.0))
         PREFILL_REQUESTS.labels(bucket="chunked").inc()
-        TTFT_SECONDS.observe(max(now - req.submit_s, 0.0))
         self.slot_req[req.slot] = req
-        self._maybe_store_prefix(req)
+        self._store_prefix(ctx, req.slot, len(ctx))
         if self._req_finished(req, tok):
             self._retire(req)
         self._update_gauges()
         return True
 
-    def _maybe_store_prefix(self, req: Request) -> None:
-        """Install this request's chunk-aligned prompt prefix into the
-        prefix cache unless it is already resident. Only chunk-path
-        prompts are stored: their rows came from the chunk program, so
-        a later cached run replays bit-identical state (the parity
-        guarantee).
+    def _store_prefix(self, ctx: List[int], slot: Optional[int],
+                      rows: int, donor_live: bool = True) -> int:
+        """Install ``ctx``'s chunk-aligned prefix (over the slot's
+        first ``rows`` resident rows) into the prefix cache unless it
+        is already resident. Returns the number of rows actually
+        installed — 0 on every skip path (no index, sub-chunk prefix,
+        already covered, dry pool, contiguous dead donor) — so a
+        caller can tell a real install from a no-op. Only chunk-path sequences are stored:
+        their rows came from the chunk program, so a later cached run
+        replays bit-identical state (the parity guarantee) — and a
+        preempted slot's rows are the literal bytes decode committed,
+        which is exactly what its resume must read back.
 
         Paged: storing is (mostly) FREE — the slot's full blocks over
         the prefix are increfed and recorded as the entry's payload, no
-        row copies. A trailing partial block is copied-on-share (the
-        donor slot keeps writing into its own copy past the prefix;
-        `skytpu_kv_cow_copies_total`). Contiguous: the slot's rows copy
-        into a pool row as before."""
+        row copies. A trailing partial block is copied-on-share while
+        the donor LIVES (it keeps writing into its own copy past the
+        prefix; `skytpu_kv_cow_copies_total`); a dying donor
+        (preemption-by-eviction) shares the partial block by incref
+        alone — no writer remains, so eviction stays a pure table
+        edit. Contiguous: the slot's rows copy into a pool row as
+        before (live donors only; a contiguous eviction resumes
+        cold)."""
         idx = self._prefix_index
-        if idx is None or req.slot is None:
-            return
-        n = (len(req.prompt) // idx.block) * idx.block
+        if idx is None or slot is None:
+            return 0
+        n = (rows // idx.block) * idx.block
         if n < idx.block:
-            return
-        covered = idx.lookup(req.prompt)
+            return 0
+        covered = idx.lookup(ctx)
         if covered is not None and covered[1] >= n:
-            return
+            return 0
         if self.paged:
             n_full, partial = divmod(n, self.kv_block)
-            blocks = self.block_table[req.slot, :n_full].tolist()
-            if partial:
+            nb = n_full + (1 if partial else 0)
+            blocks = self.block_table[slot, :nb].tolist()
+            if partial and donor_live:
                 cow = self._alloc_blocks(1)
                 if cow is None:      # pool dry: skip storing
-                    return
+                    return 0
                 self.cache = self._copy_block_fn(
                     self.cache,
-                    jnp.asarray(self.block_table[req.slot, n_full],
-                                jnp.int32),
+                    jnp.asarray(blocks[n_full], jnp.int32),
                     jnp.asarray(cow[0], jnp.int32))
                 KV_COW_COPIES.inc()
                 self._fl_cow += 1
-                blocks.append(cow[0])
+                blocks[n_full] = cow[0]
             for b in blocks[:n_full]:
                 self.allocator.incref(b)
-            for payload in idx.insert_entry(req.prompt, n,
-                                            tuple(blocks)):
+            if partial and not donor_live:
+                self.allocator.incref(blocks[n_full])
+            for payload in idx.insert_entry(ctx, n, tuple(blocks)):
                 PREFIX_EVICTIONS.inc()
                 self._fl_evictions += 1
                 for b in payload:
                     self.allocator.decref(b)
             self._update_gauges()
-            return
+            return n
+        if not donor_live:
+            return 0
         row, evicted = idx.acquire_row()
         if evicted:
             PREFIX_EVICTIONS.inc()
             self._fl_evictions += 1
         self.pool = self._pool_store_fn(
-            self.pool, self.cache, jnp.asarray(req.slot, jnp.int32),
+            self.pool, self.cache, jnp.asarray(slot, jnp.int32),
             jnp.asarray(row, jnp.int32))
-        idx.register(req.prompt, n, row)
+        idx.register(ctx, n, row)
+        return n
 
     def clear_prefix_cache(self) -> None:
         """Drop every resident prefix. Paged: the entries' block refs
@@ -1587,8 +1817,9 @@ class InferenceEngine:
         true_lens = np.ones((n,), np.int32)
         slot_ids = np.full((n,), self.n_slots, np.int32)  # spare
         for i, (req, slot) in enumerate(zip(wave, slots)):
-            tokens_b[i, :len(req.prompt)] = req.prompt
-            true_lens[i] = len(req.prompt)
+            ctx = self._ctx(req)
+            tokens_b[i, :len(ctx)] = ctx
+            true_lens[i] = len(ctx)
             slot_ids[i] = slot
         decode_active = bool(self.slot_req)
         self.cache, self.rng, first = self._admit_wave_fn(
@@ -1622,9 +1853,10 @@ class InferenceEngine:
             tok = int(first[i])
             req.slot = slot
             req.tokens.append(tok)
-            req.first_token_s = now
+            if req.first_token_s is None:      # not a preemption resume
+                req.first_token_s = now
+                TTFT_SECONDS.observe(max(now - req.submit_s, 0.0))
             PREFILL_REQUESTS.labels(bucket=str(bucket)).inc()
-            TTFT_SECONDS.observe(max(now - req.submit_s, 0.0))
             self.slot_req[slot] = req
             if self._req_finished(req, tok):
                 self._retire(req)

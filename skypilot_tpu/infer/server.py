@@ -37,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 from socketserver import ThreadingMixIn
 from typing import Dict, Optional
 
+from skypilot_tpu.infer import qos as qos_lib
 from skypilot_tpu.observability import flight as flight_lib
 from skypilot_tpu.observability import health as health_lib
 from skypilot_tpu.observability import metrics, tracing
@@ -91,9 +92,15 @@ class ModelServer:
 
     def __init__(self, engine, max_burst: int = 8,
                  open_burst: int = 4, open_window_s: float = 1.0,
-                 coalesce_s: float = 0.012):
+                 coalesce_s: float = 0.012,
+                 qos: Optional[qos_lib.AdmissionController] = None):
         self.engine = engine
         self.max_burst = max_burst
+        # Multi-tenant QoS admission (docs/serving.md §Multi-tenant
+        # QoS): handler threads run the token-bucket + overload check
+        # BEFORE a request ever touches the inbox; None (the default)
+        # is the zero-cost path.
+        self.qos = qos
         # Admission coalescing: when the inbox yields less than a full
         # wave but a request arrived within the last ``coalesce_s``,
         # wait a beat (in 2 ms slices, re-draining) before dispatching.
@@ -156,8 +163,17 @@ class ModelServer:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    def queue_depth(self) -> int:
+        """Inbox + in-flight requests — the overload-shed input.
+        Benign racy len() reads from handler threads: a threshold
+        check needs no exactness, and taking the loop's locks here
+        would serialize admission behind decode."""
+        return len(self._inbox) + len(self._pending)
+
     def _add(self, tokens, max_new_tokens: int,
-             stream: bool = False, trace_ctx=None) -> _Pending:
+             stream: bool = False, trace_ctx=None,
+             tenant: str = qos_lib.DEFAULT_TENANT,
+             priority: int = 0) -> _Pending:
         from skypilot_tpu.infer import engine as eng
         # Validate eagerly (oversized prompt -> clean 400) without
         # touching the engine from this thread.
@@ -170,20 +186,25 @@ class ModelServer:
             # add_request so the engine's per-request spans join the
             # HTTP caller's trace.
             self._inbox.append((list(tokens), max_new_tokens, p,
-                                trace_ctx))
+                                trace_ctx, tenant, priority))
             self._last_arrival = time.monotonic()
             INBOX_DEPTH.set(len(self._inbox))
         return p
 
-    def submit(self, tokens, max_new_tokens: int, trace_ctx=None) -> Dict:
-        p = self._add(tokens, max_new_tokens, trace_ctx=trace_ctx)
+    def submit(self, tokens, max_new_tokens: int, trace_ctx=None,
+               tenant: str = qos_lib.DEFAULT_TENANT,
+               priority: int = 0) -> Dict:
+        p = self._add(tokens, max_new_tokens, trace_ctx=trace_ctx,
+                      tenant=tenant, priority=priority)
         t0 = time.time()
         p.event.wait()
         out = dict(p.result or {})
         out["total_ms"] = round((time.time() - t0) * 1e3, 2)
         return out
 
-    def submit_stream(self, tokens, max_new_tokens: int, trace_ctx=None):
+    def submit_stream(self, tokens, max_new_tokens: int, trace_ctx=None,
+                      tenant: str = qos_lib.DEFAULT_TENANT,
+                      priority: int = 0):
         """Iterator of chunk dicts: {"tokens": [...]} as decoded, then
         one {"done": true, "ttft_ms": ...} (or {"error": ...}).
 
@@ -192,7 +213,8 @@ class ModelServer:
         not mid-stream after a 200 went out.
         """
         p = self._add(tokens, max_new_tokens, stream=True,
-                      trace_ctx=trace_ctx)
+                      trace_ctx=trace_ctx, tenant=tenant,
+                      priority=priority)
 
         def gen():
             while True:
@@ -260,14 +282,18 @@ class ModelServer:
         with self._inbox_lock:
             new, self._inbox = self._inbox, []
             INBOX_DEPTH.set(0)
-        for tokens, max_new, p, trace_ctx in new:
-            # trace_ctx only when one rode in: simple engine doubles
-            # (and older engines) without the kwarg keep working.
+        for tokens, max_new, p, trace_ctx, tenant, priority in new:
+            # Optional kwargs only when they carry signal: simple
+            # engine doubles (and older engines) without the kwargs
+            # keep working.
+            kwargs = {}
             if trace_ctx is not None:
-                rid = self.engine.add_request(tokens, max_new,
-                                              trace_ctx=trace_ctx)
-            else:
-                rid = self.engine.add_request(tokens, max_new)
+                kwargs["trace_ctx"] = trace_ctx
+            if tenant != qos_lib.DEFAULT_TENANT:
+                kwargs["tenant"] = tenant
+            if priority:
+                kwargs["priority"] = priority
+            rid = self.engine.add_request(tokens, max_new, **kwargs)
             # add_request appends to engine.waiting; keep the Request so
             # emitted tokens can be diffed without a rid->req search.
             p.req = self.engine.waiting[-1]
@@ -339,7 +365,17 @@ class ModelServer:
         # (retirements there may free the very slots admission wants).
         if eng.waiting:
             self._complete_burst()
-            if eng.waiting and eng.free_slots:
+            admit = bool(eng.free_slots)
+            if (not admit and eng.slot_req
+                    and getattr(eng, "qos", None) is not None):
+                # Saturated replica: admission is the only path into
+                # the engine's priority-preemption pass, so it must
+                # still run when a queued request outranks a resident —
+                # otherwise the priority lanes are dead exactly when
+                # every slot is held, the one situation they exist for.
+                floor = min(r.priority for r in eng.slot_req.values())
+                admit = any(w.priority > floor for w in eng.waiting)
+            if eng.waiting and admit:
                 eng.admit(on_wave=self._on_wave)
                 self._flush_streams()
         if chunking:
@@ -393,6 +429,9 @@ class ModelServer:
                 # request's drafts covered (accepted / drafted).
                 "spec_drafted": getattr(req, "spec_drafted", 0),
                 "spec_accepted": getattr(req, "spec_accepted", 0),
+                # QoS: how often this request was preempted-by-
+                # eviction and resumed (0 on the single-tenant path).
+                "preemptions": getattr(req, "preemptions", 0),
             }
             if p.stream:
                 p.chunks.put({"done": True, "ttft_ms": ttft,
@@ -402,7 +441,9 @@ class ModelServer:
                               "spec_drafted":
                                   getattr(req, "spec_drafted", 0),
                               "spec_accepted":
-                                  getattr(req, "spec_accepted", 0)})
+                                  getattr(req, "spec_accepted", 0),
+                              "preemptions":
+                                  getattr(req, "preemptions", 0)})
             p.event.set()
         if self.engine.finished:
             PENDING_REQUESTS.set(len(self._pending))
@@ -442,11 +483,13 @@ def make_handler(model: ModelServer):
                     time.monotonic() - t0)
                 self._t0 = None
 
-        def _json(self, code, obj):
+        def _json(self, code, obj, headers=None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
             self._observe(code)
@@ -542,6 +585,21 @@ def make_handler(model: ModelServer):
                 return self._json(400, {"error": f"bad request: {e}"})
             trace_ctx = tracing.parse_traceparent(
                 self.headers.get("traceparent"))
+            # Multi-tenant QoS: identity from header/body, then the
+            # token-bucket + overload check BEFORE any engine state is
+            # touched. A shed is a typed client signal (429
+            # rate_limited / 503 overloaded with Retry-After), never
+            # a 500 — the LB runs the same check one hop earlier.
+            tenant, priority = qos_lib.request_identity(
+                self.headers, body,
+                cfg=model.qos.cfg if model.qos is not None else None)
+            if model.qos is not None:
+                try:
+                    model.qos.admit(tenant, depth=model.queue_depth())
+                except qos_lib.ShedError as e:
+                    return self._json(
+                        e.http_status, {"error": e.typed_error},
+                        headers={"Retry-After": e.retry_after_header()})
             # Client errors carry a typed body when the engine minted
             # one (PromptTooLongError.typed_error — a prompt past the
             # largest bucket is the caller's fault, never a 500).
@@ -553,12 +611,15 @@ def make_handler(model: ModelServer):
             if stream:
                 try:
                     chunks = model.submit_stream(tokens, max_new,
-                                                 trace_ctx=trace_ctx)
+                                                 trace_ctx=trace_ctx,
+                                                 tenant=tenant,
+                                                 priority=priority)
                 except ValueError as e:  # oversized prompt etc.
                     return _bad_request(e)
                 return self._stream(chunks)
             try:
-                out = model.submit(tokens, max_new, trace_ctx=trace_ctx)
+                out = model.submit(tokens, max_new, trace_ctx=trace_ctx,
+                                   tenant=tenant, priority=priority)
             except ValueError as e:      # oversized prompt etc.
                 return _bad_request(e)
             if "error" in out:
@@ -573,11 +634,12 @@ def make_handler(model: ModelServer):
 
 def serve(engine, host: str = "0.0.0.0", port: int = 8080,
           max_burst: int = 8, open_burst: int = 4,
-          open_window_s: float = 1.0, coalesce_s: float = 0.012):
+          open_window_s: float = 1.0, coalesce_s: float = 0.012,
+          qos: Optional[qos_lib.AdmissionController] = None):
     model = ModelServer(engine, max_burst=max_burst,
                         open_burst=open_burst,
                         open_window_s=open_window_s,
-                        coalesce_s=coalesce_s)
+                        coalesce_s=coalesce_s, qos=qos)
     httpd = _Threading((host, port), make_handler(model))
     return model, httpd
 
@@ -749,7 +811,11 @@ def main() -> None:
                 else int(os.environ.get("SKYTPU_SPEC_K", "4") or 0)),
         # One compiled prefill program per bucket: an odd wave size
         # must never hit a mid-traffic XLA compile on a live replica.
-        pad_waves=True)
+        pad_waves=True,
+        # Multi-tenant QoS (SKYTPU_QOS=1): WFQ + priority lanes in the
+        # engine's waiting deque. All host-side — tenant count never
+        # enters program identity (the compile watch is the gate).
+        qos=qos_lib.scheduler_from_env())
     # The engine slims its own tree under weights_int8; drop main()'s
     # reference too or the fp block weights stay resident for the whole
     # server lifetime and the memory halving never happens.
@@ -770,7 +836,8 @@ def main() -> None:
                          max_burst=args.max_burst,
                          open_burst=args.open_burst,
                          open_window_s=args.open_window,
-                         coalesce_s=args.coalesce)
+                         coalesce_s=args.coalesce,
+                         qos=qos_lib.admission_from_env("server"))
     tracing.add_event("server.listening", {"port": args.port},
                       echo=True)
     try:

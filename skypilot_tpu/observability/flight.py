@@ -435,6 +435,17 @@ def render_table(records: List[Dict[str, Any]],
         for k in ("cow", "evictions", "lazy_grows"):
             if r.get(k):
                 flags.append(f"{k}={r[k]}")
+        # QoS attribution (engine bursts carry tenant composition when
+        # a fair scheduler is installed; "preempt" records carry the
+        # victim's lane): only non-default make-ups earn a flag.
+        tenants = r.get("tenants") or {}
+        if tenants and (len(tenants) > 1
+                        or next(iter(tenants)) != "default"):
+            flags.append("tenants=" + ",".join(
+                f"{t}:{n}" for t, n in sorted(tenants.items())))
+        if r.get("burst") == "preempt":
+            flags.append(f"prio={r.get('priority', 0)} "
+                         f"retired={r.get('retired_rows', 0)}")
         if r.get("compiled"):
             flags.append(f"COMPILED={len(r['compiled'])}")
         lines.append(fmt.format(
@@ -470,7 +481,8 @@ def as_spans(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     for r in records:
         ts = float(r.get("ts_s", 0.0))
         attrs = {k: r[k] for k in ("toks", "drafted", "accepted",
-                                   "stall", "rids")
+                                   "stall", "rids", "tenants",
+                                   "priority", "retired_rows")
                  if r.get(k)}
         attrs["slots"] = len(r.get("slots", ()))
         spans.append({

@@ -116,6 +116,14 @@ DEFAULT_RULES: List[SloRule] = [
     # unexpected compile in both windows pages.
     SloRule("unexpected-compiles", "rate", threshold=0.0,
             metric="skytpu_unexpected_compiles_total"),
+    # Sustained QoS load-shedding: sheds are the fleet protecting
+    # itself (a hot tenant over its bucket, or an overloaded queue) —
+    # working as designed in a burst, but a shed rate held across both
+    # windows means capacity or quota is mis-sized and real traffic is
+    # bouncing. The burn-rate autoscaler usually reacts first; this
+    # rule pages when it can't (max_replicas hit, scaling frozen).
+    SloRule("qos-shed-rate", "rate", threshold=1.0,
+            metric="skytpu_qos_shed_total", min_events=5.0),
     SloRule("train-step-regression", "train_step_regression",
             threshold=1.5, metric="skytpu_train_step_seconds",
             baseline_metric="skytpu_train_step_median_seconds",

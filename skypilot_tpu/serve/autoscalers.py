@@ -35,6 +35,10 @@ class Autoscaler:
     def from_spec(cls, spec: SkyServiceSpec) -> "Autoscaler":
         if spec.use_ondemand_fallback:
             return FallbackRequestRateAutoscaler(spec)
+        if spec.target_ttft_p95_seconds is not None:
+            return BurnRateAutoscaler(
+                spec,
+                snapshot_fn=BurnRateAutoscaler.federated_snapshot)
         if spec.target_qps_per_replica is not None:
             return RequestRateAutoscaler(spec)
         return FixedAutoscaler(spec)
@@ -79,6 +83,103 @@ class RequestRateAutoscaler(Autoscaler):
             self._proposal_since = None
             return ScalingDecision(desired)
         return ScalingDecision(num_total)
+
+
+class BurnRateAutoscaler(Autoscaler):
+    """SLO-driven scaling: the multi-window TTFT-p95 burn rate decides,
+    not raw QPS (ROADMAP item 4 / docs/serving.md §Multi-tenant QoS).
+
+    QPS is a proxy; the objective is latency. This autoscaler reuses
+    the SLO watchdog's rule machinery verbatim — one
+    ``histogram_quantile`` rule over ``skytpu_ttft_seconds``, evaluated
+    over a short window (responsiveness) AND a long window (confidence)
+    — and scales out one replica per upscale-delay cooldown while BOTH
+    windows breach the objective. That multi-window gate is the
+    hysteresis: a single slow request or scrape blip cannot launch a
+    replica, exactly as it cannot page. Downscale is the mirror image:
+    one replica per downscale delay while both windows sit below
+    ``downscale_factor`` x the objective (comfortably inside SLO), so
+    the fleet drains only when latency says the capacity is surplus.
+
+    Snapshots come from ``snapshot_fn`` (the controller wires the
+    federation tier via :meth:`federated_snapshot`); tests feed
+    :meth:`observe` directly, like the watchdog's own tests.
+    """
+
+    def __init__(self, spec: SkyServiceSpec, snapshot_fn=None,
+                 short_window_s: float = 60.0,
+                 long_window_s: float = 300.0,
+                 downscale_factor: float = 0.5):
+        super().__init__(spec)
+        from skypilot_tpu.observability import slo as slo_lib
+        self._slo = slo_lib
+        self.rule = slo_lib.SloRule(
+            "ttft-burn", "histogram_quantile",
+            threshold=float(spec.target_ttft_p95_seconds),
+            metric="skytpu_ttft_seconds", quantile=0.95,
+            short_window_s=short_window_s,
+            long_window_s=long_window_s)
+        self.downscale_factor = downscale_factor
+        self._snapshot_fn = snapshot_fn
+        self._history: list = []
+        self._last_upscale_s: Optional[float] = None
+        self._calm_since: Optional[float] = None
+
+    @staticmethod
+    def federated_snapshot():
+        """Fleet-wide metric families from the federation tier (what
+        the controller process scrapes anyway)."""
+        from skypilot_tpu.observability import aggregate
+        return aggregate.federate(aggregate.discover_endpoints()).families
+
+    def observe(self, families, ts: Optional[float] = None) -> None:
+        """Feed one metrics snapshot (the watchdog's Snapshot shape,
+        components unused)."""
+        ts = time.time() if ts is None else ts
+        self._history.append((ts, families, []))
+        cutoff = ts - 2 * self.rule.long_window_s
+        while len(self._history) > 2 and self._history[0][0] < cutoff:
+            self._history.pop(0)
+
+    def decide(self, current_qps, num_ready, num_total) -> ScalingDecision:
+        if self._snapshot_fn is not None:
+            try:
+                self.observe(self._snapshot_fn())
+            except Exception as e:  # noqa: BLE001 — a dead federation
+                # tier must not kill the controller loop; scaling just
+                # freezes at the current target until scrapes return.
+                from skypilot_tpu.observability import tracing
+                tracing.add_event(
+                    "autoscaler.snapshot_failed",
+                    {"error_type": type(e).__name__,
+                     "message": str(e)[:200]}, echo=True)
+        breached, short, long_ = self._slo.evaluate_rule(
+            self.rule, self._history)
+        now = self._history[-1][0] if self._history else time.time()
+        lo, hi = self.spec.min_replicas, self.spec.max_replicas
+        target = min(max(num_total, lo), hi)
+        if breached:
+            self._calm_since = None
+            cooled = (self._last_upscale_s is None
+                      or now - self._last_upscale_s
+                      >= self.spec.upscale_delay_seconds)
+            if cooled and target < hi:
+                self._last_upscale_s = now
+                return ScalingDecision(target + 1)
+            return ScalingDecision(target)
+        calm_bar = self.rule.threshold * self.downscale_factor
+        calm = (short is not None and long_ is not None
+                and short <= calm_bar and long_ <= calm_bar)
+        if calm and target > lo:
+            if self._calm_since is None:
+                self._calm_since = now
+            elif now - self._calm_since \
+                    >= self.spec.downscale_delay_seconds:
+                self._calm_since = now
+                return ScalingDecision(target - 1)
+        elif not calm:
+            self._calm_since = None
+        return ScalingDecision(target)
 
 
 class FallbackRequestRateAutoscaler(RequestRateAutoscaler):

@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import http.server
 import itertools
+import json
 import os
 import socket
 import socketserver
@@ -20,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
 from skypilot_tpu import chaos
+from skypilot_tpu.infer import qos as qos_lib
 from skypilot_tpu.observability import health as health_lib
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import serve_state
@@ -158,9 +160,35 @@ class LeastLoadPolicy(Policy):
 POLICIES = {"round_robin": RoundRobinPolicy, "least_load": LeastLoadPolicy}
 
 
-def make_handler(service: str, policy: Policy, max_retries: int = 3):
+def make_handler(service: str, policy: Policy, max_retries: int = 3,
+                 qos: Optional[qos_lib.AdmissionController] = None):
+    # Body-tenant extraction only pays when tenant identity can change
+    # the admission outcome: with no per-tenant rates configured every
+    # tenant is unlimited, so the proxy hot path must not JSON-decode a
+    # hundreds-of-KB token body just to pick a metric label the model
+    # server will attribute anyway (chaos plans still force the parse —
+    # injected sheds match on the body tenant in tests).
+    qos_rates_body_tenant = qos is not None and (
+        bool(qos.cfg.tenants) or qos.cfg.default_rate > 0)
+
     class ProxyHandler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+
+        def _typed_reject(self, code: int, typed: dict,
+                          retry_after_s: float = 1.0) -> None:
+            """A typed load-shed/overload response minted AT the LB
+            (never forwarded): JSON body + Retry-After, counted under
+            backend="none" so fleet dashboards see LB-minted rejects
+            next to replica answers."""
+            LB_PROXIED.labels(backend="none", code=str(code)).inc()
+            body = json.dumps({"error": typed}).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Retry-After",
+                             qos_lib.retry_after_header(retry_after_s))
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def _proxy(self):
             # The LB's own observability surface rides reserved paths
@@ -178,11 +206,41 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
                         reason=f"{n_ready} ready replicas")
                 return health_lib.write_healthz(
                     self, health_lib.DEGRADED, reason="no ready replicas")
-            serve_state.record_request(service)
             body = None
             length = int(self.headers.get("Content-Length") or 0)
             if length:
                 body = self.rfile.read(length)
+            tenant = qos_lib.DEFAULT_TENANT
+            if qos is not None and self.command == "POST":
+                # Fleet-edge admission control: the same per-tenant
+                # token buckets the model server runs, one hop
+                # earlier — a hot tenant is shed HERE before it costs
+                # a proxied connection and a replica inbox slot.
+                # POST-only so the two tiers' buckets drain in step:
+                # the model server admission-checks only POST
+                # /generate, and a tenant's GET polls (dashboards,
+                # /debug/flight) must not burn the quota its real
+                # generation requests need. The body is read first so
+                # the SDK path (tenant in the JSON body, no header)
+                # lands in its own bucket, not a shared 'default' one
+                # — and so a shed response leaves no unread body bytes
+                # on the keep-alive connection.
+                body_fields = None
+                if (body and not self.headers.get(qos_lib.tenant_header())
+                        and (qos_rates_body_tenant or chaos.active())):
+                    try:
+                        body_fields = json.loads(body)
+                    except (ValueError, UnicodeDecodeError):
+                        body_fields = None
+                tenant, _ = qos_lib.request_identity(
+                    self.headers, body=body_fields, cfg=qos.cfg)
+                try:
+                    qos.admit(tenant)
+                except qos_lib.ShedError as e:
+                    return self._typed_reject(
+                        e.http_status, e.typed_error,
+                        retry_after_s=e.retry_after_s)
+            serve_state.record_request(service)
             urls = serve_state.ready_urls(service)
             tried = []
             self._response_started = False
@@ -205,12 +263,21 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3):
                         # so the client sees a clean truncation.
                         self.close_connection = True
                         return
-            LB_PROXIED.labels(backend="none", code="503").inc()
-            self.send_response(503)
-            msg = b"no ready replicas"
-            self.send_header("Content-Length", str(len(msg)))
-            self.end_headers()
-            self.wfile.write(msg)
+            # Typed overload body (the model server's 503 shape): a
+            # client distinguishes "back off and retry" from a replica
+            # 5xx without parsing prose. With QoS on, the no-replica
+            # bounce IS a shed — the qos-shed-rate SLO rule and the
+            # `skytpu top` shed column must see the lb tier go dark,
+            # not just its token-bucket rejects.
+            if qos is not None:
+                qos_lib.QOS_SHED.labels(
+                    tenant=qos_lib.tenant_label(tenant, qos.cfg),
+                    reason="overloaded", where="lb").inc()
+            self._typed_reject(503, {
+                "type": "overloaded",
+                "message": "no ready replicas",
+                "service": service,
+            })
 
         def _forward(self, base_url: str, body: Optional[bytes]):
             """Streaming reverse proxy, raw-splice edition: replica
@@ -370,8 +437,10 @@ def serve(service: str, port: int, policy_name: str = "least_load",
     if bool(certfile) != bool(keyfile):
         raise ValueError("TLS needs BOTH certfile and keyfile")
     policy = POLICIES[policy_name]()
-    httpd = _ThreadingServer(("0.0.0.0", port),
-                             make_handler(service, policy))
+    httpd = _ThreadingServer(
+        ("0.0.0.0", port),
+        make_handler(service, policy,
+                     qos=qos_lib.admission_from_env("lb")))
     if certfile:
         # TLS terminates here; LB -> replica stays plaintext on the
         # cluster-internal network (reference: sky/serve TLS fields).

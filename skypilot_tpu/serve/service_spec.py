@@ -21,6 +21,13 @@ class SkyServiceSpec:
     max_replicas: Optional[int] = None
     target_num_replicas: Optional[int] = None
     target_qps_per_replica: Optional[float] = None
+    # SLO-driven autoscaling: scale on the fleet's multi-window TTFT
+    # p95 burn rate (observability/slo.py machinery) instead of raw
+    # QPS — set this to the p95 objective in seconds and the
+    # BurnRateAutoscaler takes over (docs/serving.md §Multi-tenant
+    # QoS). Mutually composable with min/max replicas and the
+    # upscale/downscale delays (cooldowns).
+    target_ttft_p95_seconds: Optional[float] = None
     replica_port: int = 8080
     upscale_delay_seconds: float = 30.0
     downscale_delay_seconds: float = 60.0
@@ -92,7 +99,8 @@ class SkyServiceSpec:
                 int(replicas)
             kwargs["max_replicas"] = int(replicas)
         for key in ("min_replicas", "max_replicas",
-                    "target_qps_per_replica", "upscale_delay_seconds",
+                    "target_qps_per_replica",
+                    "target_ttft_p95_seconds", "upscale_delay_seconds",
                     "downscale_delay_seconds",
                     "base_ondemand_fallback_replicas",
                     "dynamic_ondemand_fallback"):
@@ -127,6 +135,7 @@ class SkyServiceSpec:
                           "certfile": self.tls_certfile}
         if self.min_replicas == self.max_replicas and \
                 self.target_qps_per_replica is None and \
+                self.target_ttft_p95_seconds is None and \
                 not self.use_ondemand_fallback:
             out["replicas"] = self.min_replicas
         else:
@@ -137,6 +146,9 @@ class SkyServiceSpec:
                 "upscale_delay_seconds": self.upscale_delay_seconds,
                 "downscale_delay_seconds": self.downscale_delay_seconds,
             }
+            if self.target_ttft_p95_seconds is not None:
+                out["replica_policy"]["target_ttft_p95_seconds"] \
+                    = self.target_ttft_p95_seconds
             if self.base_ondemand_fallback_replicas is not None:
                 out["replica_policy"]["base_ondemand_fallback_replicas"] \
                     = self.base_ondemand_fallback_replicas

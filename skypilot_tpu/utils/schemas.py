@@ -64,6 +64,7 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
                 "min_replicas": {"type": "integer"},
                 "max_replicas": {"type": ["integer", "null"]},
                 "target_qps_per_replica": {"type": ["number", "null"]},
+                "target_ttft_p95_seconds": {"type": ["number", "null"]},
                 "upscale_delay_seconds": {"type": "number"},
                 "downscale_delay_seconds": {"type": "number"},
                 "base_ondemand_fallback_replicas": {"type": "integer"},

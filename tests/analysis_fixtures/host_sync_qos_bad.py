@@ -1,0 +1,35 @@
+# Golden fixture: seeded host-sync violations on the QoS path. The
+# DRR reorder runs on the engine loop before EVERY admission pass and
+# preemption-by-eviction is a block-table edit — both must work purely
+# from host state (request token lists, refcounts, token buckets).
+# Consulting the device to rank tenants or pick a victim would stall
+# the very admission pipeline QoS schedules. Checked as if it were
+# skypilot_tpu/infer/qos.py (the scheduler scope). Never imported.
+import numpy as np
+
+
+class FairScheduler:
+    def reorder(self, waiting):
+        # "Smarter" fairness by live device occupancy: a fetch per
+        # admission pass.
+        rows = np.asarray(self.cache["length"])      # expect: host-sync
+        order = sorted(waiting, key=lambda r: rows[r.slot or 0])
+        waiting.clear()
+        waiting.extend(order)
+
+    def request_cost(self, req):
+        # Costing by the slot's DEVICE length instead of the host
+        # token lists.
+        return int(self.cache["length"][req.slot])   # expect: host-sync
+
+
+class AdmissionController:
+    def admit(self, tenant, depth=None):
+        load = self.slots_active_dev.item()          # expect: host-sync
+        if load > self.cfg.max_waiting:
+            raise OverloadedError(load, self.cfg.max_waiting)
+
+
+class OverloadedError(Exception):
+    def __init__(self, depth, max_waiting):
+        super().__init__(f"{depth} > {max_waiting}")

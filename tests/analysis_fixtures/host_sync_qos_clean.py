@@ -1,0 +1,54 @@
+# Clean twin: the QoS path done right — DRR over host request lists
+# (prompt/tokens lengths ARE host state), token buckets fed by wall
+# clocks, preemption victims picked from the host slot map. The device
+# is never consulted. Never imported.
+import time
+
+
+class FairScheduler:
+    def reorder(self, waiting):
+        if len(waiting) < 2:
+            return
+        lanes = {}
+        order = []
+        for r in waiting:
+            key = (r.priority, r.tenant)
+            if key not in lanes:
+                lanes[key] = []
+                order.append(key)
+            lanes[key].append(r)
+        out = []
+        deficit = {key: 0 for key in order}
+        remaining = len(waiting)
+        while remaining:
+            for key in order:
+                q = lanes[key]
+                if not q:
+                    continue
+                deficit[key] += self.quantum
+                while q and self.request_cost(q[0]) <= deficit[key]:
+                    r = q.pop(0)
+                    deficit[key] -= self.request_cost(r)
+                    out.append(r)
+                    remaining -= 1
+        waiting.clear()
+        waiting.extend(out)
+
+    def request_cost(self, req):
+        return max(len(req.prompt) + len(req.tokens)
+                   + req.max_new_tokens, 1)
+
+
+class AdmissionController:
+    def admit(self, tenant, depth=None):
+        now = time.monotonic()
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            wait_s = bucket.take(now) if bucket is not None else 0.0
+        if wait_s > 0:
+            raise RateLimitedError(tenant, wait_s)
+
+
+class RateLimitedError(Exception):
+    def __init__(self, tenant, wait_s):
+        super().__init__(f"{tenant}: retry in {wait_s:.2f}s")

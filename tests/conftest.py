@@ -218,3 +218,18 @@ def mesh8():
 def tiny_cfg():
     from skypilot_tpu.models import llama
     return llama.CONFIGS["llama3-tiny"]
+
+
+def ttft_fams(fast, slow):
+    """Cumulative TTFT histogram family: ``fast`` samples <= 0.1 s,
+    ``slow`` in (0.1, 5] — the synthetic feed the burn-rate
+    autoscaler/SLO tests observe (shared by test_qos/test_chaos)."""
+    cum, samples = 0, []
+    for le, n in (("0.1", fast), ("5", slow), ("+Inf", 0)):
+        cum += n
+        samples.append(({"__name__": "skytpu_ttft_seconds_bucket",
+                         "le": le}, float(cum)))
+    samples.append(({"__name__": "skytpu_ttft_seconds_count"},
+                    float(cum)))
+    return {"skytpu_ttft_seconds": {"type": "histogram",
+                                    "samples": samples}}

@@ -146,6 +146,11 @@ _GOLDEN = [
     ("host-sync", "host_sync_flight_bad.py",
      "host_sync_flight_clean.py",
      "skypilot_tpu/observability/flight.py"),
+    # Multi-tenant QoS (PR 11): the DRR reorder / admission check run
+    # per admission pass / per HTTP request — pure host bookkeeping;
+    # a device fetch to rank tenants stalls the admission pipeline.
+    ("host-sync", "host_sync_qos_bad.py", "host_sync_qos_clean.py",
+     "skypilot_tpu/infer/qos.py"),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      "skypilot_tpu/utils/fixture_locks.py"),
     ("typed-errors", "typed_errors_bad.py", "typed_errors_clean.py",
