@@ -435,6 +435,43 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"span bench failed: {e}")
             out["serve_span_error"] = str(e)[:200]
+        # Pallas paged decode-attention kernel phase: kernel-vs-gather
+        # decode TPOT on the same engine at low occupancy (where the
+        # gather transient dominates), greedy parity vs the gather
+        # oracle. PARITY is required everywhere; the SPEEDUP gate only
+        # binds on real TPU runs — on CPU the kernel executes in
+        # Pallas interpret mode, where wall-clock is meaningless.
+        try:
+            from skypilot_tpu.infer import bench_serve as _bs
+            ke = _bs.run_kernel(config=serve_cfg, weights_int8=big,
+                                kv_int8=big)
+            out["serve_kernel_speedup"] = ke["speedup"]
+            out["serve_kernel_tpot_gather_ms"] = ke["tpot_gather_ms"]
+            out["serve_kernel_tpot_ms"] = ke["tpot_kernel_ms"]
+            out["serve_kernel_parity_ok"] = bool(
+                ke["parity_ok"] and ke["kernel_programs_ok"])
+            on_tpu = ke["backend"] == "tpu"
+            if "span_under_kernel_speedup" in ke:
+                out["serve_kernel_span_speedup"] = \
+                    ke["span_under_kernel_speedup"]
+                out["serve_kernel_occupancy_x"] = \
+                    ke["occupancy_under_kernel_x"]
+            out["serve_kernel_regressed"] = bool(
+                not out["serve_kernel_parity_ok"]
+                or (on_tpu and ke["speedup"] < 1.2)
+                or (on_tpu and not ke.get(
+                    "span_under_kernel_parity_ok", True))
+                or (on_tpu and not ke.get(
+                    "occupancy_under_kernel_ok", True)))
+            if out["serve_kernel_regressed"]:
+                log("SERVE KERNEL REGRESSION: "
+                    f"x{ke['speedup']} or parity broken "
+                    f"(parity_ok={ke['parity_ok']}, "
+                    f"programs_ok={ke['kernel_programs_ok']}, "
+                    f"backend={ke['backend']})")
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"kernel bench failed: {e}")
+            out["serve_kernel_error"] = str(e)[:200]
         # Multi-tenant QoS phase: background-tenant TPOT isolation
         # under a hot tenant (WFQ + admission control) and
         # preemption-by-eviction parity — the production-hardening

@@ -59,6 +59,14 @@ _SCOPES: Dict[str, Set[str]] = {
         # pick a victim would stall admission itself.
         "_requeue", "_ctx", "_resumable", "preempt_slot",
         "_preempt_for_waiting",
+        # Paged-attention kernel + KV quotas (PR 12): the kernel
+        # DISPATCH seam stays the same burst methods above (the flag
+        # is engine-constant), but the per-tenant block accounting
+        # runs at every claim/growth/free and the quota check per
+        # admission pass — all host numpy-table bookkeeping; a device
+        # fetch to count a tenant's blocks would stall admission.
+        "_kv_quota", "_kv_quota_blocked", "_set_tenant_kv",
+        "_sync_kv_charge",
     },
     # QoS scheduler + admission control: the DRR reorder runs on the
     # engine loop before every admission pass and the admission check
@@ -100,7 +108,10 @@ class HostSyncChecker(Checker):
     # v5: the flight-recorder record path + compile-watch wrapper.
     # v6: QoS — the DRR scheduler/admission (infer/qos.py) and the
     #     preemption-by-eviction path joined the scope.
-    version = 6
+    # v7: paged-attention kernel rollout (PR 12) — the per-tenant
+    #     KV-block quota/charge bookkeeping joined the engine scope;
+    #     the bump rescans the edited dispatch seam cold.
+    version = 7
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

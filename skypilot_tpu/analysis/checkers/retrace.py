@@ -93,7 +93,13 @@ class RetraceSafetyChecker(Checker):
     # joined the guarded surface — golden fixtures cover the
     # span-gather shape; the bump invalidates warm caches so the new
     # fixtures and the edited kvcache/engine hot path rescan cold.
-    version = 2
+    # v3: Pallas kernel bodies joined the guarded surface — the BFS
+    # follows ``functools.partial(kernel_fn, ...)`` targets (the
+    # pallas_call idiom wraps the kernel in a partial, which hid its
+    # body from reachability), covering ops/paged_attention.py's
+    # kernel + wrapper and the kvcache dispatch seam; the bump
+    # rescans the edited hot path and the new fixtures cold.
+    version = 3
 
     def check_project(self, ctxs: Sequence[FileContext],
                       root: str) -> List[Finding]:
@@ -150,7 +156,15 @@ class RetraceSafetyChecker(Checker):
             for sub in _util.body_walk(node):
                 if not isinstance(sub, ast.Call):
                     continue
-                info = self._resolve(sub.func, mod, file_aliases,
+                target = sub.func
+                # ``functools.partial(f, ...)``: the partial runs
+                # f's body wherever the partial is called (the
+                # pallas_call kernel idiom) — follow f itself.
+                name = _util.dotted(sub.func) or ""
+                if name.split(".")[-1] == "partial" and sub.args \
+                        and not _is_jit_expr(sub.args[0]):
+                    target = sub.args[0]
+                info = self._resolve(target, mod, file_aliases,
                                      by_module)
                 if info is not None and id(info.node) not in seen:
                     seen.add(id(info.node))

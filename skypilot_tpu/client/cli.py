@@ -393,6 +393,36 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
             prev, fams, "skytpu_decode_attn_rows", 0.5)
         if span_rows is not None:
             line += f"  span p50 {span_rows:.0f}"
+        # Decode attention read path (docs/serving.md §Paged
+        # decode-attention kernel): which big-cache path the fleet's
+        # decode bursts rode — kernel (Pallas, SKYTPU_KV_KERNEL=1),
+        # gather (the oracle/fallback), or mixed mid-rollout. Window
+        # rates when bursts flowed between frames, lifetime totals
+        # otherwise.
+        if "skytpu_decode_attn_bursts_total" in have:
+            def _path(p, window=True):
+                if window:
+                    v = rate("skytpu_decode_attn_bursts_total",
+                             match={"path": p})
+                else:
+                    v = aggregate.sample_value(
+                        fams, "skytpu_decode_attn_bursts_total",
+                        match={"path": p})
+                return v or 0
+            kern, gath = _path("kernel"), _path("gather")
+            if not kern and not gath:
+                # Idle window or first frame: fall back to lifetime
+                # totals so the indicator never vanishes mid-session.
+                # Only when BOTH window rates are dry — one flowing
+                # path means the fleet is on THAT path now, and the
+                # other's stale lifetime total must not report
+                # "mixed" forever after a rollout flip.
+                kern = _path("kernel", window=False)
+                gath = _path("gather", window=False)
+            if kern or gath:
+                line += ("  attn " + ("mixed" if kern and gath
+                                      else "kernel" if kern
+                                      else "gather"))
         # Speculative-decode acceptance (docs/serving.md): the window
         # rate when drafting happened between frames, else the
         # engines' lifetime gauge (first frame / --once / idle).

@@ -881,7 +881,8 @@ def run_spec_smoke() -> dict:
 
 
 def run_occupancy(config=None, smoke=False, kv_int8=False,
-                  weights_int8=False, factor=8, max_burst=4) -> dict:
+                  weights_int8=False, factor=8, max_burst=4,
+                  kv_kernel=False) -> dict:
     """High-occupancy decode sweep: max concurrent decode slots at the
     SAME KV HBM bytes, paged block-table cache vs the contiguous
     layout.
@@ -927,10 +928,15 @@ def run_occupancy(config=None, smoke=False, kv_int8=False,
               kv_int8=kv_int8, qweights=qw, prefill_chunk=0,
               prefix_pool=0, max_wave=8, pad_waves=True)
     nb = max_len // kv_block
+    # kv_kernel: the paged engine reads through the Pallas kernel; the
+    # contiguous twin has no block table and falls back to the gather
+    # — the parity assert below then spans kernel-vs-gather AND
+    # paged-vs-contiguous at once (the PR 9 composition re-run).
     e_paged = eng.InferenceEngine(params, cfg,
                                   n_slots=slots_c * factor,
                                   kv_block=kv_block,
-                                  kv_blocks=(slots_c + 1) * nb, **kw)
+                                  kv_blocks=(slots_c + 1) * nb,
+                                  kv_kernel=kv_kernel, **kw)
     e_contig = eng.InferenceEngine(params, cfg, n_slots=slots_c,
                                    kv_block=0, **kw)
 
@@ -996,12 +1002,14 @@ def run_occupancy(config=None, smoke=False, kv_int8=False,
         "config": config,
         "kv_int8": kv_int8,
         "weights_int8": weights_int8,
+        "kv_kernel": bool(kv_kernel),
     }
 
 
 def run_span(config=None, requests=None, prompt_len=None,
              new_tokens=None, max_burst=8, kv_int8=False,
-             weights_int8=False, spec_k=0, smoke=False) -> dict:
+             weights_int8=False, spec_k=0, smoke=False,
+             kv_kernel=False) -> dict:
     """Span-bucketed decode attention bench: span-on vs full-view
     decode TPOT on the SAME engine (same weights, same block pool —
     the ladder is host-side dispatch state, so toggling it only
@@ -1052,7 +1060,8 @@ def run_span(config=None, requests=None, prompt_len=None,
     kw = dict(n_slots=slots, max_len=max_len,
               prompt_buckets=(prompt_len,), kv_int8=kv_int8,
               prefill_chunk=0, prefix_pool=0, max_wave=slots,
-              pad_waves=True, kv_block=kv_block, spec_k=spec_k)
+              pad_waves=True, kv_block=kv_block, spec_k=spec_k,
+              kv_kernel=kv_kernel)
     if weights_int8:
         from skypilot_tpu.infer import kvcache
         params, qw = kvcache.random_quantized_params(cfg)
@@ -1123,6 +1132,7 @@ def run_span(config=None, requests=None, prompt_len=None,
         "config": config,
         "kv_int8": kv_int8,
         "weights_int8": weights_int8,
+        "kv_kernel": bool(kv_kernel),
     }
 
 
@@ -1131,6 +1141,149 @@ def run_span_smoke() -> dict:
     asserts parity and the rows/program structure; wall-clock is
     reported, never gated, on CPU)."""
     return run_span(smoke=True)
+
+
+def run_kernel(config=None, requests=None, prompt_len=None,
+               new_tokens=None, max_burst=8, kv_int8=False,
+               weights_int8=False, spec_k=0, smoke=False) -> dict:
+    """Pallas paged decode-attention kernel bench: kernel-vs-gather
+    decode TPOT on the SAME engine (the kernel flag is a static jit
+    argument — flipping it routes bursts to the other compiled
+    program; weights, block pool and RNG stream are shared), greedy
+    parity asserted against the gather oracle.
+
+    Workload: LOW occupancy-utilization — a few active requests on an
+    engine sized for many slots. The gather path materializes the
+    [slots, span, G, hd] logical view per layer per burst step
+    REGARDLESS of how many slots are active, so its fixed per-burst
+    transient cost is amortized over the fewest tokens exactly here;
+    the kernel never builds the view, which is the whole win.
+
+    ``smoke=True`` / CPU: the kernel runs in Pallas interpret mode —
+    parity and program identity (compile-watch keys carry
+    ``kernel=True``) are the asserts; wall-clock is reported but
+    MEANINGLESS on interpret (gated only by bench.py on real TPU
+    runs). Full (hardware) mode additionally re-runs the span and
+    occupancy benches under the kernel, confirming the PR 9 gates
+    still hold on the kernel path.
+    """
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.infer import engine as eng
+    from skypilot_tpu.models import llama
+
+    on_cpu = jax.default_backend() == "cpu"
+    if config is None:
+        config = "llama3-tiny" if on_cpu else "llama3-400m"
+    small = smoke or on_cpu
+    cfg = llama.CONFIGS[config]
+    max_len = 256 if small else 4096
+    kv_block = 32 if small else 256
+    slots = 8 if small else 16
+    if requests is None:
+        requests = 2 if small else 4
+    if prompt_len is None:
+        prompt_len = 8 if small else 128
+    if new_tokens is None:
+        new_tokens = 16 if small else 256
+    log(f"kernel bench: {config} max_len={max_len} block={kv_block} "
+        f"slots={slots} active={requests} (low occupancy)")
+
+    kw = dict(n_slots=slots, max_len=max_len,
+              prompt_buckets=(prompt_len,), kv_int8=kv_int8,
+              prefill_chunk=0, prefix_pool=0, max_wave=slots,
+              pad_waves=True, kv_block=kv_block, spec_k=spec_k,
+              kv_kernel=True)
+    if weights_int8:
+        from skypilot_tpu.infer import kvcache
+        params, qw = kvcache.random_quantized_params(cfg)
+        e = eng.InferenceEngine(params, cfg, qweights=qw, **kw)
+    else:
+        params = llama.init_params(jax.random.key(0), cfg)
+        e = eng.InferenceEngine(params, cfg, **kw)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(requests)]
+
+    def decode_pass(kernel_on):
+        """One admit-then-decode pass; TPOT over the decode loop only
+        (admission is kernel-free: prefill waves never read the big
+        cache)."""
+        e.kv_kernel = kernel_on
+        ids = [e.add_request(p, max_new_tokens=new_tokens)
+               for p in prompts]
+        e.admit()
+        t0 = _time.time()
+        while e.slot_req:
+            e.decode_burst(max_burst)
+        float(e.cache["length"][0])     # honest host sync
+        wall = _time.time() - t0
+        by_rid = {r.rid: list(r.tokens) for r in e.finished}
+        outs = [by_rid[i] for i in ids]
+        e.finished.clear()
+        dtoks = sum(len(o) for o in outs) - len(outs)
+        return outs, wall / max(dtoks, 1)
+
+    # Warmup compiles both modes' programs outside the timed window.
+    decode_pass(False)
+    decode_pass(True)
+
+    out_gather, tpot_gather = decode_pass(False)
+    out_kernel, tpot_kernel = decode_pass(True)
+    e.kv_kernel = True
+    parity_ok = out_kernel == out_gather
+    # Program identity: the kernel flag must live in the compile-watch
+    # keys (never a retrace surface — both values were warmed above).
+    keys = e.compile_watch.summary()
+    kernel_programs_ok = (
+        any("kernel=True" in k for k in keys)
+        and any("kernel=False" in k for k in keys))
+    speedup = tpot_gather / max(tpot_kernel, 1e-9)
+    log(f"kernel: gather {tpot_gather * 1e3:.2f}ms/tok kernel "
+        f"{tpot_kernel * 1e3:.2f}ms/tok ({speedup:.2f}x, "
+        f"parity={parity_ok}, backend={jax.default_backend()})")
+    out = {
+        "tpot_gather_ms": round(tpot_gather * 1e3, 3),
+        "tpot_kernel_ms": round(tpot_kernel * 1e3, 3),
+        # Informational on CPU (interpret mode); gated on TPU runs.
+        "speedup": round(speedup, 3),
+        "parity_ok": bool(parity_ok),
+        "kernel_programs_ok": bool(kernel_programs_ok),
+        "backend": jax.default_backend(),
+        "active_requests": requests,
+        "slots": slots,
+        "max_len": max_len,
+        "kv_block": kv_block,
+        "span_ladder": list(e.span_ladder),
+        "new_tokens": new_tokens,
+        "spec_k": spec_k,
+        "config": config,
+        "kv_int8": kv_int8,
+        "weights_int8": weights_int8,
+    }
+    if not small:
+        # The PR 9 gates, re-run on the kernel path (hardware only:
+        # interpret-mode wall-clock would drown the comparison).
+        sa = run_span(config=config, kv_int8=kv_int8,
+                      weights_int8=weights_int8, kv_kernel=True)
+        out["span_under_kernel_speedup"] = sa["speedup"]
+        out["span_under_kernel_parity_ok"] = sa["parity_ok"]
+        oc = run_occupancy(config=config, kv_int8=kv_int8,
+                           weights_int8=weights_int8, kv_kernel=True)
+        out["occupancy_under_kernel_x"] = oc["occupancy_x"]
+        out["occupancy_under_kernel_ok"] = (
+            not oc["occupancy_regressed"])
+    return out
+
+
+def run_kernel_smoke() -> dict:
+    """CI-sized kernel pass (tier-1 wiring: tests/test_paged_attention
+    .py asserts parity and program identity; interpret-mode wall-clock
+    is reported, never gated, on CPU)."""
+    return run_kernel(smoke=True)
 
 
 def run_flight(config=None, requests=None, new_tokens=None,
@@ -1556,6 +1709,15 @@ def main() -> None:
                          "a long-max_len engine), greedy parity "
                          "asserted (combine with --smoke for the "
                          "CI-sized pass)")
+    ap.add_argument("--kernel", action="store_true",
+                    help="Pallas paged decode-attention kernel bench: "
+                         "kernel-vs-gather decode TPOT on the same "
+                         "engine at low occupancy (where the gather "
+                         "transient dominates), greedy parity "
+                         "asserted; combined with --span/--occupancy "
+                         "it re-runs THOSE benches with the kernel "
+                         "enabled instead (combine with --smoke for "
+                         "the CI-sized pass)")
     ap.add_argument("--qos", action="store_true",
                     help="multi-tenant QoS bench: background-tenant "
                          "TPOT/TTFT isolation under a hot tenant "
@@ -1603,7 +1765,7 @@ def main() -> None:
     if args.span:
         r = run_span(config=args.config, kv_int8=args.kv_int8,
                      weights_int8=args.weights_int8,
-                     smoke=args.smoke)
+                     smoke=args.smoke, kv_kernel=args.kernel)
         print(json.dumps({
             "metric": "serve_span_speedup",
             "value": r["speedup"],
@@ -1611,7 +1773,27 @@ def main() -> None:
             **{k: r[k] for k in (
                 "tpot_full_ms", "tpot_span_ms", "rows_full",
                 "rows_span", "rows_ratio", "span_ladder",
-                "n_span_programs", "parity_ok", "config")},
+                "n_span_programs", "parity_ok", "kv_kernel",
+                "config")},
+        }))
+        return
+    if args.kernel and not args.occupancy:
+        # --kernel alone = the kernel-vs-gather bench; combined with
+        # --span/--occupancy those branches run THEIR bench with the
+        # kernel enabled instead (--span is dispatched above,
+        # --occupancy below).
+        r = run_kernel(config=args.config, kv_int8=args.kv_int8,
+                       weights_int8=args.weights_int8,
+                       spec_k=(args.spec_k if args.spec else 0),
+                       smoke=args.smoke)
+        print(json.dumps({
+            "metric": "serve_kernel_speedup",
+            "value": r["speedup"],
+            "unit": "x_decode_tok_s_vs_gather",
+            **{k: r[k] for k in (
+                "tpot_gather_ms", "tpot_kernel_ms", "parity_ok",
+                "kernel_programs_ok", "backend", "active_requests",
+                "slots", "span_ladder", "config")},
         }))
         return
     if args.spec:
@@ -1631,7 +1813,8 @@ def main() -> None:
         return
     if args.occupancy:
         r = run_occupancy(config=args.config, kv_int8=args.kv_int8,
-                          weights_int8=args.weights_int8)
+                          weights_int8=args.weights_int8,
+                          kv_kernel=args.kernel)
         print(json.dumps({
             "metric": "serve_occupancy_x",
             "value": r["occupancy_x"],
@@ -1639,7 +1822,7 @@ def main() -> None:
             **{k: r[k] for k in (
                 "kv_hbm_bytes", "paged_slots", "contiguous_slots",
                 "blocks_per_token", "kv_block", "parity_ok",
-                "occupancy_regressed", "config")},
+                "occupancy_regressed", "kv_kernel", "config")},
         }))
         return
     if args.smoke or args.prefix_share:

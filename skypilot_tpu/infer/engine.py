@@ -135,6 +135,27 @@ KV_LAZY_GROWS = metrics.counter(
     "Paged KV blocks allocated by lazy per-burst growth "
     "(SKYTPU_KV_LAZY=1: admission reserves prompt + one burst of "
     "rows; the rest allocates at burst dispatch)")
+DECODE_ATTN_PATH = metrics.counter(
+    "skytpu_decode_attn_bursts_total",
+    "Decode-family bursts (decode, verify, single-step) by big-cache "
+    "attention read path: 'kernel' = the Pallas paged-attention "
+    "kernel (SKYTPU_KV_KERNEL=1), 'gather' = the XLA logical-view "
+    "gather (the parity oracle and contiguous/fallback path) — the "
+    "kernel rollout is observable per burst",
+    labelnames=("path",))
+QOS_KV_QUOTA_STALLS = metrics.counter(
+    "skytpu_qos_kv_quota_stalls_total",
+    "Admissions stalled because the request's tenant is at its "
+    "per-tenant KV-block quota (qos tenant spec max_kv_blocks) — a "
+    "typed wait for the tenant's own retirements, never a 503; other "
+    "tenants keep admitting",
+    labelnames=("tenant",))
+QOS_KV_BLOCKS = metrics.gauge(
+    "skytpu_qos_kv_blocks_used",
+    "Paged KV blocks currently charged to each tenant (table "
+    "references, shared prefix blocks charged to every referencing "
+    "tenant) — the quantity max_kv_blocks caps",
+    labelnames=("tenant",))
 
 
 @dataclasses.dataclass
@@ -178,6 +199,10 @@ class Request:
     priority: int = 0
     preemptions: int = 0
     resumed_len: int = 0
+    # Per-tenant KV-block quota: True while this request sits queued
+    # because its tenant is at max_kv_blocks — the typed stall event
+    # and counter fire once per episode, not once per admission pass.
+    kv_quota_stalled: bool = False
 
 
 @dataclasses.dataclass
@@ -215,6 +240,25 @@ class PromptTooLongError(ValueError):
             "message": str(self),
             "prompt_len": prompt_len,
             "max_prompt_len": max_prompt_len,
+        }
+
+
+class KvQuotaUnsatisfiableError(ValueError):
+    """The request's own worst-case KV-block need exceeds its tenant's
+    ``max_kv_blocks`` quota, so no amount of the tenant's retirements
+    could ever admit it — stalling would hang the client forever. A
+    client error (HTTP 400, typed body), never a stall or a 500."""
+
+    def __init__(self, tenant: str, need: int, quota: int):
+        super().__init__(
+            f"request needs {need} KV blocks but tenant "
+            f"{tenant!r} is capped at max_kv_blocks={quota}")
+        self.typed_error = {
+            "type": "kv_quota_unsatisfiable",
+            "message": str(self),
+            "tenant": tenant,
+            "need_blocks": need,
+            "max_kv_blocks": quota,
         }
 
 
@@ -476,6 +520,7 @@ class InferenceEngine:
                  spec_k: Optional[int] = None,
                  spec_drafter: Optional[Callable] = None,
                  span_buckets=None, kv_lazy: Optional[bool] = None,
+                 kv_kernel: Optional[bool] = None,
                  flight_recorder: Optional[
                      flight_lib.FlightRecorder] = None,
                  qos: Optional[qos_lib.FairScheduler] = None):
@@ -630,6 +675,24 @@ class InferenceEngine:
                 span_buckets = [int(t) for t in
                                 env.replace(",", " ").split()]
         self.span_ladder = _span_ladder(span_buckets, max_len)
+        # Pallas paged-attention kernel (SKYTPU_KV_KERNEL=1 /
+        # --kv-kernel, ctor arg wins): decode/verify/chunk big-cache
+        # reads walk each slot's block table in-kernel instead of
+        # materializing the gathered logical view per layer. Paged
+        # layouts only — a contiguous engine falls back to the gather
+        # path (typed event, not an error) which also remains the
+        # greedy-parity oracle and is selectable at runtime by leaving
+        # the flag off. The flag is a STATIC jit argument on every
+        # kernel-capable entry point, so it is part of compile-watch
+        # program identity and can never be a retrace surface (it is
+        # engine-constant).
+        if kv_kernel is None:
+            kv_kernel = os.environ.get("SKYTPU_KV_KERNEL", "") == "1"
+        self.kv_kernel = bool(kv_kernel) and self.paged
+        if kv_kernel and not self.paged:
+            tracing.add_event(
+                "engine.kv_kernel_fallback",
+                {"reason": "contiguous_layout"}, echo=True)
         # Decode-side program keys actually dispatched ((kind, width,
         # span) tuples; span None = the full view): the retrace-
         # discipline tests assert this stays bounded by the ladder —
@@ -730,6 +793,14 @@ class InferenceEngine:
                     mesh, rules)
         self.rng = jax.random.key(seed)
 
+        # Per-tenant KV-block quotas (qos tenant spec max_kv_blocks):
+        # blocks a slot's table references are charged to its tenant
+        # at claim/growth and refunded when the slot's blocks free.
+        # Shared prefix blocks charge EVERY referencing tenant — a
+        # reference holds the block live, so each referencing tenant
+        # pays. Host bookkeeping only (loop thread).
+        self._slot_kv_charge: Dict[int, Tuple[str, int]] = {}
+        self._tenant_kv: Dict[str, int] = {}
         self.free_slots = list(range(n_slots))
         self.slot_req: Dict[int, Request] = {}
         self.waiting: Deque[Request] = collections.deque()
@@ -807,12 +878,13 @@ class InferenceEngine:
         # kvcache.decode_burst_staged; ~25% faster than a scan of
         # per-step cache updates on an 8B model).
         @functools.partial(jax.jit, donate_argnums=(1, 2),
-                           static_argnames=("k", "span"))
+                           static_argnames=("k", "span", "kernel"))
         def _decode_burst(params, cache, rng, active, table=None, *, k,
-                          qweights=None, span=None):
+                          qweights=None, span=None, kernel=False):
             return kvcache.decode_burst_staged(
                 params, cache, rng, active, k, cfg, sp,
-                qweights=qweights, table=table, span=span)
+                qweights=qweights, table=table, span=span,
+                kv_kernel=kernel)
 
         # Speculative verify: the decode_burst_staged formulation with
         # the sampled-token feedback replaced by the host's draft
@@ -820,26 +892,27 @@ class InferenceEngine:
         # RNG argument at all — the greedy stream stays untouched, so
         # spec-on and spec-off runs consume identical RNG.
         @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnames=("k", "span"))
+                           static_argnames=("k", "span", "kernel"))
         def _verify(params, cache, draft, n_draft, active, table=None,
-                    *, k, qweights=None, span=None):
+                    *, k, qweights=None, span=None, kernel=False):
             return kvcache.verify_draft_staged(
                 params, cache, draft, n_draft, active, k, cfg,
-                qweights=qweights, table=table, span=span)
+                qweights=qweights, table=table, span=span,
+                kv_kernel=kernel)
 
         # Chunked-prefill programs: ONE chunk program (two traces: the
         # ``final`` variant samples the first token and splits the RNG)
         # serves every bucket and every suffix offset; the claim/copy
         # programs are trivial gathers/scatters.
         @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnames=("final", "span"))
+                           static_argnames=("final", "span", "kernel"))
         def _prefill_chunk(params, cache, tokens_c, start, n_valid,
                            slot, new_len, rng, table=None, *, final,
-                           qweights=None, span=None):
+                           qweights=None, span=None, kernel=False):
             return kvcache.prefill_chunk(
                 params, cache, tokens_c, start, n_valid, slot, new_len,
                 rng, cfg, sp, final=final, qweights=qweights,
-                table=table, span=span)
+                table=table, span=span, kv_kernel=kernel)
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _claim(cache, slot, claim_len):
@@ -870,10 +943,11 @@ class InferenceEngine:
             key_fn=lambda a, kw: (("rows", a[2].shape[0]),))
         self._decode_fn = watch("decode1", _decode, ("span",))
         self._decode_burst_fn = watch("decode_burst", _decode_burst,
-                                      ("k", "span"))
-        self._verify_fn = watch("verify", _verify, ("k", "span"))
+                                      ("k", "span", "kernel"))
+        self._verify_fn = watch("verify", _verify,
+                                ("k", "span", "kernel"))
         self._prefill_chunk_fn = watch("prefill_chunk", _prefill_chunk,
-                                       ("final", "span"))
+                                       ("final", "span", "kernel"))
         self._claim_fn = watch("claim", _claim)
         self._pool_load_fn = watch("pool_load", _pool_load)
         self._pool_store_fn = watch("pool_store", _pool_store)
@@ -907,6 +981,7 @@ class InferenceEngine:
                     tenant: str = qos_lib.DEFAULT_TENANT,
                     priority: int = 0) -> int:
         _bucket(len(prompt), self.buckets)   # validate length up front
+        self.check_kv_quota(tenant, len(prompt), max_new_tokens)
         req = Request(rid=self._next_rid, prompt=list(prompt),
                       max_new_tokens=max_new_tokens, submit_s=time.time(),
                       eos_id=self.eos_id, tenant=tenant,
@@ -950,11 +1025,22 @@ class InferenceEngine:
         evs, self._fl_evictions = self._fl_evictions, 0
         lazy, self._fl_lazy_grows = self._fl_lazy_grows, 0
         compiled = self.compile_watch.drain_new()
+        # Big-cache read path this burst rode: the kernel covers the
+        # burst/verify/chunk programs; decode1 (the classic single-
+        # step fallback) stays on the gather even with the flag on.
+        attn = None
+        if burst in ("decode", "verify", "chunk", "decode1"):
+            attn = ("kernel" if self.kv_kernel and burst != "decode1"
+                    else "gather")
+            if burst != "chunk":
+                DECODE_ATTN_PATH.labels(path=attn).inc()
         fl = self.flight
         if fl is None or not fl.enabled:
             return
         program = dict(program)
         program["layout"] = "paged" if self.paged else "contig"
+        if attn is not None:
+            program["attn"] = attn
         extra: Dict[str, Any] = {}
         if stall:
             extra["stall"] = True
@@ -1036,7 +1122,8 @@ class InferenceEngine:
                     self.cache, self.rng, _ = self._decode_burst_fn(
                         self.params, self.cache, self.rng, active_dev,
                         self.table_device(), k=k,
-                        qweights=self.qweights, span=sarg)
+                        qweights=self.qweights, span=sarg,
+                        kernel=self.kv_kernel)
                     k *= 2
                 if self.spec_k:
                     draft = jnp.zeros((self.n_slots + 1, self.spec_k),
@@ -1045,7 +1132,8 @@ class InferenceEngine:
                     self.cache, _, _ = self._verify_fn(
                         self.params, self.cache, draft, n_draft,
                         active_dev, self.table_device(), k=self.spec_k,
-                        qweights=self.qweights, span=sarg)
+                        qweights=self.qweights, span=sarg,
+                        kernel=self.kv_kernel)
                 if self.prefill_chunk:
                     chunk = jnp.zeros((self.prefill_chunk,), jnp.int32)
                     for final in (False, True):
@@ -1058,7 +1146,7 @@ class InferenceEngine:
                                 jnp.asarray(self.max_len, jnp.int32),
                                 self.rng, self.table_device(),
                                 final=final, qweights=self.qweights,
-                                span=sarg)
+                                span=sarg, kernel=self.kv_kernel)
             # Admission waves: pad_waves pins every wave at max_wave
             # rows, so one program per bucket suffices. Unpadded
             # engines pad each wave to the next power of two of its
@@ -1175,6 +1263,7 @@ class InferenceEngine:
             return False
         row[have:have + len(blocks)] = blocks
         self._table_dirty = True
+        self._sync_kv_charge(slot, req.tenant)
         KV_LAZY_GROWS.inc(len(blocks))
         self._fl_lazy_grows += len(blocks)
         return True
@@ -1258,6 +1347,108 @@ class InferenceEngine:
             return None
         return [alloc.alloc() for _ in range(n)]
 
+    # -- per-tenant KV-block quotas (qos max_kv_blocks) --------------------
+
+    def _kv_quota(self, tenant: str) -> int:
+        """The tenant's ``max_kv_blocks`` quota (0 = unlimited):
+        paged engines with a QoS config only."""
+        if not self.paged or self.qos is None:
+            return 0
+        return max(self.qos.cfg.tenant(tenant).max_kv_blocks, 0)
+
+    def check_kv_quota(self, tenant: str, prompt_len: int,
+                       max_new_tokens: int) -> None:
+        """Submit-time guard: a request whose OWN worst-case block
+        need exceeds its tenant's ``max_kv_blocks`` quota can never
+        admit (the need formula is total-shaped and never shrinks), so
+        stalling it would hang the client forever — raise the typed
+        error instead. Reads only engine constants, so the server's
+        handler threads call it eagerly (the ``_bucket`` idiom: a
+        clean 400 before the request ever rides the inbox — an
+        exception on the loop thread could reach no client)."""
+        quota = self._kv_quota(tenant)
+        if not quota:
+            return
+        need = min(prompt_len + max_new_tokens, self.max_len)
+        if self.kv_lazy:
+            need = min(prompt_len + self._lazy_headroom, need)
+        need = -(-need // self.kv_block)
+        if need > quota:
+            raise KvQuotaUnsatisfiableError(tenant, need, quota)
+
+    def _kv_quota_blocked(self, req: Request) -> bool:
+        """Admission-time per-tenant KV-block quota check: True holds
+        THIS request back (typed ``qos.kv_quota_stall`` event +
+        counter, once per episode) while other tenants keep admitting
+        — a hot tenant can no longer hog the paged pool via long
+        contexts even while rate-limited. The quota gates ADMISSION
+        only: in-flight lazy growth is never blocked, so an admitted
+        request always runs to completion (growth is still charged,
+        which holds the tenant's NEXT admission)."""
+        quota = self._kv_quota(req.tenant)
+        if not quota:
+            return False
+        need = self._need_blocks(req, self._ctx_len(req))
+        used = self._tenant_kv.get(req.tenant, 0)
+        if used + need <= quota:
+            req.kv_quota_stalled = False
+            return False
+        if not req.kv_quota_stalled:
+            req.kv_quota_stalled = True
+            QOS_KV_QUOTA_STALLS.labels(
+                tenant=qos_lib.tenant_label(req.tenant,
+                                            self.qos.cfg)).inc()
+            tracing.add_event(
+                "qos.kv_quota_stall",
+                {"tenant": req.tenant, "rid": req.rid,
+                 "used_blocks": used, "need_blocks": need,
+                 "max_kv_blocks": quota})
+        return True
+
+    def _set_tenant_kv(self, tenant: str, n: int) -> None:
+        # Entries pop at zero: tenant names are client-supplied, so a
+        # scanner minting one name per request must not grow the dict
+        # for the engine's lifetime.
+        if n > 0:
+            self._tenant_kv[tenant] = n
+        else:
+            self._tenant_kv.pop(tenant, None)
+        # The gauge is absolute and its label CAP collapses overflow
+        # tenants into "other" — publish the label's SUM, not this
+        # tenant's count, or collapsed tenants would overwrite each
+        # other (a counter tolerates collapse; a .set() gauge only
+        # does summed).
+        cfg = self.qos.cfg if self.qos is not None else None
+        label = qos_lib.tenant_label(tenant, cfg)
+        total = sum(v for t, v in self._tenant_kv.items()
+                    if qos_lib.tenant_label(t, cfg) == label)
+        QOS_KV_BLOCKS.labels(tenant=label).set(total)
+
+    def _sync_kv_charge(self, slot: int,
+                        tenant: Optional[str] = None) -> None:
+        """Re-point the tenant KV-block accounting at the slot's
+        CURRENT table occupancy (called at claim, growth and free):
+        the charge is the number of blocks the slot's table
+        references, so shared prefix blocks charge every referencing
+        tenant and the refund at :meth:`_free_slot_blocks` is exact by
+        construction — no leak path exists that does not also leak the
+        table row itself."""
+        if not self.paged:
+            return
+        old_tenant, old_n = self._slot_kv_charge.get(slot, (None, 0))
+        tenant = tenant if tenant is not None else old_tenant
+        row = self.block_table[slot]
+        have = len(row[row < self.n_kv_blocks])
+        if old_tenant is not None and old_n:
+            self._set_tenant_kv(
+                old_tenant, self._tenant_kv.get(old_tenant, 0) - old_n)
+        if tenant is not None and have:
+            self._slot_kv_charge[slot] = (tenant, have)
+            self._set_tenant_kv(
+                tenant, self._tenant_kv.get(tenant, 0) + have)
+        else:
+            self._slot_kv_charge.pop(slot, None)
+
     def _wave_claim(self, req: Request) -> Optional[int]:
         """Claim a slot (+ its KV blocks when paged) for a wave-path
         request. Returns the slot, or None when the block pool is too
@@ -1273,6 +1464,7 @@ class InferenceEngine:
         row[:] = self.n_kv_blocks
         row[:len(blocks)] = blocks
         self._table_dirty = True
+        self._sync_kv_charge(slot, req.tenant)
         return slot
 
     def _free_slot_blocks(self, slot: int) -> None:
@@ -1291,6 +1483,7 @@ class InferenceEngine:
             self.allocator.decref(b)
         row[:] = self.n_kv_blocks
         self._table_dirty = True
+        self._sync_kv_charge(slot)      # refund the tenant's charge
 
     # -- QoS: re-queue, fair scheduling, preemption-by-eviction ------------
 
@@ -1471,9 +1664,19 @@ class InferenceEngine:
                 # cannot shift which tenant owns the front.
                 self.qos.reorder(self.waiting)
         stalled = False
+        # Requests held by their tenant's KV-block quota this pass: a
+        # per-TENANT limit must not stall the whole queue the way the
+        # (global) dry-pool stall does — held requests step aside,
+        # everyone behind them gets their shot, and they re-queue at
+        # the head for the next pass (the tenant's own retirements
+        # unblock them).
+        quota_held: List[Request] = []
         while self.waiting and self.free_slots and not stalled:
             dispatched = []
             while self.waiting and self.free_slots and not stalled:
+                if self._kv_quota_blocked(self.waiting[0]):
+                    quota_held.append(self.waiting.popleft())
+                    continue
                 # Chunk-path requests (prompt longer than the chunk —
                 # which also covers every possible prefix-cache hit)
                 # claim a slot and join the chunk queue; they never
@@ -1496,7 +1699,9 @@ class InferenceEngine:
                         (self.max_wave is None
                          or len(wave) < self.max_wave):
                     req = self.waiting.popleft()
-                    if self._use_chunked(req):
+                    if self._kv_quota_blocked(req):
+                        quota_held.append(req)
+                    elif self._use_chunked(req):
                         if not self._claim_chunked(req):
                             stalled = True
                     elif _bucket(self._ctx_len(req),
@@ -1523,6 +1728,9 @@ class InferenceEngine:
                     on_wave()
             # on_wave may have drained fresh arrivals into ``waiting``
             # — the outer loop admits them while slots remain.
+        if quota_held:
+            self.waiting.extendleft(reversed(quota_held))
+            ENGINE_WAITING.set(len(self.waiting))
 
     def _use_chunked(self, req: Request) -> bool:
         return (self.prefill_chunk is not None
@@ -1602,6 +1810,7 @@ class InferenceEngine:
                 PREFIX_MISSES.inc()
             row[n_shared:n_shared + len(new_blocks)] = new_blocks
             self._table_dirty = True
+            self._sync_kv_charge(slot, req.tenant)
             self.cache = self._claim_fn(
                 self.cache, jnp.asarray(slot, jnp.int32), claim_len)
         elif hit is not None:
@@ -1665,7 +1874,7 @@ class InferenceEngine:
             jnp.asarray(req.slot, jnp.int32),
             jnp.asarray(new_len, jnp.int32), self.rng,
             self.table_device(), final=final, qweights=self.qweights,
-            span=attn_span)
+            span=attn_span, kernel=self.kv_kernel)
         tok = int(tok_dev)               # host sync (garbage unless final)
         dt = time.time() - t0
         PREFILL_CHUNKS.inc()
@@ -1958,6 +2167,9 @@ class InferenceEngine:
             self.allocator.reset()
             self.block_table[:] = self.n_kv_blocks
             self._table_dirty = True
+            self._slot_kv_charge.clear()
+            for t in list(self._tenant_kv):
+                self._set_tenant_kv(t, 0)
         else:
             self.clear_prefix_cache()
         self._update_gauges()
@@ -2089,7 +2301,7 @@ class InferenceEngine:
                 self.params, self.cache, jnp.asarray(draft),
                 jnp.asarray(n_draft), jnp.asarray(active),
                 self.table_device(), k=K, qweights=self.qweights,
-                span=sarg)
+                span=sarg, kernel=self.kv_kernel)
             parts.append((slots, toks_dev, commit_dev))
             part_spans.append(sarg)
         # THE completion fetch: verify bursts are synchronous (the next
@@ -2210,7 +2422,7 @@ class InferenceEngine:
             self.cache, self.rng, toks = self._decode_burst_fn(
                 self.params, self.cache, self.rng, jnp.asarray(active),
                 self.table_device(), k=k, qweights=self.qweights,
-                span=sarg)
+                span=sarg, kernel=self.kv_kernel)
             parts.append((toks, slots))
             part_spans.append(sarg)
         self._inflight_tokens += k

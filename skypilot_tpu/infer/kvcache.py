@@ -39,6 +39,7 @@ from jax import lax
 
 from skypilot_tpu.infer import sampling as sampling_mod
 from skypilot_tpu.models import llama
+from skypilot_tpu.ops import paged_attention as paged_attn_ops
 
 Cache = Dict[str, jax.Array]
 
@@ -523,6 +524,44 @@ def _gather_slot_kv_layer(cache: Cache, i, slot, table, span=None):
     return ck, cv, cks, cvs
 
 
+def _paged_attn_stats(cache: Cache, i, table, qh, lengths, span):
+    """Big-cache attention stats via the Pallas paged-attention kernel
+    (``SKYTPU_KV_KERNEL=1``): per (slot, kv-head) the kernel walks the
+    slot's block table and streams its physical blocks through an
+    online-softmax accumulator — the ``[slots, span, G, hd]`` logical
+    view the gather path materializes per layer simply never exists.
+
+    qh: [B, G, R, hd] query rows; lengths: [B] the per-slot validity
+    bound (the same ``col < length`` rule the gather path's mask
+    encodes); ``span`` (static) bounds the block sweep to the span
+    rung's table prefix, exactly like the gather path. Returns the
+    unnormalized stats ``(acc, m, l)`` for :func:`_merge_attn_parts`.
+    """
+    bl = cache["k"].shape[2]
+    M = span if span is not None else (table.shape[1] - 1) * bl
+    return paged_attn_ops.paged_attention(
+        qh, cache["k"], cache["v"],
+        cache.get("k_scale"), cache.get("v_scale"),
+        table, lengths, i, span_blocks=-(-M // bl))
+
+
+def _merge_attn_parts(acc, m, l, ss):
+    """Two-block online-softmax combine: fold the staged-columns block
+    into the kernel's big-cache stats. ``ss``: masked staged scores
+    [..., W] (masked columns at -1e30). Returns (alpha, w_s, l_tot)
+    where the final output is ``(acc * alpha + w_s @ v_staged) /
+    l_tot`` — the same score set the one-shot softmax over
+    [cache | staged] sees, summed in online order (greedy parity, not
+    bit parity, vs the gather oracle). A slot with NO valid cache rows
+    reports m == -1e30 and ``alpha`` underflows to exactly 0 — its
+    (garbage) acc/l never contribute."""
+    m_tot = jnp.maximum(m, jnp.max(ss, axis=-1))
+    alpha = jnp.exp(m - m_tot)
+    w_s = jnp.exp(ss - m_tot[..., None])
+    l_tot = jnp.maximum(l * alpha + jnp.sum(w_s, axis=-1), 1e-30)
+    return alpha, w_s, l_tot
+
+
 # ---------------------------------------------------------------------------
 # Prefill
 # ---------------------------------------------------------------------------
@@ -746,7 +785,8 @@ def prefill_chunk(params: llama.Params, cache: Cache,
                   n_valid: jax.Array, slot: jax.Array,
                   new_len: jax.Array, rng: jax.Array,
                   cfg: llama.LlamaConfig, sp, *, final: bool,
-                  qweights=None, table=None, span=None
+                  qweights=None, table=None, span=None,
+                  kv_kernel=False
                   ) -> Tuple[Cache, jax.Array, jax.Array]:
     """One chunk of an incremental prefill into a decode slot.
 
@@ -781,6 +821,13 @@ def prefill_chunk(params: llama.Params, cache: Cache,
     covering this chunk's offset and a long-max_len engine stops
     paying max_len rows of reads per chunk. Same masked score set,
     same summation order: bit-identical to the full-view chunk.
+
+    ``kv_kernel`` (static, paged only): the big-cache block runs
+    through the Pallas paged-attention kernel over this slot's block
+    table (queries batched as ``C * rep`` rows per kv-head) and merges
+    with the intra-chunk block via the online-softmax combine — same
+    score set, online summation order, greedy parity vs the gather
+    oracle (this function with the flag off).
 
     Returns (cache', rng', first_token — 0 unless ``final``).
     """
@@ -824,29 +871,51 @@ def prefill_chunk(params: llama.Params, cache: Cache,
             ys = (kq, vq, ksc.astype(sdt), vsc.astype(sdt))
         else:
             ys = (kr.astype(kdt), vr.astype(kdt))
-        ck, cv, cks, cvs = _gather_slot_kv_layer(cache, i, slot, table,
-                                                 span)
         # bf16 dots, fp32 accumulation — int8 converts to bf16 exactly
         # (see decode_step's note).
         qh = q[0].reshape(C, G, rep, hd).astype(jnp.bfloat16)
-        sm = jnp.einsum("cgrk,mgk->cgrm", qh, ck.astype(jnp.bfloat16),
-                        preferred_element_type=jnp.float32) * scale
         ss = jnp.einsum("cgrk,jgk->cgrj", qh, kr.astype(jnp.bfloat16),
                         preferred_element_type=jnp.float32) * scale
-        if quant:
-            sm = sm * cks[None, :, None, :]
-        sm = jnp.where(col[None, None, None, :] < start, sm, neg)
         ss = jnp.where(intra_mask[:, None, None, :], ss, neg)
-        w = jax.nn.softmax(jnp.concatenate([sm, ss], axis=-1), axis=-1)
-        wm, ws = w[..., :M], w[..., M:]
-        if quant:
-            wm = wm * cvs[None, :, None, :]
-        o = jnp.einsum("cgrm,mgk->cgrk", wm.astype(jnp.bfloat16),
-                       cv.astype(jnp.bfloat16),
-                       preferred_element_type=jnp.float32)
-        o = o + jnp.einsum("cgrj,jgk->cgrk", ws.astype(jnp.bfloat16),
-                           vr.astype(jnp.bfloat16),
+        if kv_kernel and table is not None:
+            # Kernel big-cache block over THIS slot's table row: the
+            # chunk's C * rep query rows batch into one (slot,
+            # kv-head) grid cell each; the mask bound is ``start``
+            # (rows below this chunk are the resident prefix).
+            q_k = qh.transpose(1, 0, 2, 3).reshape(1, G, C * rep, hd)
+            acc, m, l = _paged_attn_stats(
+                cache, i, lax.dynamic_slice_in_dim(table, slot, 1, 0),
+                q_k, jnp.reshape(start, (1,)), span)
+            acc = acc.reshape(G, C, rep, hd).transpose(1, 0, 2, 3)
+            m = m.reshape(G, C, rep).transpose(1, 0, 2)
+            l = l.reshape(G, C, rep).transpose(1, 0, 2)
+            alpha, w_s, l_tot = _merge_attn_parts(acc, m, l, ss)
+            o = acc * alpha[..., None] + jnp.einsum(
+                "cgrj,jgk->cgrk", w_s.astype(jnp.bfloat16),
+                vr.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32)
+            o = o / l_tot[..., None]
+        else:
+            ck, cv, cks, cvs = _gather_slot_kv_layer(cache, i, slot,
+                                                     table, span)
+            sm = jnp.einsum("cgrk,mgk->cgrm", qh,
+                            ck.astype(jnp.bfloat16),
+                            preferred_element_type=jnp.float32) * scale
+            if quant:
+                sm = sm * cks[None, :, None, :]
+            sm = jnp.where(col[None, None, None, :] < start, sm, neg)
+            w = jax.nn.softmax(jnp.concatenate([sm, ss], axis=-1),
+                               axis=-1)
+            wm, ws = w[..., :M], w[..., M:]
+            if quant:
+                wm = wm * cvs[None, :, None, :]
+            o = jnp.einsum("cgrm,mgk->cgrk", wm.astype(jnp.bfloat16),
+                           cv.astype(jnp.bfloat16),
                            preferred_element_type=jnp.float32)
+            o = o + jnp.einsum("cgrj,jgk->cgrk",
+                               ws.astype(jnp.bfloat16),
+                               vr.astype(jnp.bfloat16),
+                               preferred_element_type=jnp.float32)
         o = o.reshape(1, C, cfg.n_heads, hd).astype(cfg.dtype)
         o = proj("bshk,hkd->bsd", o, layer, qlayer, "wo", 2, cfg.dtype)
         x = x + o
@@ -1103,7 +1172,8 @@ def commit_tokens(cache: Cache, tokens: jax.Array,
 
 def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
                        i, s, sk, sv, sks, svs, valid_cache,
-                       stage_valid, batch_ix, span=None):
+                       stage_valid, batch_ix, span=None, pos0=None,
+                       kv_kernel=False):
     """One decoder layer of a staged-burst step: the current step's
     K/V rows land in the staging buffers, attention runs as big-cache
     dot (rows masked by ``valid_cache``) ++ staged-columns dot
@@ -1114,6 +1184,17 @@ def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
     never drift one without the other. ``span`` (static) bounds the
     big-cache read to the first ``span`` logical rows; the caller's
     ``valid_cache`` mask must already be span-shaped.
+
+    ``kv_kernel`` (static): run the big-cache block through the Pallas
+    paged-attention kernel instead of the gather — the kernel walks
+    the block table per (slot, kv-head) and the logical-view transient
+    never materializes. Requires a ``table`` (the kernel is
+    block-table-native; contiguous callers keep the gather) and
+    ``pos0`` (the burst-start lengths the kernel masks by — the same
+    rule ``valid_cache`` encodes). The staged-columns block is
+    UNCHANGED either way; the two blocks merge via the online-softmax
+    combine (:func:`_merge_attn_parts`) — same score set, online
+    summation order, greedy parity vs the gather oracle.
     Returns (x', sk, sv, sks, svs).
     """
     quant = "k_scale" in cache
@@ -1139,37 +1220,50 @@ def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
     else:
         sk = sk.at[i, batch_ix, s].set(kk[:, 0].astype(kdt))
         sv = sv.at[i, batch_ix, s].set(v[:, 0].astype(kdt))
-    ck, cv, cks, cvs = _gather_kv_layer(cache, i, table, span)
     lk = lax.dynamic_index_in_dim(sk, i, 0, False)
     lv = lax.dynamic_index_in_dim(sv, i, 0, False)
     # bf16 dots, fp32 accumulation — int8 converts to bf16 exactly
     # (see decode_step's note).
     qh = q[:, 0].reshape(B, G, rep, hd).astype(jnp.bfloat16)
-    sm = jnp.einsum("bgrk,bmgk->bgrm", qh,
-                    ck.astype(jnp.bfloat16),
-                    preferred_element_type=jnp.float32) * scale
     ss = jnp.einsum("bgrk,bjgk->bgrj", qh,
                     lk.astype(jnp.bfloat16),
                     preferred_element_type=jnp.float32) * scale
+    lvs = None
     if quant:
         lks = lax.dynamic_index_in_dim(sks, i, 0, False)
         lvs = lax.dynamic_index_in_dim(svs, i, 0, False)
-        sm = sm * cks[:, :, None, :]
         ss = ss * lks.transpose(0, 2, 1)[:, :, None, :]
-    sm = jnp.where(valid_cache[:, None, None, :], sm, neg)
     ss = jnp.where(stage_valid[:, None, None, :], ss, neg)
-    w = jax.nn.softmax(jnp.concatenate([sm, ss], axis=-1), axis=-1)
-    wm, ws = w[..., :M], w[..., M:]
-    if quant:
-        wm = wm * cvs[:, :, None, :]
-        ws = ws * lvs.transpose(0, 2, 1)[:, :, None, :]
-    o = jnp.einsum("bgrm,bmgk->bgrk", wm.astype(jnp.bfloat16),
-                   cv.astype(jnp.bfloat16),
-                   preferred_element_type=jnp.float32)
-    o = o + jnp.einsum("bgrj,bjgk->bgrk",
-                       ws.astype(jnp.bfloat16),
-                       lv.astype(jnp.bfloat16),
+    if kv_kernel and table is not None:
+        acc, m, l = _paged_attn_stats(cache, i, table, qh, pos0, span)
+        alpha, w_s, l_tot = _merge_attn_parts(acc, m, l, ss)
+        if quant:
+            w_s = w_s * lvs.transpose(0, 2, 1)[:, :, None, :]
+        o = acc * alpha[..., None] + jnp.einsum(
+            "bgrj,bjgk->bgrk", w_s.astype(jnp.bfloat16),
+            lv.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32)
+        o = o / l_tot[..., None]
+    else:
+        ck, cv, cks, cvs = _gather_kv_layer(cache, i, table, span)
+        sm = jnp.einsum("bgrk,bmgk->bgrm", qh,
+                        ck.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32) * scale
+        if quant:
+            sm = sm * cks[:, :, None, :]
+        sm = jnp.where(valid_cache[:, None, None, :], sm, neg)
+        w = jax.nn.softmax(jnp.concatenate([sm, ss], axis=-1), axis=-1)
+        wm, ws = w[..., :M], w[..., M:]
+        if quant:
+            wm = wm * cvs[:, :, None, :]
+            ws = ws * lvs.transpose(0, 2, 1)[:, :, None, :]
+        o = jnp.einsum("bgrm,bmgk->bgrk", wm.astype(jnp.bfloat16),
+                       cv.astype(jnp.bfloat16),
                        preferred_element_type=jnp.float32)
+        o = o + jnp.einsum("bgrj,bjgk->bgrk",
+                           ws.astype(jnp.bfloat16),
+                           lv.astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32)
     x = _decode_out_ffn(cfg, layer, qlayer, wq8, x, o)
     return x, sk, sv, sks, svs
 
@@ -1199,7 +1293,8 @@ def _flush_staged_rows(cache: Cache, table, pos0, batch_ix,
 def decode_burst_staged(params: llama.Params, cache: Cache,
                         rng: jax.Array, active: jax.Array, k: int,
                         cfg: llama.LlamaConfig, sp,
-                        qweights=None, table=None, span=None
+                        qweights=None, table=None, span=None,
+                        kv_kernel=False
                         ) -> Tuple[Cache, jax.Array, jax.Array]:
     """k decode steps with a per-BURST cache flush (the engine's burst
     program; trace under jit with cache+rng donated).
@@ -1234,6 +1329,11 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
     inactive slot whose length exceeds the span computes garbage that
     is never committed, exactly like any other dead-slot row. The
     flush scatters through the FULL table, so writes are unchanged.
+
+    ``kv_kernel`` (static): route the big-cache read through the
+    Pallas paged-attention kernel (paged only — see
+    :func:`_staged_attn_layer`); greedy parity vs this function with
+    the flag off, which stays the oracle.
     Returns (cache', rng', toks [k, slots]).
     """
     B = cache["length"].shape[0]
@@ -1275,7 +1375,7 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
             x, sk, sv, sks, svs = _staged_attn_layer(
                 cfg, cache, table, layer, qlayer, x, cos, sin, i, s,
                 sk, sv, sks, svs, valid_cache, stage_valid, batch_ix,
-                span)
+                span, pos0, kv_kernel)
             return (x, i + 1, sk, sv, sks, svs), None
 
         xs = ((params["blocks"], qweights["blocks"]) if wq8
@@ -1302,7 +1402,8 @@ def verify_draft_staged(params: llama.Params, cache: Cache,
                         draft: jax.Array, n_draft: jax.Array,
                         active: jax.Array, k: int,
                         cfg: llama.LlamaConfig,
-                        qweights=None, table=None, span=None
+                        qweights=None, table=None, span=None,
+                        kv_kernel=False
                         ) -> Tuple[Cache, jax.Array, jax.Array]:
     """Speculative-decode verify: score ``k`` drafted tokens per slot
     plus the correction position in ONE device call (the engine's
@@ -1399,7 +1500,7 @@ def verify_draft_staged(params: llama.Params, cache: Cache,
             x, sk, sv, sks, svs = _staged_attn_layer(
                 cfg, cache, table, layer, qlayer, x, cos, sin, i, s,
                 sk, sv, sks, svs, valid_cache, stage_valid, batch_ix,
-                span)
+                span, pos0, kv_kernel)
             return (x, i + 1, sk, sv, sks, svs), None
 
         xs = ((params["blocks"], qweights["blocks"]) if wq8

@@ -207,12 +207,18 @@ class TokenBucket:
 class TenantSpec:
     """Per-tenant QoS knobs. ``rate`` 0 = unlimited; ``weight`` scales
     the tenant's DRR share; ``priority`` is the default lane for the
-    tenant's requests (a per-request header may override)."""
+    tenant's requests (a per-request header may override);
+    ``max_kv_blocks`` caps the paged KV blocks the tenant's resident
+    slots may reference at once (0 = unlimited) — the engine stalls
+    the tenant's admissions at the cap (typed ``qos.kv_quota_stall``,
+    never a 503) so a hot tenant cannot hog the block pool via long
+    contexts while rate-limited."""
 
     rate: float = 0.0
     burst: float = 0.0           # 0 -> max(2 * rate, 4)
     weight: int = 1
     priority: int = 0
+    max_kv_blocks: int = 0
 
     def bucket_burst(self) -> float:
         return self.burst if self.burst > 0 else max(2 * self.rate, 4.0)
@@ -246,7 +252,9 @@ class QosConfig:
                         rate=float(spec.get("rate", 0.0)),
                         burst=float(spec.get("burst", 0.0)),
                         weight=max(int(spec.get("weight", 1)), 1),
-                        priority=int(spec.get("priority", 0)))
+                        priority=int(spec.get("priority", 0)),
+                        max_kv_blocks=max(
+                            int(spec.get("max_kv_blocks", 0)), 0))
             except (ValueError, TypeError, AttributeError):
                 # A typo'd override must not silently disable QoS for
                 # every tenant; fall back to the defaults, loudly.

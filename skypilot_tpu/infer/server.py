@@ -175,9 +175,14 @@ class ModelServer:
              tenant: str = qos_lib.DEFAULT_TENANT,
              priority: int = 0) -> _Pending:
         from skypilot_tpu.infer import engine as eng
-        # Validate eagerly (oversized prompt -> clean 400) without
-        # touching the engine from this thread.
+        # Validate eagerly (oversized prompt / unsatisfiable KV quota
+        # -> clean 400) without touching the engine's mutable state
+        # from this thread — an exception raised later on the loop
+        # thread could reach no client.
         eng._bucket(len(tokens), self.engine.buckets)
+        check = getattr(self.engine, "check_kv_quota", None)
+        if check is not None:
+            check(tenant, len(tokens), max_new_tokens)
         p = _Pending()
         p.stream = stream
         with self._inbox_lock:
@@ -708,6 +713,16 @@ def main() -> None:
                          "Default: max_len/8,/4,/2 ladder "
                          "(env SKYTPU_SPAN_BUCKETS); 0 disables "
                          "(full-view reads only)")
+    ap.add_argument("--kv-kernel", action="store_true",
+                    default=None,
+                    help="Pallas paged decode-attention kernel: "
+                         "decode/verify/chunk big-cache reads walk "
+                         "each slot's block table in-kernel instead "
+                         "of materializing the gathered logical view "
+                         "per layer (paged layouts only; contiguous "
+                         "falls back to the gather, which also stays "
+                         "the greedy-parity oracle). Default env "
+                         "SKYTPU_KV_KERNEL=1")
     ap.add_argument("--kv-lazy", action="store_true",
                     default=None,
                     help="lazy paged-KV growth: admission reserves "
@@ -796,6 +811,7 @@ def main() -> None:
         prefill_chunk=args.prefill_chunk,
         kv_block=args.kv_block, kv_blocks=args.kv_blocks,
         span_buckets=span_buckets, kv_lazy=args.kv_lazy,
+        kv_kernel=args.kv_kernel,
         # Serving default: prefix reuse ON (repeated system prompts are
         # the common serving workload); the engine-level default stays
         # 0 so library users opt in.

@@ -1,0 +1,20 @@
+# Golden fixture: seeded host-sync violations on the per-tenant
+# KV-block quota / charge path (PR 12). The charge bookkeeping runs
+# at every claim/growth/free and the quota check per admission pass —
+# both must read HOST state (the numpy block table, request token
+# lists, the tenant counter dict); fetching device lengths to count a
+# tenant's blocks would stall admission itself. Checked as if it were
+# skypilot_tpu/infer/engine.py (the hot-loop scope). Never imported.
+import numpy as np
+
+
+class InferenceEngine:
+    def _sync_kv_charge(self, slot, tenant=None):
+        row = np.asarray(self.cache["table"][slot])  # expect: host-sync
+        have = int(self.cache["length"][slot])       # expect: host-sync
+        self._slot_kv_charge[slot] = (tenant, have)
+        return row
+
+    def _kv_quota_blocked(self, req):
+        used = self.cache["kv_used"].item()          # expect: host-sync
+        return used >= self._kv_quota(req.tenant)

@@ -1,0 +1,20 @@
+# Clean twin: the per-tenant KV-block quota / charge bookkeeping done
+# right — the charge is counted from the host numpy block table, the
+# quota check from host-tracked request state and the tenant counter
+# dict; the device is never consulted. Never imported.
+
+
+class InferenceEngine:
+    def _sync_kv_charge(self, slot, tenant=None):
+        row = self.block_table[slot]
+        have = len(row[row < self.n_kv_blocks])
+        if tenant is not None and have:
+            self._slot_kv_charge[slot] = (tenant, have)
+        else:
+            self._slot_kv_charge.pop(slot, None)
+
+    def _kv_quota_blocked(self, req):
+        need = self._need_blocks(
+            req, len(req.prompt) + len(req.tokens))
+        used = self._tenant_kv.get(req.tenant, 0)
+        return used + need > self._kv_quota(req.tenant)

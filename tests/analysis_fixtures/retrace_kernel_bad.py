@@ -1,0 +1,33 @@
+# Golden fixture: seeded retrace-safety violations in the Pallas
+# paged-attention kernel shape — the exact mistakes the kernel path
+# invites: deriving the span sweep from TRACED lengths instead of
+# taking it as a static argument, pulling the block table to the host
+# inside the wrapper, and concretizing/branching INSIDE the kernel
+# body (which is only reachable through the ``functools.partial``
+# the pallas_call idiom wraps it in — the v3 reachability extension).
+# Checked as if it lived at skypilot_tpu/infer/ (a jit-root
+# directory). Never imported.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _kernel(table_ref, q_ref, k_ref, o_ref, *, span_blocks):
+    j = int(table_ref[0])                         # expect: concretize
+    if (q_ref[...] > 0).any():                    # expect: traced-branch
+        o_ref[...] = q_ref[...] + j
+
+
+def paged_attn(q, k_pool, table, lengths):
+    span_blocks = int(jnp.max(lengths))           # expect: concretize
+    host_table = np.asarray(table)                # expect: host-transfer
+    kernel = functools.partial(_kernel, span_blocks=span_blocks)
+    cols = jnp.arange(jnp.max(lengths))           # expect: dynamic-shape
+    return kernel, host_table, cols
+
+
+@jax.jit
+def decode_step(cache, table, lengths):
+    return paged_attn(cache["q"], cache["k"], table, lengths)

@@ -758,6 +758,86 @@ def test_service_spec_ttft_round_trip():
     assert again.target_ttft_p95_seconds == 2.0
 
 
+# -- per-tenant KV-block quotas (max_kv_blocks) -----------------------------
+
+def test_kv_quota_stalls_tenant_not_queue(params, cfg):
+    """A tenant at its max_kv_blocks quota stalls TYPED: its request
+    steps aside (counter fires once per episode, never a 503) while
+    other tenants keep admitting, its own retirement unblocks it, and
+    the charge/refund accounting drains to exactly zero."""
+    qcfg = qos_lib.QosConfig(enabled=True, tenants={
+        "hog": qos_lib.TenantSpec(max_kv_blocks=1)})
+    e = eng.InferenceEngine(
+        params, cfg, n_slots=3, max_len=64, prompt_buckets=(16,),
+        kv_block=16, prefix_pool=0,
+        qos=qos_lib.FairScheduler(qcfg))
+    stalls = eng.QOS_KV_QUOTA_STALLS.labels(tenant="hog")
+    before = stalls.value
+    # prompt 3 + budget 4 = 7 rows -> 1 block each: hog's first
+    # request fills its quota, its second must wait for the refund.
+    e.add_request([1, 2, 3], max_new_tokens=4, tenant="hog")
+    e.add_request([4, 5, 6], max_new_tokens=4, tenant="hog")
+    e.add_request([7, 8, 9], max_new_tokens=4, tenant="bg")
+    e.admit()
+    assert sorted(r.tenant for r in e.slot_req.values()) \
+        == ["bg", "hog"]                    # hog's 2nd stepped aside
+    assert len(e.waiting) == 1 and e.waiting[0].kv_quota_stalled
+    assert e._tenant_kv["hog"] == 1
+    assert eng.QOS_KV_BLOCKS.labels(tenant="hog").value == 1
+    assert stalls.value == before + 1
+    e.admit()                               # still at quota: once per
+    assert stalls.value == before + 1       # episode, not per pass
+    done = e.run_to_completion(max_burst=4)
+    assert len(done) == 3                   # the retirement freed the
+    assert not e.waiting and not e.slot_req  # quota; the 2nd ran
+    assert not e._tenant_kv                 # charges pop at zero
+    assert eng.QOS_KV_BLOCKS.labels(tenant="hog").value == 0
+
+
+def test_kv_quota_unsatisfiable_rejected_at_submit(params, cfg):
+    """A request whose own worst-case block need exceeds its tenant's
+    quota can NEVER admit (the need formula is total-shaped and never
+    shrinks) — it must be rejected typed at submit, not stalled
+    forever."""
+    qcfg = qos_lib.QosConfig(enabled=True, tenants={
+        "hog": qos_lib.TenantSpec(max_kv_blocks=1)})
+    e = eng.InferenceEngine(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=(16,),
+        kv_block=16, prefix_pool=0,
+        qos=qos_lib.FairScheduler(qcfg))
+    with pytest.raises(eng.KvQuotaUnsatisfiableError) as ei:
+        # prompt 3 + budget 60 -> capped at max_len 64 -> 4 blocks.
+        e.add_request([1, 2, 3], max_new_tokens=60, tenant="hog")
+    assert ei.value.typed_error["type"] == "kv_quota_unsatisfiable"
+    assert not e.waiting                    # nothing half-submitted
+    # Other tenants (unlimited) are untouched by the hog's cap.
+    e.add_request([1, 2, 3], max_new_tokens=60, tenant="bg")
+    e.admit()
+    assert len(e.slot_req) == 1
+
+
+def test_kv_quota_unconfigured_tenant_unlimited(params, cfg):
+    """max_kv_blocks=0 (the default spec) never stalls — the quota is
+    an explicit operator opt-in per tenant."""
+    e = eng.InferenceEngine(
+        params, cfg, n_slots=2, max_len=64, prompt_buckets=(16,),
+        kv_block=16, prefix_pool=0,
+        qos=qos_lib.FairScheduler(qos_lib.QosConfig(enabled=True)))
+    for i in range(2):
+        e.add_request([1 + i, 2, 3], max_new_tokens=4, tenant="any")
+    e.admit()
+    assert len(e.slot_req) == 2 and not e.waiting
+
+
+def test_kv_quota_spec_parses_from_env(monkeypatch):
+    monkeypatch.setenv(
+        "SKYTPU_QOS_TENANTS",
+        '{"free": {"rate": 2, "max_kv_blocks": 64}}')
+    qcfg = qos_lib.QosConfig.from_env()
+    assert qcfg.tenant("free").max_kv_blocks == 64
+    assert qcfg.tenant("other").max_kv_blocks == 0
+
+
 # -- bench wiring -----------------------------------------------------------
 
 def test_bench_qos_smoke():

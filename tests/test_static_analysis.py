@@ -151,6 +151,16 @@ _GOLDEN = [
     # a device fetch to rank tenants stalls the admission pipeline.
     ("host-sync", "host_sync_qos_bad.py", "host_sync_qos_clean.py",
      "skypilot_tpu/infer/qos.py"),
+    # Paged-attention kernel (PR 12): Pallas kernel bodies are
+    # reachable through their functools.partial wrappers (the
+    # pallas_call idiom; retrace v3) and the per-tenant KV quota /
+    # charge bookkeeping joined the host-sync engine scope (v7).
+    ("retrace-safety", "retrace_kernel_bad.py",
+     "retrace_kernel_clean.py",
+     "skypilot_tpu/infer/fixture_retrace_kernel.py"),
+    ("host-sync", "host_sync_kernel_bad.py",
+     "host_sync_kernel_clean.py",
+     "skypilot_tpu/infer/engine.py"),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      "skypilot_tpu/utils/fixture_locks.py"),
     ("typed-errors", "typed_errors_bad.py", "typed_errors_clean.py",
