@@ -501,6 +501,35 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"qos bench failed: {e}")
             out["serve_qos_error"] = str(e)[:200]
+        # Multi-LoRA adapter-catalog phase (ROADMAP item 5): N-adapter
+        # mixed decode TPOT vs single-adapter on the same engine.
+        # Gates: overhead <= 1.15x, greedy parity vs per-adapter
+        # sequential runs exact, and ZERO unexpected compiles while
+        # adapters hot-load/evict mid-traffic (adapter count/identity
+        # must never enter program identity).
+        try:
+            from skypilot_tpu.infer import bench_serve as _bs
+            adp = _bs.run_adapters(config=serve_cfg, weights_int8=big,
+                                   kv_int8=big)
+            out["serve_adapter_overhead"] = adp["overhead_ratio"]
+            out["serve_adapter_tpot_single_ms"] = adp["tpot_single_ms"]
+            out["serve_adapter_tpot_mixed_ms"] = adp["tpot_mixed_ms"]
+            out["serve_adapter_parity_ok"] = adp["parity_ok"]
+            out["serve_adapter_hot_loads"] = adp["hot_loads"]
+            out["serve_adapter_unexpected_compiles"] = \
+                adp["unexpected_compiles"]
+            out["serve_adapter_regressed"] = bool(
+                adp["overhead_ratio"] > 1.15
+                or not adp["parity_ok"]
+                or adp["unexpected_compiles"] != 0)
+            if out["serve_adapter_regressed"]:
+                log("SERVE ADAPTER REGRESSION: "
+                    f"x{adp['overhead_ratio']} (> 1.15) or parity "
+                    f"broken (parity_ok={adp['parity_ok']}, "
+                    f"unexpected={adp['unexpected_compiles']})")
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"adapter bench failed: {e}")
+            out["serve_adapter_error"] = str(e)[:200]
         # Flight recorder + compile watch phase: the introspection
         # contract over the full mixed workload (chunked admission +
         # spec decode + span regrouping, paged + contiguous). Gates:

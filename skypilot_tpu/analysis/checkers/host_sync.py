@@ -67,6 +67,21 @@ _SCOPES: Dict[str, Set[str]] = {
         # fetch to count a tenant's blocks would stall admission.
         "_kv_quota", "_kv_quota_blocked", "_set_tenant_kv",
         "_sync_kv_charge",
+        # Multi-LoRA adapter catalog (PR 13): acquire/release and the
+        # per-slot adapter-id bookkeeping run at every claim/retire,
+        # and the aid device-copy cache mirrors table_device — all
+        # host dict/array work; a device fetch to pick a pool slot
+        # would stall admission exactly like a block-count fetch.
+        "_acquire_adapter", "_release_adapter", "_set_slot_adapter",
+        "aid_device", "_lora_args", "_fail_request",
+    },
+    # Adapter-catalog residency bookkeeping: acquire runs at every
+    # claim (the hot-load inside it is a cold path by design — a
+    # demand load IS a device dispatch — but the bookkeeping around
+    # it must stay pure host work), release at every retire.
+    "skypilot_tpu/infer/adapters.py": {
+        "acquire", "release", "_grab_slot", "check", "names",
+        "resident_count", "slot_names", "pins",
     },
     # QoS scheduler + admission control: the DRR reorder runs on the
     # engine loop before every admission pass and the admission check
@@ -111,7 +126,11 @@ class HostSyncChecker(Checker):
     # v7: paged-attention kernel rollout (PR 12) — the per-tenant
     #     KV-block quota/charge bookkeeping joined the engine scope;
     #     the bump rescans the edited dispatch seam cold.
-    version = 7
+    # v8: multi-LoRA adapter catalog (PR 13) — the engine's adapter
+    #     acquire/release/aid bookkeeping and the catalog's residency
+    #     path (infer/adapters.py) joined the scope; the bump rescans
+    #     the edited claim/retire hot path cold.
+    version = 8
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

@@ -99,7 +99,12 @@ class RetraceSafetyChecker(Checker):
     # body from reachability), covering ops/paged_attention.py's
     # kernel + wrapper and the kvcache dispatch seam; the bump
     # rescans the edited hot path and the new fixtures cold.
-    version = 3
+    # v4: multi-LoRA adapter gathers (PR 13) — the per-slot (A, B)
+    # delta helpers and the adapter-pool install program joined the
+    # jit-reachable surface (kvcache lora plumbing + engine
+    # _adapter_install); the bump rescans the edited programs and the
+    # new adapter fixtures cold.
+    version = 4
 
     def check_project(self, ctxs: Sequence[FileContext],
                       root: str) -> List[Finding]:

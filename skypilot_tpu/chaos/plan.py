@@ -52,6 +52,11 @@ KNOWN_POINTS: Dict[str, str] = {
         "QoS admission decision at the model server and the load "
         "balancer; a fault forces a typed 429 shed for the request "
         "(ctx: tenant, where=server|lb)",
+    "adapter.load":
+        "adapter-catalog hot-load attempt (checkpoint fetch + device "
+        "pool install); a transient fault retries via utils/retry, "
+        "exhaustion fails the request typed adapter_load_failed — "
+        "never a silent fall-through to the base model (ctx: adapter)",
     "train.checkpoint_save":
         "checkpoint save dispatch (ctx: step)",
     "train.checkpoint_restore":

@@ -449,6 +449,17 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
                 misses = gauge("skytpu_prefix_cache_misses_total") or 0
                 if hits is not None and (hits + misses) > 0:
                     line += f"  cache {hits / (hits + misses):4.0%}"
+        # Adapter catalog (docs/serving.md §Adapter catalog): resident
+        # fine-tunes / pool capacity fleet-wide, plus the hot-load
+        # rate when demand loads happened between frames — catalog
+        # churn (thrashing) is visible at a glance.
+        ad_active = gauge("skytpu_adapter_active")
+        ad_slots = gauge("skytpu_adapter_slots")
+        if ad_active is not None and ad_slots:
+            line += f"  adapters {ad_active:.0f}/{ad_slots:.0f}"
+            ld = rate("skytpu_adapter_loads_total")
+            if ld:
+                line += f" (ld {ld:.2f}/s)"
         # Compile watch (docs/observability.md §Flight recorder):
         # programs compiled fleet-wide, and — the alarm column — how
         # many compiled AFTER an engine declared warmup complete.

@@ -1286,6 +1286,183 @@ def run_kernel_smoke() -> dict:
     return run_kernel(smoke=True)
 
 
+def run_adapters(config=None, n_adapters=8, requests=None,
+                 prompt_len=None, new_tokens=None, max_burst=8,
+                 kv_int8=False, weights_int8=False, spec_k=0,
+                 smoke=False) -> dict:
+    """Multi-LoRA adapter-catalog bench (docs/serving.md §Adapter
+    catalog): N-adapters-vs-1 decode TPOT overhead on the SAME engine.
+
+    Three phases, one engine:
+
+    1. BASELINE — every request generates under ONE fine-tune
+       (decode gathers one pool slot's (A, B) per layer).
+    2. MIXED — the same requests spread over ``n_adapters``
+       fine-tunes in one continuous batch. The gather indexes differ;
+       the program is IDENTICAL (adapter id is slot data, exactly like
+       the span rung), so the overhead gate (bench.py:
+       ``serve_adapter_overhead`` <= 1.15x) is pure gather cost.
+       Greedy parity is asserted against per-request sequential runs
+       — a mixed batch must emit exactly what each fine-tune emits
+       alone.
+    3. HOT-LOAD CHURN — more fine-tunes than pool slots cycle through
+       traffic under ``declare_warmup_complete``: every demand load is
+       an LRU evict + install DISPATCH, and the compile watch gates
+       ZERO unexpected compiles (adapter count/identity never enters
+       program identity — the ROADMAP item 5 watch item).
+
+    ``smoke=True`` / CPU: CI-sized; wall-clock is reported, the 1.15x
+    gate binds via bench.py (structure/parity/compile gates bind
+    everywhere).
+    """
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from skypilot_tpu.infer import adapters as ad_lib
+    from skypilot_tpu.infer import engine as eng
+    from skypilot_tpu.models import llama
+
+    on_cpu = jax.default_backend() == "cpu"
+    if config is None:
+        config = "llama3-tiny" if on_cpu else "llama3-400m"
+    small = smoke or on_cpu
+    cfg = llama.CONFIGS[config]
+    rank = 4 if small else 16
+    if requests is None:
+        requests = n_adapters if small else 2 * n_adapters
+    if prompt_len is None:
+        prompt_len = 16 if small else 128
+    if new_tokens is None:
+        new_tokens = 32 if small else 256
+    slots = requests
+    max_len = 256 if small else 2048
+    log(f"adapter bench: {config} rank={rank} n_adapters={n_adapters} "
+        f"requests={requests}")
+
+    catalog = ad_lib.AdapterCatalog(cfg, n_adapters=n_adapters + 1,
+                                    rank=rank)
+    shapes = ad_lib.target_shapes(cfg, rank)
+    L = cfg.n_layers
+    # Registered fine-tunes: n_adapters for the mixed phase plus as
+    # many again for the churn phase (they cannot all be resident).
+    names = [f"ft-{i}" for i in range(2 * n_adapters)]
+    for i, name in enumerate(names):
+        r = np.random.default_rng(100 + i)
+        catalog.register(name, params={
+            t: {"a": r.normal(size=(L,) + sa).astype(np.float32) * 0.02,
+                "b": r.normal(size=(L,) + sb).astype(np.float32) * 0.02}
+            for t, (sa, sb) in shapes.items()})
+
+    kw = dict(n_slots=slots, max_len=max_len,
+              prompt_buckets=(prompt_len,), kv_int8=kv_int8,
+              prefill_chunk=0, prefix_pool=0, max_wave=slots,
+              pad_waves=True, spec_k=spec_k, adapters=catalog)
+    if weights_int8:
+        from skypilot_tpu.infer import kvcache
+        params, qw = kvcache.random_quantized_params(cfg)
+        e = eng.InferenceEngine(params, cfg, qweights=qw, **kw)
+    else:
+        params = llama.init_params(jax.random.key(0), cfg)
+        e = eng.InferenceEngine(params, cfg, **kw)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(requests)]
+
+    # Production startup: pre-compile the grid (incl. the adapter
+    # gather + hot-load programs), then arm the compile watch — every
+    # phase below runs under the zero-unexpected-compiles contract.
+    e.warm_programs(max_burst=max_burst)
+    e.declare_warmup_complete()
+
+    def decode_pass(adapter_names):
+        ids = [e.add_request(p, max_new_tokens=new_tokens, adapter=a)
+               for p, a in zip(prompts, adapter_names)]
+        e.admit()
+        t0 = _time.time()
+        while e.slot_req:
+            e.decode_burst(max_burst)
+        float(e.cache["length"][0])     # honest host sync
+        wall = _time.time() - t0
+        by_rid = {r.rid: list(r.tokens) for r in e.finished}
+        outs = [by_rid[i] for i in ids]
+        e.finished.clear()
+        dtoks = sum(len(o) for o in outs) - len(outs)
+        return outs, wall / max(dtoks, 1)
+
+    single = [names[0]] * requests
+    mixed = [names[i % n_adapters] for i in range(requests)]
+
+    # Warm both gather patterns' caches/adapters outside the window.
+    decode_pass(single)
+    decode_pass(mixed)
+
+    out_single, tpot_single = decode_pass(single)
+    out_mixed, tpot_mixed = decode_pass(mixed)
+
+    # Greedy parity: the mixed batch must emit exactly what each
+    # fine-tune emits alone (sequential single-request passes).
+    parity_ok = True
+    for p, a, want in zip(prompts, mixed, out_mixed):
+        rid = e.add_request(p, max_new_tokens=new_tokens, adapter=a)
+        e.admit()
+        while e.slot_req:
+            e.decode_burst(max_burst)
+        got = {r.rid: list(r.tokens) for r in e.finished}[rid]
+        e.finished.clear()
+        if got != want:
+            parity_ok = False
+            break
+
+    # Hot-load churn: cycle through 2x the pool's fine-tunes under
+    # live decode — every wave demand-loads (LRU evict + install),
+    # and nothing may compile.
+    loads_before = catalog.loads
+    for i in range(0, len(names), n_adapters):
+        batch = [names[(i + j) % len(names)]
+                 for j in range(min(n_adapters, requests))]
+        for p, a in zip(prompts, batch):
+            e.add_request(p, max_new_tokens=4, adapter=a)
+        e.run_to_completion()
+        e.finished.clear()
+    churn_loads = catalog.loads - loads_before
+    unexpected = list(e.compile_watch.unexpected)
+
+    overhead = tpot_mixed / max(tpot_single, 1e-9)
+    log(f"adapters: single {tpot_single * 1e3:.2f}ms/tok mixed "
+        f"{tpot_mixed * 1e3:.2f}ms/tok (x{overhead:.3f}) "
+        f"parity={parity_ok} churn_loads={churn_loads} "
+        f"evictions={catalog.evictions} unexpected={len(unexpected)}")
+    return {
+        "tpot_single_ms": round(tpot_single * 1e3, 3),
+        "tpot_mixed_ms": round(tpot_mixed * 1e3, 3),
+        # The regression-gate input: bench.py gates <= 1.15x.
+        "overhead_ratio": round(overhead, 3),
+        "parity_ok": bool(parity_ok),
+        "hot_loads": int(churn_loads),
+        "evictions": int(catalog.evictions),
+        "unexpected_compiles": len(unexpected),
+        "n_adapters": n_adapters,
+        "rank": rank,
+        "requests": requests,
+        "new_tokens": new_tokens,
+        "spec_k": spec_k,
+        "backend": jax.default_backend(),
+        "config": config,
+        "kv_int8": kv_int8,
+        "weights_int8": weights_int8,
+    }
+
+
+def run_adapters_smoke() -> dict:
+    """CI-sized adapter-catalog pass (tier-1 wiring:
+    tests/test_adapters.py asserts parity, churn and the
+    zero-compile contract; CPU wall-clock is reported, the 1.15x
+    TPOT gate binds via bench.py)."""
+    return run_adapters(smoke=True, n_adapters=4)
+
+
 def run_flight(config=None, requests=None, new_tokens=None,
                max_burst=8, spec_k=4, kv_int8=False,
                weights_int8=False, smoke=False) -> dict:
@@ -1725,6 +1902,18 @@ def main() -> None:
                          "eviction greedy parity with the allocator "
                          "audit (combine with --smoke for the "
                          "CI-sized pass)")
+    ap.add_argument("--adapters", action="store_true",
+                    help="multi-LoRA adapter-catalog bench: N-adapter "
+                         "mixed-workload decode TPOT vs a single-"
+                         "adapter baseline on the same engine, greedy "
+                         "parity vs per-adapter sequential runs, and "
+                         "zero unexpected compiles while adapters "
+                         "hot-load/evict mid-traffic (combine with "
+                         "--smoke for the CI-sized pass)")
+    ap.add_argument("--n-adapters", type=int, default=8,
+                    help="fine-tunes in the mixed workload for "
+                         "--adapters (pool sized to hold them; the "
+                         "churn phase registers 2x as many)")
     ap.add_argument("--flight", action="store_true",
                     help="flight recorder + compile watch bench: the "
                          "full mixed workload (chunked admission + "
@@ -1735,6 +1924,23 @@ def main() -> None:
                          "recorder-off no-op guard (combine with "
                          "--smoke for the CI-sized pass)")
     args = ap.parse_args()
+    if args.adapters:
+        r = run_adapters(config=args.config,
+                         n_adapters=args.n_adapters,
+                         kv_int8=args.kv_int8,
+                         weights_int8=args.weights_int8,
+                         spec_k=(args.spec_k if args.spec else 0),
+                         smoke=args.smoke)
+        print(json.dumps({
+            "metric": "serve_adapter_overhead",
+            "value": r["overhead_ratio"],
+            "unit": "x_mixed_decode_tpot_vs_single",
+            **{k: r[k] for k in (
+                "tpot_single_ms", "tpot_mixed_ms", "parity_ok",
+                "hot_loads", "evictions", "unexpected_compiles",
+                "n_adapters", "rank", "backend", "config")},
+        }))
+        return
     if args.qos:
         r = run_qos(config=args.config, kv_int8=args.kv_int8,
                     weights_int8=args.weights_int8, smoke=args.smoke)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.infer import adapters as adapters_lib
 from skypilot_tpu.infer import kvcache, sampling
 from skypilot_tpu.infer import qos as qos_lib
 from skypilot_tpu.models import llama
@@ -203,6 +204,19 @@ class Request:
     # because its tenant is at max_kv_blocks — the typed stall event
     # and counter fire once per episode, not once per admission pass.
     kv_quota_stalled: bool = False
+    # Multi-LoRA adapter catalog (docs/serving.md §Adapter catalog):
+    # ``adapter`` names the fine-tune this request generates under
+    # (None = the base model); ``adapter_slot`` is the device pool
+    # slot serving it (0 = the all-zeros base adapter), assigned at
+    # claim; ``adapter_pinned`` tracks the catalog's in-flight
+    # refcount so release happens exactly once per acquire; ``error``
+    # carries a typed failure body (adapter load failure) the server
+    # returns instead of generated tokens — a failed adapter load
+    # must NEVER silently fall through to the base model's weights.
+    adapter: Optional[str] = None
+    adapter_slot: int = 0
+    adapter_pinned: bool = False
+    error: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -298,6 +312,11 @@ class PrefixIndex:
     cacheable at every multiple of ``block`` tokens, keyed by a
     blake2b-128 digest of the token bytes (content-addressed — a
     Python ``hash`` collision would silently serve the wrong prefix).
+    ``salt`` prefixes every digest: the engine feeds the request's
+    ADAPTER identity through it, because stored K/V rows carry the
+    fine-tune's wk/wv deltas — without the salt, two adapters sharing
+    a prompt prefix would share cached K/V computed under whichever
+    stored first (silently serving the wrong model).
     One ENTRY holds one stored prefix; every chunk-multiple key of
     that prefix points at the entry, so a shorter shared prefix hits
     it too. Eviction is LRU over entries (a hit or a store bumps the
@@ -322,9 +341,10 @@ class PrefixIndex:
         self._ent_keys: Dict[Any, set] = {}
         self._ent_used: Dict[Any, int] = {}            # payload -> LRU
 
-    def _digest(self, prompt: List[int], n: int) -> bytes:
+    def _digest(self, prompt: List[int], n: int,
+                salt: bytes = b"") -> bytes:
         return hashlib.blake2b(
-            np.asarray(prompt[:n], np.int64).tobytes(),
+            salt + np.asarray(prompt[:n], np.int64).tobytes(),
             digest_size=16).digest()
 
     def eligible(self, prompt: List[int]) -> bool:
@@ -335,12 +355,14 @@ class PrefixIndex:
     def payloads(self) -> List[Any]:
         return list(self._ent_used)
 
-    def lookup(self, prompt: List[int]) -> Optional[Tuple[Any, int]]:
-        """Longest resident chunk-aligned proper prefix of ``prompt``;
-        returns (payload, cached_len) and bumps the entry's LRU
-        stamp."""
+    def lookup(self, prompt: List[int],
+               salt: bytes = b"") -> Optional[Tuple[Any, int]]:
+        """Longest resident chunk-aligned proper prefix of ``prompt``
+        (under ``salt`` — the adapter-identity namespace); returns
+        (payload, cached_len) and bumps the entry's LRU stamp."""
         for k in range((len(prompt) - 1) // self.block, 0, -1):
-            ent = self._keys.get(self._digest(prompt, k * self.block))
+            ent = self._keys.get(
+                self._digest(prompt, k * self.block, salt))
             if ent is not None:
                 self._tick += 1
                 self._ent_used[ent[0]] = self._tick
@@ -385,7 +407,7 @@ class PrefixIndex:
         return row, evicted
 
     def insert_entry(self, prompt: List[int], n_tokens: int,
-                     payload) -> List[Any]:
+                     payload, salt: bytes = b"") -> List[Any]:
         """Paged payloads: admit a new entry, evicting LRU entries past
         the ``rows`` cap. Returns the evicted payloads (caller decrefs
         their blocks)."""
@@ -397,16 +419,16 @@ class PrefixIndex:
             evicted.append(p)
         self._tick += 1
         self._ent_used[payload] = self._tick
-        self.register(prompt, n_tokens, payload)
+        self.register(prompt, n_tokens, payload, salt)
         return evicted
 
     def register(self, prompt: List[int], n_tokens: int,
-                 payload) -> None:
+                 payload, salt: bytes = b"") -> None:
         """Point every not-yet-resident chunk multiple <= n_tokens at
         ``payload`` (shorter multiples already resident keep their
         entry — both copies hold identical bytes)."""
         for k in range(1, n_tokens // self.block + 1):
-            d = self._digest(prompt, k * self.block)
+            d = self._digest(prompt, k * self.block, salt)
             if d not in self._keys:
                 self._keys[d] = (payload, k * self.block)
                 self._ent_keys.setdefault(payload, set()).add(d)
@@ -523,7 +545,9 @@ class InferenceEngine:
                  kv_kernel: Optional[bool] = None,
                  flight_recorder: Optional[
                      flight_lib.FlightRecorder] = None,
-                 qos: Optional[qos_lib.FairScheduler] = None):
+                 qos: Optional[qos_lib.FairScheduler] = None,
+                 adapters: Optional[
+                     adapters_lib.AdapterCatalog] = None):
         self.params = params
         # Multi-tenant QoS: a FairScheduler reorders ``waiting`` into
         # priority lanes + DRR interleave before each admission pass
@@ -793,6 +817,24 @@ class InferenceEngine:
                     mesh, rules)
         self.rng = jax.random.key(seed)
 
+        # Multi-LoRA adapter catalog (docs/serving.md §Adapter
+        # catalog): a device-resident stacked (A, B) pool + host LRU
+        # hot-load/evict. Per-slot adapter ids live in a host numpy
+        # array with a dirty-tracked device copy — EXACTLY the block-
+        # table idiom — and ride every program as data, so adapter
+        # count/identity never enters program identity (the compile
+        # watch is the guard). None (the default) is the zero-cost
+        # adapterless path: every program traces exactly as before.
+        self.adapters = adapters
+        if adapters is not None:
+            self.adapter_ids = np.zeros((n_slots + 1,), np.int32)
+            self._aid_dev = None
+            self._aid_dirty = True
+        else:
+            self.adapter_ids = None
+            self._aid_dev = None
+            self._aid_dirty = False
+
         # Per-tenant KV-block quotas (qos tenant spec max_kv_blocks):
         # blocks a slot's table references are charged to its tenant
         # at claim/growth and refunded when the slot's blocks free.
@@ -835,12 +877,14 @@ class InferenceEngine:
         @functools.partial(jax.jit, donate_argnums=(1, 5),
                            static_argnames=("bucket",))
         def _admit_wave(params, cache, tokens_b, true_lens, slots, rng,
-                        table=None, *, bucket, qweights=None):
+                        table=None, lora=None, aid=None, *, bucket,
+                        qweights=None):
             del bucket
             from jax import lax as _lax
             rng, sub = jax.random.split(rng)
             prefix, logits = kvcache.prefill_batch(
-                params, tokens_b, true_lens, cfg, qweights=qweights)
+                params, tokens_b, true_lens, cfg, qweights=qweights,
+                lora=lora, aid=aid)
             first = sampling.sample(logits, sub, sp)      # [W]
 
             def ins(c, w):
@@ -860,11 +904,12 @@ class InferenceEngine:
         @functools.partial(jax.jit, donate_argnums=(1, 2),
                            static_argnames=("span",))
         def _decode(params, cache, rng, active, table=None,
-                    qweights=None, *, span=None):
+                    lora=None, aid=None, qweights=None, *, span=None):
             rng, sub = jax.random.split(rng)
             cache, logits = kvcache.decode_step(params, cache, cfg,
                                                 qweights=qweights,
-                                                table=table, span=span)
+                                                table=table, span=span,
+                                                lora=lora, aid=aid)
             toks = sampling.sample(logits, sub, sp)
             cache = kvcache.commit_tokens(cache, toks, active)
             return cache, rng, toks
@@ -879,12 +924,13 @@ class InferenceEngine:
         # per-step cache updates on an 8B model).
         @functools.partial(jax.jit, donate_argnums=(1, 2),
                            static_argnames=("k", "span", "kernel"))
-        def _decode_burst(params, cache, rng, active, table=None, *, k,
+        def _decode_burst(params, cache, rng, active, table=None,
+                          lora=None, aid=None, *, k,
                           qweights=None, span=None, kernel=False):
             return kvcache.decode_burst_staged(
                 params, cache, rng, active, k, cfg, sp,
                 qweights=qweights, table=table, span=span,
-                kv_kernel=kernel)
+                kv_kernel=kernel, lora=lora, aid=aid)
 
         # Speculative verify: the decode_burst_staged formulation with
         # the sampled-token feedback replaced by the host's draft
@@ -894,11 +940,12 @@ class InferenceEngine:
         @functools.partial(jax.jit, donate_argnums=(1,),
                            static_argnames=("k", "span", "kernel"))
         def _verify(params, cache, draft, n_draft, active, table=None,
-                    *, k, qweights=None, span=None, kernel=False):
+                    lora=None, aid=None, *, k, qweights=None,
+                    span=None, kernel=False):
             return kvcache.verify_draft_staged(
                 params, cache, draft, n_draft, active, k, cfg,
                 qweights=qweights, table=table, span=span,
-                kv_kernel=kernel)
+                kv_kernel=kernel, lora=lora, aid=aid)
 
         # Chunked-prefill programs: ONE chunk program (two traces: the
         # ``final`` variant samples the first token and splits the RNG)
@@ -907,12 +954,14 @@ class InferenceEngine:
         @functools.partial(jax.jit, donate_argnums=(1,),
                            static_argnames=("final", "span", "kernel"))
         def _prefill_chunk(params, cache, tokens_c, start, n_valid,
-                           slot, new_len, rng, table=None, *, final,
+                           slot, new_len, rng, table=None, lora=None,
+                           aid=None, *, final,
                            qweights=None, span=None, kernel=False):
             return kvcache.prefill_chunk(
                 params, cache, tokens_c, start, n_valid, slot, new_len,
                 rng, cfg, sp, final=final, qweights=qweights,
-                table=table, span=span, kv_kernel=kernel)
+                table=table, span=span, kv_kernel=kernel, lora=lora,
+                aid=aid)
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _claim(cache, slot, claim_len):
@@ -929,6 +978,16 @@ class InferenceEngine:
         @functools.partial(jax.jit, donate_argnums=(0,))
         def _copy_block(cache, src, dst):
             return kvcache.copy_block(cache, src, dst)
+
+        # Adapter hot-load: scatter one fine-tune's stacked (A, B)
+        # weights into a pool slot (pool donated — the install is in
+        # place). Weight shapes are pool constants, so ONE program
+        # serves every adapter for the engine's lifetime; it rides the
+        # compile watch and the warm grid like every other entry point,
+        # which is what makes mid-traffic hot-loads compile-free.
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _adapter_install(pool, slot, weights):
+            return adapters_lib.pool_install(pool, slot, weights)
 
         # Every jit entry point rides the compile watch: a program key
         # is (entry point, static args) — plus the wave's ROW COUNT,
@@ -952,6 +1011,12 @@ class InferenceEngine:
         self._pool_load_fn = watch("pool_load", _pool_load)
         self._pool_store_fn = watch("pool_store", _pool_store)
         self._copy_block_fn = watch("copy_block", _copy_block)
+        self._adapter_install_fn = watch("adapter_load",
+                                         _adapter_install)
+        if self.adapters is not None:
+            self.adapters.bind_loader(
+                lambda pool, slot, weights: self._adapter_install_fn(
+                    pool, jnp.asarray(slot, jnp.int32), weights))
 
     # -- admission ---------------------------------------------------------
 
@@ -979,13 +1044,15 @@ class InferenceEngine:
                     max_new_tokens: int = 128,
                     trace_ctx: Optional[tracing.SpanContext] = None,
                     tenant: str = qos_lib.DEFAULT_TENANT,
-                    priority: int = 0) -> int:
+                    priority: int = 0,
+                    adapter: Optional[str] = None) -> int:
         _bucket(len(prompt), self.buckets)   # validate length up front
         self.check_kv_quota(tenant, len(prompt), max_new_tokens)
+        self.check_adapter(adapter)          # unknown name -> typed 404
         req = Request(rid=self._next_rid, prompt=list(prompt),
                       max_new_tokens=max_new_tokens, submit_s=time.time(),
                       eos_id=self.eos_id, tenant=tenant,
-                      priority=priority)
+                      priority=priority, adapter=adapter)
         # Per-request span identity, minted at submit so child spans
         # recorded before retirement can already parent to it. The
         # parent comes from the caller's explicit context (the HTTP
@@ -1055,6 +1122,16 @@ class InferenceEngine:
             extra["lazy_grows"] = lazy
         if compiled:
             extra["compiled"] = compiled
+        if self.adapters is not None and reqs:
+            # Per-burst adapter composition (host dict over the
+            # request list): `skytpu flight` and the bench read which
+            # fine-tunes shared each dispatch straight off records.
+            ads: Dict[str, int] = {}
+            for r in reqs:
+                if r.adapter:
+                    ads[r.adapter] = ads.get(r.adapter, 0) + 1
+            if ads:
+                extra["adapters"] = ads
         if self.qos is not None and reqs:
             # Per-burst tenant/priority composition (host dict builds
             # over the request list): the chaos fairness scenario and
@@ -1111,19 +1188,20 @@ class InferenceEngine:
         active[spare] = True
         active_dev = jnp.asarray(active)
         spans = [self._span_arg(s) for s in self.span_ladder]
+        lora_kw = self._lora_args()
         with metrics.suppress():
             for sarg in spans:
                 self.cache, self.rng, _ = self._decode_fn(
                     self.params, self.cache, self.rng, active_dev,
                     self.table_device(), qweights=self.qweights,
-                    span=sarg)
+                    span=sarg, **lora_kw)
                 k = 1
                 while k <= max_burst:
                     self.cache, self.rng, _ = self._decode_burst_fn(
                         self.params, self.cache, self.rng, active_dev,
                         self.table_device(), k=k,
                         qweights=self.qweights, span=sarg,
-                        kernel=self.kv_kernel)
+                        kernel=self.kv_kernel, **lora_kw)
                     k *= 2
                 if self.spec_k:
                     draft = jnp.zeros((self.n_slots + 1, self.spec_k),
@@ -1133,7 +1211,7 @@ class InferenceEngine:
                         self.params, self.cache, draft, n_draft,
                         active_dev, self.table_device(), k=self.spec_k,
                         qweights=self.qweights, span=sarg,
-                        kernel=self.kv_kernel)
+                        kernel=self.kv_kernel, **lora_kw)
                 if self.prefill_chunk:
                     chunk = jnp.zeros((self.prefill_chunk,), jnp.int32)
                     for final in (False, True):
@@ -1146,7 +1224,8 @@ class InferenceEngine:
                                 jnp.asarray(self.max_len, jnp.int32),
                                 self.rng, self.table_device(),
                                 final=final, qweights=self.qweights,
-                                span=sarg, kernel=self.kv_kernel)
+                                span=sarg, kernel=self.kv_kernel,
+                                **lora_kw)
             # Admission waves: pad_waves pins every wave at max_wave
             # rows, so one program per bucket suffices. Unpadded
             # engines pad each wave to the next power of two of its
@@ -1166,12 +1245,17 @@ class InferenceEngine:
                     tokens_b = np.ones((rows, bucket), np.int32)
                     true_lens = np.ones((rows,), np.int32)
                     slot_ids = np.full((rows,), spare, np.int32)
+                    wave_lora = {}
+                    if self.adapters is not None:
+                        wave_lora = {
+                            "lora": self.adapters.pool,
+                            "aid": jnp.zeros((rows,), jnp.int32)}
                     self.cache, self.rng, _ = self._admit_wave_fn(
                         self.params, self.cache, jnp.asarray(tokens_b),
                         jnp.asarray(true_lens),
                         jnp.asarray(slot_ids), self.rng,
                         self.table_device(), bucket=bucket,
-                        qweights=self.qweights)
+                        qweights=self.qweights, **wave_lora)
             # The admission path's small gather/scatter programs.
             claim_len = jnp.asarray(self.max_len, jnp.int32)
             self.cache = self._claim_fn(
@@ -1188,6 +1272,13 @@ class InferenceEngine:
                 self.cache = self._copy_block_fn(
                     self.cache, jnp.asarray(0, jnp.int32),
                     jnp.asarray(0, jnp.int32))
+            if self.adapters is not None:
+                # Warm the hot-load program by installing the all-zero
+                # weights into the base slot (values unchanged): a
+                # demand load mid-traffic must dispatch, not compile.
+                self.adapters.pool = self._adapter_install_fn(
+                    self.adapters.pool, jnp.asarray(0, jnp.int32),
+                    self.adapters.zero_weights())
             # Scrub: zero the length bookkeeping — the sweep's data
             # rows are dead without a length exposing them.
             self.cache["length"] = jnp.zeros_like(self.cache["length"])
@@ -1221,6 +1312,102 @@ class InferenceEngine:
             self._table_dev = jnp.asarray(self.block_table)
             self._table_dirty = False
         return self._table_dev
+
+    # -- adapter catalog ---------------------------------------------------
+
+    def aid_device(self):
+        """The per-slot adapter-id vector as a device array (None when
+        no catalog). Cached between calls — claims/retires mark it
+        dirty — so a steady decode stream pays no per-burst
+        host->device copy (the block-table idiom)."""
+        if self.adapters is None:
+            return None
+        if self._aid_dirty or self._aid_dev is None:
+            self._aid_dev = jnp.asarray(self.adapter_ids)
+            self._aid_dirty = False
+        return self._aid_dev
+
+    def _lora_args(self) -> Dict[str, Any]:
+        """kwargs routing the adapter pool + per-slot ids into a
+        decode-family dispatch ({} on the adapterless path — the
+        programs then trace exactly as before)."""
+        if self.adapters is None:
+            return {}
+        return {"lora": self.adapters.pool, "aid": self.aid_device()}
+
+    def check_adapter(self, name: Optional[str]) -> None:
+        """Submit-time guard (server handler threads, the _bucket
+        idiom): an unknown fine-tune is a clean typed 404 before the
+        request ever rides the inbox. An engine with NO catalog knows
+        no adapters at all."""
+        if name is None:
+            return
+        if self.adapters is None:
+            raise adapters_lib.UnknownAdapterError(name, [])
+        self.adapters.check(name)
+
+    def _acquire_adapter(self, req: Request) -> str:
+        """Pin the request's fine-tune into the device pool at claim
+        time. Returns "ok" (adapter_slot assigned, pin counted),
+        "stall" (every pool slot pinned by in-flight requests — the
+        caller re-queues and retries once a retirement unpins), or
+        "failed" (checkpoint load failed after retries / unknown name:
+        the request has been FAILED TYPED and consumed — it must never
+        silently fall through to the base model's weights)."""
+        if self.adapters is None or req.adapter is None:
+            req.adapter_slot = 0
+            return "ok"
+        try:
+            slot = self.adapters.acquire(req.adapter)
+        except (adapters_lib.AdapterLoadError,
+                adapters_lib.UnknownAdapterError) as e:
+            self._fail_request(req, e)
+            return "failed"
+        if slot is None:
+            return "stall"
+        req.adapter_slot = slot
+        req.adapter_pinned = slot > 0
+        return "ok"
+
+    def _release_adapter(self, req: Request) -> None:
+        """Drop the request's in-flight adapter pin (exactly once per
+        acquire: retirement, preemption, or an abandoned claim)."""
+        if req.adapter_pinned and self.adapters is not None:
+            self.adapters.release(req.adapter_slot)
+            req.adapter_pinned = False
+
+    def _set_slot_adapter(self, slot: int, pool_slot: int) -> None:
+        if self.adapters is None:
+            return
+        if self.adapter_ids[slot] != pool_slot:
+            self.adapter_ids[slot] = pool_slot
+            self._aid_dirty = True
+
+    def _prefix_salt(self, req: Request) -> bytes:
+        """The request's prefix-cache key namespace. Stored K/V rows
+        carry the fine-tune's wk/wv deltas, so cached prefixes are
+        ADAPTER-SPECIFIC: without the salt, two adapters sharing a
+        prompt prefix would hit cached K/V computed under whichever
+        stored first — silently serving the wrong model. Keyed by the
+        adapter's CONTENT digest (warm prefixes survive evict/reload
+        and alias names); base-model requests keep the empty salt
+        (the pre-adapter key space, bit-compatible)."""
+        if self.adapters is None or not req.adapter_slot:
+            return b""
+        return self.adapters.slot_content(req.adapter_slot)
+
+    def _fail_request(self, req: Request, exc: Exception) -> None:
+        """Retire a request with a typed error instead of tokens (the
+        adapter-load failure path). The server returns the body with
+        the error's HTTP status; the engine never substitutes base-
+        model output for a named fine-tune."""
+        req.error = getattr(exc, "typed_error", None) or {
+            "type": "error", "message": str(exc)}
+        if getattr(exc, "http_status", None):
+            req.error = dict(req.error,
+                             http_status=exc.http_status)
+        req.done = True
+        self.finished.append(req)
 
     def _need_blocks(self, req: Request,
                      ctx_len: Optional[int] = None) -> int:
@@ -1449,23 +1636,42 @@ class InferenceEngine:
         else:
             self._slot_kv_charge.pop(slot, None)
 
-    def _wave_claim(self, req: Request) -> Optional[int]:
-        """Claim a slot (+ its KV blocks when paged) for a wave-path
-        request. Returns the slot, or None when the block pool is too
-        dry (the caller re-queues the request)."""
+    def _wave_claim(self, req: Request
+                    ) -> Tuple[str, Optional[int]]:
+        """Claim a slot (+ its KV blocks when paged, + the adapter
+        pool pin when the request names a fine-tune) for a wave-path
+        request. Returns (status, slot): ("ok", slot); ("dry", None)
+        — the block pool is too dry, the caller re-queues and stalls
+        admission globally; ("held", None) — every adapter-pool slot
+        is pinned by in-flight requests, the caller steps THIS request
+        aside (the quota-held idiom — a per-resource limit must not
+        head-of-line-block base-model traffic); ("failed", None) —
+        the adapter failed to load and the request has been FAILED
+        TYPED and consumed."""
+        st = self._acquire_adapter(req)
+        if st == "failed":
+            return "failed", None
+        if st == "stall":
+            return "held", None
         if not self.paged:
-            return self.free_slots.pop(0)
+            slot = self.free_slots.pop(0)
+            self._set_slot_adapter(slot, req.adapter_slot)
+            return "ok", slot
         blocks = self._alloc_blocks(
             self._need_blocks(req, self._ctx_len(req)))
         if blocks is None:
-            return None
+            # The adapter pin must not leak across the re-queue: the
+            # next pass re-acquires (resident slots are warm hits).
+            self._release_adapter(req)
+            return "dry", None
         slot = self.free_slots.pop(0)
         row = self.block_table[slot]
         row[:] = self.n_kv_blocks
         row[:len(blocks)] = blocks
         self._table_dirty = True
         self._sync_kv_charge(slot, req.tenant)
-        return slot
+        self._set_slot_adapter(slot, req.adapter_slot)
+        return "ok", slot
 
     def _free_slot_blocks(self, slot: int) -> None:
         """Release a slot's block references and clear its table row to
@@ -1566,19 +1772,23 @@ class InferenceEngine:
             # victim's prompt rows came from the wave program. Such a
             # victim still evicts; it just resumes cold.
             self._store_prefix(ctx, slot, len(ctx) - 1,
-                               donor_live=False)
+                               donor_live=False,
+                               salt=self._prefix_salt(req))
             # The flight record reports what the RESUME will read
             # warm: the cached rows covering the victim's context
             # after the store (admission may have stored the prompt's
             # prefix already — still warm; a dry-pool or sub-chunk
             # skip with no prior entry — cold, 0). Never the raw
             # context length.
-            covered = self._prefix_index.lookup(ctx)
+            covered = self._prefix_index.lookup(
+                ctx, self._prefix_salt(req))
             if covered is not None:
                 retired_rows = covered[1]
         self.slot_req.pop(slot, None)
         self.free_slots.append(slot)
         self._free_slot_blocks(slot)
+        self._set_slot_adapter(slot, 0)
+        self._release_adapter(req)
         req.slot = None
         req.preemptions += 1
         qos_lib.QOS_PREEMPTIONS.labels(
@@ -1664,12 +1874,14 @@ class InferenceEngine:
                 # cannot shift which tenant owns the front.
                 self.qos.reorder(self.waiting)
         stalled = False
-        # Requests held by their tenant's KV-block quota this pass: a
-        # per-TENANT limit must not stall the whole queue the way the
-        # (global) dry-pool stall does — held requests step aside,
-        # everyone behind them gets their shot, and they re-queue at
-        # the head for the next pass (the tenant's own retirements
-        # unblock them).
+        # Requests held by a PER-REQUEST resource limit this pass —
+        # their tenant's KV-block quota, or a fully-pinned adapter
+        # pool: such limits must not stall the whole queue the way
+        # the (global) dry-block-pool stall does. Held requests step
+        # aside, everyone behind them gets their shot, and they
+        # re-queue at the head for the next pass (a retirement
+        # unblocks them: it frees the tenant's blocks / unpins an
+        # adapter slot).
         quota_held: List[Request] = []
         while self.waiting and self.free_slots and not stalled:
             dispatched = []
@@ -1680,14 +1892,20 @@ class InferenceEngine:
                 # Chunk-path requests (prompt longer than the chunk —
                 # which also covers every possible prefix-cache hit)
                 # claim a slot and join the chunk queue; they never
-                # ride a bucketed wave. A False return means the paged
-                # block pool is dry: the request went back to the queue
-                # head and admission stops until retirements free
-                # blocks (the pool, not the slot count, is then the
-                # admission limiter).
+                # ride a bucketed wave. "stall" means the paged block
+                # pool is dry: the request went back to the queue head
+                # and admission stops until retirements free blocks
+                # (the pool, not the slot count, is then the admission
+                # limiter); "held" means its fine-tune's pool is fully
+                # pinned — it steps aside and everyone behind it keeps
+                # admitting.
                 if self._use_chunked(self.waiting[0]):
-                    if not self._claim_chunked(self.waiting.popleft()):
+                    req = self.waiting.popleft()
+                    cst = self._claim_chunked(req)
+                    if cst == "stall":
                         stalled = True
+                    elif cst == "held":
+                        quota_held.append(req)
                     continue
                 bucket = _bucket(self._ctx_len(self.waiting[0]),
                                  self.buckets)
@@ -1702,17 +1920,26 @@ class InferenceEngine:
                     if self._kv_quota_blocked(req):
                         quota_held.append(req)
                     elif self._use_chunked(req):
-                        if not self._claim_chunked(req):
+                        cst = self._claim_chunked(req)
+                        if cst == "stall":
                             stalled = True
+                        elif cst == "held":
+                            quota_held.append(req)
                     elif _bucket(self._ctx_len(req),
                                  self.buckets) == bucket:
-                        slot = self._wave_claim(req)
-                        if slot is None:          # block pool dry
-                            self._requeue(req)
-                            stalled = True
-                        else:
+                        st, slot = self._wave_claim(req)
+                        if st == "ok":
                             wave.append(req)
                             slots.append(slot)
+                        elif st == "held":
+                            # Adapter pool fully pinned: step aside —
+                            # base-model and resident-adapter traffic
+                            # behind it keeps admitting.
+                            quota_held.append(req)
+                        elif st == "dry":    # block pool dry
+                            self._requeue(req)
+                            stalled = True
+                        # "failed": consumed (failed typed)
                     else:
                         rest.append(req)
                 self.waiting.extendleft(reversed(rest))
@@ -1749,12 +1976,22 @@ class InferenceEngine:
         block (block_len not dividing the cached length) is copied on
         write first (`skytpu_kv_cow_copies_total`): this slot's suffix
         prefill writes into it at offset cached%block. Contiguous: the
-        hit copies the pool row on-device as before. Returns False
-        (request re-queued at the head) when the paged pool is dry.
+        hit copies the pool row on-device as before. Returns "ok"
+        (claimed), "failed" (adapter load failed — the request was
+        consumed, failed typed), "held" (adapter pool fully pinned —
+        the caller steps this request aside, everyone behind it keeps
+        admitting), or "stall" (paged block pool dry — the request was
+        re-queued at the head and admission pauses).
         """
+        st = self._acquire_adapter(req)
+        if st == "failed":
+            return "failed"  # consumed (failed typed); keep admitting
+        if st == "stall":
+            return "held"    # adapter pool pinned: step aside
         ctx = self._ctx(req)
         idx = self._prefix_index
-        hit = idx.lookup(ctx) if idx is not None else None
+        hit = (idx.lookup(ctx, self._prefix_salt(req))
+               if idx is not None else None)
         payload = cached = None
         n_shared = partial = 0
         shared: List[int] = []
@@ -1779,9 +2016,11 @@ class InferenceEngine:
             if new_blocks is None:
                 for b in shared:          # unpin; retry next pass
                     self.allocator.decref(b)
+                self._release_adapter(req)
                 self._requeue(req)
-                return False
+                return "stall"
         slot = self.free_slots.pop(0)
+        self._set_slot_adapter(slot, req.adapter_slot)
         req.slot = slot
         req.prefill_begin_s = time.time()
         tracing.record_span(
@@ -1838,7 +2077,7 @@ class InferenceEngine:
         # gauge overreports by one per claim for the whole (possibly
         # multi-second) chunked prefill.
         self._update_gauges()
-        return True
+        return "ok"
 
     def prefill_chunk_step(self) -> bool:
         """Run ONE chunk of the head chunked prefill (host-synced: the
@@ -1874,7 +2113,8 @@ class InferenceEngine:
             jnp.asarray(req.slot, jnp.int32),
             jnp.asarray(new_len, jnp.int32), self.rng,
             self.table_device(), final=final, qweights=self.qweights,
-            span=attn_span, kernel=self.kv_kernel)
+            span=attn_span, kernel=self.kv_kernel,
+            **self._lora_args())
         tok = int(tok_dev)               # host sync (garbage unless final)
         dt = time.time() - t0
         PREFILL_CHUNKS.inc()
@@ -1907,14 +2147,16 @@ class InferenceEngine:
             max(now - req.prefill_begin_s, 0.0))
         PREFILL_REQUESTS.labels(bucket="chunked").inc()
         self.slot_req[req.slot] = req
-        self._store_prefix(ctx, req.slot, len(ctx))
+        self._store_prefix(ctx, req.slot, len(ctx),
+                           salt=self._prefix_salt(req))
         if self._req_finished(req, tok):
             self._retire(req)
         self._update_gauges()
         return True
 
     def _store_prefix(self, ctx: List[int], slot: Optional[int],
-                      rows: int, donor_live: bool = True) -> int:
+                      rows: int, donor_live: bool = True,
+                      salt: bytes = b"") -> int:
         """Install ``ctx``'s chunk-aligned prefix (over the slot's
         first ``rows`` resident rows) into the prefix cache unless it
         is already resident. Returns the number of rows actually
@@ -1942,7 +2184,7 @@ class InferenceEngine:
         n = (rows // idx.block) * idx.block
         if n < idx.block:
             return 0
-        covered = idx.lookup(ctx)
+        covered = idx.lookup(ctx, salt)
         if covered is not None and covered[1] >= n:
             return 0
         if self.paged:
@@ -1964,7 +2206,8 @@ class InferenceEngine:
                 self.allocator.incref(b)
             if partial and not donor_live:
                 self.allocator.incref(blocks[n_full])
-            for payload in idx.insert_entry(ctx, n, tuple(blocks)):
+            for payload in idx.insert_entry(ctx, n, tuple(blocks),
+                                            salt):
                 PREFIX_EVICTIONS.inc()
                 self._fl_evictions += 1
                 for b in payload:
@@ -1980,7 +2223,7 @@ class InferenceEngine:
         self.pool = self._pool_store_fn(
             self.pool, self.cache, jnp.asarray(slot, jnp.int32),
             jnp.asarray(row, jnp.int32))
-        idx.register(ctx, n, row)
+        idx.register(ctx, n, row, salt)
         return n
 
     def clear_prefix_cache(self) -> None:
@@ -2031,10 +2274,21 @@ class InferenceEngine:
             true_lens[i] = len(ctx)
             slot_ids[i] = slot
         decode_active = bool(self.slot_req)
+        wave_lora = {}
+        if self.adapters is not None:
+            # Per-wave-row adapter ids (dummy rows ride the all-zeros
+            # base slot): the wave's rows each gather their own
+            # fine-tune — mixed-adapter admission is one dispatch.
+            aid_w = np.zeros((n,), np.int32)
+            for i, req in enumerate(wave):
+                aid_w[i] = req.adapter_slot
+            wave_lora = {"lora": self.adapters.pool,
+                         "aid": jnp.asarray(aid_w)}
         self.cache, self.rng, first = self._admit_wave_fn(
             self.params, self.cache, jnp.asarray(tokens_b),
             jnp.asarray(true_lens), jnp.asarray(slot_ids), self.rng,
-            self.table_device(), bucket=bucket, qweights=self.qweights)
+            self.table_device(), bucket=bucket, qweights=self.qweights,
+            **wave_lora)
         return first, span, decode_active
 
     def _complete_wave(self, wave: List["Request"], slots: List[int],
@@ -2118,7 +2372,9 @@ class InferenceEngine:
             self.slot_req.pop(req.slot, None)
             self.free_slots.append(req.slot)
             self._free_slot_blocks(req.slot)
+            self._set_slot_adapter(req.slot, 0)
             req.slot = None
+        self._release_adapter(req)
         SLOTS_ACTIVE.set(len(self.slot_req))
         if self.paged:
             KV_BLOCKS_USED.set(self.allocator.used)
@@ -2172,6 +2428,13 @@ class InferenceEngine:
                 self._set_tenant_kv(t, 0)
         else:
             self.clear_prefix_cache()
+        if self.adapters is not None:
+            # A failure mid-hot-load may have left pins inconsistent;
+            # drop all residency (pool arrays stay — nothing maps to
+            # them until re-acquired).
+            self.adapters.reset()
+            self.adapter_ids[:] = 0
+            self._aid_dirty = True
         self._update_gauges()
 
     def step_burst(self, max_burst: int = 8,
@@ -2301,7 +2564,8 @@ class InferenceEngine:
                 self.params, self.cache, jnp.asarray(draft),
                 jnp.asarray(n_draft), jnp.asarray(active),
                 self.table_device(), k=K, qweights=self.qweights,
-                span=sarg, kernel=self.kv_kernel)
+                span=sarg, kernel=self.kv_kernel,
+                **self._lora_args())
             parts.append((slots, toks_dev, commit_dev))
             part_spans.append(sarg)
         # THE completion fetch: verify bursts are synchronous (the next
@@ -2422,7 +2686,8 @@ class InferenceEngine:
             self.cache, self.rng, toks = self._decode_burst_fn(
                 self.params, self.cache, self.rng, jnp.asarray(active),
                 self.table_device(), k=k, qweights=self.qweights,
-                span=sarg, kernel=self.kv_kernel)
+                span=sarg, kernel=self.kv_kernel,
+                **self._lora_args())
             parts.append((toks, slots))
             part_spans.append(sarg)
         self._inflight_tokens += k
@@ -2511,7 +2776,8 @@ class InferenceEngine:
         ev.begin()
         self.cache, self.rng, toks = self._decode_fn(
             self.params, self.cache, self.rng, jnp.asarray(active),
-            self.table_device(), qweights=self.qweights, span=sarg)
+            self.table_device(), qweights=self.qweights, span=sarg,
+            **self._lora_args())
         toks = np.asarray(toks)
         ev.end()
         out: Dict[int, int] = {}

@@ -206,6 +206,69 @@ def proj(eq: str, x: jax.Array, layer: Dict, qlayer, name: str,
     return jnp.einsum(eq, x, layer[name].astype(dtype))
 
 
+# ---------------------------------------------------------------------------
+# Multi-LoRA adapter gathers (infer/adapters.py)
+# ---------------------------------------------------------------------------
+# Per-slot LoRA: every program below takes an optional ``lora`` pool
+# (per target {"a": [L, N, d_in..., r], "b": [L, N, r, d_out...]},
+# layer axis leading so slices ride the decoder scan as xs) plus an
+# ``aid`` vector of per-row adapter-pool slots. The delta is the
+# factored pair x @ A[aid] @ B[aid] (alpha/rank already folded into B
+# at load) added to the base projection — ONE gather per layer per
+# target, rank static, so requests for different fine-tunes batch in
+# one dispatch and adapter identity is pure device DATA (never program
+# identity). Pool slot 0 is all zeros: base-model rows add an
+# exact-zero delta, which is what makes an adapter-capable engine's
+# base output bit-identical to an adapterless engine's.
+
+
+def _layer_parts(layer_q, wq8: bool, has_lora: bool):
+    """Unpack one scan step's xs slice into (layer, qlayer, llayer) —
+    the single decoder between fp, w8a8 and adapter-pool variants."""
+    if wq8 and has_lora:
+        layer, qlayer, llayer = layer_q
+    elif wq8:
+        (layer, qlayer), llayer = layer_q, None
+    elif has_lora:
+        layer, llayer = layer_q
+        qlayer = None
+    else:
+        layer, qlayer, llayer = layer_q, None, None
+    return layer, qlayer, llayer
+
+
+def _scan_xs(params, qweights, lora):
+    """The decoder scan's xs: blocks (+ int8 blocks) (+ the adapter
+    pool). A lora-less call builds the identical structure it always
+    did — the adapterless trace is unchanged."""
+    if qweights is not None and lora is not None:
+        return (params["blocks"], qweights["blocks"], lora)
+    if qweights is not None:
+        return (params["blocks"], qweights["blocks"])
+    if lora is not None:
+        return (params["blocks"], lora)
+    return params["blocks"]
+
+
+def _lora_in_delta(h, ab, aid):
+    """Per-slot delta for an embed->heads/kv target. h: [B, S, D];
+    ab: ONE layer's pool slice {"a": [N, D, r], "b": [N, r, H, hd]};
+    aid: [B] int32 pool slots (one gather per layer per target)."""
+    a = ab["a"][aid].astype(h.dtype)               # [B, D, r]
+    b = ab["b"][aid].astype(h.dtype)               # [B, r, H, hd]
+    u = jnp.einsum("bsd,bdr->bsr", h, a)
+    return jnp.einsum("bsr,brhk->bshk", u, b)
+
+
+def _lora_out_delta(o, ab, aid):
+    """Per-slot delta for the wo target. o (pre-projection attention
+    output): [B, S, H, hd]; a: [N, H, hd, r]; b: [N, r, D]."""
+    a = ab["a"][aid].astype(o.dtype)               # [B, H, hd, r]
+    b = ab["b"][aid].astype(o.dtype)               # [B, r, D]
+    u = jnp.einsum("bshk,bhkr->bsr", o, a)
+    return jnp.einsum("bsr,brd->bsd", u, b)
+
+
 def slim_params(params: llama.Params) -> llama.Params:
     """Drop the fp copies of quantized weights: blocks keep only the
     norms; lm_head is covered by the quantized head."""
@@ -580,7 +643,8 @@ def prefill(params: llama.Params, tokens: jax.Array, true_len: jax.Array,
 
 def prefill_batch(params: llama.Params, tokens: jax.Array,
                   true_lens: jax.Array, cfg: llama.LlamaConfig,
-                  constrain=None, qweights=None) -> Tuple[Cache, jax.Array]:
+                  constrain=None, qweights=None, lora=None,
+                  aid=None) -> Tuple[Cache, jax.Array]:
     """Causal forward over a WAVE of right-padded prompts.
 
     tokens: [W, S_bucket] int32, true_lens: [W] int32.
@@ -590,7 +654,10 @@ def prefill_batch(params: llama.Params, tokens: jax.Array,
     matmuls run at W x S rows — admission cost per request drops vs a
     scan of W single-request prefills. With ``qweights`` the block
     matmuls + head run w8a8 int8, so params may omit the fp matrices
-    entirely (slim tree: embed + norms only).
+    entirely (slim tree: embed + norms only). ``lora``/``aid``: the
+    adapter pool + per-wave-row pool slots — each row's (A, B) pair
+    gathers into the batched matmuls (dummy rows ride slot 0, the
+    all-zeros base).
     """
     if constrain is None:
         constrain = lambda x, axes: x
@@ -602,20 +669,24 @@ def prefill_batch(params: llama.Params, tokens: jax.Array,
 
     def body(carry, layer_q):
         x = carry
-        if wq8:
-            layer, qlayer = layer_q
-        else:
-            layer, qlayer = layer_q, None
+        layer, qlayer, llayer = _layer_parts(layer_q, wq8,
+                                             lora is not None)
         h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
         q = proj("bsd,dhk->bshk", h, layer, qlayer, "wq", 1, cfg.dtype)
         k = proj("bsd,dhk->bshk", h, layer, qlayer, "wk", 1, cfg.dtype)
         v = proj("bsd,dhk->bshk", h, layer, qlayer, "wv", 1, cfg.dtype)
+        if llayer is not None:
+            q = q + _lora_in_delta(h, llayer["wq"], aid)
+            k = k + _lora_in_delta(h, llayer["wk"], aid)
+            v = v + _lora_in_delta(h, llayer["wv"], aid)
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         from skypilot_tpu.ops import attention as attn_ops
         o = attn_ops.gqa_attention(q, k, v, causal=True)
-        o = proj("bshk,hkd->bsd", o, layer, qlayer, "wo", 2, cfg.dtype)
-        x = x + o
+        y = proj("bshk,hkd->bsd", o, layer, qlayer, "wo", 2, cfg.dtype)
+        if llayer is not None:
+            y = y + _lora_out_delta(o, llayer["wo"], aid)
+        x = x + y
         h = llama.rms_norm(x, layer["ln2"], cfg.norm_eps)
         if wq8 and not hasattr(cfg, "n_experts"):
             g = proj("bsd,df->bsf", h, layer, qlayer, "w_gate", 1,
@@ -628,8 +699,7 @@ def prefill_batch(params: llama.Params, tokens: jax.Array,
             x = x + _ffn(cfg, h, layer)
         return x, (k, v)
 
-    xs = ((params["blocks"], qweights["blocks"]) if wq8
-          else params["blocks"])
+    xs = _scan_xs(params, qweights, lora)
     x, (ks, vs) = lax.scan(body, x, xs)        # ks: [L, W, S, G, hd]
     x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     last = jnp.take_along_axis(
@@ -786,7 +856,7 @@ def prefill_chunk(params: llama.Params, cache: Cache,
                   new_len: jax.Array, rng: jax.Array,
                   cfg: llama.LlamaConfig, sp, *, final: bool,
                   qweights=None, table=None, span=None,
-                  kv_kernel=False
+                  kv_kernel=False, lora=None, aid=None
                   ) -> Tuple[Cache, jax.Array, jax.Array]:
     """One chunk of an incremental prefill into a decode slot.
 
@@ -847,6 +917,9 @@ def prefill_chunk(params: llama.Params, cache: Cache,
     cos, sin = llama.rope_frequencies(cfg, positions)
     col = jnp.arange(M)
     j = jnp.arange(C)
+    # The chunk program runs ONE slot: its adapter id is the single
+    # entry of aid_b ([1], aligned with x's batch dim).
+    aid_b = aid[slot][None] if lora is not None else None
     # Padding columns (>= n_valid) are masked out of the intra-chunk
     # scores; padding ROWS compute garbage that lands past the prompt's
     # true length, where decode's validity mask never reads.
@@ -854,14 +927,16 @@ def prefill_chunk(params: llama.Params, cache: Cache,
 
     def body(carry, layer_q):
         x, i = carry
-        if wq8:
-            layer, qlayer = layer_q
-        else:
-            layer, qlayer = layer_q, None
+        layer, qlayer, llayer = _layer_parts(layer_q, wq8,
+                                             lora is not None)
         h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
         q = proj("bsd,dhk->bshk", h, layer, qlayer, "wq", 1, cfg.dtype)
         k = proj("bsd,dhk->bshk", h, layer, qlayer, "wk", 1, cfg.dtype)
         v = proj("bsd,dhk->bshk", h, layer, qlayer, "wv", 1, cfg.dtype)
+        if llayer is not None:
+            q = q + _lora_in_delta(h, llayer["wq"], aid_b)
+            k = k + _lora_in_delta(h, llayer["wk"], aid_b)
+            v = v + _lora_in_delta(h, llayer["wv"], aid_b)
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         kr, vr = k[0], v[0]                       # [C, G, hd]
@@ -917,8 +992,10 @@ def prefill_chunk(params: llama.Params, cache: Cache,
                                vr.astype(jnp.bfloat16),
                                preferred_element_type=jnp.float32)
         o = o.reshape(1, C, cfg.n_heads, hd).astype(cfg.dtype)
-        o = proj("bshk,hkd->bsd", o, layer, qlayer, "wo", 2, cfg.dtype)
-        x = x + o
+        y = proj("bshk,hkd->bsd", o, layer, qlayer, "wo", 2, cfg.dtype)
+        if llayer is not None:
+            y = y + _lora_out_delta(o, llayer["wo"], aid_b)
+        x = x + y
         h = llama.rms_norm(x, layer["ln2"], cfg.norm_eps)
         if wq8 and not hasattr(cfg, "n_experts"):
             g = proj("bsd,df->bsf", h, layer, qlayer, "w_gate", 1,
@@ -931,8 +1008,7 @@ def prefill_chunk(params: llama.Params, cache: Cache,
             x = x + _ffn(cfg, h, layer)
         return (x, i + 1), ys
 
-    xs = ((params["blocks"], qweights["blocks"]) if wq8
-          else params["blocks"])
+    xs = _scan_xs(params, qweights, lora)
     (x, _), ys = lax.scan(body, (x, jnp.int32(0)), xs)
 
     if final:
@@ -983,27 +1059,38 @@ def prefill_chunk(params: llama.Params, cache: Cache,
 # Decode
 # ---------------------------------------------------------------------------
 
-def _decode_qkv(cfg, layer, qlayer, x, cos, sin):
+def _decode_qkv(cfg, layer, qlayer, x, cos, sin, llayer=None,
+                aid=None):
     """Shared decode-layer front half: norm + q/k/v projections + rope
     (used by decode_step AND decode_burst_staged so quantization or
-    projection changes land in ONE place)."""
+    projection changes land in ONE place). ``llayer``/``aid``: one
+    layer's adapter-pool slice + per-slot pool ids — the per-slot
+    (A, B) gather adds its delta before rope, exactly as a merged
+    weight would."""
     h = llama.rms_norm(x, layer["ln1"], cfg.norm_eps)
     q = proj("bsd,dhk->bshk", h, layer, qlayer, "wq", 1, cfg.dtype)
     k = proj("bsd,dhk->bshk", h, layer, qlayer, "wk", 1, cfg.dtype)
     v = proj("bsd,dhk->bshk", h, layer, qlayer, "wv", 1, cfg.dtype)
+    if llayer is not None:
+        q = q + _lora_in_delta(h, llayer["wq"], aid)
+        k = k + _lora_in_delta(h, llayer["wk"], aid)
+        v = v + _lora_in_delta(h, llayer["wv"], aid)
     q = llama.apply_rope(q, cos, sin)
     k = llama.apply_rope(k, cos, sin)
     return q, k, v
 
 
-def _decode_out_ffn(cfg, layer, qlayer, wq8, x, o):
+def _decode_out_ffn(cfg, layer, qlayer, wq8, x, o, llayer=None,
+                    aid=None):
     """Shared decode-layer back half: output projection + residual +
     FFN (w8a8 dense when quantized weights are present, the model's
     own _ffn — incl. MoE experts — otherwise)."""
     B = x.shape[0]
     o = o.reshape(B, 1, cfg.n_heads, cfg.head_dim).astype(cfg.dtype)
-    o = proj("bshk,hkd->bsd", o, layer, qlayer, "wo", 2, cfg.dtype)
-    x = x + o
+    y = proj("bshk,hkd->bsd", o, layer, qlayer, "wo", 2, cfg.dtype)
+    if llayer is not None:
+        y = y + _lora_out_delta(o, llayer["wo"], aid)
+    x = x + y
     h = llama.rms_norm(x, layer["ln2"], cfg.norm_eps)
     if wq8 and not hasattr(cfg, "n_experts"):
         g = proj("bsd,df->bsf", h, layer, qlayer, "w_gate", 1,
@@ -1030,7 +1117,8 @@ def _decode_head(cfg, params, qweights, x):
 def decode_step(params: llama.Params, cache: Cache,
                 cfg: llama.LlamaConfig,
                 constrain=None, qweights=None,
-                table=None, span=None) -> Tuple[Cache, jax.Array]:
+                table=None, span=None, lora=None,
+                aid=None) -> Tuple[Cache, jax.Array]:
     """One token for every slot. Returns (cache', logits [slots, vocab]).
 
     ``qweights`` (from ``quantize_block_weights``/``quantize_head``):
@@ -1085,11 +1173,10 @@ def decode_step(params: llama.Params, cache: Cache,
 
     def body(carry, layer_q):
         x, i = carry
-        if wq8:
-            layer, qlayer = layer_q
-        else:
-            layer, qlayer = layer_q, None
-        q, k, v = _decode_qkv(cfg, layer, qlayer, x, cos, sin)
+        layer, qlayer, llayer = _layer_parts(layer_q, wq8,
+                                             lora is not None)
+        q, k, v = _decode_qkv(cfg, layer, qlayer, x, cos, sin,
+                              llayer, aid)
         if quant:
             kq, ks = quantize_rows(k[:, 0])     # ks/vs: [B, G]
             vq, vs = quantize_rows(v[:, 0])
@@ -1131,11 +1218,10 @@ def decode_step(params: llama.Params, cache: Cache,
                        cv.astype(jnp.bfloat16),
                        preferred_element_type=jnp.float32)
         o = o + w_self[..., None] * v_new[:, :, None, :]
-        x = _decode_out_ffn(cfg, layer, qlayer, wq8, x, o)
+        x = _decode_out_ffn(cfg, layer, qlayer, wq8, x, o, llayer, aid)
         return (x, i + 1), ys
 
-    xs = ((params["blocks"], qweights["blocks"]) if wq8
-          else params["blocks"])
+    xs = _scan_xs(params, qweights, lora)
     (x, _), ys = lax.scan(body, (x, jnp.int32(0)), xs)
     logits = _decode_head(cfg, params, qweights, x)
     # One batched scatter per cache array: every layer's pending row
@@ -1173,7 +1259,7 @@ def commit_tokens(cache: Cache, tokens: jax.Array,
 def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
                        i, s, sk, sv, sks, svs, valid_cache,
                        stage_valid, batch_ix, span=None, pos0=None,
-                       kv_kernel=False):
+                       kv_kernel=False, llayer=None, aid=None):
     """One decoder layer of a staged-burst step: the current step's
     K/V rows land in the staging buffers, attention runs as big-cache
     dot (rows masked by ``valid_cache``) ++ staged-columns dot
@@ -1208,7 +1294,8 @@ def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
     scale = hd ** -0.5
     neg = jnp.asarray(-1e30, jnp.float32)
 
-    q, kk, v = _decode_qkv(cfg, layer, qlayer, x, cos, sin)
+    q, kk, v = _decode_qkv(cfg, layer, qlayer, x, cos, sin, llayer,
+                           aid)
     if quant:
         kq, ksc = quantize_rows(kk[:, 0])
         vq, vsc = quantize_rows(v[:, 0])
@@ -1264,7 +1351,7 @@ def _staged_attn_layer(cfg, cache, table, layer, qlayer, x, cos, sin,
                            ws.astype(jnp.bfloat16),
                            lv.astype(jnp.bfloat16),
                            preferred_element_type=jnp.float32)
-    x = _decode_out_ffn(cfg, layer, qlayer, wq8, x, o)
+    x = _decode_out_ffn(cfg, layer, qlayer, wq8, x, o, llayer, aid)
     return x, sk, sv, sks, svs
 
 
@@ -1294,7 +1381,7 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
                         rng: jax.Array, active: jax.Array, k: int,
                         cfg: llama.LlamaConfig, sp,
                         qweights=None, table=None, span=None,
-                        kv_kernel=False
+                        kv_kernel=False, lora=None, aid=None
                         ) -> Tuple[Cache, jax.Array, jax.Array]:
     """k decode steps with a per-BURST cache flush (the engine's burst
     program; trace under jit with cache+rng donated).
@@ -1368,18 +1455,15 @@ def decode_burst_staged(params: llama.Params, cache: Cache,
 
         def body(carry2, layer_q):
             x, i, sk, sv, sks, svs = carry2
-            if wq8:
-                layer, qlayer = layer_q
-            else:
-                layer, qlayer = layer_q, None
+            layer, qlayer, llayer = _layer_parts(layer_q, wq8,
+                                                 lora is not None)
             x, sk, sv, sks, svs = _staged_attn_layer(
                 cfg, cache, table, layer, qlayer, x, cos, sin, i, s,
                 sk, sv, sks, svs, valid_cache, stage_valid, batch_ix,
-                span, pos0, kv_kernel)
+                span, pos0, kv_kernel, llayer, aid)
             return (x, i + 1, sk, sv, sks, svs), None
 
-        xs = ((params["blocks"], qweights["blocks"]) if wq8
-              else params["blocks"])
+        xs = _scan_xs(params, qweights, lora)
         (x, _, sk, sv, sks, svs), _ = lax.scan(
             body, (x, jnp.int32(0), sk, sv, sks, svs), xs)
         logits = _decode_head(cfg, params, qweights, x)
@@ -1403,7 +1487,7 @@ def verify_draft_staged(params: llama.Params, cache: Cache,
                         active: jax.Array, k: int,
                         cfg: llama.LlamaConfig,
                         qweights=None, table=None, span=None,
-                        kv_kernel=False
+                        kv_kernel=False, lora=None, aid=None
                         ) -> Tuple[Cache, jax.Array, jax.Array]:
     """Speculative-decode verify: score ``k`` drafted tokens per slot
     plus the correction position in ONE device call (the engine's
@@ -1493,18 +1577,15 @@ def verify_draft_staged(params: llama.Params, cache: Cache,
 
         def body(carry2, layer_q):
             x, i, sk, sv, sks, svs = carry2
-            if wq8:
-                layer, qlayer = layer_q
-            else:
-                layer, qlayer = layer_q, None
+            layer, qlayer, llayer = _layer_parts(layer_q, wq8,
+                                                 lora is not None)
             x, sk, sv, sks, svs = _staged_attn_layer(
                 cfg, cache, table, layer, qlayer, x, cos, sin, i, s,
                 sk, sv, sks, svs, valid_cache, stage_valid, batch_ix,
-                span, pos0, kv_kernel)
+                span, pos0, kv_kernel, llayer, aid)
             return (x, i + 1, sk, sv, sks, svs), None
 
-        xs = ((params["blocks"], qweights["blocks"]) if wq8
-              else params["blocks"])
+        xs = _scan_xs(params, qweights, lora)
         (x, _, sk, sv, sks, svs), _ = lax.scan(
             body, (x, jnp.int32(0), sk, sv, sks, svs), xs)
         logits = _decode_head(cfg, params, qweights, x)

@@ -173,16 +173,21 @@ class ModelServer:
     def _add(self, tokens, max_new_tokens: int,
              stream: bool = False, trace_ctx=None,
              tenant: str = qos_lib.DEFAULT_TENANT,
-             priority: int = 0) -> _Pending:
+             priority: int = 0,
+             adapter: Optional[str] = None) -> _Pending:
         from skypilot_tpu.infer import engine as eng
-        # Validate eagerly (oversized prompt / unsatisfiable KV quota
-        # -> clean 400) without touching the engine's mutable state
-        # from this thread — an exception raised later on the loop
-        # thread could reach no client.
+        # Validate eagerly (oversized prompt / unsatisfiable KV quota /
+        # unknown adapter -> clean 400/404) without touching the
+        # engine's mutable state from this thread — an exception
+        # raised later on the loop thread could reach no client.
         eng._bucket(len(tokens), self.engine.buckets)
         check = getattr(self.engine, "check_kv_quota", None)
         if check is not None:
             check(tenant, len(tokens), max_new_tokens)
+        if adapter is not None:
+            check_ad = getattr(self.engine, "check_adapter", None)
+            if check_ad is not None:
+                check_ad(adapter)
         p = _Pending()
         p.stream = stream
         with self._inbox_lock:
@@ -191,16 +196,16 @@ class ModelServer:
             # add_request so the engine's per-request spans join the
             # HTTP caller's trace.
             self._inbox.append((list(tokens), max_new_tokens, p,
-                                trace_ctx, tenant, priority))
+                                trace_ctx, tenant, priority, adapter))
             self._last_arrival = time.monotonic()
             INBOX_DEPTH.set(len(self._inbox))
         return p
 
     def submit(self, tokens, max_new_tokens: int, trace_ctx=None,
                tenant: str = qos_lib.DEFAULT_TENANT,
-               priority: int = 0) -> Dict:
+               priority: int = 0, adapter: Optional[str] = None) -> Dict:
         p = self._add(tokens, max_new_tokens, trace_ctx=trace_ctx,
-                      tenant=tenant, priority=priority)
+                      tenant=tenant, priority=priority, adapter=adapter)
         t0 = time.time()
         p.event.wait()
         out = dict(p.result or {})
@@ -209,17 +214,18 @@ class ModelServer:
 
     def submit_stream(self, tokens, max_new_tokens: int, trace_ctx=None,
                       tenant: str = qos_lib.DEFAULT_TENANT,
-                      priority: int = 0):
+                      priority: int = 0, adapter: Optional[str] = None):
         """Iterator of chunk dicts: {"tokens": [...]} as decoded, then
         one {"done": true, "ttft_ms": ...} (or {"error": ...}).
 
         Admission validation happens EAGERLY (before any bytes are
-        written), so an oversized prompt raises here as a clean 400 —
-        not mid-stream after a 200 went out.
+        written), so an oversized prompt — or an unknown adapter
+        name — raises here as a clean 400/404, not mid-stream after a
+        200 went out.
         """
         p = self._add(tokens, max_new_tokens, stream=True,
                       trace_ctx=trace_ctx, tenant=tenant,
-                      priority=priority)
+                      priority=priority, adapter=adapter)
 
         def gen():
             while True:
@@ -287,7 +293,8 @@ class ModelServer:
         with self._inbox_lock:
             new, self._inbox = self._inbox, []
             INBOX_DEPTH.set(0)
-        for tokens, max_new, p, trace_ctx, tenant, priority in new:
+        for tokens, max_new, p, trace_ctx, tenant, priority, adapter \
+                in new:
             # Optional kwargs only when they carry signal: simple
             # engine doubles (and older engines) without the kwargs
             # keep working.
@@ -298,6 +305,8 @@ class ModelServer:
                 kwargs["tenant"] = tenant
             if priority:
                 kwargs["priority"] = priority
+            if adapter is not None:
+                kwargs["adapter"] = adapter
             rid = self.engine.add_request(tokens, max_new, **kwargs)
             # add_request appends to engine.waiting; keep the Request so
             # emitted tokens can be diffed without a rid->req search.
@@ -418,6 +427,18 @@ class ModelServer:
             p = self._pending.pop(req.rid, None)
             if p is None:
                 continue
+            err = getattr(req, "error", None)
+            if err is not None:
+                # Typed per-request failure (adapter load failed): the
+                # body rides verbatim with the error's HTTP status —
+                # the engine never substituted base-model output.
+                err = dict(err)
+                status = err.pop("http_status", 500)
+                p.result = {"error": err, "http_status": status}
+                if p.stream:
+                    p.chunks.put({"error": err})
+                p.event.set()
+                continue
             ttft = ((req.first_token_s - req.submit_s) * 1e3
                     if req.first_token_s is not None else None)
             ttft = round(ttft, 2) if ttft is not None else None
@@ -437,6 +458,9 @@ class ModelServer:
                 # QoS: how often this request was preempted-by-
                 # eviction and resumed (0 on the single-tenant path).
                 "preemptions": getattr(req, "preemptions", 0),
+                # Adapter catalog: which fine-tune generated this
+                # (None = the base model).
+                "model": getattr(req, "adapter", None),
             }
             if p.stream:
                 p.chunks.put({"done": True, "ttft_ms": ttft,
@@ -586,6 +610,19 @@ def make_handler(model: ModelServer):
                 tokens = [int(t) for t in body["tokens"]]
                 max_new = int(body.get("max_new_tokens", 64))
                 stream = bool(body.get("stream", False))
+                # Adapter catalog: the fine-tune this request targets.
+                # HEADER FIRST, body ``model`` (the SDK path) as the
+                # fallback — the LB resolves in exactly this order
+                # (it never parses the body when the header is
+                # present), and the two tiers must agree or a request
+                # carrying both would route/validate under one
+                # adapter and be served under another. None/"" = the
+                # base model.
+                from skypilot_tpu.infer import adapters as ad_lib
+                model_name = (self.headers.get(ad_lib.MODEL_HEADER)
+                              or body.get("model"))
+                model_name = (str(model_name).strip()[:128]
+                              if model_name else None)
             except (ValueError, TypeError, KeyError) as e:
                 return self._json(400, {"error": f"bad request: {e}"})
             trace_ctx = tracing.parse_traceparent(
@@ -607,10 +644,11 @@ def make_handler(model: ModelServer):
                         headers={"Retry-After": e.retry_after_header()})
             # Client errors carry a typed body when the engine minted
             # one (PromptTooLongError.typed_error — a prompt past the
-            # largest bucket is the caller's fault, never a 500).
+            # largest bucket is the caller's fault, never a 500; an
+            # unknown adapter name rides its 404 the same way).
             def _bad_request(e):
                 return self._json(
-                    400,
+                    getattr(e, "http_status", 400),
                     {"error": getattr(e, "typed_error", None) or str(e)})
 
             if stream:
@@ -618,17 +656,19 @@ def make_handler(model: ModelServer):
                     chunks = model.submit_stream(tokens, max_new,
                                                  trace_ctx=trace_ctx,
                                                  tenant=tenant,
-                                                 priority=priority)
-                except ValueError as e:  # oversized prompt etc.
+                                                 priority=priority,
+                                                 adapter=model_name)
+                except ValueError as e:  # oversized prompt, 404 etc.
                     return _bad_request(e)
                 return self._stream(chunks)
             try:
                 out = model.submit(tokens, max_new, trace_ctx=trace_ctx,
-                                   tenant=tenant, priority=priority)
-            except ValueError as e:      # oversized prompt etc.
+                                   tenant=tenant, priority=priority,
+                                   adapter=model_name)
+            except ValueError as e:      # oversized prompt, 404 etc.
                 return _bad_request(e)
             if "error" in out:
-                return self._json(500, out)
+                return self._json(out.pop("http_status", 500), out)
             return self._json(200, out)
 
         def log_message(self, *a):
@@ -745,6 +785,23 @@ def main() -> None:
                          "cache over the first N local devices "
                          "(Megatron head/mlp/vocab split — serves "
                          "models bigger than one chip's HBM)")
+    ap.add_argument("--adapters", default=None,
+                    help="multi-LoRA adapter catalog: JSON object of "
+                         "{name: checkpoint path} (adapters.save_"
+                         "adapter .npz files). Requests pick a "
+                         "fine-tune via the body's 'model' field or "
+                         "the x-skytpu-model header; unknown names "
+                         "get a typed 404. Default env "
+                         "SKYTPU_ADAPTERS (how the serve controller "
+                         "hands a replica its catalog)")
+    ap.add_argument("--adapter-slots", type=int, default=None,
+                    help="device adapter-pool capacity (fine-tunes "
+                         "resident at once; LRU hot-load/evict past "
+                         "it; default env SKYTPU_ADAPTER_SLOTS or 8)")
+    ap.add_argument("--adapter-rank", type=int, default=None,
+                    help="adapter-pool LoRA rank (lower-rank "
+                         "checkpoints zero-pad; default env "
+                         "SKYTPU_ADAPTER_RANK or 16)")
     ap.add_argument("--warm-grid", action="store_true",
                     default=os.environ.get("SKYTPU_WARM_GRID") == "1",
                     help="pre-compile the engine's whole program grid "
@@ -799,6 +856,14 @@ def main() -> None:
         rungs = [int(t) for t in
                  args.span_buckets.replace(",", " ").split()]
         span_buckets = [r for r in rungs if r > 0] or 0
+    # Multi-LoRA adapter catalog (docs/serving.md §Adapter catalog):
+    # a JSON {name: checkpoint path} names the replica's fine-tunes;
+    # loading to device is on demand (the first request naming one
+    # pays the hot-load). None = the zero-cost adapterless engine.
+    from skypilot_tpu.infer import adapters as ad_lib
+    catalog = ad_lib.catalog_from_env(cfg, adapters_json=args.adapters,
+                                      slots=args.adapter_slots,
+                                      rank=args.adapter_rank)
     engine = eng.InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.max_len,
         mesh=mesh,
@@ -831,7 +896,8 @@ def main() -> None:
         # Multi-tenant QoS (SKYTPU_QOS=1): WFQ + priority lanes in the
         # engine's waiting deque. All host-side — tenant count never
         # enters program identity (the compile watch is the gate).
-        qos=qos_lib.scheduler_from_env())
+        qos=qos_lib.scheduler_from_env(),
+        adapters=catalog)
     # The engine slims its own tree under weights_int8; drop main()'s
     # reference too or the fp block weights stay resident for the whole
     # server lifetime and the memory halving never happens.

@@ -443,6 +443,12 @@ def render_table(records: List[Dict[str, Any]],
                         or next(iter(tenants)) != "default"):
             flags.append("tenants=" + ",".join(
                 f"{t}:{n}" for t, n in sorted(tenants.items())))
+        # Adapter-catalog composition: which fine-tunes shared this
+        # dispatch (base-model members carry no entry).
+        ads = r.get("adapters") or {}
+        if ads:
+            flags.append("adapters=" + ",".join(
+                f"{a}:{n}" for a, n in sorted(ads.items())))
         if r.get("burst") == "preempt":
             flags.append(f"prio={r.get('priority', 0)} "
                          f"retired={r.get('retired_rows', 0)}")
@@ -482,7 +488,8 @@ def as_spans(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         ts = float(r.get("ts_s", 0.0))
         attrs = {k: r[k] for k in ("toks", "drafted", "accepted",
                                    "stall", "rids", "tenants",
-                                   "priority", "retired_rows")
+                                   "adapters", "priority",
+                                   "retired_rows")
                  if r.get(k)}
         attrs["slots"] = len(r.get("slots", ()))
         spans.append({

@@ -211,7 +211,8 @@ def to_perfetto(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             from skypilot_tpu.observability import flight as flight_lib
             args = dict(args)
             args.update({k: r[k] for k in ("toks", "rids", "drafted",
-                                           "accepted", "compiled")
+                                           "accepted", "compiled",
+                                           "adapters")
                          if r.get(k)})
             args["slots"] = len(r.get("slots", ()))
             events.append({

@@ -10,6 +10,7 @@ recorded there for the autoscaler.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import http.server
 import itertools
 import json
@@ -17,10 +18,12 @@ import os
 import socket
 import socketserver
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
 from skypilot_tpu import chaos
+from skypilot_tpu.infer import adapters as adapters_lib
 from skypilot_tpu.infer import qos as qos_lib
 from skypilot_tpu.observability import health as health_lib
 from skypilot_tpu.observability import metrics
@@ -115,6 +118,41 @@ class _ChunkedTracker:
                 self._data = size + 2   # chunk data + trailing CRLF
 
 
+# Adapter-catalog routing (docs/serving.md §Adapter catalog): the
+# service's published fine-tune names come from its spec (`service.
+# adapters`), read off the serve DB with a short TTL so the proxy hot
+# path pays one DB hit per window, not per request. None = the service
+# publishes no catalog — names pass through untouched (the replica
+# tier still 404s unknowns).
+_ADAPTER_TTL_S = 5.0
+_adapter_cache: Dict[str, Tuple[float, Optional[frozenset]]] = {}
+
+
+def _service_adapters(service: str) -> Optional[frozenset]:
+    now = time.monotonic()
+    hit = _adapter_cache.get(service)
+    if hit is not None and now - hit[0] < _ADAPTER_TTL_S:
+        return hit[1]
+    names = None
+    rec = serve_state.get_service(service)
+    if rec is not None:
+        ads = (rec.get("spec") or {}).get("adapters")
+        if isinstance(ads, dict) and ads:
+            names = frozenset(str(k) for k in ads)
+    _adapter_cache[service] = (now, names)
+    return names
+
+
+def _affinity_url(model_name: str, urls: List[str]) -> str:
+    """Rendezvous (highest-random-weight) pick: one fine-tune's
+    traffic lands on the same replica while it is up — its device
+    adapter pool stays warm — and fails over deterministically to the
+    next-highest weight when it dies. Composes with the policy: only
+    adapter-naming requests route this way."""
+    return max(urls, key=lambda u: hashlib.blake2b(
+        (model_name + "|" + u).encode(), digest_size=8).digest())
+
+
 class Policy:
     def select(self, urls: List[str]) -> Optional[str]:
         raise NotImplementedError
@@ -175,17 +213,20 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
         protocol_version = "HTTP/1.1"
 
         def _typed_reject(self, code: int, typed: dict,
-                          retry_after_s: float = 1.0) -> None:
-            """A typed load-shed/overload response minted AT the LB
-            (never forwarded): JSON body + Retry-After, counted under
+                          retry_after_s: Optional[float] = 1.0) -> None:
+            """A typed load-shed/overload/unknown-adapter response
+            minted AT the LB (never forwarded): JSON body (+
+            Retry-After for retryable sheds), counted under
             backend="none" so fleet dashboards see LB-minted rejects
             next to replica answers."""
             LB_PROXIED.labels(backend="none", code=str(code)).inc()
             body = json.dumps({"error": typed}).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
-            self.send_header("Retry-After",
-                             qos_lib.retry_after_header(retry_after_s))
+            if retry_after_s is not None:
+                self.send_header(
+                    "Retry-After",
+                    qos_lib.retry_after_header(retry_after_s))
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -210,6 +251,22 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
             length = int(self.headers.get("Content-Length") or 0)
             if length:
                 body = self.rfile.read(length)
+
+            # The body parses AT MOST once per request, and only when
+            # something needs a field out of it (tenant identity or an
+            # adapter name) — never on the plain proxy hot path.
+            parsed = {"fields": None, "done": False}
+
+            def _body_json():
+                if not parsed["done"]:
+                    parsed["done"] = True
+                    if body:
+                        try:
+                            parsed["fields"] = json.loads(body)
+                        except (ValueError, UnicodeDecodeError):
+                            parsed["fields"] = None
+                return parsed["fields"]
+
             tenant = qos_lib.DEFAULT_TENANT
             if qos is not None and self.command == "POST":
                 # Fleet-edge admission control: the same per-tenant
@@ -228,10 +285,7 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                 body_fields = None
                 if (body and not self.headers.get(qos_lib.tenant_header())
                         and (qos_rates_body_tenant or chaos.active())):
-                    try:
-                        body_fields = json.loads(body)
-                    except (ValueError, UnicodeDecodeError):
-                        body_fields = None
+                    body_fields = _body_json()
                 tenant, _ = qos_lib.request_identity(
                     self.headers, body=body_fields, cfg=qos.cfg)
                 try:
@@ -240,22 +294,61 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                     return self._typed_reject(
                         e.http_status, e.typed_error,
                         retry_after_s=e.retry_after_s)
+            # Adapter-catalog routing: the named fine-tune (header
+            # first — the cheap proxy path — else the body's ``model``
+            # field, parsed only when the service publishes a
+            # catalog). Unknown names reject typed HERE, one hop
+            # before they cost a proxied connection; known names get
+            # replica AFFINITY below so each fine-tune's device pool
+            # stays warm on one replica.
+            model_name = None
+            if self.command == "POST":
+                model_name = self.headers.get(adapters_lib.MODEL_HEADER)
+                known = _service_adapters(service)
+                if model_name is None and known is not None and body:
+                    fields = _body_json()
+                    if isinstance(fields, dict):
+                        model_name = fields.get("model")
+                if model_name:
+                    model_name = str(model_name).strip()[:128] or None
+                if model_name and known is not None \
+                        and model_name not in known:
+                    return self._typed_reject(
+                        404, {
+                            "type": "unknown_adapter",
+                            "adapter": model_name,
+                            "service": service,
+                            "message": f"unknown adapter "
+                                       f"{model_name!r}",
+                        }, retry_after_s=None)
             serve_state.record_request(service)
             urls = serve_state.ready_urls(service)
             tried = []
             self._response_started = False
             for _ in range(min(max_retries, max(len(urls), 1))):
-                url = policy.select([u for u in urls if u not in tried])
+                cand = [u for u in urls if u not in tried]
+                used_policy = not (model_name and len(cand) > 1)
+                if used_policy:
+                    url = policy.select(cand)
+                else:
+                    # Adapter affinity composes with backend
+                    # selection: adapter-naming requests rendezvous-
+                    # hash onto a stable replica (warm pool), all
+                    # other traffic keeps the configured policy, and
+                    # failover still walks the remaining candidates.
+                    url = _affinity_url(model_name, cand)
                 if url is None:
                     break
                 tried.append(url)
                 try:
                     code = self._forward(url, body)
-                    policy.done(url)
+                    if used_policy:
+                        policy.done(url)
                     LB_PROXIED.labels(backend=url, code=str(code)).inc()
                     return
                 except Exception:  # noqa: BLE001 — try next replica
-                    policy.done(url)
+                    if used_policy:
+                        policy.done(url)
                     LB_RETRIES.labels(backend=url).inc()
                     if self._response_started:
                         # Bytes already reached the client: a retry

@@ -9,6 +9,7 @@ readiness prober :1026).
 from __future__ import annotations
 
 import concurrent.futures
+import json
 import threading
 import time
 import urllib.error
@@ -194,6 +195,14 @@ class ReplicaManager:
             task = Task.from_yaml_config(task_config)
             task.update_envs({"SKYTPU_REPLICA_ID": str(rid),
                               "SKYTPU_REPLICA_PORT": str(self._port(rid))})
+            if getattr(self.spec, "adapters", None):
+                # Adapter-catalog distribution: each replica's model
+                # server registers the service's fine-tunes from this
+                # env (checkpoints are ordinary small files the task's
+                # file_mounts/shared storage put in place; loading to
+                # device stays demand-driven on the replica).
+                task.update_envs({
+                    "SKYTPU_ADAPTERS": json.dumps(self.spec.adapters)})
             job_id, handle = execution.launch(task, cluster_name=cluster,
                                               retry_until_up=True)
             # The controller may have terminated this replica while the

@@ -37,6 +37,14 @@ class SkyServiceSpec:
     # controller (push them via file_mounts for cloud controllers).
     tls_keyfile: Optional[str] = None
     tls_certfile: Optional[str] = None
+    # Multi-LoRA adapter catalog (docs/serving.md §Adapter catalog):
+    # {fine-tune name: checkpoint path} — the serve controller hands
+    # each replica the catalog (SKYTPU_ADAPTERS env; the paths are
+    # ordinary small checkpoints valid on the replica, pushed via
+    # file_mounts or shared storage), the model server hot-loads on
+    # demand, and the LB routes `model=` names (unknown -> typed 404
+    # at BOTH tiers, affinity for known names).
+    adapters: Optional[Dict[str, str]] = None
     # Spot/on-demand mixed fleet (reference: sky/serve/autoscalers.py
     # FallbackRequestRateAutoscaler:546): keep this many always-on
     # on-demand replicas under the spot fleet...
@@ -74,6 +82,13 @@ class SkyServiceSpec:
         if bool(self.tls_keyfile) != bool(self.tls_certfile):
             raise exceptions.ServeError(
                 "service.tls needs both keyfile and certfile")
+        if self.adapters is not None:
+            if not isinstance(self.adapters, dict) or not all(
+                    isinstance(k, str) and k and isinstance(v, str)
+                    and v for k, v in self.adapters.items()):
+                raise exceptions.ServeError(
+                    "service.adapters must map non-empty adapter "
+                    "names to checkpoint paths")
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> "SkyServiceSpec":
@@ -108,6 +123,10 @@ class SkyServiceSpec:
                 kwargs[key] = policy[key]
         if "port" in config:
             kwargs["replica_port"] = int(config.pop("port"))
+        adapters = config.pop("adapters", None)
+        if adapters is not None:
+            kwargs["adapters"] = {str(k): str(v)
+                                  for k, v in dict(adapters).items()}
         tls = config.pop("tls", None) or {}
         if tls:
             if not (tls.get("keyfile") and tls.get("certfile")):
@@ -130,6 +149,8 @@ class SkyServiceSpec:
         }
         if self.post_data:
             out["readiness_probe"]["post_data"] = self.post_data
+        if self.adapters:
+            out["adapters"] = dict(self.adapters)
         if self.tls_certfile:
             out["tls"] = {"keyfile": self.tls_keyfile,
                           "certfile": self.tls_certfile}

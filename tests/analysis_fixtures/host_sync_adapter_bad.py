@@ -1,0 +1,22 @@
+# Golden fixture: seeded host-sync violations on the adapter-catalog
+# claim/retire path (PR 13). Acquire/release and the per-slot
+# adapter-id bookkeeping run at EVERY claim and retirement — they must
+# read host state (the registry dict, pin counters, the numpy aid
+# array); fetching the device aid vector or pool state to pick a slot
+# would stall admission itself. Checked as if it were
+# skypilot_tpu/infer/engine.py (the hot-loop scope). Never imported.
+import numpy as np
+
+
+class InferenceEngine:
+    def _acquire_adapter(self, req):
+        ids = np.asarray(self._aid_dev)              # expect: host-sync
+        slot = int(self.adapters.pool["wq"]["a"][0, 0, 0, 0])  # expect: host-sync
+        req.adapter_slot = slot
+        return ids
+
+    def _set_slot_adapter(self, slot, pool_slot):
+        cur = self._aid_dev[slot].item()             # expect: host-sync
+        if cur != pool_slot:
+            self.adapter_ids[slot] = pool_slot
+            self._aid_dirty = True

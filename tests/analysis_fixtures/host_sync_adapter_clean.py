@@ -1,0 +1,22 @@
+# Clean twin: the adapter-catalog claim/retire bookkeeping done
+# right — pins, residency and the per-slot adapter ids are host dicts
+# and a host numpy array; the device copy is only WRITTEN (cached,
+# dirty-tracked), never read back. Never imported.
+
+
+class InferenceEngine:
+    def _acquire_adapter(self, req):
+        if self.adapters is None or req.adapter is None:
+            req.adapter_slot = 0
+            return "ok"
+        slot = self.adapters.acquire(req.adapter)
+        if slot is None:
+            return "stall"
+        req.adapter_slot = slot
+        req.adapter_pinned = slot > 0
+        return "ok"
+
+    def _set_slot_adapter(self, slot, pool_slot):
+        if self.adapter_ids[slot] != pool_slot:
+            self.adapter_ids[slot] = pool_slot
+            self._aid_dirty = True

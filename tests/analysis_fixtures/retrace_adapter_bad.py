@@ -1,0 +1,31 @@
+# Golden fixture: seeded retrace-safety violations in the multi-LoRA
+# adapter-gather shape (PR 13) — the exact mistakes the adapter path
+# invites: concretizing a traced adapter id to pick a pool slice in
+# Python (bakes ONE adapter into the compiled program — the mixed
+# batch silently serves the wrong fine-tune), branching on the traced
+# id to skip the delta, and building the gather from a host-fetched
+# aid vector. Checked as if it lived at skypilot_tpu/infer/ (a
+# jit-root directory). Never imported.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _lora_delta(h, pool_a, pool_b, aid):
+    slot = int(aid[0])                            # expect: concretize
+    if (aid > 0).any():                           # expect: traced-branch
+        a = pool_a[slot]
+        u = jnp.einsum("bsd,dr->bsr", h, a)
+        return jnp.einsum("bsr,rhk->bshk", u, pool_b[slot])
+    return jnp.zeros_like(h)
+
+
+def adapter_proj(h, pool, aid):
+    host_aid = np.asarray(aid)                    # expect: host-transfer
+    delta = _lora_delta(h, pool["a"], pool["b"], aid)
+    return delta, host_aid
+
+
+@jax.jit
+def decode_step(cache, pool, aid):
+    return adapter_proj(cache["x"], pool, aid)

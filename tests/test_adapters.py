@@ -1,0 +1,715 @@
+"""Multi-LoRA adapter catalog: pool units, engine greedy parity,
+hot-load compile discipline, typed 404/failure at both serving tiers.
+
+The headline guarantees (docs/serving.md §Adapter catalog):
+* a zero-adapter request on an adapter-capable engine is BIT-IDENTICAL
+  to an adapterless engine (pool slot 0 is all zeros — exact-zero
+  delta);
+* a mixed-adapter batch is BIT-IDENTICAL to per-adapter sequential
+  runs (the per-slot gather is row-independent), across
+  {fp32, int8 KV} x {spec on, off} on the paged layout;
+* adapter count/identity never enters program identity — adapters
+  hot-load/evict mid-traffic under ``declare_warmup_complete`` with
+  ZERO unexpected compiles;
+* an unknown fine-tune is a typed 404 at the LB and the model server
+  (stream path included); a failed checkpoint load fails the request
+  typed — never a silent fall-through to the base model's weights.
+"""
+
+import http.server
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu import chaos
+from skypilot_tpu.chaos import plan as chaos_plan
+from skypilot_tpu.infer import adapters as ad
+from skypilot_tpu.infer import engine as eng
+from skypilot_tpu.infer import server as srv
+from skypilot_tpu.models import llama
+
+CFG = llama.CONFIGS["llama3-tiny"]
+RANK = 4
+PROMPTS = [[3, 17, 42, 5], [7, 9, 11, 13, 2], [23, 29, 31]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.key(0), CFG)
+
+
+def _mk_params(seed, rank=RANK, targets=None, scale=0.05):
+    """A random nonzero adapter tree in the train/lora layout."""
+    r = np.random.default_rng(seed)
+    L = CFG.n_layers
+    shapes = ad.target_shapes(CFG, rank)
+    out = {}
+    for t, (sa, sb) in shapes.items():
+        if targets is not None and t not in targets:
+            continue
+        sa = sa[:-1] + (rank,)
+        sb = (rank,) + sb[1:]
+        out[t] = {"a": r.normal(size=(L,) + sa).astype(np.float32)
+                  * scale,
+                  "b": r.normal(size=(L,) + sb).astype(np.float32)
+                  * scale}
+    return out
+
+
+def _catalog(n_adapters=4, rank=RANK, register=3):
+    cat = ad.AdapterCatalog(CFG, n_adapters=n_adapters, rank=rank)
+    for i in range(register):
+        cat.register(f"ft-{i}", params=_mk_params(100 + i, rank))
+    return cat
+
+
+def _engine(params, catalog=None, **kw):
+    kw.setdefault("n_slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_buckets", (8, 16))
+    kw.setdefault("kv_block", 16)
+    kw.setdefault("prefill_chunk", 0)
+    return eng.InferenceEngine(params, CFG, adapters=catalog, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Catalog units: registry, content addressing, LRU, pins.
+
+
+def test_unknown_adapter_typed():
+    cat = _catalog()
+    with pytest.raises(ad.UnknownAdapterError) as e:
+        cat.check("nope")
+    assert e.value.typed_error["type"] == "unknown_adapter"
+    assert e.value.http_status == 404
+    cat.check("ft-0")       # known: no raise
+    cat.check(None)         # base model: no raise
+
+
+def test_engine_without_catalog_knows_no_adapters(params):
+    e = _engine(params)
+    with pytest.raises(ad.UnknownAdapterError):
+        e.add_request(PROMPTS[0], 4, adapter="ft-0")
+
+
+def _bind_fake_loader(cat):
+    loads = []
+
+    def loader(pool, slot, weights):
+        loads.append(int(slot))
+        return pool
+
+    cat.bind_loader(loader)
+    return loads
+
+
+def test_content_addressed_sharing():
+    """Two names registering identical bytes share ONE pool slot (and
+    one hot-load)."""
+    cat = ad.AdapterCatalog(CFG, n_adapters=4, rank=RANK)
+    same = _mk_params(1)
+    cat.register("alias-a", params=same)
+    cat.register("alias-b", params={t: {k: v.copy()
+                                        for k, v in ab.items()}
+                                    for t, ab in same.items()})
+    loads = _bind_fake_loader(cat)
+    s1 = cat.acquire("alias-a")
+    s2 = cat.acquire("alias-b")
+    assert s1 == s2
+    assert loads == [s1]
+    assert cat.resident_count() == 1
+
+
+def test_alpha_is_part_of_content_identity():
+    """alpha folds into B at install, so identical raw weights under
+    different alphas are DIFFERENT effective models — they must never
+    dedup to one pool slot."""
+    cat = ad.AdapterCatalog(CFG, n_adapters=4, rank=RANK)
+    same = _mk_params(3)
+    cat.register("a16", params=same, alpha=16.0)
+    cat.register("a32", params={t: {k: v.copy() for k, v in ab.items()}
+                                for t, ab in same.items()}, alpha=32.0)
+    loads = _bind_fake_loader(cat)
+    s1 = cat.acquire("a16")
+    s2 = cat.acquire("a32")
+    assert s1 != s2
+    assert loads == [s1, s2]
+    assert cat.resident_count() == 2
+
+
+def test_path_alias_shares_one_slot(tmp_path):
+    """Two names registered from the SAME checkpoint path (digest
+    unknown until first load) still converge on one resident slot —
+    one digest must never map two slots."""
+    path = str(tmp_path / "ft.npz")
+    ad.save_adapter(path, _mk_params(9), alpha=8.0)
+    cat = ad.AdapterCatalog(CFG, n_adapters=4, rank=RANK)
+    cat.register("alias-a", path=path)
+    cat.register("alias-b", path=path)
+    _bind_fake_loader(cat)
+    s1 = cat.acquire("alias-a")
+    s2 = cat.acquire("alias-b")
+    assert s1 == s2
+    assert cat.resident_count() == 1
+    assert cat.pins(s1) == 2
+    # The duplicate install's slot went back to the free list: a third
+    # distinct adapter still fits without eviction.
+    cat.register("other", params=_mk_params(11))
+    assert cat.acquire("other") not in (None, s1)
+    assert cat.evictions == 0
+
+
+def test_lru_eviction_and_pinning():
+    """Eviction is LRU over UNPINNED residents; an adapter pinned by
+    an in-flight request is never evicted — a full-pinned pool stalls
+    (None) instead."""
+    cat = ad.AdapterCatalog(CFG, n_adapters=3, rank=RANK)  # 2 + base
+    for i in range(4):
+        cat.register(f"ft-{i}", params=_mk_params(200 + i))
+    _bind_fake_loader(cat)
+    s0 = cat.acquire("ft-0")
+    s1 = cat.acquire("ft-1")
+    assert cat.resident_count() == 2
+    # Pool full, both pinned: a third acquire STALLS, evicts nothing.
+    assert cat.acquire("ft-2") is None
+    assert cat.evictions == 0
+    # Release ft-0's pin: it stays resident (warm) but evictable...
+    cat.release(s0)
+    s2 = cat.acquire("ft-2")
+    assert s2 == s0                  # ...and LRU eviction reused it
+    assert cat.evictions == 1
+    assert cat.resident_count() == 2
+    # ft-1 (still pinned) survived; re-acquiring it is a warm hit.
+    assert cat.acquire("ft-1") == s1
+    assert cat.loads == 3            # ft-0, ft-1, ft-2 — no reload
+
+
+def test_release_refcounts():
+    cat = ad.AdapterCatalog(CFG, n_adapters=2, rank=RANK)
+    cat.register("ft-0", params=_mk_params(1))
+    cat.register("ft-1", params=_mk_params(2))
+    _bind_fake_loader(cat)
+    s = cat.acquire("ft-0")
+    s_again = cat.acquire("ft-0")
+    assert s == s_again and cat.pins(s) == 2
+    cat.release(s)
+    assert cat.pins(s) == 1          # still pinned by the other
+    assert cat.acquire("ft-1") is None
+    cat.release(s)
+    assert cat.pins(s) == 0
+    assert cat.acquire("ft-1") is not None     # now evictable
+    # Base slot (0) never refcounts.
+    assert cat.acquire(None) == 0
+    cat.release(0)
+
+
+def test_rank_validation():
+    cat = ad.AdapterCatalog(CFG, n_adapters=2, rank=2)
+    with pytest.raises(ValueError, match="rank"):
+        cat.register("big", params=_mk_params(1, rank=4))
+    cat.register("small", params=_mk_params(1, rank=1))  # zero-pads
+
+
+def test_save_load_roundtrip(tmp_path, params):
+    """A path-registered .npz checkpoint serves end to end and
+    matches the same adapter registered in memory."""
+    tree = _mk_params(7)
+    path = str(tmp_path / "ft.npz")
+    ad.save_adapter(path, tree, alpha=8.0)
+    loaded, alpha = ad.load_adapter_file(path)
+    assert alpha == 8.0
+    assert set(loaded) == set(tree)
+
+    cat_mem = _catalog(register=0)
+    cat_mem.register("ft", params=tree, alpha=8.0)
+    e1 = _engine(params, cat_mem)
+    r1 = e1.add_request(PROMPTS[0], 6, adapter="ft")
+    e1.run_to_completion()
+    out_mem = [r.tokens for r in e1.finished if r.rid == r1][0]
+
+    cat_path = _catalog(register=0)
+    cat_path.register("ft", path=path)
+    e2 = _engine(params, cat_path)
+    r2 = e2.add_request(PROMPTS[0], 6, adapter="ft")
+    e2.run_to_completion()
+    out_path = [r.tokens for r in e2.finished if r.rid == r2][0]
+    assert out_mem == out_path
+
+
+# ---------------------------------------------------------------------------
+# Greedy parity matrix: {fp32, int8 KV} x {spec on, off}, paged layout.
+
+
+@pytest.mark.parametrize("kv_int8", [False, True],
+                         ids=["fp32", "int8kv"])
+@pytest.mark.parametrize("spec_k", [0, 2], ids=["spec0", "spec2"])
+def test_parity_matrix(params, kv_int8, spec_k):
+    """(a) A zero-adapter request on an adapter-capable engine is
+    bit-identical to an adapterless engine. (b) A mixed-adapter batch
+    is bit-identical to per-adapter sequential runs."""
+    kw = dict(kv_int8=kv_int8, spec_k=spec_k, prefill_chunk=8,
+              prefix_pool=2)
+    base = _engine(params, None, **kw)
+    want = base.generate(PROMPTS, max_new_tokens=6)
+
+    def build():
+        return _engine(params, _catalog(), **kw)
+
+    e = build()
+    got = e.generate(PROMPTS, max_new_tokens=6)
+    assert got == want, "zero-adapter output drifted from adapterless"
+
+    names = ["ft-0", "ft-1", None]
+    e = build()
+    ids = [e.add_request(p, 6, adapter=n)
+           for p, n in zip(PROMPTS, names)]
+    e.run_to_completion()
+    mixed = {r.rid: r.tokens for r in e.finished}
+    for i, (p, n) in enumerate(zip(PROMPTS, names)):
+        solo = build()
+        rid = solo.add_request(p, 6, adapter=n)
+        solo.run_to_completion()
+        assert mixed[ids[i]] == solo.finished[0].tokens, \
+            f"mixed batch diverged from sequential for {n}"
+        if n is None:
+            assert mixed[ids[i]] == want[i]
+        else:
+            assert mixed[ids[i]] != want[i], \
+                "adapter output identical to base — vacuous test"
+
+
+def test_prefix_cache_is_adapter_scoped(params):
+    """Stored K/V rows carry the fine-tune's wk/wv deltas, so the
+    prefix cache must be keyed PER ADAPTER: a shared prompt prefix
+    warmed under adapter A must never serve B or the base model — and
+    within one adapter, the warm hit still pays off and stays
+    bit-identical to cold."""
+    shared = list(np.random.default_rng(5).integers(
+        1, CFG.vocab_size, 24))
+    tails = [[1, 2, 3], [4, 5, 6], [7, 8, 9]]
+    kw = dict(prefill_chunk=8, prefix_pool=4, max_len=64,
+              prompt_buckets=(8, 32))
+
+    def run(e, tail, adapter):
+        rid = e.add_request(shared + tail, 5, adapter=adapter)
+        e.run_to_completion()
+        req = [r for r in e.finished if r.rid == rid][0]
+        e.finished.clear()
+        return list(req.tokens), req.cached_len
+
+    # Cold references, one engine per (adapter, tail).
+    want = {}
+    for i, name in enumerate(["ft-0", "ft-1", None]):
+        solo = _engine(params, _catalog(), **kw)
+        want[name] = run(solo, tails[i], name)[0]
+
+    # One engine, interleaved: A warms the prefix, then B and base
+    # use the same prompt prefix — no cross-adapter hit may occur.
+    e = _engine(params, _catalog(), **kw)
+    out_a, cached_a = run(e, tails[0], "ft-0")
+    assert out_a == want["ft-0"] and cached_a == 0
+    out_b, cached_b = run(e, tails[1], "ft-1")
+    assert cached_b == 0, "cross-adapter prefix hit"
+    assert out_b == want["ft-1"]
+    out_base, cached_base = run(e, tails[2], None)
+    assert cached_base == 0, "adapter-warmed prefix served the base"
+    assert out_base == want[None]
+    # Same adapter again: the warm hit fires and stays bit-identical.
+    out_a2, cached_a2 = run(e, tails[2], "ft-0")
+    assert cached_a2 > 0
+    solo = _engine(params, _catalog(), **kw)
+    assert out_a2 == run(solo, tails[2], "ft-0")[0]
+
+
+# ---------------------------------------------------------------------------
+# Hot-load compile discipline.
+
+
+def test_hot_load_zero_unexpected_compiles(params):
+    """Adapters hot-load/evict mid-traffic under an armed compile
+    watch: adapter count/identity never enters program identity."""
+    cat = ad.AdapterCatalog(CFG, n_adapters=3, rank=RANK)
+    for i in range(6):
+        cat.register(f"ft-{i}", params=_mk_params(300 + i))
+    e = _engine(params, cat, spec_k=2, prefill_chunk=8,
+                max_wave=4, pad_waves=True)
+    e.warm_programs()
+    e.declare_warmup_complete()
+    for i in range(6):
+        e.add_request(PROMPTS[i % len(PROMPTS)], 4,
+                      adapter=f"ft-{i}")
+        e.run_to_completion()
+        e.finished.clear()
+    assert cat.loads >= 6            # every name demand-loaded once
+    assert cat.evictions >= 4        # the pool churned
+    assert e.compile_watch.unexpected == [], (
+        "adapter hot-load caused a mid-traffic compile: "
+        f"{e.compile_watch.unexpected}")
+
+
+def test_pinned_pool_stall_steps_aside(params):
+    """A request whose fine-tune cannot load because every adapter
+    slot is pinned steps ASIDE — base-model traffic behind it keeps
+    admitting (the quota-held idiom, not a head-of-line stall) — and
+    admits once a retirement unpins a slot."""
+    cat = ad.AdapterCatalog(CFG, n_adapters=2, rank=RANK)  # 1 + base
+    cat.register("ft-0", params=_mk_params(1))
+    cat.register("ft-1", params=_mk_params(2))
+    e = _engine(params, cat, n_slots=4)
+    r0 = e.add_request(PROMPTS[0], 8, adapter="ft-0")
+    r1 = e.add_request(PROMPTS[1], 4, adapter="ft-1")   # pool pinned
+    r2 = e.add_request(PROMPTS[2], 4)                   # base, behind
+    e.admit()
+    admitted = {r.rid for r in e.slot_req.values()}
+    assert r0 in admitted
+    assert r1 not in admitted        # held: its pool slot is pinned
+    assert r2 in admitted, "base request head-of-line blocked"
+    e.run_to_completion()            # ft-0 retires -> ft-1 admits
+    by_rid = {r.rid: r for r in e.finished}
+    assert by_rid[r1].error is None and len(by_rid[r1].tokens) == 4
+    assert cat.evictions == 1        # ft-1 evicted the unpinned ft-0
+
+
+def test_aid_device_cache_dirty_tracking(params):
+    """The device aid copy only rebuilds when a claim/retire changed
+    the host array (the table_device idiom)."""
+    e = _engine(params, _catalog())
+    d1 = e.aid_device()
+    assert e.aid_device() is d1
+    rid = e.add_request(PROMPTS[0], 3, adapter="ft-0")
+    e.admit()
+    d2 = e.aid_device()
+    assert d2 is not d1
+    slot = [r for r in e.slot_req.values() if r.rid == rid][0].slot
+    assert int(np.asarray(d2)[slot]) > 0
+    e.run_to_completion()
+    assert int(np.asarray(e.aid_device())[slot]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the adapter.load fault point.
+
+
+def _chaos_plan(times):
+    return chaos_plan.parse_plan({
+        "seed": 0,
+        "faults": [{"point": "adapter.load",
+                    "match": {"adapter": "ft-0"},
+                    "times": times, "error": "OSError",
+                    "message": "injected load fault"}],
+    })
+
+
+def test_load_fault_retries_then_succeeds(params):
+    """One injected fault is absorbed by utils/retry — the request
+    generates normally under its fine-tune."""
+    cat = _catalog()
+    e = _engine(params, cat)
+    ref = _engine(params, _catalog())
+    rid_ref = ref.add_request(PROMPTS[0], 5, adapter="ft-0")
+    ref.run_to_completion()
+    want = [r.tokens for r in ref.finished if r.rid == rid_ref][0]
+    chaos.configure(_chaos_plan(times=1))
+    try:
+        rid = e.add_request(PROMPTS[0], 5, adapter="ft-0")
+        e.run_to_completion()
+    finally:
+        chaos.deactivate()
+    got = [r for r in e.finished if r.rid == rid][0]
+    assert got.error is None
+    assert got.tokens == want
+    assert cat.loads == 1
+
+
+def test_load_fault_exhaustion_fails_typed(params):
+    """Exhausted retries fail the REQUEST typed — it never falls
+    through to the base model's weights — while other requests keep
+    admitting."""
+    cat = _catalog()
+    e = _engine(params, cat)
+    chaos.configure(_chaos_plan(times=4))
+    try:
+        rid_bad = e.add_request(PROMPTS[0], 5, adapter="ft-0")
+        rid_ok = e.add_request(PROMPTS[1], 5, adapter="ft-1")
+        rid_base = e.add_request(PROMPTS[2], 5)
+        e.run_to_completion()
+    finally:
+        chaos.deactivate()
+    by_rid = {r.rid: r for r in e.finished}
+    bad = by_rid[rid_bad]
+    assert bad.error is not None
+    assert bad.error["type"] == "adapter_load_failed"
+    assert bad.error["adapter"] == "ft-0"
+    assert bad.tokens == []          # NOT base-model output
+    assert len(by_rid[rid_ok].tokens) == 5
+    assert len(by_rid[rid_base].tokens) == 5
+    # The failed slot never became resident; the pool has no leak.
+    assert cat.resident_count() == 1          # ft-1 only
+    # The catalog recovers once the fault clears.
+    rid2 = e.add_request(PROMPTS[0], 5, adapter="ft-0")
+    e.run_to_completion()
+    assert by_rid[rid_bad].error is not None
+    got2 = [r for r in e.finished if r.rid == rid2][0]
+    assert got2.error is None and len(got2.tokens) == 5
+
+
+# ---------------------------------------------------------------------------
+# Model-server tier: model= field, typed 404 (blocking AND stream),
+# typed load failure, trailer.
+
+
+@pytest.fixture(scope="module")
+def model_server(params):
+    cat = ad.AdapterCatalog(CFG, n_adapters=4, rank=RANK)
+    for i in range(3):
+        cat.register(f"ft-{i}", params=_mk_params(100 + i))
+    engine = eng.InferenceEngine(params, CFG, n_slots=2, max_len=64,
+                                 prompt_buckets=(16,), adapters=cat)
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    model, httpd = srv.serve(engine, host="127.0.0.1", port=port)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    assert model._ready.wait(timeout=300)
+    yield f"http://127.0.0.1:{port}", engine
+    model.shutdown()
+    httpd.shutdown()
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_model_generates_under_adapter(model_server, params):
+    url, engine = model_server
+    prompt = [3, 17, 42]
+    solo = _engine(params, _catalog(), kv_block=0,
+                   prompt_buckets=(16,), n_slots=1)
+    rid = solo.add_request(prompt, 5, adapter="ft-0")
+    solo.run_to_completion()
+    want = [r.tokens for r in solo.finished if r.rid == rid][0]
+    code, out = _post(f"{url}/generate",
+                      {"tokens": prompt, "max_new_tokens": 5,
+                       "model": "ft-0"})
+    assert code == 200
+    assert out["tokens"] == want
+    assert out["model"] == "ft-0"    # the trailer names the fine-tune
+
+
+def test_http_model_header_path(model_server):
+    url, _ = model_server
+    code, out = _post(f"{url}/generate",
+                      {"tokens": [1, 2], "max_new_tokens": 3},
+                      headers={ad.MODEL_HEADER: "ft-1"})
+    assert code == 200 and out["model"] == "ft-1"
+
+
+def test_http_unknown_adapter_404(model_server):
+    url, _ = model_server
+    code, out = _post(f"{url}/generate",
+                      {"tokens": [1, 2], "max_new_tokens": 3,
+                       "model": "nope"})
+    assert code == 404
+    assert out["error"]["type"] == "unknown_adapter"
+    assert out["error"]["adapter"] == "nope"
+
+
+def test_http_unknown_adapter_404_stream(model_server):
+    """The stream path rejects BEFORE any 200/stream bytes go out —
+    a clean typed 404, not an error chunk mid-stream."""
+    url, _ = model_server
+    code, out = _post(f"{url}/generate",
+                      {"tokens": [1, 2], "max_new_tokens": 3,
+                       "stream": True, "model": "nope"})
+    assert code == 404
+    assert out["error"]["type"] == "unknown_adapter"
+
+
+def test_http_load_failure_typed(model_server):
+    """A mid-traffic load failure surfaces as the typed 503 body on
+    the blocking path and as a typed error chunk on a live stream."""
+    url, _ = model_server
+    chaos.configure(chaos_plan.parse_plan({
+        "seed": 0,
+        "faults": [{"point": "adapter.load",
+                    "match": {"adapter": "ft-2"},
+                    "error": "OSError", "message": "injected"}],
+    }))
+    try:
+        code, out = _post(f"{url}/generate",
+                          {"tokens": [1, 2], "max_new_tokens": 3,
+                           "model": "ft-2"})
+    finally:
+        chaos.deactivate()
+    assert code == 503
+    assert out["error"]["type"] == "adapter_load_failed"
+    assert out["error"]["adapter"] == "ft-2"
+
+
+def test_http_stream_load_failure_error_chunk(model_server):
+    """Stream path: the load failure happens AFTER admission (claim
+    time), so the stream is already open — the typed error must ride
+    a stream chunk, not vanish."""
+    url, _ = model_server
+    chaos.configure(chaos_plan.parse_plan({
+        "seed": 0,
+        "faults": [{"point": "adapter.load",
+                    "match": {"adapter": "ft-2"},
+                    "error": "OSError", "message": "injected"}],
+    }))
+    try:
+        req = urllib.request.Request(
+            f"{url}/generate",
+            data=json.dumps({"tokens": [1, 2], "max_new_tokens": 3,
+                             "stream": True,
+                             "model": "ft-2"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            lines = [json.loads(x) for x in r.read().decode()
+                     .strip().split("\n") if x]
+    finally:
+        chaos.deactivate()
+    assert any(c.get("error", {}).get("type") == "adapter_load_failed"
+               for c in lines if isinstance(c.get("error"), dict)), lines
+
+
+# ---------------------------------------------------------------------------
+# Load-balancer tier: typed 404 one hop early + affinity routing.
+
+
+class _FakeReplica(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    seen = []     # (port, path, model)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(n) or b"{}")
+        type(self).seen.append((self.server.server_address[1],
+                                self.path, body.get("model")))
+        out = json.dumps({"tokens": [1], "model": body.get("model")})
+        out = out.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def adapter_lb(tmp_path, monkeypatch):
+    from skypilot_tpu.serve import load_balancer, serve_state
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "home"))
+    load_balancer._adapter_cache.clear()
+    _FakeReplica.seen = []
+    replicas, urls = [], []
+    for _ in range(2):
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                _FakeReplica)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        replicas.append(httpd)
+        urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+    serve_state.add_service(
+        "adlb", {"adapters": {"ft-a": "/ckpt/a.npz",
+                              "ft-b": "/ckpt/b.npz"}}, {}, 0)
+    for i, u in enumerate(urls):
+        serve_state.upsert_replica("adlb", i + 1, f"r{i + 1}",
+                                   serve_state.ReplicaStatus.READY, u)
+    httpd = load_balancer._ThreadingServer(
+        ("127.0.0.1", 0),
+        load_balancer.make_handler("adlb",
+                                   load_balancer.LeastLoadPolicy()))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", urls
+    httpd.shutdown()
+    for r in replicas:
+        r.shutdown()
+    load_balancer._adapter_cache.clear()
+
+
+def test_lb_unknown_adapter_404(adapter_lb):
+    lb_url, _ = adapter_lb
+    code, out = _post(f"{lb_url}/generate",
+                      {"tokens": [1], "model": "nope"})
+    assert code == 404
+    assert out["error"]["type"] == "unknown_adapter"
+    assert not _FakeReplica.seen     # rejected BEFORE a proxied hop
+
+
+def test_lb_unknown_adapter_404_stream(adapter_lb):
+    lb_url, _ = adapter_lb
+    code, out = _post(f"{lb_url}/generate",
+                      {"tokens": [1], "stream": True, "model": "nope"})
+    assert code == 404
+    assert out["error"]["type"] == "unknown_adapter"
+
+
+def test_lb_known_adapter_routes_with_affinity(adapter_lb):
+    """Known names pass through AND stick to one replica (rendezvous
+    affinity keeps each fine-tune's device pool warm)."""
+    lb_url, _ = adapter_lb
+    for _ in range(4):
+        code, out = _post(f"{lb_url}/generate",
+                          {"tokens": [1], "model": "ft-a"})
+        assert code == 200 and out["model"] == "ft-a"
+    ports = {p for p, _, m in _FakeReplica.seen if m == "ft-a"}
+    assert len(ports) == 1           # all four hit ONE replica
+    # Header path routes identically to the body path.
+    code, out = _post(f"{lb_url}/generate", {"tokens": [1]},
+                      headers={ad.MODEL_HEADER: "ft-a"})
+    assert code == 200
+    assert {p for p, _, m in _FakeReplica.seen} == ports
+    # Base-model traffic still spreads via the policy (no affinity).
+    for _ in range(4):
+        code, _ = _post(f"{lb_url}/generate", {"tokens": [1]})
+        assert code == 200
+    assert len({p for p, _, m in _FakeReplica.seen if m is None}) == 2
+
+
+# ---------------------------------------------------------------------------
+# Service spec + smoke-bench wiring.
+
+
+def test_service_spec_adapters_roundtrip():
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec.from_yaml_config({
+        "port": 8080, "replicas": 2,
+        "adapters": {"ft-a": "/ckpt/a.npz", "ft-b": "/ckpt/b.npz"},
+    })
+    assert spec.adapters == {"ft-a": "/ckpt/a.npz",
+                             "ft-b": "/ckpt/b.npz"}
+    rt = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert rt.adapters == spec.adapters
+    with pytest.raises(exceptions.ServeError, match="adapters"):
+        SkyServiceSpec(adapters={"": "/x"})
+
+
+@pytest.mark.slow
+def test_adapter_smoke_bench():
+    """CI-sized bench wiring: overhead reported, parity and the
+    zero-compile contract hold (the 1.15x TPOT gate binds via
+    bench.py on hardware)."""
+    from skypilot_tpu.infer import bench_serve
+    r = bench_serve.run_adapters_smoke()
+    assert r["parity_ok"]
+    assert r["unexpected_compiles"] == 0
+    assert r["hot_loads"] > 0 and r["evictions"] > 0
+    assert r["overhead_ratio"] > 0

@@ -161,6 +161,17 @@ _GOLDEN = [
     ("host-sync", "host_sync_kernel_bad.py",
      "host_sync_kernel_clean.py",
      "skypilot_tpu/infer/engine.py"),
+    # Multi-LoRA adapter catalog (PR 13): the per-slot (A, B) gather
+    # is guarded like the paged/span/spec shapes (adapter identity
+    # must stay device DATA — concretizing it bakes one fine-tune
+    # into the program), and the catalog claim/retire bookkeeping
+    # joined the host-sync engine scope (v8).
+    ("retrace-safety", "retrace_adapter_bad.py",
+     "retrace_adapter_clean.py",
+     "skypilot_tpu/infer/fixture_retrace_adapter.py"),
+    ("host-sync", "host_sync_adapter_bad.py",
+     "host_sync_adapter_clean.py",
+     "skypilot_tpu/infer/engine.py"),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      "skypilot_tpu/utils/fixture_locks.py"),
     ("typed-errors", "typed_errors_bad.py", "typed_errors_clean.py",
