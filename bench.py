@@ -375,14 +375,55 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"occupancy bench failed: {e}")
             out["serve_occupancy_error"] = str(e)[:200]
-        # Speculative-decoding phase: spec-on vs spec-off decode TPOT
-        # on the same engine (repetition-heavy workload) plus the
-        # oracle-draft ceiling — the raw-TPOT lever tracked release
-        # over release (ROADMAP item 2).
+        # Speculative-decoding phase. Headline: the MODEL-backed
+        # drafter + async draft/verify pipeline on the NON-repetitive
+        # workload (the honest one — n-gram speculation is a wash
+        # there by design and rides along as a reported column).
+        # Secondary: the PR 8 repetition-heavy n-gram column + the
+        # oracle-draft ceiling, keys and meanings unchanged. The
+        # >= 1.5x wall-clock gates bind on TPU runs only (the
+        # kernel-bench precedent: a compute-bound CPU cannot show a
+        # memory-bandwidth win); parity and the pipeline-overlap
+        # structure gate everywhere.
         try:
             from skypilot_tpu.infer import bench_serve as _bs
             sp = _bs.run_spec(config=serve_cfg, weights_int8=big,
                               kv_int8=big)
+            on_tpu = sp["backend"] == "tpu"
+            out["serve_spec_model_speedup"] = sp["model_speedup"]
+            out["serve_spec_model_accept_rate"] = \
+                sp["model_accept_rate"]
+            out["serve_spec_model_tpot_off_ms"] = \
+                sp["model_tpot_off_ms"]
+            out["serve_spec_model_tpot_ms"] = sp["tpot_model_ms"]
+            out["serve_spec_model_tpot_sync_ms"] = \
+                sp["tpot_model_sync_ms"]
+            out["serve_spec_pipeline_ratio"] = sp["pipeline_ratio"]
+            out["serve_spec_overlap_ok"] = sp["overlap_ok"]
+            out["serve_spec_ngram_nonrep_speedup"] = \
+                sp["ngram_nonrep_speedup"]
+            out["serve_spec_ngram_nonrep_accept_rate"] = \
+                sp["ngram_nonrep_accept_rate"]
+            out["serve_spec_model_parity_ok"] = bool(
+                sp["model_parity_ok"] and sp["model_sync_parity_ok"]
+                and sp["ngram_nonrep_parity_ok"])
+            # Gate: >= 1.5x decode tok/s from the model drafter on the
+            # non-repetitive workload (TPU; the tentpole target is
+            # 2x), bit-identical greedy output in every mode, and the
+            # pipeline's draft dispatches structurally inside verify
+            # windows.
+            out["serve_spec_model_regressed"] = bool(
+                not out["serve_spec_model_parity_ok"]
+                or not sp["overlap_ok"]
+                or (on_tpu and sp["model_speedup"] < 1.5))
+            if out["serve_spec_model_regressed"]:
+                log("SERVE SPEC MODEL REGRESSION: "
+                    f"x{sp['model_speedup']} (< 1.5 on TPU) or "
+                    f"parity broken "
+                    f"(model={sp['model_parity_ok']}, "
+                    f"sync={sp['model_sync_parity_ok']}, "
+                    f"ngram={sp['ngram_nonrep_parity_ok']}) or "
+                    f"overlap_ok={sp['overlap_ok']}")
             out["serve_spec_speedup"] = sp["speedup"]
             out["serve_spec_accept_rate"] = sp["accept_rate"]
             out["serve_spec_tpot_off_ms"] = sp["tpot_off_ms"]
@@ -392,11 +433,10 @@ def main() -> None:
                 sp["oracle_accept_rate"]
             out["serve_spec_parity_ok"] = bool(
                 sp["parity_ok"] and sp["oracle_parity_ok"])
-            # Gate: >= 1.5x decode tok/s on the repetition-heavy
-            # workload with bit-identical greedy output (the tentpole
-            # target is 2x; 1.5x is the regression floor).
+            # Secondary gate: the repetition-heavy n-gram column keeps
+            # its floor on TPU with bit-identical greedy output.
             out["serve_spec_regressed"] = bool(
-                sp["speedup"] < 1.5
+                (on_tpu and sp["speedup"] < 1.5)
                 or not out["serve_spec_parity_ok"])
             if out["serve_spec_regressed"]:
                 log("SERVE SPEC REGRESSION: "

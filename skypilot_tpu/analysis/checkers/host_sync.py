@@ -74,6 +74,23 @@ _SCOPES: Dict[str, Set[str]] = {
         # would stall admission exactly like a block-count fetch.
         "_acquire_adapter", "_release_adapter", "_set_slot_adapter",
         "aid_device", "_lora_args", "_fail_request",
+        # Draft-model pipeline (PR 14): drafter-mode resolution runs
+        # per slot per verify round from pure request bookkeeping — a
+        # device fetch to pick a drafter rung would stall every spec
+        # dispatch.
+        "_spec_mode",
+    },
+    # Model-backed drafter (PR 14): draft_batch/rollout run once per
+    # verify round on the engine loop; everything except the draft
+    # path's OWN completion fetch (the next verify window needs the
+    # token values — baselined with justification) must stay pure
+    # host bookkeeping, or the pipeline stalls the very verify
+    # in-flight window it exists to overlap.
+    "skypilot_tpu/infer/draft.py": {
+        "draft_batch", "rollout", "_apply_pending", "_apply_rollout",
+        "_sync_slot", "_ingest", "_dispatch_sync",
+        "_dispatch_rollout", "release", "_acquire", "table_device",
+        "_span_for", "_span_arg", "claimed", "stats",
     },
     # Adapter-catalog residency bookkeeping: acquire runs at every
     # claim (the hot-load inside it is a cold path by design — a
@@ -130,7 +147,11 @@ class HostSyncChecker(Checker):
     #     acquire/release/aid bookkeeping and the catalog's residency
     #     path (infer/adapters.py) joined the scope; the bump rescans
     #     the edited claim/retire hot path cold.
-    version = 8
+    # v9: draft-model speculation + async pipeline (PR 14) — the
+    #     engine's drafter-mode ladder and the DraftEngine's
+    #     draft/rollout/lockstep path (infer/draft.py) joined the
+    #     scope; the bump rescans the edited spec hot path cold.
+    version = 9
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

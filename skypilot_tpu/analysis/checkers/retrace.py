@@ -104,7 +104,12 @@ class RetraceSafetyChecker(Checker):
     # jit-reachable surface (kvcache lora plumbing + engine
     # _adapter_install); the bump rescans the edited programs and the
     # new adapter fixtures cold.
-    version = 4
+    # v5: draft-model speculation (PR 14) — infer/draft.py's jitted
+    # rollout/ingest/sync programs are new roots in the infer/ root
+    # dir and kvcache.sync_slots joined the reachable surface; the
+    # bump rescans the edited spec programs and the new draft
+    # fixtures cold.
+    version = 5
 
     def check_project(self, ctxs: Sequence[FileContext],
                       root: str) -> List[Finding]:

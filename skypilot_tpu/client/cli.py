@@ -423,18 +423,47 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
                 line += ("  attn " + ("mixed" if kern and gath
                                       else "kernel" if kern
                                       else "gather"))
-        # Speculative-decode acceptance (docs/serving.md): the window
-        # rate when drafting happened between frames, else the
-        # engines' lifetime gauge (first frame / --once / idle).
+        # Speculative-decode drafter kind + acceptance (docs/
+        # serving.md): which drafter rung the fleet's spec rounds rode
+        # (model|ngram|mixed — the fallback ladder is observable at a
+        # glance), the window acceptance rate when drafting happened
+        # between frames (else the engines' lifetime gauge), and the
+        # pipeline overlap ratio — draft-dispatch wall the rounds hid
+        # inside the verify's dispatch->fetch window.
         if "skytpu_spec_drafted_total" in have:
+            def _kind(k, window=True):
+                if window:
+                    v = rate("skytpu_spec_draft_tokens_total",
+                             match={"drafter": k})
+                else:
+                    v = aggregate.sample_value(
+                        fams, "skytpu_spec_draft_tokens_total",
+                        match={"drafter": k})
+                return v or 0
+            model, ngram = _kind("model"), _kind("ngram")
+            if not model and not ngram:
+                # Idle window / first frame: lifetime totals, the
+                # attn-indicator idiom — one flowing kind means the
+                # fleet drafts THAT way now.
+                model = _kind("model", window=False)
+                ngram = _kind("ngram", window=False)
+            kind = ("mixed" if model and ngram
+                    else "model" if model
+                    else "ngram" if ngram else None)
             d_dr = rate("skytpu_spec_drafted_total")
             d_ac = rate("skytpu_spec_accepted_total")
-            if d_dr:
-                line += f"  spec acc {(d_ac or 0) / d_dr:4.0%}"
-            else:
-                g = gauge("skytpu_spec_acceptance_rate", agg="max")
-                if g is not None:
-                    line += f"  spec acc {g:4.0%}"
+            acc = ((d_ac or 0) / d_dr if d_dr
+                   else gauge("skytpu_spec_acceptance_rate", agg="max"))
+            if acc is not None:
+                line += (f"  spec {kind} acc {acc:4.0%}" if kind
+                         else f"  spec acc {acc:4.0%}")
+            ov = rate("skytpu_spec_overlap_wall_seconds_total")
+            vw = rate("skytpu_spec_verify_wall_seconds_total")
+            if ov is None or not vw:
+                ov = gauge("skytpu_spec_overlap_wall_seconds_total")
+                vw = gauge("skytpu_spec_verify_wall_seconds_total")
+            if ov is not None and vw:
+                line += f"  ovl {min(ov / vw, 1.0):4.0%}"
         # Fleet prefix-cache hit rate (ROADMAP item 3 slice): the
         # federation already sums per-replica counters — the window
         # rate when traffic flowed between frames, else the lifetime

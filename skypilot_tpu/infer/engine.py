@@ -124,6 +124,23 @@ SPEC_ACCEPT_RATE = metrics.gauge(
     "skytpu_spec_acceptance_rate",
     "Speculative-decode lifetime acceptance rate "
     "(accepted / drafted; 0 until the first draft)")
+SPEC_DRAFT_TOKENS = metrics.counter(
+    "skytpu_spec_draft_tokens_total",
+    "Speculative-decode draft tokens proposed, by drafter kind: "
+    "'model' = the draft-model engine (infer/draft.py), 'ngram' = "
+    "the host prompt-lookup drafter (also the demotion fallback) — "
+    "the fallback ladder model -> ngram -> off is observable per "
+    "window", labelnames=("drafter",))
+SPEC_VERIFY_WALL = metrics.counter(
+    "skytpu_spec_verify_wall_seconds_total",
+    "Host wall seconds spent per verify round, dispatch to fetch — "
+    "the window the async draft pipeline overlaps draft work into")
+SPEC_OVERLAP_WALL = metrics.counter(
+    "skytpu_spec_overlap_wall_seconds_total",
+    "Host wall seconds spent dispatching the NEXT round's draft "
+    "rollout while the current verify was in flight (the pipelined "
+    "predraft); overlap ratio = this over "
+    "skytpu_spec_verify_wall_seconds_total")
 DECODE_ATTN_ROWS = metrics.histogram(
     "skytpu_decode_attn_rows",
     "Span bucket (logical KV rows gathered per slot) actually "
@@ -190,6 +207,15 @@ class Request:
     spec_accepted: int = 0
     spec_off: bool = False
     drafter: Optional[Any] = None
+    # Drafter kind this request is currently riding ("model" when the
+    # engine has a DraftEngine, else "ngram"; "off" once collapsed).
+    # The acceptance-collapse fallback DEMOTES down the ladder
+    # model -> ngram -> off, with a fresh acceptance window per rung
+    # (spec_mode_drafted/accepted reset on demotion — the lifetime
+    # spec_drafted/accepted keep feeding the trailer).
+    spec_mode: Optional[str] = None
+    spec_mode_drafted: int = 0
+    spec_mode_accepted: int = 0
     # Multi-tenant QoS (docs/serving.md §Multi-tenant QoS): tenant
     # feeds the fair scheduler and flight attribution; priority picks
     # the lane (higher preempts lower); ``preemptions`` counts how
@@ -541,6 +567,8 @@ class InferenceEngine:
                  kv_blocks: Optional[int] = None,
                  spec_k: Optional[int] = None,
                  spec_drafter: Optional[Callable] = None,
+                 draft_engine: Optional[Any] = None,
+                 spec_pipeline: Optional[bool] = None,
                  span_buckets=None, kv_lazy: Optional[bool] = None,
                  kv_kernel: Optional[bool] = None,
                  flight_recorder: Optional[
@@ -618,16 +646,40 @@ class InferenceEngine:
             spec_k = 0
         self.spec_k = spec_k
         # Pluggable drafter factory (request -> drafter with the
-        # NGramDrafter protocol: catch_up/draft). The seam a future
-        # draft-model drafter plugs into; default is prompt-lookup.
+        # NGramDrafter protocol: catch_up/draft). The per-request seam
+        # PR 8 built; default is prompt-lookup. It is ALSO the
+        # demotion target: a request whose model-draft acceptance
+        # collapses falls back to this factory's drafter.
         self._spec_drafter_factory = (
             spec_drafter
             if spec_drafter is not None
             else (lambda req: NGramDrafter(req.prompt)))
+        # Model-backed batched drafter (infer/draft.py DraftEngine):
+        # when present, requests start in "model" mode — K tokens per
+        # slot per round from the draft model's own staged-burst
+        # program, its paged KV advanced/rolled-back in lockstep with
+        # the verifier's commits. The n-gram factory above stays the
+        # zero-cost fallback rung.
+        self.draft_engine = draft_engine
+        # Async draft/verify pipeline: while a verify dispatch is in
+        # flight, the drafter runs the NEXT round's rollout (its
+        # prediction of the bonus token + the following K drafts) and
+        # the fetch reconciles — a matched predraft serves the next
+        # round with zero new draft work, a miss is discarded
+        # host-side (drafter rollback = length non-advance). Only
+        # meaningful with a model drafter (n-gram drafting is pure
+        # host work with nothing to overlap). Knob:
+        # SKYTPU_SPEC_PIPELINE (default on; ctor arg wins).
+        if spec_pipeline is None:
+            spec_pipeline = (
+                os.environ.get("SKYTPU_SPEC_PIPELINE", "1") != "0")
+        self.spec_pipeline = bool(spec_pipeline) \
+            and draft_engine is not None
         # Per-request acceptance-collapse fallback: once a request has
         # drafted >= spec_min_drafted tokens at an acceptance rate
-        # below spec_min_rate, it stops drafting (spec_off) — verify
-        # compute stops being wasted on a workload n-grams can't
+        # below spec_min_rate IN ITS CURRENT MODE, it demotes down the
+        # drafter ladder (model -> ngram -> off) — verify compute
+        # stops being wasted on a workload the current drafter can't
         # predict, and the burst degrades to plain decode when every
         # active request has collapsed.
         self.spec_min_drafted = 16
@@ -1079,7 +1131,9 @@ class InferenceEngine:
     def _record_flight(self, burst: str, begin_s: float, end_s: float,
                        program: Dict[str, Any], slots, reqs,
                        toks: int, stall: bool = False,
-                       drafted: int = 0, accepted: int = 0) -> None:
+                       drafted: int = 0, accepted: int = 0,
+                       drafter: Optional[str] = None,
+                       overlap_ms: float = 0.0) -> None:
         """Append one burst record to the flight recorder. HOST
         bookkeeping only — every value here already lives on the host
         (request lists, ints, floats); a device fetch on this path
@@ -1114,6 +1168,17 @@ class InferenceEngine:
         if drafted:
             extra["drafted"] = drafted
             extra["accepted"] = accepted
+        if drafter:
+            # Which drafter kind fed this burst (verify bursts:
+            # model|ngram|mixed group composition; "draft" records:
+            # the pipelined predraft dispatch itself).
+            extra["drafter"] = drafter
+        if overlap_ms:
+            # Host wall the round spent dispatching next-round draft
+            # work INSIDE the verify's dispatch->fetch window — the
+            # pipeline-overlap attribution skytpu flight/--perfetto
+            # render as overlapping spans.
+            extra["overlap_ms"] = overlap_ms
         if cow:
             extra["cow"] = cow
         if evs:
@@ -1162,6 +1227,11 @@ class InferenceEngine:
         ``skytpu_unexpected_compiles_total`` (the SLO watchdog's
         ``unexpected-compiles`` rule alarms on it)."""
         self.compile_watch.declare_warm()
+        if self.draft_engine is not None:
+            # The drafter's programs are part of this replica's live
+            # surface: a mid-traffic draft-model compile stalls the
+            # spec path exactly like a main-engine one.
+            self.draft_engine.declare_warmup_complete()
 
     def warm_programs(self, max_burst: int = 8) -> int:
         """Pre-compile the engine's reachable program grid so no XLA
@@ -1293,7 +1363,14 @@ class InferenceEngine:
                 flight_lib.COMPILE_SECONDS.labels(
                     program=key).observe(summ[key])
                 flight_lib.PROGRAMS_COMPILED.inc()
-        return self.compile_watch.count - before
+        n = self.compile_watch.count - before
+        if self.spec_k and self.draft_engine is not None:
+            # The drafter's grid (rollouts at K and K+1 per span rung,
+            # ingest, sync) is reachable the moment the first request
+            # drafts — warm it with the engine's, or a live replica's
+            # first spec round pays a draft-model compile.
+            n += self.draft_engine.warm_programs(self.spec_k)
+        return n
 
     # -- paged block management --------------------------------------------
 
@@ -1788,6 +1865,8 @@ class InferenceEngine:
         self.free_slots.append(slot)
         self._free_slot_blocks(slot)
         self._set_slot_adapter(slot, 0)
+        if self.draft_engine is not None:
+            self.draft_engine.release(slot)
         self._release_adapter(req)
         req.slot = None
         req.preemptions += 1
@@ -1963,7 +2042,7 @@ class InferenceEngine:
         return (self.prefill_chunk is not None
                 and self._ctx_len(req) > self.prefill_chunk)
 
-    def _claim_chunked(self, req: Request) -> bool:
+    def _claim_chunked(self, req: Request) -> str:
         """Claim a slot for an incremental prefill: look up the prefix
         cache, reuse a hit's rows (suffix-only prefill), and queue the
         remaining chunks. The claim stamps the slot's cache length to
@@ -2373,6 +2452,11 @@ class InferenceEngine:
             self.free_slots.append(req.slot)
             self._free_slot_blocks(req.slot)
             self._set_slot_adapter(req.slot, 0)
+            if self.draft_engine is not None:
+                # Drafter lifecycle rides the slot's: the mirrored
+                # draft slot frees its blocks with the main slot (a
+                # reused slot's next occupant re-ingests from zero).
+                self.draft_engine.release(req.slot)
             req.slot = None
         self._release_adapter(req)
         SLOTS_ACTIVE.set(len(self.slot_req))
@@ -2435,6 +2519,10 @@ class InferenceEngine:
             self.adapters.reset()
             self.adapter_ids[:] = 0
             self._aid_dirty = True
+        if self.draft_engine is not None:
+            # Drafter state mirrors the slots just wiped; a failure
+            # mid-rollout may have left its counts inconsistent too.
+            self.draft_engine.reset()
         self._update_gauges()
 
     def step_burst(self, max_burst: int = 8,
@@ -2473,22 +2561,52 @@ class InferenceEngine:
             return {}
         return self.complete_decode_burst(handle)
 
-    def _draft_for(self, req: Request) -> List[int]:
-        """This request's draft for the next verify burst (possibly
-        empty). Host-only: builds the drafter lazily, syncs it with
-        tokens committed through any path, and applies the
-        acceptance-collapse fallback."""
+    def _spec_mode(self, req: Request) -> str:
+        """Resolve (and advance) this request's drafter rung. Requests
+        start at "model" when the engine has a DraftEngine, else
+        "ngram" (the factory seam — custom test drafters ride it too).
+        Acceptance collapse in the CURRENT mode (>= spec_min_drafted
+        drafted below spec_min_rate accepted since the last demotion)
+        demotes one rung: model -> ngram (fresh window, fresh factory
+        drafter, draft-engine slot released) -> off."""
         if req.spec_off:
-            return []
-        if (req.spec_drafted >= self.spec_min_drafted
-                and req.spec_accepted
-                < self.spec_min_rate * req.spec_drafted):
-            req.spec_off = True
-            return []
+            return "off"
+        if req.spec_mode is None:
+            req.spec_mode = ("model" if self.draft_engine is not None
+                             else "ngram")
+        if req.spec_mode == "model" and self.draft_engine is None:
+            # The drafter was detached mid-flight (tests/bench toggle
+            # routing between passes): fall to the factory rung with a
+            # fresh window rather than dereference a gone engine.
+            req.spec_mode = "ngram"
+            req.spec_mode_drafted = 0
+            req.spec_mode_accepted = 0
+        if (req.spec_mode_drafted >= self.spec_min_drafted
+                and req.spec_mode_accepted
+                < self.spec_min_rate * req.spec_mode_drafted):
+            if req.spec_mode == "model":
+                req.spec_mode = "ngram"
+                req.spec_mode_drafted = 0
+                req.spec_mode_accepted = 0
+                req.drafter = None       # factory rebuilds on demand
+                if self.draft_engine is not None \
+                        and req.slot is not None:
+                    self.draft_engine.release(req.slot)
+            else:
+                req.spec_mode = "off"
+                req.spec_off = True
+        return req.spec_mode
+
+    def _draft_for(self, req: Request) -> List[int]:
+        """This request's draft through the per-request factory seam
+        (n-gram by default; the demotion rung below the model
+        drafter). Host-only: builds the drafter lazily and syncs it
+        with tokens committed through any path."""
         if req.drafter is None:
             req.drafter = self._spec_drafter_factory(req)
             if req.drafter is None:          # factory opted this one out
                 req.spec_off = True
+                req.spec_mode = "off"
                 return []
         req.drafter.catch_up(req.prompt, req.tokens)
         return req.drafter.draft(self.spec_k)
@@ -2500,10 +2618,16 @@ class InferenceEngine:
         (+ the correction token) commits — up to K+1 tokens per slot
         per device call instead of 1.
 
-        Synchronous by design (unlike the async plain-burst pair): the
-        NEXT draft depends on the tokens this burst commits, so there
-        is nothing to double-buffer — the fetch below IS the
-        completion fetch.
+        The verify FETCH is synchronous (the next round's window needs
+        these tokens), but with a model drafter and ``spec_pipeline``
+        the round is internally overlapped: the NEXT round's draft
+        rollout dispatches while the verify program is in flight (the
+        device chews on it behind the verify; the host fetches it
+        lazily next round), so neither model waits on the other — the
+        overlap PR 8's spec engines forfeited by skipping the async
+        double-buffer. A mispredicted predraft is discarded host-side
+        at the next ``draft_batch`` (drafter rollback = length
+        non-advance, free under paged blocks).
 
         Returns None when the spec path can't run this round and the
         caller should fall back to a plain decode burst: no active
@@ -2517,6 +2641,7 @@ class InferenceEngine:
         draft = np.zeros((self.n_slots + 1, K), np.int32)
         n_draft = np.zeros((self.n_slots + 1,), np.int32)
         dlen: Dict[int, int] = {}
+        model_reqs: Dict[int, Request] = {}
         for slot, req in self.slot_req.items():
             # A slot within K+1 rows of max_len drafts NOTHING instead
             # of disabling speculation engine-wide: its single
@@ -2529,11 +2654,28 @@ class InferenceEngine:
             # the cap via _req_finished.)
             if len(req.prompt) + len(req.tokens) + K + 1 > self.max_len:
                 continue
+            mode = self._spec_mode(req)
+            if mode == "off":
+                continue
+            if mode == "model":
+                # Model-mode slots draft BATCHED below: one draft-
+                # model dispatch covers every such slot (the whole
+                # point of a DraftEngine over per-request drafters).
+                model_reqs[slot] = req
+                continue
             d = self._draft_for(req)
             if d:
                 n_draft[slot] = len(d)
                 draft[slot, :len(d)] = d
                 dlen[slot] = len(d)
+        if model_reqs:
+            batch = self.draft_engine.draft_batch(
+                {s: self._ctx(r) for s, r in model_reqs.items()}, K)
+            for slot, d in batch.items():
+                if d:
+                    n_draft[slot] = len(d)
+                    draft[slot, :len(d)] = d
+                    dlen[slot] = len(d)
         if not dlen:
             return None
         # Span regrouping, exactly as the plain burst: one verify
@@ -2568,18 +2710,45 @@ class InferenceEngine:
                 **self._lora_args())
             parts.append((slots, toks_dev, commit_dev))
             part_spans.append(sarg)
-        # THE completion fetch: verify bursts are synchronous (the next
-        # draft depends on these tokens), so this is the one deliberate
-        # sync of the spec path — same role as complete_decode_burst's.
+        # Pipelined predraft: with the verify program(s) now in
+        # flight, roll the draft model forward K+1 steps for the
+        # model-drafting slots — its prediction of the verifier's
+        # bonus/correction token plus the NEXT round's K drafts. The
+        # dispatch is async (the device runs it behind the verify;
+        # the tokens fetch lazily at the next draft_batch, which
+        # validates them against what the verify actually committed),
+        # so the draft model's work overlaps the verify wall instead
+        # of serializing after the fetch.
+        overlap_s = 0.0
+        pre_slots = [s for s in dlen if s in model_reqs]
+        if self.spec_pipeline and pre_slots:
+            t_d0 = time.time()
+            if self.draft_engine.rollout(pre_slots, K + 1):
+                t_d1 = time.time()
+                overlap_s = t_d1 - t_d0
+                SPEC_OVERLAP_WALL.inc(overlap_s)
+                self._record_flight(
+                    "draft", begin_s=t_d0, end_s=t_d1,
+                    program={"k": K + 1, "span": None},
+                    slots=pre_slots,
+                    reqs=[model_reqs[s] for s in pre_slots], toks=0,
+                    drafter="model")
+        # THE completion fetch: the verify tokens are this round's
+        # output (the next round's window input), so this is the one
+        # deliberate sync of the spec path — same role as
+        # complete_decode_burst's.
         fetched = [(slots, np.asarray(t), np.asarray(c))
                    for slots, t, c in parts]       # [B, K+1] / [B]
         span.end()
         end_s = time.time()
+        SPEC_VERIFY_WALL.inc(max(end_s - span.begin_s, 0.0))
         out: Dict[int, List[int]] = {}
         n_emitted = accepted = 0
+        model_drafted = ngram_drafted = 0
         for (slots, toks, n_commit), sarg in zip(fetched, part_spans):
             grp_emitted = grp_drafted = grp_accepted = 0
             grp_reqs: List[Request] = []
+            grp_kinds = set()
             for slot in slots:
                 req = self.slot_req.get(slot)
                 if req is None or req.done:
@@ -2603,6 +2772,15 @@ class InferenceEngine:
                 acc = min(len(emitted), nc - 1)
                 req.spec_drafted += nd
                 req.spec_accepted += acc
+                req.spec_mode_drafted += nd
+                req.spec_mode_accepted += acc
+                if nd:
+                    if slot in model_reqs:
+                        model_drafted += nd
+                        grp_kinds.add("model")
+                    else:
+                        ngram_drafted += nd
+                        grp_kinds.add("ngram")
                 accepted += acc
                 out[req.rid] = emitted
                 n_emitted += len(emitted)
@@ -2614,7 +2792,14 @@ class InferenceEngine:
                 "verify", begin_s=span.begin_s, end_s=end_s,
                 program={"k": K, "span": sarg},
                 slots=slots, reqs=grp_reqs, toks=grp_emitted,
-                drafted=grp_drafted, accepted=grp_accepted)
+                drafted=grp_drafted, accepted=grp_accepted,
+                drafter=("mixed" if len(grp_kinds) > 1
+                         else next(iter(grp_kinds), None)),
+                overlap_ms=round(overlap_s * 1e3, 3))
+        if model_drafted:
+            SPEC_DRAFT_TOKENS.labels(drafter="model").inc(model_drafted)
+        if ngram_drafted:
+            SPEC_DRAFT_TOKENS.labels(drafter="ngram").inc(ngram_drafted)
         SPEC_DRAFTED.inc(drafted)
         if accepted:
             SPEC_ACCEPTED.inc(accepted)

@@ -850,6 +850,27 @@ def claim_slot(cache: Cache, slot: jax.Array,
                 length=cache["length"].at[slot].set(claim_len))
 
 
+def sync_slots(cache: Cache, active: jax.Array, lengths: jax.Array,
+               tokens: jax.Array) -> Cache:
+    """Force selected slots' (length, last_token) bookkeeping to
+    host-given values in ONE batched program — the draft engine's
+    lockstep/rollback seam (infer/draft.py).
+
+    The drafter's KV rows for a mispredicted rollout sit PAST the
+    committed length by construction (the same free-rollback property
+    the verifier's window rows have), so rolling a draft slot back to
+    the verifier's commit point — or re-pointing its pending token at
+    the correction token — is purely this bookkeeping write: no K/V
+    row moves, no block moves. ``active`` masks which slots sync;
+    inactive slots are untouched (the commit_tokens idiom)."""
+    return dict(
+        cache,
+        length=jnp.where(active, lengths.astype(jnp.int32),
+                         cache["length"]),
+        last_token=jnp.where(active, tokens.astype(jnp.int32),
+                             cache["last_token"]))
+
+
 def prefill_chunk(params: llama.Params, cache: Cache,
                   tokens_c: jax.Array, start: jax.Array,
                   n_valid: jax.Array, slot: jax.Array,

@@ -136,9 +136,14 @@ class ModelServer:
         # k's JSON framing + socket writes + LB hop. Fake/simple
         # engines without the pair fall back to sync decode_burst.
         # Speculative engines (spec_k > 0) also run the sync path:
-        # verify bursts can't double-buffer — the next burst's draft
+        # verify FETCHES can't double-buffer — the next round's window
         # depends on the tokens this one commits — and decode_burst
-        # itself routes to the verify program there.
+        # itself routes to the verify program there. The overlap spec
+        # mode used to forfeit now lives INSIDE the round: with a
+        # model drafter + spec_pipeline, the next round's draft
+        # rollout dispatches while the verify is in flight
+        # (engine.spec_decode_burst), so the draft model's work rides
+        # the verify wall instead of serializing after it.
         self._burst = None
         self._async_decode = (hasattr(engine, "dispatch_decode_burst")
                               and not getattr(engine, "spec_k", 0))
@@ -452,9 +457,12 @@ class ModelServer:
                 "cached_tokens": cached,
                 "prefill_chunks": getattr(req, "n_chunks", 0),
                 # Speculative-decode stats: how much of the decode this
-                # request's drafts covered (accepted / drafted).
+                # request's drafts covered (accepted / drafted), and
+                # which drafter rung served it last (model|ngram|off —
+                # the acceptance-collapse ladder's resting place).
                 "spec_drafted": getattr(req, "spec_drafted", 0),
                 "spec_accepted": getattr(req, "spec_accepted", 0),
+                "drafter": getattr(req, "spec_mode", None) or "off",
                 # QoS: how often this request was preempted-by-
                 # eviction and resumed (0 on the single-tenant path).
                 "preemptions": getattr(req, "preemptions", 0),
@@ -471,6 +479,9 @@ class ModelServer:
                                   getattr(req, "spec_drafted", 0),
                               "spec_accepted":
                                   getattr(req, "spec_accepted", 0),
+                              "drafter":
+                                  getattr(req, "spec_mode", None)
+                                  or "off",
                               "preemptions":
                                   getattr(req, "preemptions", 0)})
             p.event.set()
@@ -621,7 +632,11 @@ def make_handler(model: ModelServer):
                 from skypilot_tpu.infer import adapters as ad_lib
                 model_name = (self.headers.get(ad_lib.MODEL_HEADER)
                               or body.get("model"))
-                model_name = (str(model_name).strip()[:128]
+                # `or None` AFTER the strip: a whitespace-only header
+                # must read as the base model at BOTH tiers (the LB
+                # normalizes the same way) — not 404 here while the
+                # LB routed it as base.
+                model_name = (str(model_name).strip()[:128] or None
                               if model_name else None)
             except (ValueError, TypeError, KeyError) as e:
                 return self._json(400, {"error": f"bad request: {e}"})
@@ -774,12 +789,30 @@ def main() -> None:
                          "default")
     ap.add_argument("--spec-k", type=int, default=None,
                     help="speculative decoding: draft up to K tokens "
-                         "per slot per burst (n-gram prompt-lookup) "
-                         "and verify them in one device call — up to "
-                         "K+1 committed tokens per decode dispatch, "
-                         "greedy output bit-preserved (0 disables; "
-                         "forced off under --temperature > 0; default "
-                         "env SKYTPU_SPEC_K or 4)")
+                         "per slot per burst and verify them in one "
+                         "device call — up to K+1 committed tokens "
+                         "per decode dispatch, greedy output "
+                         "bit-preserved (0 disables; forced off under "
+                         "--temperature > 0; default env SKYTPU_SPEC_K "
+                         "or 4)")
+    ap.add_argument("--draft-model", default=None,
+                    help="model-backed speculative drafter: 'self:N' "
+                         "(truncated-layer draft sharing the target's "
+                         "first N blocks — zero extra weights) or a "
+                         "llama config name (e.g. llama3-400m; a "
+                         "distilled checkpoint's config). The draft "
+                         "model runs the engine's own staged-burst "
+                         "program on its own paged KV, advanced/"
+                         "rolled-back in lockstep with the verifier; "
+                         "unset = the n-gram drafter only (env "
+                         "SKYTPU_DRAFT_MODEL)")
+    ap.add_argument("--spec-pipeline", type=int, default=None,
+                    help="async draft/verify pipeline (model drafter "
+                         "only): 1 = dispatch the next round's draft "
+                         "rollout while the verify is in flight, "
+                         "reconciling on fetch; 0 = synchronous "
+                         "draft-then-verify (default env "
+                         "SKYTPU_SPEC_PIPELINE or 1)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel degree: shard weights + KV "
                          "cache over the first N local devices "
@@ -864,6 +897,14 @@ def main() -> None:
     catalog = ad_lib.catalog_from_env(cfg, adapters_json=args.adapters,
                                       slots=args.adapter_slots,
                                       rank=args.adapter_rank)
+    # Model-backed drafter (docs/serving.md §Speculative decoding):
+    # built BEFORE the engine slims the fp tree (a 'self:N' draft
+    # shares the target's first N blocks by reference). None = the
+    # n-gram drafter stays the only rung.
+    from skypilot_tpu.infer import draft as draft_lib
+    draft_engine = draft_lib.draft_engine_from_env(
+        params, cfg, n_slots=args.slots, max_len=args.max_len,
+        spec=args.draft_model, kv_int8=args.kv_int8)
     engine = eng.InferenceEngine(
         params, cfg, n_slots=args.slots, max_len=args.max_len,
         mesh=mesh,
@@ -890,6 +931,9 @@ def main() -> None:
         spec_k=(args.spec_k
                 if args.spec_k is not None
                 else int(os.environ.get("SKYTPU_SPEC_K", "4") or 0)),
+        draft_engine=draft_engine,
+        spec_pipeline=(bool(args.spec_pipeline)
+                       if args.spec_pipeline is not None else None),
         # One compiled prefill program per bucket: an odd wave size
         # must never hit a mid-traffic XLA compile on a live replica.
         pad_waves=True,

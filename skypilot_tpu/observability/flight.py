@@ -432,6 +432,16 @@ def render_table(records: List[Dict[str, Any]],
         if r.get("drafted"):
             flags.append(f"spec {r.get('accepted', 0)}"
                          f"/{r.get('drafted', 0)}")
+        # Drafter attribution (PR 14): which drafter kind fed a verify
+        # burst (model|ngram|mixed — "draft" records ARE the pipelined
+        # predraft dispatch), and how much host wall the round spent
+        # dispatching next-round draft work inside the verify's
+        # dispatch->fetch window — draft and verify render as
+        # OVERLAPPING spans under --perfetto, not a serial chain.
+        if r.get("drafter"):
+            flags.append(f"drafter={r['drafter']}")
+        if r.get("overlap_ms"):
+            flags.append(f"overlap={r['overlap_ms']:.2f}ms")
         for k in ("cow", "evictions", "lazy_grows"):
             if r.get(k):
                 flags.append(f"{k}={r[k]}")
@@ -489,7 +499,8 @@ def as_spans(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         attrs = {k: r[k] for k in ("toks", "drafted", "accepted",
                                    "stall", "rids", "tenants",
                                    "adapters", "priority",
-                                   "retired_rows")
+                                   "retired_rows", "drafter",
+                                   "overlap_ms")
                  if r.get(k)}
         attrs["slots"] = len(r.get("slots", ()))
         spans.append({

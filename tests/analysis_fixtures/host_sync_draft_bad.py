@@ -1,0 +1,26 @@
+# Golden fixture: seeded host-sync violations on the draft-model
+# pipeline path. Checked as if it were skypilot_tpu/infer/draft.py
+# (the DraftEngine hot-loop scope). Never imported.
+import numpy as np
+
+
+class DraftEngine:
+    def rollout(self, slots, k):
+        # The async predraft must DISPATCH only — fetching here
+        # serializes the draft behind the verify instead of
+        # overlapping it (the pipeline's whole point).
+        toks = self._dispatch_rollout(slots, k)
+        toks.block_until_ready()                           # expect: host-sync
+        self._pending_roll = (toks, slots, k)
+
+    def _sync_slot(self, slot, st, ctx, fix):
+        # Lockstep sync is pure host bookkeeping over the token
+        # mirror; peeking at device lengths per slot per round drains
+        # the dispatch pipeline once per spec burst.
+        rows = int(self.cache["length"][slot])             # expect: host-sync
+        pending = self.cache["last_token"].item()          # expect: host-sync
+        return [rows, pending]
+
+    def _dispatch_sync(self, fix):
+        probe = np.asarray(self.cache["length"])           # expect: host-sync
+        return probe

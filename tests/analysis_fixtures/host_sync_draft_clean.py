@@ -1,0 +1,35 @@
+# Clean twin of host_sync_draft_bad.py: the same methods doing the
+# same jobs with pure host bookkeeping — the draft path's one
+# deliberate completion fetch lives in draft_batch/_apply_pending and
+# is baselined with a justification, not seeded here. Never imported.
+import numpy as np
+
+
+class DraftEngine:
+    def rollout(self, slots, k):
+        # Dispatch only; the tokens land lazily at the next
+        # draft_batch (the deferred, baselined fetch).
+        live = [s for s in slots if s in self._state]
+        if not live:
+            return False
+        toks = self._dispatch_rollout(live, k)
+        self._pending_roll = (toks, live, k)
+        return True
+
+    def _sync_slot(self, slot, st, ctx, fix):
+        # Host token mirror only — row validity is decided by
+        # comparison against the committed context, never by a device
+        # peek.
+        v = st.confirmed
+        limit = min(len(st.toks), len(ctx) - 1)
+        while v < limit and st.toks[v] == ctx[v]:
+            v += 1
+        del st.toks[v:]
+        fix[slot] = (len(ctx) - 1, ctx[-1])
+        return []
+
+    def _dispatch_sync(self, fix):
+        active = np.zeros((self.n_slots + 1,), bool)
+        for slot in fix:
+            active[slot] = True
+        self.cache = self._sync_fn(self.cache, active)

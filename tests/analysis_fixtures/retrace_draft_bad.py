@@ -1,0 +1,23 @@
+# Golden fixture: seeded retrace-safety violations in the draft
+# rollout/lockstep shape. Checked as if it lived at
+# skypilot_tpu/infer/ (a jit-root directory). Never imported.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def draft_rollout_sync(cache, active, lengths, tokens):
+    # The lockstep sync is data-only; branching on the mask or
+    # concretizing a length would retrace per round.
+    if active.any():                          # expect: traced-branch
+        lengths = lengths + 0
+    new_len = cache["length"] + active.astype(jnp.int32)
+    rows = int(new_len[0])                    # expect: concretize
+    host = np.asarray(new_len)                # expect: host-transfer
+    kept = jnp.zeros(jnp.sum(new_len))        # expect: dynamic-shape
+    out = dict(cache)
+    out["length"] = jnp.where(active, lengths, cache["length"])
+    out["last_token"] = jnp.where(active, tokens,
+                                  cache["last_token"])
+    return out, rows, host, kept

@@ -1,0 +1,15 @@
+# Clean twin of retrace_draft_bad.py: the lockstep sync as pure
+# masked data flow — no traced branches, no concretization, shapes
+# static. Never imported.
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def draft_rollout_sync(cache, active, lengths, tokens):
+    out = dict(cache)
+    out["length"] = jnp.where(active, lengths.astype(jnp.int32),
+                              cache["length"])
+    out["last_token"] = jnp.where(active, tokens.astype(jnp.int32),
+                                  cache["last_token"])
+    return out

@@ -1,0 +1,526 @@
+"""Draft-model speculative decoding + async draft/verify pipeline.
+
+Tier-1 guards for PR 14's claims: greedy output with a MODEL drafter
+is exactly the spec-off output (pipelined and synchronous, fp32 and
+int8 KV); the drafter's paged KV advances/rolls back in lockstep with
+the verifier's commits (a rejected rollout leaves committed rows
+bit-equal to a never-drafted drafter cache — rollback is a length
+non-advance); the acceptance-collapse fallback demotes down the
+ladder model -> ngram -> off; the pipeline structurally overlaps (a
+draft dispatch lands INSIDE a verify's dispatch->fetch window, proven
+from flight records, never wall-clock); and the drafter's program
+surface is warm-able (zero unexpected compiles with the drafter
+live).
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import draft as draft_lib
+from skypilot_tpu.infer import engine as eng
+from skypilot_tpu.infer import kvcache
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import flight as flight_lib
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # fp32: accumulation differences cannot hide behind bf16 eps (the
+    # PR 6 test_infer_tp lesson).
+    return dataclasses.replace(llama.CONFIGS["llama3-tiny"],
+                               dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(jax.random.key(0), cfg)
+
+
+@pytest.fixture(scope="module")
+def distilled(params, cfg):
+    """(target, draft_params, draft_cfg) at the self-distillation
+    endpoint: the truncated-layer draft agrees with the target."""
+    return draft_lib.self_distilled_pair(params, cfg, 1)
+
+
+def _prompts(cfg, n=3, length=12, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, length).tolist()
+            for _ in range(n)]
+
+
+def _engine(params, cfg, slots=4, max_len=128, buckets=(32,), **kw):
+    return eng.InferenceEngine(params, cfg, n_slots=slots,
+                               max_len=max_len, prompt_buckets=buckets,
+                               **kw)
+
+
+def _draft_engine(dparams, dcfg, slots=4, max_len=128, **kw):
+    return draft_lib.DraftEngine(dparams, dcfg, n_slots=slots,
+                                 max_len=max_len, **kw)
+
+
+def _random_draft(cfg, seed=7):
+    """A 1-layer random draft model: acceptance ~0 on a full-vocab
+    workload — the rollback/demotion exercise."""
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    return llama.init_params(jax.random.key(seed), dcfg), dcfg
+
+
+# -- draft-model construction ------------------------------------------------
+
+def test_truncated_draft_shapes(params, cfg):
+    dparams, dcfg = draft_lib.truncated_draft(params, cfg, 1)
+    assert dcfg.n_layers == 1
+    for name, w in dparams["blocks"].items():
+        assert w.shape[0] == 1
+        assert w.shape[1:] == params["blocks"][name].shape[1:]
+    # Clamped to [1, n_layers].
+    assert draft_lib.truncated_draft(params, cfg, 99)[1].n_layers \
+        == cfg.n_layers
+    assert draft_lib.truncated_draft(params, cfg, 0)[1].n_layers == 1
+
+
+def test_self_distilled_pair_agrees_exactly(params, cfg):
+    """The distillation endpoint: zeroed upper residual blocks pass
+    the stream through unchanged, so target and truncated draft
+    produce BIT-equal logits (fp32: adding exact zeros is exact)."""
+    target, dparams, dcfg = draft_lib.self_distilled_pair(params, cfg,
+                                                          1)
+    toks = jnp.asarray(np.array([[5, 9, 2, 6, 5, 3, 5, 8]], np.int32))
+    lens = jnp.asarray(np.array([8], np.int32))
+    _, lt = kvcache.prefill_batch(target, toks, lens, cfg)
+    _, ld = kvcache.prefill_batch(dparams, toks, lens, dcfg)
+    assert np.array_equal(np.asarray(lt), np.asarray(ld))
+
+
+def test_draft_engine_from_env(params, cfg, monkeypatch):
+    de = draft_lib.draft_engine_from_env(params, cfg, 2, 64,
+                                         spec="self:1")
+    assert de is not None and de.cfg.n_layers == 1
+    assert draft_lib.draft_engine_from_env(params, cfg, 2, 64,
+                                           spec="") is None
+    monkeypatch.setenv("SKYTPU_DRAFT_MODEL", "self:1")
+    assert draft_lib.draft_engine_from_env(params, cfg, 2,
+                                           64) is not None
+    monkeypatch.delenv("SKYTPU_DRAFT_MODEL")
+    with pytest.raises(ValueError):
+        draft_lib.draft_engine_from_env(params, cfg, 2, 64,
+                                        spec="no-such-model")
+
+
+# -- DraftEngine unit: lockstep + rollback -----------------------------------
+
+def _slot_rows(de, slot, rows):
+    """A draft slot's first ``rows`` K/V rows (+ scales when int8) as
+    numpy, gathered through its block table in logical order."""
+    out = []
+    for name in ("k", "v", "k_scale", "v_scale"):
+        if name not in de.cache:
+            continue
+        arr = np.asarray(de.cache[name])
+        bl = arr.shape[2] if name in ("k", "v") else arr.shape[3]
+        nb = -(-rows // de.kv_block)
+        blocks = de.block_table[slot, :nb]
+        if name in ("k", "v"):
+            rs = arr[:, blocks].reshape(arr.shape[0], -1,
+                                        *arr.shape[3:])[:, :rows]
+        else:       # scales: [L, nb, G, bl] -> [L, G, rows]
+            rs = arr[:, blocks].transpose(0, 2, 1, 3).reshape(
+                arr.shape[0], arr.shape[2], -1)[..., :rows]
+        del bl
+        out.append(rs)
+    return out
+
+
+@pytest.mark.parametrize("kv_int8", [False, True], ids=["fp32", "int8"])
+def test_rejected_rollout_leaves_kv_bit_equal(distilled, kv_int8):
+    """The lockstep/rollback invariant at the drafter level: a draft
+    round whose tokens the verifier fully REJECTS (the correction
+    token differs at position 0) leaves every committed row, plus the
+    device length/last_token bookkeeping, bit-equal to a drafter that
+    NEVER drafted — rollback is purely the length not advancing; the
+    rejected rows sit past it, unreadable."""
+    _, dparams, dcfg = distilled
+    ctx = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+    de = _draft_engine(dparams, dcfg, slots=2, max_len=64,
+                       kv_int8=kv_int8)
+    d = de.draft_batch({0: ctx}, 4)
+    assert len(d[0]) == 4
+    # The verifier rejected everything: committed context extends by
+    # ONE token that provably differs from the draft's first.
+    corr = (d[0][0] + 1) % dcfg.vocab_size or 1
+    ctx2 = ctx + [corr]
+    # Sync WITHOUT a fresh rollout (the draft_batch entry would draft
+    # again): exactly what the next round's sync pass does.
+    st = de._state[0]
+    fix = {}
+    assert de._sync_slot(0, st, ctx2, fix) == []
+    de._dispatch_sync(fix)
+    assert st.toks == ctx2[:-1] and st.last == corr
+
+    # A drafter that never drafted, synced to the same context.
+    de2 = _draft_engine(dparams, dcfg, slots=2, max_len=64,
+                        kv_int8=kv_int8)
+    st2 = de2._acquire(0)
+    fix2 = {}
+    de2._sync_slot(0, st2, ctx2, fix2)
+    de2._dispatch_sync(fix2)
+
+    rows = len(ctx2) - 1
+    for a, b in zip(_slot_rows(de, 0, rows), _slot_rows(de2, 0, rows)):
+        assert np.array_equal(a, b)
+    for name in ("length", "last_token"):
+        assert (np.asarray(de.cache[name])[0]
+                == np.asarray(de2.cache[name])[0])
+
+
+def test_predraft_reconcile_and_reuse(distilled):
+    """The pipeline's reconcile path: a predraft rollout whose chain
+    matches the committed context serves the next round with ZERO new
+    device work (reuse_hits); a mispredicted one is discarded
+    host-side (rollbacks) and the round redrafts."""
+    _, dparams, dcfg = distilled
+    ctx = [3, 1, 4, 1, 5, 9, 2, 6]
+    de = _draft_engine(dparams, dcfg, slots=2, max_len=64)
+    d = de.draft_batch({0: ctx}, 3)[0]
+    assert de.rollout([0], 4)                 # predraft: bonus + next 3
+    assert de.stats()["pending"] == 1
+    # Full accept + the drafter's own bonus prediction: the drafter's
+    # chain IS the committed context — next round reuses it.
+    st = de._state[0]
+    rolls0 = de.rollouts
+    bonus_chain = st.toks + [st.last]         # pending roll not applied
+    de._apply_pending()
+    bonus = (de._state[0].toks + [de._state[0].last])[len(ctx) + 3]
+    del bonus_chain
+    ctx_full = ctx + d + [bonus]
+    d2 = de.draft_batch({0: ctx_full}, 3)[0]
+    assert len(d2) == 3
+    assert de.rollouts == rolls0              # zero new rollouts
+    assert de.reuse_hits >= 1
+    # Mispredicted round: correction token diverges -> discard +
+    # redraft (a fresh rollout runs).
+    corr = (d2[0] + 1) % dcfg.vocab_size or 1
+    ctx_miss = ctx_full + [corr]
+    rb0 = de.rollbacks
+    d3 = de.draft_batch({0: ctx_miss}, 3)[0]
+    assert len(d3) == 3
+    assert de.rollbacks > rb0
+    assert de.rollouts == rolls0 + 1
+
+
+def test_release_frees_blocks_and_reacquire_reingests(distilled):
+    _, dparams, dcfg = distilled
+    de = _draft_engine(dparams, dcfg, slots=2, max_len=64)
+    de.draft_batch({0: [1, 2, 3, 4, 5]}, 2)
+    assert de.blocks_used > 0 and de.claimed(0)
+    de.release(0)
+    assert de.blocks_used == 0 and not de.claimed(0)
+    # Re-acquire with a DIFFERENT context: full re-ingest from zero.
+    ic0 = de.ingest_chunks
+    d = de.draft_batch({0: [9, 8, 7, 6, 5, 4]}, 2)
+    assert len(d[0]) == 2
+    assert de.ingest_chunks > ic0
+
+
+# -- engine-level greedy parity ----------------------------------------------
+
+@pytest.fixture(scope="module")
+def off_outputs(distilled, cfg):
+    """Spec-off reference outputs per kv_int8 (computed once — every
+    parity combo below compares against these)."""
+    target, _, _ = distilled
+    prompts = _prompts(cfg)
+    return {kv8: _engine(target, cfg, spec_k=0, kv_int8=kv8).generate(
+                prompts, max_new_tokens=24)
+            for kv8 in (False, True)}
+
+
+@pytest.mark.parametrize("pipeline", [True, False],
+                         ids=["pipelined", "sync"])
+@pytest.mark.parametrize("kv_int8", [False, True], ids=["fp32", "int8"])
+def test_model_draft_parity(distilled, cfg, off_outputs, kv_int8,
+                            pipeline):
+    """Greedy output with the model drafter — pipelined and
+    synchronous, fp32 and int8 KV — is exactly the spec-off output."""
+    target, dparams, dcfg = distilled
+    de = _draft_engine(dparams, dcfg, kv_int8=kv_int8)
+    on = _engine(target, cfg, spec_k=4, draft_engine=de,
+                 spec_pipeline=pipeline, kv_int8=kv_int8).generate(
+                     _prompts(cfg), max_new_tokens=24)
+    assert on == off_outputs[kv_int8]
+
+
+def test_model_draft_parity_low_acceptance(distilled, cfg,
+                                           off_outputs):
+    """A random 1-layer draft (acceptance ~0 — every round rolls
+    back) still emits exactly the spec-off output: draft quality can
+    never touch correctness."""
+    target, _, _ = distilled
+    rp, rcfg = _random_draft(cfg)
+    de = _draft_engine(rp, rcfg)
+    on = _engine(target, cfg, spec_k=4, draft_engine=de,
+                 spec_pipeline=True).generate(_prompts(cfg),
+                                              max_new_tokens=24)
+    assert on == off_outputs[False]
+    assert de.rollbacks > 0
+
+
+def test_model_draft_parity_with_adapters(distilled, cfg):
+    """The parity matrix's adapters axis: a mixed base/fine-tune batch
+    under the model drafter emits exactly the spec-off outputs. The
+    drafter drafts from the BASE draft model (adapter deltas only
+    shape draft quality, never correctness — verification is
+    greedy-exact against the target's adapter-aware programs)."""
+    from skypilot_tpu.infer import adapters as ad
+    target, dparams, dcfg = distilled
+    rng = np.random.default_rng(11)
+    rank = 4
+    shapes = ad.target_shapes(cfg, rank)
+    aw = {}
+    for t, (sa, sb) in shapes.items():
+        sa = sa[:-1] + (rank,)
+        sb = (rank,) + sb[1:]
+        aw[t] = {
+            "a": rng.normal(size=(cfg.n_layers,) + sa).astype(
+                np.float32) * 0.05,
+            "b": rng.normal(size=(cfg.n_layers,) + sb).astype(
+                np.float32) * 0.05}
+
+    def catalog():
+        cat = ad.AdapterCatalog(cfg, n_adapters=4, rank=rank)
+        cat.register("ft-0", params=aw)
+        return cat
+
+    prompts = _prompts(cfg)
+
+    def run(spec_k, de=None):
+        e = _engine(target, cfg, spec_k=spec_k, draft_engine=de,
+                    spec_pipeline=de is not None, adapters=catalog())
+        ids = [e.add_request(p, max_new_tokens=16,
+                             adapter="ft-0" if i == 1 else None)
+               for i, p in enumerate(prompts)]
+        e.run_to_completion()
+        by_rid = {r.rid: r.tokens for r in e.finished}
+        return [by_rid[i] for i in ids]
+
+    off = run(0)
+    on = run(4, de=_draft_engine(dparams, dcfg))
+    assert on == off
+
+
+def test_pipelined_equals_synchronous(distilled, cfg):
+    """The pipeline is a scheduling change only: pipelined and
+    synchronous spec modes emit identical tokens."""
+    target, dparams, dcfg = distilled
+    prompts = _prompts(cfg)
+    outs = []
+    for pipeline in (True, False):
+        de = _draft_engine(dparams, dcfg)
+        e = _engine(target, cfg, spec_k=4, draft_engine=de,
+                    spec_pipeline=pipeline)
+        outs.append(e.generate(prompts, max_new_tokens=16))
+    assert outs[0] == outs[1]
+
+
+def test_distilled_acceptance_and_reuse(distilled, cfg):
+    """The self-distilled pair accepts (near-)everything, and the
+    pipelined predraft serves rounds without fresh draft work."""
+    target, dparams, dcfg = distilled
+    de = _draft_engine(dparams, dcfg)
+    e = _engine(target, cfg, spec_k=4, draft_engine=de,
+                spec_pipeline=True)
+    e.generate(_prompts(cfg), max_new_tokens=16)
+    drafted = sum(r.spec_drafted for r in e.finished)
+    accepted = sum(r.spec_accepted for r in e.finished)
+    assert drafted > 0
+    assert accepted / drafted > 0.9
+    assert de.reuse_hits > 0
+    # Every request rode the model rung the whole way.
+    assert all(r.spec_mode == "model" for r in e.finished)
+    # Drafter slots released with their requests.
+    assert de.blocks_used == 0 and not de._state
+
+
+# -- pipeline overlap (structural, from flight records) ----------------------
+
+def test_pipeline_overlap_structural(distilled, cfg):
+    """The async pipeline's proof, timing-free: every 'draft' flight
+    record (the predraft dispatch) lands INSIDE a verify record's
+    dispatch->fetch window — draft and verify overlap instead of
+    chaining serially. The verify records carry drafter= and
+    overlap_ms attribution."""
+    target, dparams, dcfg = distilled
+    fl = flight_lib.FlightRecorder()
+    de = _draft_engine(dparams, dcfg)
+    e = _engine(target, cfg, spec_k=4, draft_engine=de,
+                spec_pipeline=True, flight_recorder=fl)
+    e.generate(_prompts(cfg), max_new_tokens=16)
+    recs = fl.tail()
+    drafts = [r for r in recs if r["burst"] == "draft"]
+    verifies = [r for r in recs if r["burst"] == "verify"]
+    assert drafts and verifies
+    for d in drafts:
+        assert d["drafter"] == "model"
+        assert any(v["ts_s"] <= d["ts_s"] <= v["ts_s"] + v["dur_s"]
+                   for v in verifies), \
+            "draft dispatch not inside any verify window"
+    assert any(r.get("drafter") == "model" for r in verifies)
+    assert any(r.get("overlap_ms", 0) > 0 for r in verifies)
+    # Synchronous mode emits no 'draft' records (drafting happens
+    # inside draft_batch before the dispatch) — the records are the
+    # pipeline's signature.
+    fl2 = flight_lib.FlightRecorder()
+    de2 = _draft_engine(dparams, dcfg)
+    e2 = _engine(target, cfg, spec_k=4, draft_engine=de2,
+                 spec_pipeline=False, flight_recorder=fl2)
+    e2.generate(_prompts(cfg), max_new_tokens=16)
+    assert not [r for r in fl2.tail() if r["burst"] == "draft"]
+
+
+# -- fallback ladder ---------------------------------------------------------
+
+def test_collapse_demotes_model_to_ngram_to_off(params, cfg):
+    """The demotion chain: a random draft model's acceptance collapses
+    -> the request falls back to the factory drafter (ngram rung) with
+    its draft-engine slot released; when THAT rung collapses too (an
+    always-wrong factory drafter), speculation turns off for the
+    request — and only that request."""
+    rp, rcfg = _random_draft(cfg)
+    de = _draft_engine(rp, rcfg)
+    prompts = _prompts(cfg, n=1)
+    # Known-correct continuation, so the always-wrong factory drafter
+    # provably mismatches every position.
+    oracle_out = _engine(params, cfg, spec_k=0).generate(
+        prompts, max_new_tokens=32)
+    wrong = {tuple(p): [(t + 1) % cfg.vocab_size for t in o]
+             for p, o in zip(prompts, oracle_out)}
+
+    class Wrong:
+        def __init__(self, req):
+            self.out = wrong[tuple(req.prompt)]
+            self.seen = 0
+
+        def catch_up(self, prompt, generated):
+            self.seen = len(generated)
+
+        def draft(self, k):
+            return self.out[self.seen:self.seen + k]
+
+    e = _engine(params, cfg, spec_k=4, draft_engine=de,
+                spec_pipeline=True, spec_drafter=lambda r: Wrong(r))
+    ids = [e.add_request(p, max_new_tokens=32) for p in prompts]
+    e.admit()
+    modes = set()
+    while e.slot_req:
+        req = next(iter(e.slot_req.values()))
+        modes.add(req.spec_mode)
+        e.decode_burst(4)
+    del ids
+    req = e.finished[0]
+    assert modes >= {"model", "ngram"}
+    assert req.spec_mode == "off" and req.spec_off
+    # Output stayed exactly greedy through every rung.
+    assert [r.tokens for r in e.finished] == oracle_out
+    # The demotion released the draft slot.
+    assert de.blocks_used == 0
+
+
+def test_no_draft_engine_keeps_ngram_ladder(params, cfg):
+    """Without a DraftEngine requests start at the ngram rung (PR 8
+    behavior preserved) and collapse straight to off."""
+    e = _engine(params, cfg, spec_k=2)
+    e.generate(_prompts(cfg, n=1), max_new_tokens=8)
+    assert e.finished[0].spec_mode in ("ngram", None)
+    assert e.draft_engine is None and not e.spec_pipeline
+
+
+# -- knobs + compile surface -------------------------------------------------
+
+def test_spec_pipeline_env_knob(params, cfg, distilled, monkeypatch):
+    target, dparams, dcfg = distilled
+    de = _draft_engine(dparams, dcfg)
+    monkeypatch.setenv("SKYTPU_SPEC_PIPELINE", "0")
+    assert not _engine(target, cfg, spec_k=4,
+                       draft_engine=de).spec_pipeline
+    monkeypatch.delenv("SKYTPU_SPEC_PIPELINE")
+    assert _engine(target, cfg, spec_k=4,
+                   draft_engine=de).spec_pipeline
+    # No draft engine -> no pipeline, whatever the knob says.
+    assert not _engine(params, cfg, spec_k=4,
+                       spec_pipeline=True).spec_pipeline
+
+
+def test_warm_grid_zero_unexpected_compiles_with_drafter(distilled,
+                                                         cfg):
+    """The compile-watch contract extends to the drafter: after
+    warm_programs + declare_warmup_complete, live spec traffic (with
+    rollbacks and predrafts) compiles NOTHING on either engine.
+    (span_buckets=0 keeps the warm sweep to one rung — the ladder's
+    own coverage is test_span_attn's job.)"""
+    target, dparams, dcfg = distilled
+    de = _draft_engine(dparams, dcfg, span_buckets=0)
+    e = _engine(target, cfg, spec_k=4, draft_engine=de,
+                spec_pipeline=True, max_wave=4, pad_waves=True,
+                span_buckets=0)
+    n = e.warm_programs(max_burst=8)
+    assert n > 0
+    e.declare_warmup_complete()
+    assert de.compile_watch.warm
+    e.generate(_prompts(cfg), max_new_tokens=24)
+    assert e.compile_watch.unexpected == []
+    assert de.compile_watch.unexpected == []
+
+
+def test_top_serve_line_shows_drafter_and_overlap():
+    """`skytpu top`'s serve line surfaces the drafter kind, window
+    acceptance and the pipeline overlap ratio from the new metric
+    families (the ROADMAP item 2 observability slice)."""
+    from skypilot_tpu.client import cli as cli_mod
+
+    def fams(drafted, accepted, model_toks, overlap_s, verify_s):
+        return {
+            "skytpu_ttft_seconds": {"type": "histogram", "samples": []},
+            "skytpu_spec_drafted_total": {
+                "type": "counter", "samples": [({}, float(drafted))]},
+            "skytpu_spec_accepted_total": {
+                "type": "counter", "samples": [({}, float(accepted))]},
+            "skytpu_spec_draft_tokens_total": {
+                "type": "counter",
+                "samples": [({"drafter": "model"}, float(model_toks))]},
+            "skytpu_spec_overlap_wall_seconds_total": {
+                "type": "counter", "samples": [({}, float(overlap_s))]},
+            "skytpu_spec_verify_wall_seconds_total": {
+                "type": "counter", "samples": [({}, float(verify_s))]},
+        }
+
+    payload = {"components": [], "alerts": []}
+    now = 1000.0
+    frame = cli_mod._render_top_frame(
+        fams(0, 0, 0, 0.0, 0.0), now - 10.0,
+        fams(100, 90, 100, 4.0, 5.0), now, payload)
+    serve_line = next(l for l in frame.splitlines()
+                      if l.startswith("serve"))
+    assert "spec model acc  90%" in serve_line
+    assert "ovl  80%" in serve_line
+
+
+def test_engine_reset_resets_drafter(distilled, cfg):
+    target, dparams, dcfg = distilled
+    de = _draft_engine(dparams, dcfg)
+    e = _engine(target, cfg, spec_k=4, draft_engine=de)
+    ids = [e.add_request(p, max_new_tokens=32)
+           for p in _prompts(cfg, n=2)]
+    e.admit()
+    e.decode_burst(4)
+    del ids
+    assert de.blocks_used > 0
+    e.reset()
+    assert de.blocks_used == 0 and not de._state
+    assert de.stats()["pending"] == 0

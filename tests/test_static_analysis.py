@@ -133,6 +133,15 @@ _GOLDEN = [
      "skypilot_tpu/infer/fixture_retrace_spec.py"),
     ("host-sync", "host_sync_spec_bad.py", "host_sync_spec_clean.py",
      "skypilot_tpu/infer/engine.py"),
+    # Draft-model speculation + async pipeline (PR 14): the drafter's
+    # jitted rollout/lockstep-sync shape and the DraftEngine hot path
+    # (infer/draft.py scope) are guarded like the verify shape.
+    ("retrace-safety", "retrace_draft_bad.py",
+     "retrace_draft_clean.py",
+     "skypilot_tpu/infer/fixture_retrace_draft.py"),
+    ("host-sync", "host_sync_draft_bad.py",
+     "host_sync_draft_clean.py",
+     "skypilot_tpu/infer/draft.py"),
     # Span-bucketed attention (PR 9): the static-span gather and the
     # host-side bucket/headroom selection are guarded like the paged
     # and spec shapes before them.
