@@ -587,15 +587,21 @@ def main() -> None:
             out["serve_flight_overhead"] = fli["overhead_ratio"]
             out["serve_flight_coverage_ok"] = fli["coverage_ok"]
             out["serve_flight_parity_ok"] = fli["parity_ok"]
+            out["serve_flight_calibration_parity_ok"] = \
+                fli["calibration_parity_ok"]
+            out["serve_flight_calibration_samples"] = \
+                fli["calibration_samples"]
             out["serve_flight_regressed"] = bool(
                 fli["unexpected_compiles"] != 0
                 or not fli["coverage_ok"] or not fli["parity_ok"]
+                or not fli["calibration_parity_ok"]
                 or fli["overhead_ratio"] > 1.01)
             if out["serve_flight_regressed"]:
                 log("SERVE FLIGHT REGRESSION: "
                     f"unexpected={fli['unexpected_compiles']} "
                     f"coverage={fli['coverage_ok']} "
                     f"parity={fli['parity_ok']} "
+                    f"cal_parity={fli['calibration_parity_ok']} "
                     f"overhead=x{fli['overhead_ratio']} (> 1.01)")
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"flight bench failed: {e}")

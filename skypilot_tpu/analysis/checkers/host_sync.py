@@ -114,6 +114,16 @@ _SCOPES: Dict[str, Set[str]] = {
     "skypilot_tpu/observability/flight.py": {
         "record", "wrap", "tail", "since", "drain_new", "summary",
     },
+    # Device-truth attribution (PR 16): the calibrator's tick/estimate
+    # and the roofline cost model ride every dispatch and every
+    # _record_flight call — pure host arithmetic, except timed_call's
+    # ONE deliberate block_until_ready: that bracket IS the
+    # calibration measurement, fires on a sampled ~1/64 of hit-path
+    # dispatches, and is baselined with justification.
+    "skypilot_tpu/observability/attribution.py": {
+        "timed_call", "tick", "update", "estimate", "record_cost",
+        "set_bytes", "snapshot", "total",
+    },
     "skypilot_tpu/infer/server.py": {
         "_loop", "_step", "_drain_inbox", "_flush_streams",
         "_complete_burst", "_on_wave",
@@ -151,7 +161,11 @@ class HostSyncChecker(Checker):
     #     engine's drafter-mode ladder and the DraftEngine's
     #     draft/rollout/lockstep path (infer/draft.py) joined the
     #     scope; the bump rescans the edited spec hot path cold.
-    version = 9
+    # v10: device-truth attribution (PR 16) — the calibrator tick/
+    #     estimate path, the roofline cost model and the HBM ledger
+    #     (observability/attribution.py) joined the scope; the one
+    #     deliberate calibration bracket is baselined.
+    version = 10
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

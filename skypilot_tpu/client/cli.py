@@ -498,6 +498,21 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
             line += f"  compiles {comp:.0f}"
             line += (f" (! {unexp:.0f} unexpected)" if unexp
                      else " (0 unexpected)")
+        # Device-truth roofline (docs/observability.md §Device-truth
+        # attribution): windowed MFU and HBM-bandwidth utilization —
+        # the fleet's analytical FLOPs/bytes rates over its summed
+        # published peaks. Rates need two frames (first frame and
+        # --once show nothing); lifetime totals are meaningless as a
+        # utilization proxy, so no fallback.
+        peak_f = gauge("skytpu_roofline_peak_flops")
+        if peak_f:
+            fl = rate("skytpu_device_flops_total")
+            if fl is not None:
+                line += f"  mfu {min(fl / peak_f, 1.0):5.1%}"
+            peak_b = gauge("skytpu_roofline_peak_hbm_bytes_per_s")
+            bw = rate("skytpu_device_hbm_moved_bytes_total")
+            if peak_b and bw is not None:
+                line += f"  bw {min(bw / peak_b, 1.0):5.1%}"
         lines.append(line)
     # Per-tenant QoS columns (docs/serving.md §Multi-tenant QoS):
     # top-N tenants by request rate, each with its shed rate, plus the
@@ -691,7 +706,11 @@ def trace_cmd(request_id, perfetto_path):
               help="Also write the burst records as Chrome "
                    "trace-format JSON (Perfetto loadable) to this "
                    "path.")
-def flight_cmd(target, local, last, port, perfetto_path):
+@click.option("--bubbles", "bubbles", is_flag=True, default=False,
+              help="Append the bubble analysis: device-idle gaps "
+                   "between bursts attributed to named host causes "
+                   "(docs/observability.md §Device-truth attribution).")
+def flight_cmd(target, local, last, port, perfetto_path, bubbles):
     """Engine flight recorder: the last-N bursts and program summary.
 
     Burst-level serving introspection (docs/observability.md §Flight
@@ -707,6 +726,7 @@ def flight_cmd(target, local, last, port, perfetto_path):
     import json as json_lib
     import urllib.request
 
+    from skypilot_tpu.observability import attribution as attribution_lib
     from skypilot_tpu.observability import flight as flight_lib
     from skypilot_tpu.observability import trace_view
 
@@ -741,12 +761,19 @@ def flight_cmd(target, local, last, port, perfetto_path):
     else:
         records = flight_lib.load_records()
     if perfetto_path:
+        # Burst spans plus synthetic `bubble:<cause>` idle spans — the
+        # perfetto timeline shows WHY the device sat idle between
+        # bursts, not just that it did.
+        spans = (flight_lib.as_spans(records)
+                 + attribution_lib.idle_spans(records))
         with open(os.path.expanduser(perfetto_path), "w") as f:
-            json_lib.dump(
-                trace_view.to_perfetto(flight_lib.as_spans(records)),
-                f)
+            json_lib.dump(trace_view.to_perfetto(spans), f)
         click.echo(f"perfetto trace written to {perfetto_path}")
     click.echo(flight_lib.render_table(records, programs, last=last))
+    if bubbles:
+        click.echo("")
+        click.echo(attribution_lib.render_bubbles(
+            attribution_lib.analyze_bubbles(records)))
 
 
 @cli.command()

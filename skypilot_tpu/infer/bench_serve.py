@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -1758,6 +1759,23 @@ def run_flight(config=None, requests=None, new_tokens=None,
         rec.enabled = True
         tpot_on = min(tpot_on, tpot_on2)
         tpot_off = min(tpot_off, tpot_off2)
+        # Calibration parity: every pass above ran with the device-time
+        # calibrator at its default cadence (the bracket rides the
+        # compile-watch hit path whether or not the recorder is on), so
+        # overhead_ratio already prices calibration into BOTH sides.
+        # Here the off-switch itself is gated: SKYTPU_DEVTIME_EVERY=0
+        # must produce bit-identical greedy tokens — the bracket only
+        # ever observes, never perturbs.
+        cal_samples = e.devtime.samples
+        prev_every = os.environ.get("SKYTPU_DEVTIME_EVERY")
+        os.environ["SKYTPU_DEVTIME_EVERY"] = "0"
+        try:
+            out_nocal, _ = workload(e)
+        finally:
+            if prev_every is None:
+                os.environ.pop("SKYTPU_DEVTIME_EVERY", None)
+            else:
+                os.environ["SKYTPU_DEVTIME_EVERY"] = prev_every
         layouts["paged" if paged else "contig"] = {
             "programs_warmed": warmed,
             "warmup_compile_s": round(warm_compile_s, 3),
@@ -1765,6 +1783,8 @@ def run_flight(config=None, requests=None, new_tokens=None,
             "unexpected": unexpected,
             "coverage_ok": bool(coverage_ok),
             "parity_ok": bool(out_on == out_off),
+            "calibration_parity_ok": bool(out_nocal == out_on),
+            "calibration_samples": int(cal_samples),
             "n_records": len(window),
             "n_chunk_records": n_chunks,
             "n_wave_records": n_waves,
@@ -1781,6 +1801,10 @@ def run_flight(config=None, requests=None, new_tokens=None,
                                    for v in layouts.values()),
         "coverage_ok": all(v["coverage_ok"] for v in layouts.values()),
         "parity_ok": all(v["parity_ok"] for v in layouts.values()),
+        "calibration_parity_ok": all(v["calibration_parity_ok"]
+                                     for v in layouts.values()),
+        "calibration_samples": sum(v["calibration_samples"]
+                                   for v in layouts.values()),
         "n_records": sum(v["n_records"] for v in layouts.values()),
         # Worst layout: the gate must catch a recorder change that
         # slows only one of the two decode paths.
@@ -2129,6 +2153,7 @@ def main() -> None:
             "unit": "programs_compiled_in_timed_window",
             **{k: r[k] for k in (
                 "warmup_compile_s", "coverage_ok", "parity_ok",
+                "calibration_parity_ok", "calibration_samples",
                 "n_records", "overhead_ratio", "layouts", "config")},
         }))
         return

@@ -58,6 +58,7 @@ import numpy as np
 
 from skypilot_tpu.infer import kvcache, sampling
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import attribution as attribution_lib
 from skypilot_tpu.observability import flight as flight_lib
 from skypilot_tpu.observability import metrics
 
@@ -156,6 +157,12 @@ class DraftEngine:
         self.reuse_hits = 0          # rounds served from a predraft
         self.decode_programs: set = set()
         self.compile_watch = flight_lib.CompileWatch()
+        # Device-time calibration for the DRAFT model's programs: the
+        # engine's "draft" flight records look their dev_ms_est up in
+        # THIS calibrator (draft program identity is drafter-scoped,
+        # exactly like its compile watch).
+        self.devtime = attribution_lib.DeviceTimeCalibrator()
+        self.compile_watch.calibrator = self.devtime
 
         sp = sampling.SamplingParams()     # drafting is argmax-only
 
@@ -273,6 +280,15 @@ class DraftEngine:
             self.block_table[:] = self.n_kv_blocks
             self._table_dirty = True
         self.cache["length"] = jnp.zeros_like(self.cache["length"])
+
+    def hbm_bytes(self) -> int:
+        """Device-resident bytes the drafter holds (draft weights +
+        its KV pool) — the engine's HBM ledger publishes this as the
+        ``draft_pool`` component. Metadata reads only (nbytes), never
+        a device fetch."""
+        return (attribution_lib.tensor_bytes(self.params)
+                + attribution_lib.tensor_bytes(self.qweights)
+                + attribution_lib.tensor_bytes(self.cache))
 
     # -- drafting ----------------------------------------------------------
 

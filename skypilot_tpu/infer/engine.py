@@ -31,6 +31,7 @@ from skypilot_tpu.infer import adapters as adapters_lib
 from skypilot_tpu.infer import kvcache, sampling
 from skypilot_tpu.infer import qos as qos_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import attribution as attribution_lib
 from skypilot_tpu.observability import flight as flight_lib
 from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.utils import timeline
@@ -262,6 +263,14 @@ class BurstHandle:
     # the flight record written at completion carries each part's
     # program identity.
     spans: List[Optional[int]] = dataclasses.field(default_factory=list)
+    # Per-part compile-watch program keys (parallel to ``parts``) —
+    # the completion record's dev_ms_est looks each part's calibrated
+    # device-time EWMA up by this identity.
+    keys: List[Optional[str]] = dataclasses.field(default_factory=list)
+    # Wall clock when the last part's dispatch returned: the
+    # completion record splits its host wall into dispatch vs fetch
+    # at this stamp.
+    dispatch_done_s: Optional[float] = None
 
 
 class PromptTooLongError(ValueError):
@@ -784,6 +793,13 @@ class InferenceEngine:
         # below — first-dispatch compile cost, and the mid-traffic
         # unexpected-compile alarm once warmup is declared complete.
         self.compile_watch = flight_lib.CompileWatch()
+        # Device-time calibration: every Nth hit dispatch of a program
+        # key (SKYTPU_DEVTIME_EVERY; 0 = off) is timed synchronously
+        # through the calibrator's bracket, maintaining a per-program
+        # EWMA of pure device seconds — the dev_ms_est column flight
+        # records carry next to host wall.
+        self.devtime = attribution_lib.DeviceTimeCalibrator()
+        self.compile_watch.calibrator = self.devtime
         # Per-burst attribution accumulators for the flight record
         # (loop-thread only): COW copies / prefix evictions / lazy
         # grows since the previous record.
@@ -887,6 +903,50 @@ class InferenceEngine:
             self._aid_dev = None
             self._aid_dirty = False
 
+        # HBM ledger + roofline model (observability/attribution.py):
+        # analytical byte accounting of every device-resident tensor
+        # family this engine owns, refreshed from host bookkeeping at
+        # every _update_gauges, and the per-record FLOPs/bytes cost
+        # model behind the MFU / bandwidth-utilization columns. KV
+        # bytes-per-token is computed from the ACTUAL cache dtypes
+        # (int8 KV counts its fp32 scales).
+        itemsize = self.cache["k"].dtype.itemsize
+        G, hd, L = cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+        self._kv_token_bytes = 2 * L * G * hd * itemsize \
+            + (2 * L * G * 4 if "k_scale" in self.cache else 0)
+        self._kv_block_bytes = (self._kv_token_bytes * self.kv_block
+                                if self.paged else 0)
+        weight_bytes = (attribution_lib.tensor_bytes(self.params)
+                        + attribution_lib.tensor_bytes(self.qweights))
+        self.hbm_ledger = attribution_lib.HbmLedger()
+        self._weight_bytes = weight_bytes
+        self.roofline = attribution_lib.Roofline(
+            param_count=cfg.num_params(), weight_bytes=weight_bytes,
+            kv_token_bytes=self._kv_token_bytes, d_model=cfg.d_model,
+            n_layers=L, n_heads=cfg.n_heads, head_dim=hd,
+            max_len=max_len, chunk_tokens=self.prefill_chunk)
+        # The draft model's rollouts attribute at ITS scale, not the
+        # verifier's — a second roofline on the draft config.
+        self._draft_roofline = None
+        if draft_engine is not None:
+            dcfg = draft_engine.cfg
+            d_itemsize = draft_engine.cache["k"].dtype.itemsize
+            d_kvt = 2 * dcfg.n_layers * dcfg.n_kv_heads \
+                * dcfg.head_dim * d_itemsize \
+                + (2 * dcfg.n_layers * dcfg.n_kv_heads * 4
+                   if "k_scale" in draft_engine.cache else 0)
+            self._draft_roofline = attribution_lib.Roofline(
+                param_count=dcfg.num_params(),
+                weight_bytes=(
+                    attribution_lib.tensor_bytes(draft_engine.params)
+                    + attribution_lib.tensor_bytes(
+                        draft_engine.qweights)),
+                kv_token_bytes=d_kvt, d_model=dcfg.d_model,
+                n_layers=dcfg.n_layers, n_heads=dcfg.n_heads,
+                head_dim=dcfg.head_dim, max_len=draft_engine.max_len)
+        peak_flops, peak_bw = attribution_lib.device_peaks()
+        attribution_lib.ROOFLINE_PEAK_FLOPS.set(peak_flops)
+        attribution_lib.ROOFLINE_PEAK_BW.set(peak_bw)
         # Per-tenant KV-block quotas (qos tenant spec max_kv_blocks):
         # blocks a slot's table references are charged to its tenant
         # at claim/growth and refunded when the slot's blocks free.
@@ -905,6 +965,13 @@ class InferenceEngine:
         # host-side (one outstanding async burst at a time is the
         # expected pattern; the count caps the next burst).
         self._inflight_tokens = 0
+        # Static ledger components once; the dynamic ones (kv_used,
+        # prefix_pinned) refresh with the slot gauges, so the ledger
+        # init must follow the slot bookkeeping above. The runtime
+        # cross-check fills bytes_in_use / the true bytes_limit where
+        # the backend reports memory_stats (CPU: typed fallback event,
+        # analytical-only).
+        self._init_hbm_ledger()
         SLOTS_TOTAL.set(n_slots)
         self._update_gauges()
 
@@ -1125,6 +1192,75 @@ class InferenceEngine:
         ENGINE_WAITING.set(len(self.waiting))
         if self.paged:
             KV_BLOCKS_USED.set(self.allocator.used)
+        self._refresh_hbm_ledger()
+
+    # -- HBM ledger --------------------------------------------------------
+
+    def _init_hbm_ledger(self) -> None:
+        """Static ledger components: resident capacity each tensor
+        family holds for the engine's lifetime (array nbytes are
+        metadata reads — no device fetch). The workspace entry is the
+        per-program activation ESTIMATE for the widest admission wave
+        (rows x bucket x (ff + 2d) fp32 plus the wave logits), the one
+        family with no host-authoritative array to read."""
+        led = self.hbm_ledger
+        led.set_bytes("weights", self._weight_bytes)
+        led.set_bytes("kv_pool",
+                      attribution_lib.tensor_bytes(self.cache))
+        led.set_bytes("prefix_pool",
+                      attribution_lib.tensor_bytes(self.pool))
+        led.set_bytes("draft_pool",
+                      self.draft_engine.hbm_bytes()
+                      if self.draft_engine is not None else 0)
+        led.set_bytes("adapter_pool",
+                      attribution_lib.tensor_bytes(self.adapters.pool)
+                      if self.adapters is not None else 0)
+        rows = (self.max_wave if self.pad_waves else self.n_slots) + 1
+        widest = max(self.buckets) if self.buckets else self.max_len
+        cfg = self.cfg
+        workspace = rows * widest * (cfg.d_ff + 2 * cfg.d_model) * 4 \
+            + rows * cfg.vocab_size * 4
+        led.set_bytes("workspace", workspace)
+        stats = led.cross_check()
+        if stats is None or "bytes_limit" not in stats:
+            # No backend truth: the alarmable limit comes from the
+            # operator (env) or defaults to the analytical total plus
+            # slack — headroom stays a meaningful ratio either way.
+            env = os.environ.get("SKYTPU_HBM_LIMIT_BYTES", "")
+            try:
+                limit = int(env) if env else 0
+            except ValueError:
+                limit = 0
+            led.set_limit(limit if limit > 0
+                          else int(led.total() * 1.25))
+        self._refresh_hbm_ledger()
+
+    def _refresh_hbm_ledger(self) -> None:
+        """Dynamic (occupancy) components, recomputed from the SAME
+        host bookkeeping the engine admits against — allocator block
+        counts and prefix payloads — so a ledger leak IS a structure
+        leak. Occupancy views overlap the capacity components
+        (kv_used is resident inside kv_pool); the headroom SLO rule
+        sums capacity components only."""
+        led = self.hbm_ledger
+        if self.paged:
+            led.set_bytes("kv_used",
+                          self.allocator.used * self._kv_block_bytes)
+            pinned = 0
+            if self._prefix_index is not None:
+                for payload in self._prefix_index.payloads():
+                    if isinstance(payload, (list, tuple)):
+                        pinned += len(payload) * self._kv_block_bytes
+            led.set_bytes("prefix_pinned", pinned)
+        else:
+            led.set_bytes("kv_used",
+                          len(self.slot_req) * self.max_len
+                          * self._kv_token_bytes)
+            led.set_bytes(
+                "prefix_pinned",
+                (len(self._prefix_index.payloads()) * self.max_len
+                 * self._kv_token_bytes)
+                if self._prefix_index is not None else 0)
 
     # -- flight recorder + compile watch -----------------------------------
 
@@ -1133,7 +1269,12 @@ class InferenceEngine:
                        toks: int, stall: bool = False,
                        drafted: int = 0, accepted: int = 0,
                        drafter: Optional[str] = None,
-                       overlap_ms: float = 0.0) -> None:
+                       overlap_ms: float = 0.0,
+                       dispatch_s: Optional[float] = None,
+                       dev_keys: Optional[List[Optional[str]]] = None,
+                       calibrator: Optional[
+                           attribution_lib.DeviceTimeCalibrator]
+                       = None) -> None:
         """Append one burst record to the flight recorder. HOST
         bookkeeping only — every value here already lives on the host
         (request lists, ints, floats); a device fetch on this path
@@ -1187,6 +1328,35 @@ class InferenceEngine:
             extra["lazy_grows"] = lazy
         if compiled:
             extra["compiled"] = compiled
+        # Device-truth attribution (observability/attribution.py).
+        # dur_s stays the dispatch->fetch host wall for render/test
+        # compat; the split names where it went (enqueueing vs
+        # waiting), and dev_ms_est is the calibrated EWMA of pure
+        # device time for the program(s) this record dispatched.
+        dur_ms = max(end_s - begin_s, 0.0) * 1e3
+        if dispatch_s is not None:
+            disp_ms = min(max((dispatch_s - begin_s) * 1e3, 0.0),
+                          dur_ms)
+            extra["dispatch_wall_ms"] = round(disp_ms, 4)
+            extra["fetch_wall_ms"] = round(dur_ms - disp_ms, 4)
+        cal = calibrator if calibrator is not None else self.devtime
+        if dev_keys:
+            ests = [cal.estimate(k) for k in dev_keys]
+            ests = [e for e in ests if e is not None]
+            if ests:
+                dev_ms = sum(ests) * 1e3
+                extra["dev_ms_est"] = round(dev_ms, 4)
+                attribution_lib.DEVICE_SECONDS.inc(dev_ms / 1e3)
+        rl = (self._draft_roofline if burst == "draft"
+              else self.roofline)
+        if rl is not None:
+            flops, hbm = rl.record_cost(burst, program,
+                                        len(slots), toks)
+            if flops:
+                extra["flops"] = flops
+                extra["hbm_bytes"] = hbm
+                attribution_lib.DEVICE_FLOPS.inc(flops)
+                attribution_lib.DEVICE_HBM_MOVED.inc(hbm)
         if self.adapters is not None and reqs:
             # Per-burst adapter composition (host dict over the
             # request list): `skytpu flight` and the bench read which
@@ -2026,10 +2196,11 @@ class InferenceEngine:
                     dispatched.append(
                         (wave, slots, bucket) + self._dispatch_wave(
                             wave, slots, bucket))
-            for wave, slots, bucket, first_dev, span, stall in \
-                    dispatched:
+            for wave, slots, bucket, first_dev, span, stall, disp_s, \
+                    dev_key in dispatched:
                 self._complete_wave(wave, slots, first_dev, span,
-                                    bucket, stall)
+                                    bucket, stall, dispatch_s=disp_s,
+                                    dev_key=dev_key)
                 if on_wave is not None:
                     on_wave()
             # on_wave may have drained fresh arrivals into ``waiting``
@@ -2194,6 +2365,8 @@ class InferenceEngine:
             self.table_device(), final=final, qweights=self.qweights,
             span=attn_span, kernel=self.kv_kernel,
             **self._lora_args())
+        t_disp = time.time()             # dispatch returned; fetch next
+        chunk_key = self.compile_watch.last_key
         tok = int(tok_dev)               # host sync (garbage unless final)
         dt = time.time() - t0
         PREFILL_CHUNKS.inc()
@@ -2204,7 +2377,8 @@ class InferenceEngine:
             "chunk", begin_s=t0, end_s=t0 + dt,
             program={"span": attn_span, "final": final},
             slots=[req.slot], reqs=[req], toks=1 if final else 0,
-            stall=decode_active)
+            stall=decode_active, dispatch_s=t_disp,
+            dev_keys=[chunk_key])
         st.pos += n_valid
         if not final:
             return True
@@ -2323,7 +2497,8 @@ class InferenceEngine:
 
     def _dispatch_wave(self, wave: List["Request"], slots: List[int],
                        bucket: int
-                       ) -> Tuple[jax.Array, timeline.Event, bool]:
+                       ) -> Tuple[jax.Array, timeline.Event, bool,
+                                  float, Optional[str]]:
         """Enqueue one wave's prefill+insert program; returns the
         (device) first-token array without forcing a host sync, the
         open prefill span (closed at completion — the span covers
@@ -2368,11 +2543,14 @@ class InferenceEngine:
             jnp.asarray(true_lens), jnp.asarray(slot_ids), self.rng,
             self.table_device(), bucket=bucket, qweights=self.qweights,
             **wave_lora)
-        return first, span, decode_active
+        return (first, span, decode_active, time.time(),
+                self.compile_watch.last_key)
 
     def _complete_wave(self, wave: List["Request"], slots: List[int],
                        first_dev: jax.Array, span: timeline.Event,
-                       bucket: int, decode_active: bool = False) -> None:
+                       bucket: int, decode_active: bool = False,
+                       dispatch_s: Optional[float] = None,
+                       dev_key: Optional[str] = None) -> None:
         first = np.asarray(first_dev)          # host sync for THIS wave
         span.end()
         now = time.time()
@@ -2382,7 +2560,8 @@ class InferenceEngine:
             "wave", begin_s=span.begin_s, end_s=now,
             program={"bucket": bucket, "rows": first.shape[0]},
             slots=slots, reqs=wave, toks=len(wave),
-            stall=decode_active)
+            stall=decode_active, dispatch_s=dispatch_s,
+            dev_keys=[dev_key])
         for req in wave:
             # The latency the request experienced: dispatch through
             # first-token fetch (same window as the histogram span).
@@ -2695,6 +2874,7 @@ class InferenceEngine:
         span.begin()
         parts = []
         part_spans: List[Optional[int]] = []
+        part_keys: List[Optional[str]] = []
         for attn_span, slots in groups:
             active = np.zeros((self.n_slots + 1,), bool)
             for s in slots:
@@ -2710,6 +2890,8 @@ class InferenceEngine:
                 **self._lora_args())
             parts.append((slots, toks_dev, commit_dev))
             part_spans.append(sarg)
+            part_keys.append(self.compile_watch.last_key)
+        dispatch_done_s = time.time()   # verify programs all enqueued
         # Pipelined predraft: with the verify program(s) now in
         # flight, roll the draft model forward K+1 steps for the
         # model-drafting slots — its prediction of the verifier's
@@ -2732,7 +2914,10 @@ class InferenceEngine:
                     program={"k": K + 1, "span": None},
                     slots=pre_slots,
                     reqs=[model_reqs[s] for s in pre_slots], toks=0,
-                    drafter="model")
+                    drafter="model",
+                    dev_keys=[self.draft_engine.compile_watch.last_key],
+                    calibrator=getattr(self.draft_engine, "devtime",
+                                       None) or self.devtime)
         # THE completion fetch: the verify tokens are this round's
         # output (the next round's window input), so this is the one
         # deliberate sync of the spec path — same role as
@@ -2745,7 +2930,8 @@ class InferenceEngine:
         out: Dict[int, List[int]] = {}
         n_emitted = accepted = 0
         model_drafted = ngram_drafted = 0
-        for (slots, toks, n_commit), sarg in zip(fetched, part_spans):
+        for part_i, ((slots, toks, n_commit), sarg) in enumerate(
+                zip(fetched, part_spans)):
             grp_emitted = grp_drafted = grp_accepted = 0
             grp_reqs: List[Request] = []
             grp_kinds = set()
@@ -2795,7 +2981,10 @@ class InferenceEngine:
                 drafted=grp_drafted, accepted=grp_accepted,
                 drafter=("mixed" if len(grp_kinds) > 1
                          else next(iter(grp_kinds), None)),
-                overlap_ms=round(overlap_s * 1e3, 3))
+                overlap_ms=round(overlap_s * 1e3, 3),
+                dispatch_s=dispatch_done_s,
+                dev_keys=[part_keys[part_i]] if part_i < len(part_keys)
+                else None)
         if model_drafted:
             SPEC_DRAFT_TOKENS.labels(drafter="model").inc(model_drafted)
         if ngram_drafted:
@@ -2861,6 +3050,7 @@ class InferenceEngine:
         ev.begin()
         parts: List[Tuple[jax.Array, List[int]]] = []
         part_spans: List[Optional[int]] = []
+        part_keys: List[Optional[str]] = []
         for attn_span, slots in groups:
             active = np.zeros((self.n_slots + 1,), bool)
             for s in slots:
@@ -2875,10 +3065,12 @@ class InferenceEngine:
                 **self._lora_args())
             parts.append((toks, slots))
             part_spans.append(sarg)
+            part_keys.append(self.compile_watch.last_key)
         self._inflight_tokens += k
         return BurstHandle(parts=parts, k=k,
                            slot_req=dict(self.slot_req), span=ev,
-                           spans=part_spans)
+                           spans=part_spans, keys=part_keys,
+                           dispatch_done_s=time.time())
 
     def complete_decode_burst(self, handle: "BurstHandle"
                               ) -> Dict[int, List[int]]:
@@ -2924,7 +3116,10 @@ class InferenceEngine:
                          "span": (handle.spans[part_i]
                                   if part_i < len(handle.spans)
                                   else None)},
-                slots=slots, reqs=part_reqs, toks=part_emitted)
+                slots=slots, reqs=part_reqs, toks=part_emitted,
+                dispatch_s=handle.dispatch_done_s,
+                dev_keys=([handle.keys[part_i]]
+                          if part_i < len(handle.keys) else None))
         if n_emitted:
             DECODE_TOKENS.inc(n_emitted)
         return out
@@ -2963,6 +3158,8 @@ class InferenceEngine:
             self.params, self.cache, self.rng, jnp.asarray(active),
             self.table_device(), qweights=self.qweights, span=sarg,
             **self._lora_args())
+        t_disp = time.time()
+        step_key = self.compile_watch.last_key
         toks = np.asarray(toks)
         ev.end()
         out: Dict[int, int] = {}
@@ -2982,7 +3179,8 @@ class InferenceEngine:
         self._record_flight(
             "decode1", begin_s=ev.begin_s, end_s=time.time(),
             program={"k": 1, "span": sarg},
-            slots=step_slots, reqs=step_reqs, toks=len(out))
+            slots=step_slots, reqs=step_reqs, toks=len(out),
+            dispatch_s=t_disp, dev_keys=[step_key])
         return out
 
     def run_to_completion(self, max_burst: int = 8) -> List[Request]:

@@ -568,6 +568,13 @@ def make_handler(model: ModelServer):
                 eng = model.engine
                 fl = getattr(eng, "flight", None)
                 watch = getattr(eng, "compile_watch", None)
+                # Device-truth attribution (PR 16): the calibrated
+                # per-program device-time EWMAs and the HBM ledger
+                # ride the same live-state read — skytpu flight
+                # renders host-vs-device and headroom without a
+                # second endpoint.
+                devtime = getattr(eng, "devtime", None)
+                ledger = getattr(eng, "hbm_ledger", None)
                 return self._json(200, {
                     "records": fl.tail(n) if fl is not None else [],
                     "enabled": bool(fl is not None and fl.enabled),
@@ -576,6 +583,10 @@ def make_handler(model: ModelServer):
                     "warm": bool(watch is not None and watch.warm),
                     "unexpected": (watch.unexpected
                                    if watch is not None else []),
+                    "devtime": (devtime.summary()
+                                if devtime is not None else {}),
+                    "hbm": (ledger.snapshot()
+                            if ledger is not None else {}),
                 })
             return self._json(404, {"error": "not found"})
 

@@ -264,6 +264,15 @@ class CompileWatch:
         self._unexpected: List[str] = []         # guarded-by: _lock
         self._new: List[str] = []                # guarded-by: _lock
         self._warm = False                       # guarded-by: _lock
+        # Device-time calibration (attribution.DeviceTimeCalibrator,
+        # attached by the engine): every Nth HIT dispatch of a key is
+        # routed through the calibrator's timed bracket, maintaining
+        # the per-program device-seconds EWMA. None = no calibration.
+        self.calibrator = None
+        # Key of the most recent dispatch through any wrapper. Loop-
+        # thread discipline (the engine reads it right after the
+        # dispatch it made), so a plain attribute suffices.
+        self.last_key: Optional[str] = None
 
     def wrap(self, name: str, fn: Callable,
              static_argnames: Sequence[str] = (),
@@ -276,9 +285,16 @@ class CompileWatch:
             if key_fn is not None:
                 parts.extend(f"{k}={v}" for k, v in key_fn(args, kwargs))
             key = name + (f"[{' '.join(parts)}]" if parts else "")
+            self.last_key = key
             with self._lock:
                 hit = key in self._programs
             if hit:
+                # Sampled device-time calibration rides the HIT path
+                # only: the first dispatch is the compile, whose wall
+                # would poison a pure-execution EWMA.
+                cal = self.calibrator
+                if cal is not None and cal.tick(key):
+                    return cal.timed_call(key, fn, *args, **kwargs)
                 return fn(*args, **kwargs)
             t0 = time.monotonic()
             out = fn(*args, **kwargs)
@@ -394,7 +410,9 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
     for r in records:
         agg = out.setdefault(program_label(r), {
             "count": 0, "toks": 0, "total_s": 0.0, "max_s": 0.0,
-            "drafted": 0, "accepted": 0, "compiled": 0})
+            "drafted": 0, "accepted": 0, "compiled": 0,
+            "dev_s": 0.0, "dev_samples": 0, "flops": 0,
+            "hbm_bytes": 0})
         dur = max(float(r.get("dur_s", 0.0)), 0.0)
         agg["count"] += 1
         agg["toks"] += int(r.get("toks", 0))
@@ -403,10 +421,22 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
         agg["drafted"] += int(r.get("drafted", 0))
         agg["accepted"] += int(r.get("accepted", 0))
         agg["compiled"] += len(r.get("compiled", ()))
+        # Device-truth attribution (calibrated estimates + analytical
+        # roofline inputs); records predating the attribution layer
+        # simply contribute nothing.
+        if r.get("dev_ms_est") is not None:
+            agg["dev_s"] += float(r["dev_ms_est"]) / 1e3
+            agg["dev_samples"] += 1
+        agg["flops"] += int(r.get("flops", 0))
+        agg["hbm_bytes"] += int(r.get("hbm_bytes", 0))
     for agg in out.values():
         agg["mean_ms"] = round(agg["total_s"] / agg["count"] * 1e3, 3)
         agg["max_s"] = round(agg["max_s"], 6)
         agg["total_s"] = round(agg["total_s"], 6)
+        agg["dev_ms"] = (round(agg["dev_s"] / agg["dev_samples"] * 1e3,
+                               3)
+                         if agg["dev_samples"] else None)
+        agg["dev_s"] = round(agg["dev_s"], 6)
     return out
 
 
@@ -422,9 +452,9 @@ def render_table(records: List[Dict[str, Any]],
     shown = records[-last:]
     t0 = shown[0].get("ts_s", 0.0)
     lines.append(f"last {len(shown)} of {len(records)} bursts:")
-    fmt = "{:>9}  {:<34} {:>5} {:>5} {:>9}  {}"
+    fmt = "{:>9}  {:<34} {:>5} {:>5} {:>9} {:>8}  {}"
     lines.append(fmt.format("T+MS", "PROGRAM", "SLOTS", "TOKS",
-                            "HOST-MS", "FLAGS"))
+                            "HOST-MS", "DEV-MS", "FLAGS"))
     for r in shown:
         flags = []
         if r.get("stall"):
@@ -464,22 +494,25 @@ def render_table(records: List[Dict[str, Any]],
                          f"retired={r.get('retired_rows', 0)}")
         if r.get("compiled"):
             flags.append(f"COMPILED={len(r['compiled'])}")
+        dev = r.get("dev_ms_est")
         lines.append(fmt.format(
             f"+{(r.get('ts_s', t0) - t0) * 1e3:.1f}",
             program_label(r)[:34],
             len(r.get("slots", ())), r.get("toks", 0),
             f"{float(r.get('dur_s', 0.0)) * 1e3:.2f}",
+            f"{float(dev):.2f}" if dev is not None else "-",
             " ".join(flags)))
     lines.append("")
     lines.append("per-program summary:")
-    fmt2 = "{:<40} {:>6} {:>8} {:>9} {:>9}  {}"
+    fmt2 = "{:<40} {:>6} {:>8} {:>9} {:>8} {:>9}  {}"
     lines.append(fmt2.format("PROGRAM", "BURSTS", "TOKS", "MEAN-MS",
-                             "MAX-MS", "SPEC"))
+                             "DEV-MS", "MAX-MS", "SPEC"))
     for label, agg in sorted(summarize(records).items()):
         spec = (f"{agg['accepted']}/{agg['drafted']}"
                 if agg["drafted"] else "-")
         lines.append(fmt2.format(
             label[:40], agg["count"], agg["toks"], agg["mean_ms"],
+            agg["dev_ms"] if agg["dev_ms"] is not None else "-",
             round(agg["max_s"] * 1e3, 3), spec))
     if programs:
         lines.append("")
@@ -500,7 +533,10 @@ def as_spans(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
                                    "stall", "rids", "tenants",
                                    "adapters", "priority",
                                    "retired_rows", "drafter",
-                                   "overlap_ms")
+                                   "overlap_ms", "dev_ms_est",
+                                   "dispatch_wall_ms",
+                                   "fetch_wall_ms", "flops",
+                                   "hbm_bytes")
                  if r.get(k)}
         attrs["slots"] = len(r.get("slots", ()))
         spans.append({

@@ -129,6 +129,16 @@ DEFAULT_RULES: List[SloRule] = [
             baseline_metric="skytpu_train_step_median_seconds",
             min_events=3.0),
     SloRule("component-alive", "component_dead", threshold=0.0),
+    # Analytical HBM pressure from the engine's ledger: capacity
+    # components (weights, pools, workspace) summed against the
+    # published limit. Occupancy views (kv_used, prefix_pinned) are
+    # excluded — they live INSIDE kv_pool/prefix_pool and would
+    # double-count. Pages before the allocator does, while there is
+    # still headroom to act (evict prefixes, shrink max_batch).
+    SloRule("hbm-headroom", "hbm_headroom", threshold=0.92,
+            metric="skytpu_hbm_bytes",
+            baseline_metric="skytpu_hbm_limit_bytes",
+            exclude_labels={"component": ["kv_used", "prefix_pinned"]}),
 ]
 
 
@@ -255,10 +265,42 @@ def _eval_window(rule: SloRule, start: Optional[Snapshot],
         if not n or n < rule.min_events or s is None or not baseline:
             return None
         return (s / n) / baseline
+    if rule.kind == "hbm_headroom":
+        # Instantaneous gauge ratio: ledger components over the limit.
+        # sample_value has no exclusion filter, so walk the family by
+        # hand — exclude_labels drops the occupancy views that overlap
+        # the capacity components. Each serving instance has its OWN
+        # HBM: federated gauges arrive instance-labeled (never summed),
+        # so group by instance and page on the worst ratio — summing
+        # across replicas would breach on fleet size, not memory.
+        fam = families.get(rule.metric)
+        lim_fam = families.get(rule.baseline_metric)
+        if fam is None or lim_fam is None:
+            return None
+        excluded = _excluded_fn(rule)
+        inst_l = aggregate.INSTANCE_LABEL
+        used: Dict[str, float] = {}
+        for labels, value in fam["samples"]:
+            if "__name__" in labels or excluded(labels):
+                continue
+            inst = labels.get(inst_l, "")
+            used[inst] = used.get(inst, 0.0) + value
+        limits: Dict[str, float] = {}
+        for labels, value in lim_fam["samples"]:
+            if "__name__" in labels:
+                continue
+            inst = labels.get(inst_l, "")
+            limits[inst] = max(limits.get(inst, 0.0), value)
+        fallback = max(limits.values(), default=0.0)
+        ratios = [u / (limits.get(inst) or fallback)
+                  for inst, u in used.items()
+                  if limits.get(inst) or fallback]
+        return max(ratios) if ratios else None
     return None
 
 
-_INSTANT_KINDS = ("component_dead", "heartbeat_staleness")
+_INSTANT_KINDS = ("component_dead", "heartbeat_staleness",
+                  "hbm_headroom")
 
 
 def evaluate_rule(rule: SloRule, history: List[Snapshot]

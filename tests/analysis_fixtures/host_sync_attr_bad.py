@@ -1,0 +1,52 @@
+# Golden fixture: seeded host-sync violations on the device-truth
+# attribution path. The calibrator/ledger/roofline ride every dispatch
+# and every flight record from HOST state (monotonic timestamps,
+# program-dict scalars, allocator counts x bytes) — the ONE legal sync
+# is the sampled calibration bracket itself. Anything else here
+# (deciding WHETHER to sample by fetching a device counter, costing a
+# burst by reading its arrays) turns the attribution layer into the
+# very stall it exists to measure. Checked as if it were
+# skypilot_tpu/observability/attribution.py (the attribution scope).
+# Never imported.
+import numpy as np
+
+
+class DeviceTimeCalibrator:
+    def tick(self, key, dispatched_dev=None):
+        # The sampling decision read off the DEVICE: every tick — i.e.
+        # every dispatch of every program — becomes a blocking fetch.
+        c = int(dispatched_dev)                    # expect: host-sync
+        with self._lock:
+            self._counts[key] = c
+        return c % self.every == 1
+
+    def update(self, key, out):
+        # Syncing on the OUTPUT inside update: the bracket already
+        # measured the duration; draining again doubles the stall.
+        out.block_until_ready()                    # expect: host-sync
+        with self._lock:
+            self._ewma[key] = self._host_dur(out)
+
+    def estimate(self, key, ewma_dev=None):
+        # Estimates are read once per flight record on the engine
+        # loop — a device-resident EWMA makes every record a fetch.
+        return float(ewma_dev)                     # expect: host-sync
+
+
+class HbmLedger:
+    def set_bytes(self, component, used_rows_dev=None, row_bytes=0):
+        # The ledger mirrors host bookkeeping by design; counting
+        # device-side rows re-introduces the drift it exists to avoid
+        # AND stalls the refresh that runs inside the serving loop.
+        n = np.asarray(used_rows_dev)              # expect: host-sync
+        with self._lock:
+            self._components[component] = n.sum() * row_bytes
+
+
+class Roofline:
+    def record_cost(self, burst, program, toks_dev=None):
+        # Costing the burst from its device arrays instead of the
+        # program-dict scalars: one pipeline drain per flight record.
+        toks = toks_dev.sum().item()               # expect: host-sync
+        return (2 * self.param_count * toks,
+                toks * self.kv_token_bytes)

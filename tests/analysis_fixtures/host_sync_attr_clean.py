@@ -1,0 +1,51 @@
+# Clean twin: device-truth attribution done right — the sampling
+# decision is a host counter under a lock, the EWMA consumes a
+# monotonic-clock delta the calibration bracket already measured, the
+# ledger is recomputed from host bookkeeping (counts x bytes), and the
+# roofline prices a dispatch from program-dict scalars. The device is
+# consulted only by the bracket itself (the one baselined sync).
+# Never imported.
+import time
+
+
+class DeviceTimeCalibrator:
+    def tick(self, key):
+        if self.every <= 0:
+            return False
+        with self._lock:
+            c = self._counts.get(key, 0) + 1
+            self._counts[key] = c
+        return c % self.every == 1
+
+    def update(self, key, dev_s):
+        with self._lock:
+            prev = self._ewma.get(key)
+            self._ewma[key] = (dev_s if prev is None
+                               else prev + self.alpha * (dev_s - prev))
+            self._stamp[key] = time.monotonic()
+
+    def estimate(self, key):
+        if key is None:
+            return None
+        with self._lock:
+            return self._ewma.get(key)
+
+
+class HbmLedger:
+    def set_bytes(self, component, n):
+        with self._lock:
+            self._components[component] = max(n, 0)
+
+    def total(self):
+        with self._lock:
+            return sum(self._components.values())
+
+
+class Roofline:
+    def record_cost(self, burst, program, n_slots, toks):
+        span = program.get("span") or self.max_len
+        flops = 2 * self.param_count * toks
+        moved = (self.weight_bytes
+                 + n_slots * span * self.kv_token_bytes
+                 + toks * self.kv_token_bytes)
+        return flops, moved

@@ -274,6 +274,32 @@ def test_counter_deltas_match_record_sums(flown_engine):
     stalls = sum(1 for r in window if r.get("stall"))
     assert _hist_count_delta(before, after,
                              "skytpu_decode_stall_seconds") == stalls
+    # Device-truth attribution (ISSUE 16): the roofline counters are
+    # incremented on the SAME path that stamps the record fields — a
+    # record with a cost and no counter inc (or vice versa) splits
+    # these. flops are stamped on every costed burst, so the workload
+    # must have produced some.
+    flops = sum(r.get("flops", 0) for r in window)
+    hbm = sum(r.get("hbm_bytes", 0) for r in window)
+    assert flops > 0 and hbm > 0
+    assert _counter_delta(before, after,
+                          "skytpu_device_flops_total") == flops
+    assert _counter_delta(before, after,
+                          "skytpu_device_hbm_moved_bytes_total") == hbm
+    # dev_ms_est is rounded on the record; the counter takes the raw
+    # value — equal to rounding noise.
+    dev_s = sum(r.get("dev_ms_est", 0.0) for r in window) / 1e3
+    assert _counter_delta(before, after,
+                          "skytpu_device_seconds_total") == \
+        pytest.approx(dev_s, abs=1e-6)
+    # The host-wall split sums back to dur_s exactly wherever present.
+    for r in window:
+        if "dispatch_wall_ms" in r:
+            assert r["dispatch_wall_ms"] >= 0
+            assert r["fetch_wall_ms"] >= 0
+            assert r["dispatch_wall_ms"] + r["fetch_wall_ms"] == \
+                pytest.approx(r["dur_s"] * 1e3, abs=1e-3)
+    assert any("dispatch_wall_ms" in r for r in window)
 
 
 def test_chunk_verify_interleave_consistency():

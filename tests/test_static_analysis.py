@@ -181,6 +181,13 @@ _GOLDEN = [
     ("host-sync", "host_sync_adapter_bad.py",
      "host_sync_adapter_clean.py",
      "skypilot_tpu/infer/engine.py"),
+    # Device-truth attribution (PR 16): the calibrator tick/estimate
+    # path, the HBM ledger and the roofline cost model ride every
+    # dispatch / flight record — host-only by design, the sampled
+    # calibration bracket being the one baselined sync (v10).
+    ("host-sync", "host_sync_attr_bad.py",
+     "host_sync_attr_clean.py",
+     "skypilot_tpu/observability/attribution.py"),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      "skypilot_tpu/utils/fixture_locks.py"),
     ("typed-errors", "typed_errors_bad.py", "typed_errors_clean.py",
