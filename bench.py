@@ -591,18 +591,30 @@ def main() -> None:
                 fli["calibration_parity_ok"]
             out["serve_flight_calibration_samples"] = \
                 fli["calibration_samples"]
+            # Request forensics rides the same bench: the per-request
+            # ledger/tail machinery must be output-invariant when off
+            # and <=1% TPOT when on (same bound as the recorder).
+            out["serve_forensics_overhead"] = \
+                fli["forensics_overhead_ratio"]
+            out["serve_forensics_parity_ok"] = \
+                fli["forensics_parity_ok"]
             out["serve_flight_regressed"] = bool(
                 fli["unexpected_compiles"] != 0
                 or not fli["coverage_ok"] or not fli["parity_ok"]
                 or not fli["calibration_parity_ok"]
-                or fli["overhead_ratio"] > 1.01)
+                or not fli["forensics_parity_ok"]
+                or fli["overhead_ratio"] > 1.01
+                or fli["forensics_overhead_ratio"] > 1.01)
             if out["serve_flight_regressed"]:
                 log("SERVE FLIGHT REGRESSION: "
                     f"unexpected={fli['unexpected_compiles']} "
                     f"coverage={fli['coverage_ok']} "
                     f"parity={fli['parity_ok']} "
                     f"cal_parity={fli['calibration_parity_ok']} "
-                    f"overhead=x{fli['overhead_ratio']} (> 1.01)")
+                    f"forensics_parity={fli['forensics_parity_ok']} "
+                    f"overhead=x{fli['overhead_ratio']} "
+                    f"forensics=x{fli['forensics_overhead_ratio']} "
+                    f"(> 1.01)")
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"flight bench failed: {e}")
             out["serve_flight_error"] = str(e)[:200]

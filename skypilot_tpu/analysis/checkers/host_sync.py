@@ -79,6 +79,12 @@ _SCOPES: Dict[str, Set[str]] = {
         # device fetch to pick a drafter rung would stall every spec
         # dispatch.
         "_spec_mode",
+        # Request forensics (PR 17): stall-episode bookkeeping rides
+        # every claim attempt, and the retire record + P^2 tail
+        # observe ride every retirement — all pure host dict/float
+        # work; a device fetch inside _retire would stall the very
+        # completion path whose latency the ledger decomposes.
+        "_retire", "_mark_stall", "_end_stall", "_observe_tail",
     },
     # Model-backed drafter (PR 14): draft_batch/rollout run once per
     # verify round on the engine loop; everything except the draft
@@ -124,6 +130,14 @@ _SCOPES: Dict[str, Set[str]] = {
         "timed_call", "tick", "update", "estimate", "record_cost",
         "set_bytes", "snapshot", "total",
     },
+    # Request forensics (PR 17): the ledger builder replays flight
+    # records on demand (CLI/debug endpoint — cold), but the P^2
+    # quantile observe and the exemplar pin run inline at every
+    # retirement on the engine loop — pure host arithmetic over
+    # floats and dicts.
+    "skypilot_tpu/observability/forensics.py": {
+        "observe", "pin", "value", "_parabolic",
+    },
     "skypilot_tpu/infer/server.py": {
         "_loop", "_step", "_drain_inbox", "_flush_streams",
         "_complete_burst", "_on_wave",
@@ -165,7 +179,11 @@ class HostSyncChecker(Checker):
     #     estimate path, the roofline cost model and the HBM ledger
     #     (observability/attribution.py) joined the scope; the one
     #     deliberate calibration bracket is baselined.
-    version = 10
+    # v11: request forensics (PR 17) — the engine's retire/stall/tail
+    #     path and the P^2 observe + exemplar pin
+    #     (observability/forensics.py) joined the scope; the bump
+    #     rescans the edited retirement hot path cold.
+    version = 11
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

@@ -334,12 +334,31 @@ def status(refresh, show_ip, show_metrics, show_health, raw, clusters):
 
 
 def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
+    """One `skytpu top` frame as rendered text (the dict-first core is
+    :func:`_top_frame`; this wrapper keeps the render-only callers and
+    tests on the string)."""
+    return _top_frame(prev, prev_ts, fams, now, payload)[0]
+
+
+def _top_frame(prev, prev_ts, fams, now, payload):
     """One `skytpu top` frame: the health table plus fleet-wide rates
     and latencies. Counter rates need two snapshots — the first frame
-    (and --once) shows '-' where a delta would go."""
+    (and --once) shows '-' where a delta would go.
+
+    Returns ``(rendered, data)``: the text frame AND its underlying
+    values as one machine-readable dict (``skytpu top --json``) — the
+    render is a VIEW over ``data``, so a dashboard scraping the JSON
+    sees exactly the numbers the table shows."""
     from skypilot_tpu.observability import aggregate, slo
 
     span = (now - prev_ts) if prev_ts else None
+    data = {
+        "ts": now,
+        "window_s": span,
+        "fleet": {"status": payload.get("status"),
+                  "alerts": payload.get("alerts", []),
+                  "components": payload.get("components", [])},
+    }
 
     def rate(name, match=None, sample_name=None):
         if prev is None or not span:
@@ -370,21 +389,33 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
     have = fams.keys()
     if "skytpu_http_requests_total" in have or \
             "skytpu_ttft_seconds" in have:
+        serve = {}
+        data["serve"] = serve
         ttft = aggregate.histogram_quantile(prev, fams,
                                             "skytpu_ttft_seconds", 0.95)
         slots = gauge("skytpu_slots_active")
         slots_total = gauge("skytpu_slots_total")
+        req_rate = rate("skytpu_http_requests_total")
+        err5_rate = rate_prefix("skytpu_http_requests_total",
+                                "code", "5")
+        serve["req_per_s"] = req_rate
+        serve["err5xx_per_s"] = err5_rate
+        serve["ttft_p95_s"] = ttft
         line = (
-            f"serve   req {f_rate(rate('skytpu_http_requests_total'))}"
-            f"  5xx {f_rate(rate_prefix('skytpu_http_requests_total', 'code', '5'))}"
+            f"serve   req {f_rate(req_rate)}"
+            f"  5xx {f_rate(err5_rate)}"
             f"  ttft p95 {f_ms(ttft)}")
         if slots is not None and slots_total:
+            serve["slots_active"] = slots
+            serve["slots_total"] = slots_total
             line += f"  slots {slots:.0f}/{slots_total:.0f}"
         # Paged KV-cache block occupancy (docs/serving.md): how full
         # the shared block pool is across the fleet's engines.
         kv_used = gauge("skytpu_kv_blocks_used")
         kv_total = gauge("skytpu_kv_blocks_total")
         if kv_used is not None and kv_total:
+            serve["kv_blocks_used"] = kv_used
+            serve["kv_blocks_total"] = kv_total
             line += f"  kv {kv_used:.0f}/{kv_total:.0f}"
         # Span-bucketed decode attention (docs/serving.md): median KV
         # rows a decode/verify burst gathered between frames — decode
@@ -392,6 +423,7 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
         span_rows = aggregate.histogram_quantile(
             prev, fams, "skytpu_decode_attn_rows", 0.5)
         if span_rows is not None:
+            serve["attn_rows_p50"] = span_rows
             line += f"  span p50 {span_rows:.0f}"
         # Decode attention read path (docs/serving.md §Paged
         # decode-attention kernel): which big-cache path the fleet's
@@ -420,9 +452,10 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
                 kern = _path("kernel", window=False)
                 gath = _path("gather", window=False)
             if kern or gath:
-                line += ("  attn " + ("mixed" if kern and gath
-                                      else "kernel" if kern
-                                      else "gather"))
+                attn = ("mixed" if kern and gath
+                        else "kernel" if kern else "gather")
+                serve["attn_path"] = attn
+                line += "  attn " + attn
         # Speculative-decode drafter kind + acceptance (docs/
         # serving.md): which drafter rung the fleet's spec rounds rode
         # (model|ngram|mixed — the fallback ladder is observable at a
@@ -454,7 +487,10 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
             d_ac = rate("skytpu_spec_accepted_total")
             acc = ((d_ac or 0) / d_dr if d_dr
                    else gauge("skytpu_spec_acceptance_rate", agg="max"))
+            if kind is not None:
+                serve["spec_drafter"] = kind
             if acc is not None:
+                serve["spec_acceptance"] = acc
                 line += (f"  spec {kind} acc {acc:4.0%}" if kind
                          else f"  spec acc {acc:4.0%}")
             ov = rate("skytpu_spec_overlap_wall_seconds_total")
@@ -463,6 +499,7 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
                 ov = gauge("skytpu_spec_overlap_wall_seconds_total")
                 vw = gauge("skytpu_spec_verify_wall_seconds_total")
             if ov is not None and vw:
+                serve["spec_overlap"] = min(ov / vw, 1.0)
                 line += f"  ovl {min(ov / vw, 1.0):4.0%}"
         # Fleet prefix-cache hit rate (ROADMAP item 3 slice): the
         # federation already sums per-replica counters — the window
@@ -471,13 +508,17 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
         if "skytpu_prefix_cache_hits_total" in have:
             d_h = rate("skytpu_prefix_cache_hits_total")
             d_m = rate("skytpu_prefix_cache_misses_total")
+            cache_rate = None
             if d_h is not None and d_m is not None and (d_h + d_m) > 0:
-                line += f"  cache {d_h / (d_h + d_m):4.0%}"
+                cache_rate = d_h / (d_h + d_m)
             else:
                 hits = gauge("skytpu_prefix_cache_hits_total")
                 misses = gauge("skytpu_prefix_cache_misses_total") or 0
                 if hits is not None and (hits + misses) > 0:
-                    line += f"  cache {hits / (hits + misses):4.0%}"
+                    cache_rate = hits / (hits + misses)
+            if cache_rate is not None:
+                serve["prefix_cache_hit_rate"] = cache_rate
+                line += f"  cache {cache_rate:4.0%}"
         # Adapter catalog (docs/serving.md §Adapter catalog): resident
         # fine-tunes / pool capacity fleet-wide, plus the hot-load
         # rate when demand loads happened between frames — catalog
@@ -485,9 +526,12 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
         ad_active = gauge("skytpu_adapter_active")
         ad_slots = gauge("skytpu_adapter_slots")
         if ad_active is not None and ad_slots:
+            serve["adapters_active"] = ad_active
+            serve["adapter_slots"] = ad_slots
             line += f"  adapters {ad_active:.0f}/{ad_slots:.0f}"
             ld = rate("skytpu_adapter_loads_total")
             if ld:
+                serve["adapter_loads_per_s"] = ld
                 line += f" (ld {ld:.2f}/s)"
         # Compile watch (docs/observability.md §Flight recorder):
         # programs compiled fleet-wide, and — the alarm column — how
@@ -495,6 +539,8 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
         comp = gauge("skytpu_programs_compiled_total")
         if comp is not None:
             unexp = gauge("skytpu_unexpected_compiles_total") or 0
+            serve["programs_compiled"] = comp
+            serve["unexpected_compiles"] = unexp
             line += f"  compiles {comp:.0f}"
             line += (f" (! {unexp:.0f} unexpected)" if unexp
                      else " (0 unexpected)")
@@ -508,10 +554,12 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
         if peak_f:
             fl = rate("skytpu_device_flops_total")
             if fl is not None:
+                serve["mfu"] = min(fl / peak_f, 1.0)
                 line += f"  mfu {min(fl / peak_f, 1.0):5.1%}"
             peak_b = gauge("skytpu_roofline_peak_hbm_bytes_per_s")
             bw = rate("skytpu_device_hbm_moved_bytes_total")
             if peak_b and bw is not None:
+                serve["hbm_bw_util"] = min(bw / peak_b, 1.0)
                 line += f"  bw {min(bw / peak_b, 1.0):5.1%}"
         lines.append(line)
     # Per-tenant QoS columns (docs/serving.md §Multi-tenant QoS):
@@ -564,37 +612,54 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
                        if pre_life is not None else "-")
         else:
             pre_txt = f_rate(pre).strip()
+        data["qos"] = {
+            "tenants": [{"tenant": t, "req_per_s": rr,
+                         "shed_per_s": sr}
+                        for _, t, rr, sr in scored[:3]],
+            "preempt_per_s": pre,
+            "preempt_total": (gauge("skytpu_qos_preemptions_total")
+                              if pre is None else None),
+        }
         lines.append(f"qos     {cols}  preempt {pre_txt}")
     if "skytpu_lb_proxied_total" in have:
+        proxied = rate("skytpu_lb_proxied_total")
+        retries = rate("skytpu_lb_retries_total")
+        data["lb"] = {"proxied_per_s": proxied,
+                      "retries_per_s": retries}
         lines.append(
-            f"lb      proxied {f_rate(rate('skytpu_lb_proxied_total'))}"
-            f"  retries {f_rate(rate('skytpu_lb_retries_total'))}")
+            f"lb      proxied {f_rate(proxied)}"
+            f"  retries {f_rate(retries)}")
     if "skytpu_api_requests_total" in have:
         busy = gauge("skytpu_api_workers_busy")
+        api_rate = rate("skytpu_api_requests_total")
+        data["api"] = {"req_per_s": api_rate, "workers_busy": busy}
         lines.append(
-            f"api     req {f_rate(rate('skytpu_api_requests_total'))}"
+            f"api     req {f_rate(api_rate)}"
             f"  workers busy {busy:.0f}" if busy is not None else
-            f"api     req {f_rate(rate('skytpu_api_requests_total'))}")
+            f"api     req {f_rate(api_rate)}")
     if "skytpu_train_step_last_seconds" in have:
         last = gauge("skytpu_train_step_last_seconds", agg="max")
         med = gauge("skytpu_train_step_median_seconds", agg="max")
         tps = gauge("skytpu_train_tokens_per_second")
+        data["train"] = {"step_last_s": last, "step_median_s": med,
+                         "tokens_per_s": tps}
         lines.append(f"train   step {f_ms(last)} (median {f_ms(med)})"
                      f"  tokens {f_rate(tps)}")
     # Oldest heartbeat = worst skylet; the freshest would mask a
     # wedged sibling.
     hb = gauge("skytpu_skylet_last_tick_timestamp_seconds", agg="min")
     if hb:
+        data["skylet_oldest_heartbeat_age_s"] = max(now - hb, 0)
         lines.append(f"skylet  oldest heartbeat age {max(now - hb, 0):.0f}s")
     down = [t for t in fams.get("skytpu_fleet_scrape_up",
                                 {"samples": []})["samples"]
             if t[1] == 0]
     if down:
-        names = ", ".join(
-            f"{lab.get('component')}/{lab.get('instance')}"
-            for lab, _ in down)
-        lines.append(f"scrape  DOWN: {names}")
-    return "\n".join(lines)
+        names = [f"{lab.get('component')}/{lab.get('instance')}"
+                 for lab, _ in down]
+        data["scrape_down"] = names
+        lines.append(f"scrape  DOWN: {', '.join(names)}")
+    return "\n".join(lines), data
 
 
 @cli.command(name="top")
@@ -603,7 +668,11 @@ def _render_top_frame(prev, prev_ts, fams, now, payload) -> str:
 @click.option("--once", is_flag=True, default=False,
               help="Render a single frame and exit (scripting/tests; "
                    "rate columns need two frames and show '-').")
-def top(interval, once):
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="Emit ONE machine-readable frame (the table's "
+                   "underlying dict: fleet health + serve/qos/attn "
+                   "columns) and exit. Implies --once.")
+def top(interval, once, as_json):
     """Live fleet overview: health, rates, latencies, per-tenant QoS.
 
     Data comes from the API server's federation tier (`GET
@@ -614,6 +683,7 @@ def top(interval, once):
     and the fleet preemption rate.
     """
     import time as time_mod
+    once = once or as_json
     prev, prev_ts = None, None
     try:
         while True:
@@ -633,10 +703,15 @@ def top(interval, once):
                 time_mod.sleep(max(interval, 0.1))
                 continue
             now = time_mod.time()
-            frame = _render_top_frame(prev, prev_ts, families, now,
-                                      payload)
+            frame, frame_data = _top_frame(prev, prev_ts, families,
+                                           now, payload)
             if once:
-                click.echo(frame)
+                if as_json:
+                    import json as json_lib
+                    click.echo(json_lib.dumps(frame_data, indent=2,
+                                              default=str))
+                else:
+                    click.echo(frame)
                 return
             click.clear()
             click.echo(frame)
@@ -710,7 +785,15 @@ def trace_cmd(request_id, perfetto_path):
               help="Append the bubble analysis: device-idle gaps "
                    "between bursts attributed to named host causes "
                    "(docs/observability.md §Device-truth attribution).")
-def flight_cmd(target, local, last, port, perfetto_path, bubbles):
+@click.option("-f", "--follow", "follow", is_flag=True, default=False,
+              help="Keep polling and print new bursts as they land. "
+                   "Uses the /debug/flight?since=<seq> cursor so each "
+                   "poll ships only the delta, not the whole ring. "
+                   "Requires a server target; Ctrl-C to stop.")
+@click.option("--interval", type=float, default=2.0, show_default=True,
+              help="Poll interval in seconds for --follow.")
+def flight_cmd(target, local, last, port, perfetto_path, bubbles,
+               follow, interval):
     """Engine flight recorder: the last-N bursts and program summary.
 
     Burst-level serving introspection (docs/observability.md §Flight
@@ -731,6 +814,11 @@ def flight_cmd(target, local, last, port, perfetto_path, bubbles):
     from skypilot_tpu.observability import trace_view
 
     programs = None
+    if follow and (local or not target):
+        raise click.ClickException(
+            "--follow needs a live server TARGET (it tails the "
+            "in-memory ring via /debug/flight?since=...); flushed "
+            "--local logs don't grow.")
     if target and not local:
         if target.startswith(("http://", "https://")):
             url = target.rstrip("/")
@@ -774,6 +862,198 @@ def flight_cmd(target, local, last, port, perfetto_path, bubbles):
         click.echo("")
         click.echo(attribution_lib.render_bubbles(
             attribution_lib.analyze_bubbles(records)))
+    if follow:
+        # Tail the ring: re-send the server's returned "seq" cursor so
+        # each poll transfers only records stamped after it. A dropped
+        # poll just means the next one carries a bigger delta; records
+        # that rolled out of the ring between polls are gone (the
+        # cursor can't resurrect them — pin exemplars for that).
+        import time as time_mod
+        seq = int(payload.get("seq", 0))
+        click.echo(f"-- following (every {interval:g}s, Ctrl-C to "
+                   f"stop) --")
+        try:
+            while True:
+                time_mod.sleep(max(interval, 0.1))
+                try:
+                    with urllib.request.urlopen(
+                            f"{url}/debug/flight?since={seq}",
+                            timeout=10) as resp:
+                        delta = json_lib.loads(resp.read().decode())
+                except OSError as e:
+                    click.echo(f"poll failed ({e}); retrying")
+                    continue
+                seq = int(delta.get("seq", seq))
+                new = delta.get("records", [])
+                for r in new:
+                    ts = r.get("ts_s", 0.0)
+                    label = flight_lib.program_label(r)
+                    click.echo(
+                        f"{ts:>14.3f}  {label:<34} "
+                        f"slots={len(r.get('slots', ()))} "
+                        f"toks={r.get('toks', 0)} "
+                        f"host={1e3 * r.get('dur_s', 0.0):.2f}ms")
+        except KeyboardInterrupt:
+            click.echo("-- stopped --")
+
+
+@cli.command(name="why")
+@click.argument("rid", type=int)
+@click.argument("target", required=False)
+@click.option("--local", "local", is_flag=True, default=False,
+              help="Rebuild the ledger from this machine's flushed "
+                   "flight logs instead of querying a server.")
+@click.option("--port", type=int, default=8080, show_default=True,
+              help="Model-server port when TARGET is a cluster name.")
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="Emit the raw ledger dict instead of the table.")
+def why_cmd(rid, target, local, port, as_json):
+    """Explain where one request's latency went, phase by phase.
+
+    The forensics ledger (docs/observability.md §Request forensics)
+    decomposes the request's measured submit->retire wall into named
+    phases — queue wait, admission stalls by cause, prefill waves and
+    chunks, decode device-vs-host, speculative draft/verify, delivery
+    — that sum to the wall. Built entirely from flight records, so it
+    works on any retired request still in the ring, and on tail
+    exemplars pinned past ring rollover.
+
+    RID is the request id (the "rid" in flight records, access logs
+    and span attrs). TARGET is a model-server URL or cluster name;
+    `--local` (or no target) replays the flushed flight logs instead.
+    """
+    import json as json_lib
+    import urllib.error
+    import urllib.request
+
+    from skypilot_tpu.observability import flight as flight_lib
+    from skypilot_tpu.observability import forensics as forensics_lib
+
+    if target and not local:
+        if target.startswith(("http://", "https://")):
+            url = target.rstrip("/")
+        else:
+            url = f"http://{_resolve_head_ip(target)}:{port}"
+        try:
+            with urllib.request.urlopen(
+                    f"{url}/debug/forensics?rid={rid}",
+                    timeout=10) as resp:
+                payload = json_lib.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                body = json_lib.loads(e.read().decode())
+                msg = body.get("error", str(e))
+            except Exception:
+                msg = str(e)
+            raise click.ClickException(f"{url}: {msg}")
+        except OSError as e:
+            raise click.ClickException(
+                f"GET {url}/debug/forensics failed: {e}")
+        ledger = payload.get("ledger")
+        if payload.get("exemplar"):
+            click.echo("(from a pinned tail exemplar — this request "
+                       "rolled out of the live ring)")
+    else:
+        records = flight_lib.load_records()
+        ledger = forensics_lib.ledger_from_records(rid, records)
+        if ledger is None:
+            raise click.ClickException(
+                f"no retired request {rid} in the flushed flight "
+                f"logs (not retired yet, or logs rolled/never "
+                f"flushed — try a live TARGET)")
+    if ledger is None:
+        raise click.ClickException(f"no ledger for request {rid}")
+    if as_json:
+        click.echo(json_lib.dumps(ledger, indent=2, default=str))
+    else:
+        click.echo(forensics_lib.render_ledger(ledger))
+
+
+@cli.group(name="incidents")
+def incidents_group():
+    """SLO incident snapshots captured at breach transitions.
+
+    When a Watchdog rule crosses into breach, the server freezes an
+    atomic forensics bundle — flight-ring tail, recent events, a
+    metrics snapshot, fleet health and the pinned tail exemplars —
+    into ~/.skypilot_tpu/incidents/<stamp>-<rule>/ (GC'd, newest
+    SKYTPU_INCIDENTS_KEEP kept). `list` enumerates them, `show`
+    renders one bundle's manifest and alert.
+    """
+
+
+@incidents_group.command(name="list")
+def incidents_list():
+    """List captured incident bundles, newest first."""
+    import time as time_mod
+
+    from skypilot_tpu.observability import forensics as forensics_lib
+
+    rows = forensics_lib.list_incidents()
+    if not rows:
+        click.echo("no incidents captured (no breach transitions, or "
+                   "SKYTPU_INCIDENTS=0)")
+        return
+    fmt = "{:<40} {:<20} {:>8}  {}"
+    click.echo(fmt.format("INCIDENT", "RULE", "AGE", "ALERT"))
+    now = time_mod.time()
+    for row in rows:
+        age_s = max(0.0, now - (row.get("ts_s") or now))
+        if age_s >= 3600:
+            age = f"{age_s / 3600:.1f}h"
+        elif age_s >= 60:
+            age = f"{age_s / 60:.1f}m"
+        else:
+            age = f"{age_s:.0f}s"
+        attrs = row.get("attrs") or {}
+        brief = " ".join(
+            f"{k}={attrs[k]}" for k in ("value", "threshold", "window_s")
+            if k in attrs)
+        click.echo(fmt.format(row.get("name", "?"),
+                              row.get("rule") or "?", age, brief))
+
+
+@incidents_group.command(name="show")
+@click.argument("name")
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="Emit the bundle manifest + alert as JSON.")
+def incidents_show(name, as_json):
+    """Show one incident bundle: manifest, alert and file inventory."""
+    import json as json_lib
+    import time as time_mod
+
+    from skypilot_tpu.observability import forensics as forensics_lib
+
+    bundle = forensics_lib.load_incident(name)
+    if bundle is None:
+        raise click.ClickException(
+            f"no incident {name!r} (GC'd, or captured under another "
+            f"home? — `skytpu incidents list`)")
+    if as_json:
+        click.echo(json_lib.dumps(bundle, indent=2, default=str))
+        return
+    meta = bundle.get("meta", {})
+    click.echo(f"incident {name}")
+    click.echo(f"  rule:     {meta.get('rule', '?')}")
+    ts = meta.get("ts_s")
+    if ts:
+        stamp = time_mod.strftime("%Y-%m-%d %H:%M:%S",
+                                  time_mod.localtime(ts))
+        click.echo(f"  captured: {stamp}")
+    attrs = meta.get("attrs") or {}
+    if attrs:
+        click.echo("  alert:")
+        for k in sorted(attrs):
+            click.echo(f"    {k}: {attrs[k]}")
+    files = bundle.get("files") or []
+    if files:
+        click.echo("  files:")
+        for row in files:
+            lines = (f"  ({row['lines']} records)"
+                     if row.get("lines") is not None else "")
+            click.echo(f"    {row.get('file', '?'):<16} "
+                       f"{row.get('bytes', 0):>10} bytes{lines}")
+    click.echo(f"  path: {bundle.get('path', '?')}")
 
 
 @cli.command()

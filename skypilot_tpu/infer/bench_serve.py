@@ -1776,6 +1776,21 @@ def run_flight(config=None, requests=None, new_tokens=None,
                 os.environ.pop("SKYTPU_DEVTIME_EVERY", None)
             else:
                 os.environ["SKYTPU_DEVTIME_EVERY"] = prev_every
+        # Forensics guard: the request-ledger machinery (stall-episode
+        # bookkeeping, the retire record, the P^2 tail observe) rides
+        # the retire path. The timed window above ran forensics-ON (the
+        # default), so measure the off side the same best-of-two way.
+        # Off must be bit-identical greedy output — forensics observes
+        # retirement, it never steers scheduling.
+        e.forensics = False
+        out_foff, tpot_foff = workload(e)
+        e.forensics = True
+        _, tpot_fon = workload(e)
+        e.forensics = False
+        _, tpot_foff2 = workload(e)
+        e.forensics = True
+        tpot_fon = min(tpot_on, tpot_fon)
+        tpot_foff = min(tpot_foff, tpot_foff2)
         layouts["paged" if paged else "contig"] = {
             "programs_warmed": warmed,
             "warmup_compile_s": round(warm_compile_s, 3),
@@ -1791,6 +1806,11 @@ def run_flight(config=None, requests=None, new_tokens=None,
             "tpot_on_ms": round(tpot_on * 1e3, 3),
             "tpot_off_ms": round(tpot_off * 1e3, 3),
             "overhead_ratio": round(tpot_on / max(tpot_off, 1e-9), 4),
+            "forensics_parity_ok": bool(out_foff == out_on),
+            "tpot_forensics_on_ms": round(tpot_fon * 1e3, 3),
+            "tpot_forensics_off_ms": round(tpot_foff * 1e3, 3),
+            "forensics_overhead_ratio": round(
+                tpot_fon / max(tpot_foff, 1e-9), 4),
         }
         log(f"flight {'paged' if paged else 'contig'}: "
             f"{layouts['paged' if paged else 'contig']}")
@@ -1810,6 +1830,10 @@ def run_flight(config=None, requests=None, new_tokens=None,
         # slows only one of the two decode paths.
         "overhead_ratio": max(v["overhead_ratio"]
                               for v in layouts.values()),
+        "forensics_parity_ok": all(v["forensics_parity_ok"]
+                                   for v in layouts.values()),
+        "forensics_overhead_ratio": max(v["forensics_overhead_ratio"]
+                                        for v in layouts.values()),
         "layouts": layouts,
         "config": config,
         "spec_k": spec_k,

@@ -33,6 +33,7 @@ from skypilot_tpu.infer import qos as qos_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.observability import attribution as attribution_lib
 from skypilot_tpu.observability import flight as flight_lib
+from skypilot_tpu.observability import forensics as forensics_lib
 from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.utils import timeline
 
@@ -59,12 +60,12 @@ DECODE_TOKENS = metrics.counter(
     "Output tokens committed to requests by decode")
 TTFT_SECONDS = metrics.histogram(
     "skytpu_ttft_seconds",
-    "Per-request time to first token (submit/enqueue to first token)")
+    "Per-request time to first token (submit/enqueue to first token)",
+    buckets=metrics.latency_buckets())
 TPOT_SECONDS = metrics.histogram(
     "skytpu_tpot_seconds",
     "Per-request mean time per output token after the first",
-    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
-             0.25, 0.5, 1.0, 2.5))
+    buckets=metrics.latency_buckets())
 SLOTS_ACTIVE = metrics.gauge(
     "skytpu_slots_active", "Decode slots currently serving a request")
 SLOTS_TOTAL = metrics.gauge(
@@ -244,6 +245,16 @@ class Request:
     adapter_slot: int = 0
     adapter_pinned: bool = False
     error: Optional[Dict[str, Any]] = None
+    # Request forensics (observability/forensics.py): admission-stall
+    # episode accounting. ``stall_ms`` accumulates closed episodes by
+    # cause (pool_dry / kv_quota / adapter_pin); an OPEN episode is
+    # (stall_cause, stall_begin_s) and closes — idempotently — at the
+    # successful claim. The retirement record carries the totals, and
+    # the ledger's queue-wait gaps consume them into named stall
+    # phases.
+    stall_ms: Dict[str, float] = dataclasses.field(default_factory=dict)
+    stall_cause: Optional[str] = None
+    stall_begin_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -584,7 +595,10 @@ class InferenceEngine:
                      flight_lib.FlightRecorder] = None,
                  qos: Optional[qos_lib.FairScheduler] = None,
                  adapters: Optional[
-                     adapters_lib.AdapterCatalog] = None):
+                     adapters_lib.AdapterCatalog] = None,
+                 forensics: Optional[bool] = None,
+                 exemplar_store: Optional[
+                     forensics_lib.ExemplarStore] = None):
         self.params = params
         # Multi-tenant QoS: a FairScheduler reorders ``waiting`` into
         # priority lanes + DRR interleave before each admission pass
@@ -806,6 +820,19 @@ class InferenceEngine:
         self._fl_cow = 0
         self._fl_evictions = 0
         self._fl_lazy_grows = 0
+        # Request forensics (observability/forensics.py): one
+        # retirement record per request (the ledger's anchor) plus
+        # streaming P2 tail detection on TTFT/TPOT that pins crossing
+        # requests' full evidence into the exemplar store. A plain
+        # runtime-flippable flag, exactly like the recorder's — the
+        # off path is bit-identical and the bench gates the on path
+        # at <= 1.01x.
+        if forensics is None:
+            forensics = forensics_lib.forensics_enabled()
+        self.forensics = bool(forensics)
+        self.tail = forensics_lib.TailDetector()
+        self.exemplars = (exemplar_store if exemplar_store is not None
+                          else forensics_lib.EXEMPLARS)
         # Lazy per-burst block growth (paged only): admission reserves
         # the prompt plus ONE burst of rows instead of the full
         # max_new_tokens worst case; the rest allocates at burst
@@ -1826,7 +1853,10 @@ class InferenceEngine:
         used = self._tenant_kv.get(req.tenant, 0)
         if used + need <= quota:
             req.kv_quota_stalled = False
+            if req.stall_cause == "kv_quota":
+                self._end_stall(req)
             return False
+        self._mark_stall(req, "kv_quota")
         if not req.kv_quota_stalled:
             req.kv_quota_stalled = True
             QOS_KV_QUOTA_STALLS.labels(
@@ -1899,10 +1929,12 @@ class InferenceEngine:
         if st == "failed":
             return "failed", None
         if st == "stall":
+            self._mark_stall(req, "adapter_pin")
             return "held", None
         if not self.paged:
             slot = self.free_slots.pop(0)
             self._set_slot_adapter(slot, req.adapter_slot)
+            self._end_stall(req)
             return "ok", slot
         blocks = self._alloc_blocks(
             self._need_blocks(req, self._ctx_len(req)))
@@ -1910,6 +1942,7 @@ class InferenceEngine:
             # The adapter pin must not leak across the re-queue: the
             # next pass re-acquires (resident slots are warm hits).
             self._release_adapter(req)
+            self._mark_stall(req, "pool_dry")
             return "dry", None
         slot = self.free_slots.pop(0)
         row = self.block_table[slot]
@@ -1918,6 +1951,7 @@ class InferenceEngine:
         self._table_dirty = True
         self._sync_kv_charge(slot, req.tenant)
         self._set_slot_adapter(slot, req.adapter_slot)
+        self._end_stall(req)
         return "ok", slot
 
     def _free_slot_blocks(self, slot: int) -> None:
@@ -1939,6 +1973,34 @@ class InferenceEngine:
         self._sync_kv_charge(slot)      # refund the tenant's charge
 
     # -- QoS: re-queue, fair scheduling, preemption-by-eviction ------------
+
+    def _mark_stall(self, req: Request, cause: str) -> None:
+        """Open (or re-assert) an admission-stall episode on ``req``.
+        Idempotent per cause while the episode stays open — one
+        episode spans every admission pass that re-hits the same
+        blocker; a cause CHANGE closes the old episode into
+        ``stall_ms`` and opens the new one. Host floats/dicts only,
+        on paths that only run when admission is already blocked."""
+        now = time.time()
+        if req.stall_cause is not None:
+            if req.stall_cause == cause:
+                return
+            req.stall_ms[req.stall_cause] = (
+                req.stall_ms.get(req.stall_cause, 0.0)
+                + (now - req.stall_begin_s) * 1e3)
+        req.stall_cause = cause
+        req.stall_begin_s = now
+
+    def _end_stall(self, req: Request) -> None:
+        """Close an open stall episode (successful claim, quota
+        unblock, retirement). No-op when none is open."""
+        if req.stall_cause is None:
+            return
+        req.stall_ms[req.stall_cause] = (
+            req.stall_ms.get(req.stall_cause, 0.0)
+            + max(time.time() - req.stall_begin_s, 0.0) * 1e3)
+        req.stall_cause = None
+        req.stall_begin_s = 0.0
 
     def _requeue(self, req: Request) -> None:
         """THE re-queue path: every request going back to the queue
@@ -2237,6 +2299,7 @@ class InferenceEngine:
         if st == "failed":
             return "failed"  # consumed (failed typed); keep admitting
         if st == "stall":
+            self._mark_stall(req, "adapter_pin")
             return "held"    # adapter pool pinned: step aside
         ctx = self._ctx(req)
         idx = self._prefix_index
@@ -2267,11 +2330,13 @@ class InferenceEngine:
                 for b in shared:          # unpin; retry next pass
                     self.allocator.decref(b)
                 self._release_adapter(req)
+                self._mark_stall(req, "pool_dry")
                 self._requeue(req)
                 return "stall"
         slot = self.free_slots.pop(0)
         self._set_slot_adapter(slot, req.adapter_slot)
         req.slot = slot
+        self._end_stall(req)
         req.prefill_begin_s = time.time()
         tracing.record_span(
             "engine.queue_wait", req.submit_s, req.prefill_begin_s,
@@ -2626,6 +2691,39 @@ class InferenceEngine:
             TPOT_SECONDS.observe(
                 max(now - req.first_token_s, 0.0)
                 / (len(req.tokens) - 1))
+        if self.forensics:
+            # Request forensics: ONE retirement record anchors the
+            # critical-path ledger (submit/first-token/end stamps +
+            # closed stall episodes — `skytpu why` reassembles the
+            # request's bursts around it), then the streaming tail
+            # detector decides whether this request's evidence is
+            # worth pinning past ring rollover. Host bookkeeping
+            # only, once per request, off the burst path.
+            self._end_stall(req)
+            fl = self.flight
+            if fl is not None and fl.enabled:
+                fl.record(
+                    "retire", ts_s=now, dur_s=0.0,
+                    program={"layout":
+                             "paged" if self.paged else "contig"},
+                    slots=[req.slot] if req.slot is not None else [],
+                    rids=[req.rid],
+                    traces=[req.span_ctx.trace_id]
+                    if req.span_ctx is not None else [],
+                    toks=0, submit_s=req.submit_s,
+                    first_token_s=req.first_token_s, end_s=now,
+                    prompt_len=len(req.prompt),
+                    n_toks=len(req.tokens),
+                    cached_len=req.cached_len,
+                    resumed_len=req.resumed_len,
+                    n_chunks=req.n_chunks,
+                    spec_drafted=req.spec_drafted,
+                    spec_accepted=req.spec_accepted,
+                    preemptions=req.preemptions,
+                    stalls={k: round(v, 4)
+                            for k, v in req.stall_ms.items()},
+                    tenants={req.tenant: 1}, adapter=req.adapter)
+            self._observe_tail(req, now)
         if req.slot is not None:
             self.slot_req.pop(req.slot, None)
             self.free_slots.append(req.slot)
@@ -2641,6 +2739,55 @@ class InferenceEngine:
         SLOTS_ACTIVE.set(len(self.slot_req))
         if self.paged:
             KV_BLOCKS_USED.set(self.allocator.used)
+
+    def _observe_tail(self, req: Request, now: float) -> None:
+        """Streaming tail detection at retirement: fold this request's
+        TTFT/TPOT into the P2 estimators (O(1) host floats), and when
+        it crosses the configured quantile pin its FULL evidence —
+        retirement record, every flight record it rode, its assembled
+        ledger — into the exemplar store. The ring scan happens only
+        on a crossing (~1 in 10^3 at the default p99.9), never on the
+        ordinary retire path."""
+        if metrics.suppressed():      # warmup must not skew the tail
+            return
+        hits = []
+        if req.first_token_s is not None:
+            ttft_ms = max(req.first_token_s - req.submit_s, 0.0) * 1e3
+            crossed, thr = self.tail.observe("ttft", ttft_ms)
+            if crossed:
+                hits.append(("ttft", ttft_ms, thr))
+            if len(req.tokens) > 1:
+                tpot_ms = (max(now - req.first_token_s, 0.0) * 1e3
+                           / (len(req.tokens) - 1))
+                crossed, thr = self.tail.observe("tpot", tpot_ms)
+                if crossed:
+                    hits.append(("tpot", tpot_ms, thr))
+        if not hits:
+            return
+        fl = self.flight
+        recs: List[Dict[str, Any]] = []
+        retire = None
+        if fl is not None and fl.enabled:
+            for r in fl.tail():
+                if req.rid in (r.get("rids") or ()):
+                    recs.append(r)
+                    if r.get("burst") == "retire":
+                        retire = r
+        ledger = (forensics_lib.build_ledger(retire, recs)
+                  if retire is not None else None)
+        for metric, value, thr in hits:
+            forensics_lib.TAIL_EXEMPLARS_PINNED.labels(
+                metric=metric).inc()
+            self.exemplars.pin({
+                "rid": req.rid, "metric": metric,
+                "value_ms": round(value, 4),
+                "threshold_ms": (round(thr, 4)
+                                 if thr is not None else None),
+                "ts_s": now,
+                "trace_id": (req.span_ctx.trace_id
+                             if req.span_ctx is not None else None),
+                "tenant": req.tenant, "adapter": req.adapter,
+                "retire": retire, "records": recs, "ledger": ledger})
 
     def step(self) -> Dict[int, int]:
         """Admit waiting requests (draining any chunked prefills to

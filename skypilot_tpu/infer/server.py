@@ -46,7 +46,8 @@ from skypilot_tpu.utils import timeline
 HTTP_SECONDS = metrics.histogram(
     "skytpu_http_request_seconds",
     "Model-server HTTP request latency (streaming requests span the "
-    "full generation)", labelnames=("route",))
+    "full generation)", labelnames=("route",),
+    buckets=metrics.latency_buckets())
 HTTP_REQUESTS = metrics.counter(
     "skytpu_http_requests_total",
     "Model-server HTTP requests by route and status code",
@@ -503,7 +504,8 @@ class _Threading(ThreadingMixIn, HTTPServer):
 
 
 _KNOWN_ROUTES = frozenset({"/health", "/healthz", "/metrics",
-                           "/generate", "/debug/flight"})
+                           "/generate", "/debug/flight",
+                           "/debug/forensics"})
 
 
 def make_handler(model: ModelServer):
@@ -558,11 +560,17 @@ def make_handler(model: ModelServer):
                 # needed — this reads live state). ?n= caps the
                 # record tail (default 128).
                 n = 128
+                since = None
                 if "?" in self.path:
                     from urllib.parse import parse_qs
                     qs = parse_qs(self.path.split("?", 1)[1])
                     try:
                         n = max(int(qs.get("n", ["128"])[0]), 1)
+                    except ValueError:
+                        pass
+                    try:
+                        if "since" in qs:
+                            since = int(qs["since"][0])
                     except ValueError:
                         pass
                 eng = model.engine
@@ -575,8 +583,20 @@ def make_handler(model: ModelServer):
                 # second endpoint.
                 devtime = getattr(eng, "devtime", None)
                 ledger = getattr(eng, "hbm_ledger", None)
+                # ?since=<seq> is the incremental cursor: only records
+                # the recorder stamped AFTER that sequence number come
+                # back (``skytpu flight --follow`` tails the ring by
+                # re-sending the returned "seq" instead of refetching
+                # 8192 records per poll).
+                if fl is None:
+                    records: list = []
+                elif since is not None:
+                    records = fl.since(since)
+                else:
+                    records = fl.tail(n)
                 return self._json(200, {
-                    "records": fl.tail(n) if fl is not None else [],
+                    "records": records,
+                    "seq": fl.seq() if fl is not None else 0,
                     "enabled": bool(fl is not None and fl.enabled),
                     "programs": (watch.summary()
                                  if watch is not None else {}),
@@ -587,6 +607,56 @@ def make_handler(model: ModelServer):
                                 if devtime is not None else {}),
                     "hbm": (ledger.snapshot()
                             if ledger is not None else {}),
+                })
+            if self.path.split("?", 1)[0] == "/debug/forensics":
+                # Request forensics: bare — the engine's streaming
+                # tail estimates + pinned-exemplar summaries;
+                # ?rid=<id> — that request's critical-path ledger
+                # assembled from the live flight ring (falling back
+                # to a pinned exemplar once the ring rolled over),
+                # what `skytpu why <rid>` renders.
+                rid = None
+                if "?" in self.path:
+                    from urllib.parse import parse_qs
+                    qs = parse_qs(self.path.split("?", 1)[1])
+                    try:
+                        if "rid" in qs:
+                            rid = int(qs["rid"][0])
+                    except ValueError:
+                        return self._json(400, {"error": "bad rid"})
+                eng = model.engine
+                fl = getattr(eng, "flight", None)
+                tail = getattr(eng, "tail", None)
+                store = getattr(eng, "exemplars", None)
+                if rid is None:
+                    return self._json(200, {
+                        "enabled": bool(getattr(eng, "forensics",
+                                                False)),
+                        "tail": (tail.snapshot()
+                                 if tail is not None else {}),
+                        "exemplars": (store.list()
+                                      if store is not None else []),
+                    })
+                from skypilot_tpu.observability import (
+                    forensics as forensics_lib)
+                recs = fl.tail() if fl is not None else []
+                ledger = forensics_lib.ledger_from_records(rid, recs)
+                records = forensics_lib.records_for(rid, recs)
+                exemplar = (store.get(rid)
+                            if store is not None else None)
+                if ledger is None and exemplar is not None:
+                    # Ring rolled over; the pinned evidence is the
+                    # whole point of the exemplar store.
+                    ledger = exemplar.get("ledger")
+                    records = exemplar.get("records") or []
+                if ledger is None:
+                    return self._json(404, {
+                        "error": f"no retired request {rid} in the "
+                                 f"flight ring or exemplar store"})
+                return self._json(200, {
+                    "rid": rid, "ledger": ledger,
+                    "records": records,
+                    "exemplar": exemplar is not None,
                 })
             return self._json(404, {"error": "not found"})
 

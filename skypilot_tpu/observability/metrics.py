@@ -32,7 +32,42 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# Serving-latency ladder for the user-visible histograms (TTFT, TPOT,
+# HTTP request seconds): DEFAULT_BUCKETS puts exactly TWO boundaries
+# between 25 ms and 250 ms — the region serving SLOs actually live in
+# — so a p99 read off it can be interpolated across a 2.5x-wide
+# bucket. This ladder is dense where decisions are made (1 ms .. 400
+# ms) and still covers the cold-compile tail. Quantiles read from ANY
+# histogram are linear interpolations within a bucket; see
+# docs/observability.md ("quantile interpolation bias").
+SERVING_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.0075, 0.01, 0.015, 0.02, 0.03, 0.045,
+    0.065, 0.1, 0.15, 0.25, 0.4, 0.65, 1.0, 1.5, 2.5, 5.0, 10.0,
+    30.0, 60.0)
+
 _INF = float("inf")
+
+
+def latency_buckets(default: Sequence[float] = SERVING_LATENCY_BUCKETS
+                    ) -> Tuple[float, ...]:
+    """Bucket ladder for the serving-latency histograms:
+    ``SKYTPU_LATENCY_BUCKETS`` (comma-separated seconds) when set,
+    else ``default``. The env var applies to every process that
+    declares these histograms, so a fleet whose replicas share the
+    environment stays merge-consistent — an override set on ONE
+    replica is exactly the bucket-layout mismatch the fleet merge
+    detects and refuses to sum. A malformed value falls back to the
+    default (a typo must not take down metric declaration at import
+    time)."""
+    env = os.environ.get("SKYTPU_LATENCY_BUCKETS", "")
+    if env:
+        try:
+            parsed = sorted(float(v) for v in env.split(",") if v.strip())
+            if parsed and all(b > 0 for b in parsed):
+                return tuple(parsed)
+        except ValueError:
+            pass
+    return tuple(default)
 
 _suppress_local = threading.local()
 

@@ -40,7 +40,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from skypilot_tpu.observability import aggregate, health, metrics, tracing
+from skypilot_tpu.observability import (aggregate, forensics, health,
+                                        metrics, tracing)
 
 SLO_BREACHES = metrics.counter(
     "skytpu_slo_breaches_total",
@@ -393,6 +394,21 @@ class Watchdog:
             if breached and not was_active:
                 SLO_BREACHES.labels(rule=rule.name).inc()
                 SLO_ACTIVE.labels(rule=rule.name).set(1)
+                # Incident snapshot (observability/forensics.py): the
+                # breach TRANSITION is the one moment the evidence —
+                # flight-ring tail, recent events, metrics, pinned
+                # tail exemplars — is still in memory; capture it to a
+                # GC'd bundle and link the dir from the breach event
+                # (`skytpu incidents show <name>` reads it back).
+                # Contained: a full disk must not kill the watchdog.
+                try:
+                    inc = forensics.capture_incident(
+                        rule.name, attrs,
+                        health={"components": components})
+                except Exception:  # noqa: BLE001
+                    inc = None
+                if inc:
+                    attrs["incident"] = os.path.basename(inc)
                 tracing.add_event("slo.breach", attrs=attrs, echo=True)
                 transitions.append({"event": "slo.breach", **attrs})
             elif not breached and was_active:

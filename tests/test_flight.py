@@ -302,6 +302,37 @@ def test_counter_deltas_match_record_sums(flown_engine):
     assert any("dispatch_wall_ms" in r for r in window)
 
 
+def test_ledger_sums_to_wall(flown_engine):
+    """The ledger-sums gate (ISSUE 17): every retired request of the
+    mixed workload gets a forensics ledger whose phases sum to the
+    measured submit->retire wall (exact partition to rounding), with
+    >=90% of the wall in NAMED phases — an unsorted ring or a
+    double-counted overlap breaks the sum, a classification hole
+    breaks the coverage."""
+    from skypilot_tpu.observability import forensics
+
+    _, window, _, _, ids, finished = flown_engine
+    retires = [r for r in window if r["burst"] == "retire"]
+    assert {r["rids"][0] for r in retires} == set(ids)
+    for rid in ids:
+        led = forensics.ledger_from_records(rid, window)
+        assert led is not None
+        total = sum(p["ms"] for p in led["phases"])
+        assert total == pytest.approx(led["wall_ms"], abs=0.05), \
+            f"rid {rid}: phases sum {total} != wall {led['wall_ms']}"
+        assert led["named_ms"] >= 0.90 * led["wall_ms"], \
+            f"rid {rid}: named {led['named_ms']} < 90% of " \
+            f"{led['wall_ms']}"
+        assert led["named_ms"] + led["other_ms"] == \
+            pytest.approx(led["wall_ms"], abs=0.05)
+        # The retire record mirrors the request's own stamps.
+        req = finished[rid]
+        assert led["wall_ms"] > 0
+        assert led["detail"]["n_toks"] == len(req.tokens)
+        # Renders without crashing, names the request.
+        assert f"request {rid}" in forensics.render_ledger(led)
+
+
 def test_chunk_verify_interleave_consistency():
     """The ISSUE-named audit path: chunked prefills interleaving with
     LIVE speculative verify bursts (small vocab => the drafter

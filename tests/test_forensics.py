@@ -1,0 +1,417 @@
+"""Request forensics: the P² tail estimators, exemplar pinning past
+ring rollover, SLO incident bundles, the /debug/forensics + ?since=
+cursor surfaces, and the serving latency-bucket ladder
+(docs/observability.md §Request forensics)."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import engine as eng
+from skypilot_tpu.models import llama
+from skypilot_tpu.observability import flight as fl
+from skypilot_tpu.observability import forensics
+from skypilot_tpu.observability import metrics as metrics_lib
+
+
+# ---------------------------------------------------------------------------
+# P-squared streaming quantiles.
+
+def test_p2_matches_numpy_percentile():
+    """Five floats vs the full reservoir: the P² estimate lands within
+    a few percent of numpy's exact quantile on a lognormal stream (the
+    latency-shaped distribution the detector actually watches)."""
+    rng = np.random.default_rng(3)
+    xs = rng.lognormal(mean=3.0, sigma=0.6, size=20_000)
+    for q in (0.5, 0.9, 0.99):
+        est = forensics.P2Quantile(q)
+        for x in xs:
+            est.observe(float(x))
+        exact = float(np.percentile(xs, 100 * q))
+        assert est.value() == pytest.approx(exact, rel=0.08), \
+            f"q={q}: est {est.value()} vs exact {exact}"
+        assert est.count == len(xs)
+
+
+def test_p2_small_stream_and_validation():
+    with pytest.raises(ValueError):
+        forensics.P2Quantile(1.0)
+    est = forensics.P2Quantile(0.9)
+    assert est.value() is None
+    for x in (5.0, 1.0, 3.0):
+        est.observe(x)
+    # Pre-marker regime: the empirical quantile of what we have.
+    assert est.value() == 5.0
+
+
+def test_tail_detector_warmup_and_crossing(monkeypatch):
+    monkeypatch.setenv("SKYTPU_TAIL_QUANTILE", "0.9")
+    monkeypatch.setenv("SKYTPU_TAIL_MIN_SAMPLES", "10")
+    det = forensics.TailDetector()
+    assert det.quantile == 0.9 and det.min_samples == 10
+    # Warmup: nothing crosses while count < min_samples, even an
+    # outlier 100x the rest.
+    crossed, _ = det.observe("ttft", 500.0)
+    assert not crossed
+    for _ in range(12):
+        crossed, _ = det.observe("ttft", 5.0)
+    # Past warmup an outlier above the p90-of-priors crosses...
+    crossed, thr = det.observe("ttft", 400.0)
+    assert crossed and thr is not None
+    # ...and a typical sample does not.
+    crossed, _ = det.observe("ttft", 5.0)
+    assert not crossed
+    snap = det.snapshot()
+    assert snap["estimates"]["ttft"]["count"] == 15
+    assert snap["estimates"]["tpot"]["count"] == 0
+
+
+def test_exemplar_store_bounded_newest_wins():
+    store = forensics.ExemplarStore(capacity=3)
+    for i in range(6):
+        store.pin({"rid": i % 2, "metric": "ttft", "value_ms": i})
+    assert len(store) == 3
+    # get() returns the NEWEST pin for a rid.
+    assert store.get(1)["value_ms"] == 5
+    assert store.get(99) is None
+    rows = store.list()
+    assert [r["value_ms"] for r in rows] == [5, 4, 3]
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: pinning survives ring rollover.
+
+def _tiny_engine(**overrides):
+    cfg = llama.CONFIGS["llama3-tiny"]
+    params = llama.init_params(jax.random.key(0), cfg)
+    kw = dict(n_slots=2, max_len=64, prompt_buckets=(16,),
+              flight_recorder=fl.FlightRecorder())
+    kw.update(overrides)
+    return eng.InferenceEngine(params, cfg, **kw)
+
+
+def test_exemplar_survives_ring_rollover(monkeypatch):
+    """The tail store's reason to exist: a slow request's full ledger
+    evidence stays retrievable after the flight ring rolled past its
+    records. A tiny ring + an every-request tail bar make the
+    rollover and the pin both certain."""
+    monkeypatch.setenv("SKYTPU_TAIL_QUANTILE", "0.5")
+    monkeypatch.setenv("SKYTPU_TAIL_MIN_SAMPLES", "5")
+    store = forensics.ExemplarStore(capacity=8)
+    e = _tiny_engine(flight_recorder=fl.FlightRecorder(capacity=32),
+                     exemplar_store=store)
+    rid = None
+    for _ in range(10):
+        ids = [e.add_request([4, 9, 2], max_new_tokens=3)]
+        e.run_to_completion(4)
+        ex = next((ex for i in ids
+                   if (ex := store.get(i)) is not None), None)
+        if ex is not None:
+            rid = ids[0]
+            break
+    assert rid is not None, "no retirement crossed a p50 tail bar"
+    ex = store.get(rid)
+    assert ex["ledger"] is not None and ex["records"]
+    assert ex["ledger"]["rid"] == rid
+    assert any(r["burst"] == "retire" for r in ex["records"])
+    # Roll the ring: 32-slot capacity, 40 fresh records.
+    for i in range(40):
+        e.flight.record("decode", toks=0)
+    assert forensics.ledger_from_records(rid, e.flight.tail()) is None
+    # The pin still answers `skytpu why` with the full ledger.
+    ex = store.get(rid)
+    total = sum(p["ms"] for p in ex["ledger"]["phases"])
+    assert total == pytest.approx(ex["ledger"]["wall_ms"], abs=0.05)
+    assert metrics_lib.REGISTRY.snapshot()[
+        "skytpu_tail_exemplars_pinned_total"]["samples"]
+
+
+def test_forensics_off_is_inert(monkeypatch):
+    """SKYTPU_FORENSICS=0: no retire records, no stall dict growth on
+    the records, no pins — and identical greedy output (the parity
+    the bench gates; here the structural half)."""
+    store = forensics.ExemplarStore(capacity=4)
+    e_on = _tiny_engine()
+    out_on = e_on.generate([[4, 9, 2]], max_new_tokens=4)
+    monkeypatch.setenv("SKYTPU_FORENSICS", "0")
+    e_off = _tiny_engine(exemplar_store=store)
+    assert e_off.forensics is False
+    out_off = e_off.generate([[4, 9, 2]], max_new_tokens=4)
+    assert out_on == out_off
+    assert not any(r["burst"] == "retire" for r in e_off.flight.tail())
+    assert len(store) == 0
+    # Explicit ctor flag beats the env.
+    e_forced = _tiny_engine(forensics=True)
+    assert e_forced.forensics is True
+
+
+# ---------------------------------------------------------------------------
+# Incident bundles.
+
+def _reset_rate_limit():
+    forensics._last_capture_s = 0.0
+
+
+def test_incident_capture_bundle_and_gc(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYTPU_INCIDENTS_KEEP", "2")
+    _reset_rate_limit()
+    base = str(tmp_path / "incidents")
+    rec = fl.FlightRecorder()
+    rec.record("decode", toks=3)
+    store = forensics.ExemplarStore(capacity=4)
+    store.pin({"rid": 7, "metric": "ttft", "value_ms": 123.0})
+    path = forensics.capture_incident(
+        "ttft-p95", {"value": 12.0, "threshold": 10.0},
+        recorder=rec, exemplars=store,
+        health={"components": [{"component": "model-server",
+                                "status": "degraded"}]},
+        base_dir=base, force=True)
+    assert path is not None and os.path.isdir(path)
+    names = set(os.listdir(path))
+    assert {"meta.json", "alert.json", "health.json",
+            "exemplars.json", "flight.jsonl",
+            "metrics.prom"} <= names
+    meta = json.load(open(os.path.join(path, "meta.json")))
+    assert meta["rule"] == "ttft-p95"
+    assert meta["attrs"]["threshold"] == 10.0
+    exemplars = json.load(open(os.path.join(path, "exemplars.json")))
+    assert exemplars[0]["rid"] == 7
+    flight_lines = open(os.path.join(path, "flight.jsonl")).read()
+    assert json.loads(flight_lines.splitlines()[0])["toks"] == 3
+    # list / load round-trip.
+    rows = forensics.list_incidents(base)
+    assert rows[0]["rule"] == "ttft-p95"
+    bundle = forensics.load_incident(rows[0]["name"], base)
+    assert bundle["meta"]["rule"] == "ttft-p95"
+    assert any(f["file"] == "flight.jsonl" and f["lines"] == 1
+               for f in bundle["files"])
+    # Path traversal never escapes the incidents dir.
+    assert forensics.load_incident("../oops", base) is None
+    # GC: keep=2 — two more captures leave exactly two on disk.
+    for i in range(2):
+        assert forensics.capture_incident(
+            f"rule-{i}", {}, recorder=rec, exemplars=store,
+            base_dir=base, force=True)
+    kept = [n for n in os.listdir(base) if not n.endswith(".tmp")]
+    assert len(kept) == 2
+    assert not any(n.endswith("ttft-p95") for n in kept)
+
+
+def test_incident_rate_limit_and_disable(tmp_path, monkeypatch):
+    base = str(tmp_path / "inc")
+    rec = fl.FlightRecorder()
+    _reset_rate_limit()
+    monkeypatch.setenv("SKYTPU_INCIDENT_MIN_INTERVAL_S", "3600")
+    first = forensics.capture_incident("r", {}, recorder=rec,
+                                       base_dir=base)
+    assert first is not None
+    # A flapping rule inside the interval captures nothing...
+    assert forensics.capture_incident("r", {}, recorder=rec,
+                                      base_dir=base) is None
+    # ...unless forced (tests, manual `capture now`).
+    assert forensics.capture_incident("r", {}, recorder=rec,
+                                      base_dir=base, force=True)
+    monkeypatch.setenv("SKYTPU_INCIDENTS", "0")
+    _reset_rate_limit()
+    assert forensics.capture_incident("r", {}, recorder=rec,
+                                      base_dir=base,
+                                      force=True) is None
+
+
+def test_watchdog_breach_captures_incident(tmp_path, monkeypatch):
+    """The slo.py hook: a breach TRANSITION captures a bundle and
+    stamps its name into the breach event's attrs."""
+    import time
+
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "home"))
+    _reset_rate_limit()
+    from skypilot_tpu.observability import slo
+
+    rule = slo.SloRule(
+        "hb", "heartbeat_staleness", threshold=120.0,
+        metric="skytpu_skylet_last_tick_timestamp_seconds")
+    wd = slo.Watchdog(rules=[rule])
+    now = time.time()
+    fams = {"skytpu_skylet_last_tick_timestamp_seconds": {
+        "type": "gauge", "samples": [({"instance": "c1"}, now - 900)]}}
+    transitions = wd.observe(fams, [], ts=now)
+    assert [t["event"] for t in transitions] == ["slo.breach"]
+    inc = transitions[0].get("incident")
+    assert inc, "breach event carries no incident attr"
+    bundle = forensics.load_incident(inc)
+    assert bundle is not None
+    assert bundle["meta"]["rule"] == "hb"
+    assert forensics.list_incidents()[0]["name"] == inc
+
+
+# ---------------------------------------------------------------------------
+# Latency bucket ladder.
+
+def test_latency_buckets_env_override(monkeypatch):
+    default = metrics_lib.latency_buckets()
+    assert default == metrics_lib.SERVING_LATENCY_BUCKETS
+    assert default[0] < 0.005 and list(default) == sorted(default)
+    monkeypatch.setenv("SKYTPU_LATENCY_BUCKETS", "0.5, 0.1, 1.0")
+    assert metrics_lib.latency_buckets() == (0.1, 0.5, 1.0)
+    monkeypatch.setenv("SKYTPU_LATENCY_BUCKETS", "0.1,bogus")
+    assert metrics_lib.latency_buckets() == \
+        metrics_lib.SERVING_LATENCY_BUCKETS
+    monkeypatch.setenv("SKYTPU_LATENCY_BUCKETS", "0,-1")
+    assert metrics_lib.latency_buckets() == \
+        metrics_lib.SERVING_LATENCY_BUCKETS
+
+
+# ---------------------------------------------------------------------------
+# Ledger edge shapes (unit level; the sums gate rides test_flight).
+
+def test_ledger_stall_attribution_and_gaps():
+    """Queue-ish gaps consume the retire record's typed stall totals
+    before falling back to plain queue_wait; chunk->chunk gaps read as
+    prefill_interleave; the post-burst tail is deliver."""
+    retire = {"burst": "retire", "rids": [1], "submit_s": 100.0,
+              "end_s": 100.5, "first_token_s": 100.3,
+              "stalls": {"kv_quota": 60.0, "pool_dry": 20.0},
+              "n_toks": 4}
+    records = [
+        retire,
+        {"burst": "chunk", "rids": [1], "ts_s": 100.2, "dur_s": 0.05,
+         "seq": 1},
+        {"burst": "chunk", "rids": [1], "ts_s": 100.3, "dur_s": 0.05,
+         "seq": 2},
+        {"burst": "decode", "rids": [1], "ts_s": 100.4, "dur_s": 0.05,
+         "seq": 3, "dev_ms_est": 30.0},
+    ]
+    led = forensics.build_ledger(retire, records)
+    ph = {p["phase"]: p["ms"] for p in led["phases"]}
+    # 200ms pre-first-burst gap: 20 pool_dry + 60 kv_quota + 120 queue.
+    assert ph["stall_pool_dry"] == pytest.approx(20.0, abs=0.01)
+    assert ph["stall_kv_quota"] == pytest.approx(60.0, abs=0.01)
+    assert ph["queue_wait"] == pytest.approx(120.0, abs=0.01)
+    assert ph["prefill_interleave"] == pytest.approx(50.0, abs=0.01)
+    assert ph["prefill_chunk"] == pytest.approx(100.0, abs=0.01)
+    assert ph["decode_device"] == pytest.approx(30.0, abs=0.01)
+    assert ph["decode_host"] == pytest.approx(70.0, abs=0.01)
+    assert ph["deliver"] == pytest.approx(50.0, abs=0.01)
+    assert sum(ph.values()) == pytest.approx(led["wall_ms"], abs=0.05)
+    assert led["other_ms"] == 0.0
+    # Phase render order follows PHASE_ORDER.
+    order = [p["phase"] for p in led["phases"]]
+    assert order == [k for k in forensics.PHASE_ORDER if k in ph]
+
+
+def test_ledger_no_records_is_all_other():
+    retire = {"burst": "retire", "rids": [2], "submit_s": 10.0,
+              "end_s": 10.1, "stalls": {}}
+    led = forensics.build_ledger(retire, [retire])
+    assert led["n_records"] == 0
+    assert led["named_ms"] == 0.0
+    assert led["other_ms"] == pytest.approx(100.0, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces: `skytpu why --local`, `skytpu incidents`, `top --json`.
+
+@pytest.fixture
+def fresh_events(tmp_path, monkeypatch):
+    from skypilot_tpu.observability import tracing
+    monkeypatch.setenv(tracing.EVENTS_DIR_ENV_VAR, str(tmp_path))
+    monkeypatch.delenv(tracing.ENV_VAR, raising=False)
+    tracing._reset_for_tests()
+    yield str(tmp_path)
+    tracing._reset_for_tests()
+
+
+def test_why_cli_local(fresh_events):
+    from click.testing import CliRunner
+
+    from skypilot_tpu.client import cli as cli_mod
+
+    e = _tiny_engine()
+    rid = e.add_request([5, 3, 8, 2], max_new_tokens=4)
+    e.run_to_completion(4)
+    e.flight.flush()
+    runner = CliRunner()
+    res = runner.invoke(cli_mod.cli, ["why", str(rid), "--local"])
+    assert res.exit_code == 0, res.output
+    assert f"request {rid}" in res.output
+    assert "sum (= wall)" in res.output
+    res_json = runner.invoke(cli_mod.cli,
+                             ["why", str(rid), "--local", "--json"])
+    assert res_json.exit_code == 0, res_json.output
+    led = json.loads(res_json.output)
+    assert led["rid"] == rid
+    assert sum(p["ms"] for p in led["phases"]) == \
+        pytest.approx(led["wall_ms"], abs=0.05)
+    # A rid that never retired is a typed error, not a traceback.
+    res_miss = runner.invoke(cli_mod.cli, ["why", "424242", "--local"])
+    assert res_miss.exit_code != 0
+    assert "no retired request 424242" in res_miss.output
+
+
+def test_incidents_cli_list_show(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from skypilot_tpu.client import cli as cli_mod
+
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "home"))
+    _reset_rate_limit()
+    rec = fl.FlightRecorder()
+    rec.record("decode", toks=1)
+    path = forensics.capture_incident(
+        "ttft-p95", {"value": 11.0}, recorder=rec,
+        exemplars=forensics.ExemplarStore(capacity=2), force=True)
+    assert path is not None
+    name = os.path.basename(path)
+    runner = CliRunner()
+    res = runner.invoke(cli_mod.cli, ["incidents", "list"])
+    assert res.exit_code == 0, res.output
+    assert name in res.output and "ttft-p95" in res.output
+    res_show = runner.invoke(cli_mod.cli, ["incidents", "show", name])
+    assert res_show.exit_code == 0, res_show.output
+    assert "rule:     ttft-p95" in res_show.output
+    assert "flight.jsonl" in res_show.output
+    res_miss = runner.invoke(cli_mod.cli,
+                             ["incidents", "show", "nope"])
+    assert res_miss.exit_code != 0
+    assert "no incident" in res_miss.output
+
+
+def test_top_json_frame_is_machine_readable():
+    """--json emits one dict mirroring the rendered frame: the same
+    rates/columns, no ANSI, parseable by dashboards."""
+    from skypilot_tpu.client import cli as cli_mod
+
+    def fams(n):
+        return {
+            "skytpu_http_requests_total": {
+                "type": "counter",
+                "samples": [({"route": "/generate", "code": "200"},
+                             float(n))]},
+            "skytpu_slots_active": {
+                "type": "gauge", "samples": [({}, 3.0)]},
+            "skytpu_slots_total": {
+                "type": "gauge", "samples": [({}, 4.0)]},
+        }
+
+    payload = {"status": "healthy",
+               "components": [{"component": "model-server",
+                               "instance": "i1", "status": "healthy",
+                               "reason": "", "last_seen_s": 0.0}],
+               "alerts": []}
+    now = 2000.0
+    rendered, data = cli_mod._top_frame(fams(0), now - 10.0, fams(20),
+                                        now, payload)
+    # The wrapper the existing column tests call is the same string.
+    assert rendered == cli_mod._render_top_frame(
+        fams(0), now - 10.0, fams(20), now, payload)
+    assert data["serve"]["req_per_s"] == pytest.approx(2.0)
+    assert data["serve"]["slots_active"] == 3
+    assert data["serve"]["slots_total"] == 4
+    assert data["fleet"]["status"] == "healthy"
+    assert data["window_s"] == pytest.approx(10.0)
+    json.dumps(data, default=str)   # round-trips as JSON

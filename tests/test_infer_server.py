@@ -503,3 +503,73 @@ def test_debug_flight_endpoint(model_server):
     assert payload["programs"]
     assert any(k.startswith(("decode_burst", "admit_wave"))
                for k in payload["programs"])
+
+
+def test_debug_flight_since_cursor(model_server):
+    """?since=<seq> is the incremental tail (`skytpu flight --follow`):
+    each response carries the ring's cursor, and re-sending it returns
+    only records stamped after it."""
+    url, _, _ = model_server
+    with urllib.request.urlopen(f"{url}/debug/flight?n=1",
+                                timeout=30) as r:
+        first = json.loads(r.read())
+    seq = first["seq"]
+    assert seq > 0
+    # Nothing new yet: the delta from the cursor is empty.
+    with urllib.request.urlopen(f"{url}/debug/flight?since={seq}",
+                                timeout=30) as r:
+        delta = json.loads(r.read())
+    assert delta["records"] == [] and delta["seq"] == seq
+    # New traffic lands past the cursor — and only it.
+    code, _ = _post(f"{url}/generate",
+                    {"tokens": [7, 1, 5], "max_new_tokens": 2})
+    assert code == 200
+    with urllib.request.urlopen(f"{url}/debug/flight?since={seq}",
+                                timeout=30) as r:
+        delta = json.loads(r.read())
+    assert delta["records"] and delta["seq"] > seq
+    assert all(r["seq"] > seq for r in delta["records"])
+
+
+def test_debug_forensics_endpoint(model_server):
+    """GET /debug/forensics: the tail-detector state + exemplar index;
+    ?rid= builds the request's critical-path ledger from the live ring
+    (docs/observability.md §Request forensics)."""
+    url, _, _ = model_server
+    code, out = _post(f"{url}/generate",
+                      {"tokens": [6, 2, 8], "max_new_tokens": 3})
+    assert code == 200
+    with urllib.request.urlopen(f"{url}/debug/forensics",
+                                timeout=30) as r:
+        payload = json.loads(r.read())
+    assert payload["enabled"] is True
+    assert set(payload["tail"]["estimates"]) == {"ttft", "tpot"}
+    assert payload["tail"]["estimates"]["ttft"]["count"] >= 1
+    # Find a retired rid in the ring and ask why it was slow.
+    with urllib.request.urlopen(f"{url}/debug/flight?n=8192",
+                                timeout=30) as r:
+        records = json.loads(r.read())["records"]
+    retires = [r for r in records if r["burst"] == "retire"]
+    assert retires, "forensics-on server emitted no retire records"
+    rid = retires[-1]["rids"][0]
+    with urllib.request.urlopen(f"{url}/debug/forensics?rid={rid}",
+                                timeout=30) as r:
+        ans = json.loads(r.read())
+    led = ans["ledger"]
+    assert led["rid"] == rid
+    total = sum(p["ms"] for p in led["phases"])
+    assert total == pytest.approx(led["wall_ms"], abs=0.05)
+    assert ans["records"]
+    # Unknown rid -> typed 404; bad rid -> 400.
+    try:
+        urllib.request.urlopen(f"{url}/debug/forensics?rid=999999",
+                               timeout=30)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404 and "999999" in json.loads(e.read())["error"]
+    try:
+        urllib.request.urlopen(f"{url}/debug/forensics?rid=bogus",
+                               timeout=30)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
