@@ -144,6 +144,20 @@ _SCOPES: Dict[str, Set[str]] = {
     },
     "skypilot_tpu/train/trainer.py": {
         "_instrument_step", "observe_loss",
+        # Training goodput (PR 18): the compile-watch key function
+        # rides EVERY train-step dispatch — shape metadata reads only,
+        # never array values.
+        "_batch_key_fn",
+    },
+    # Training goodput forensics (PR 18): the step ledger's
+    # start/phase/end path and the cursor/bucket credits run once per
+    # train step on the loop thread, and the anomaly watchdog's
+    # observe folds in the losses the logging cadence ALREADY fetched
+    # — all pure host float/dict arithmetic; a device fetch here would
+    # stall the very step pipeline whose goodput it measures.
+    "skypilot_tpu/observability/goodput.py": {
+        "step_start", "phase", "step_end", "account", "snapshot",
+        "_credit_locked", "_advance_locked", "observe",
     },
 }
 
@@ -183,7 +197,11 @@ class HostSyncChecker(Checker):
     #     path and the P^2 observe + exemplar pin
     #     (observability/forensics.py) joined the scope; the bump
     #     rescans the edited retirement hot path cold.
-    version = 11
+    # v12: training goodput (PR 18) — the goodput step-ledger/anomaly
+    #     path (observability/goodput.py) and the trainer's compile-
+    #     watch key function joined the scope; the calibrator's
+    #     sampled block_until_ready bracket stays baselined from v10.
+    version = 12
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

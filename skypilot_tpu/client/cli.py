@@ -643,8 +643,33 @@ def _top_frame(prev, prev_ts, fams, now, payload):
         tps = gauge("skytpu_train_tokens_per_second")
         data["train"] = {"step_last_s": last, "step_median_s": med,
                          "tokens_per_s": tps}
-        lines.append(f"train   step {f_ms(last)} (median {f_ms(med)})"
-                     f"  tokens {f_rate(tps)}")
+        line = (f"train   step {f_ms(last)} (median {f_ms(med)})"
+                f"  tokens {f_rate(tps)}")
+        # Goodput/MFU/straggler columns (docs/observability.md
+        # §Training goodput): the worst host's cumulative goodput
+        # ratio (agg=min — the slice trains at the slowest host's
+        # pace), windowed train MFU over the published roofline peak,
+        # and the straggler spread of the federated per-host step
+        # walls.
+        gput = gauge("skytpu_train_goodput_ratio", agg="min")
+        if gput is not None:
+            data["train"]["goodput"] = gput
+            line += f"  goodput {gput:5.1%}"
+        peak_f = gauge("skytpu_roofline_peak_flops")
+        fl = rate("skytpu_device_flops_total")
+        if peak_f and fl is not None:
+            data["train"]["mfu"] = min(fl / peak_f, 1.0)
+            line += f"  mfu {min(fl / peak_f, 1.0):5.1%}"
+        hosts = [(lab.get("host", "?"), v) for lab, v in
+                 fams.get("skytpu_train_host_step_seconds",
+                          {"samples": []})["samples"]]
+        if len(hosts) > 1:
+            worst = max(hosts, key=lambda h: h[1])
+            lag_ms = (worst[1] - min(h[1] for h in hosts)) * 1e3
+            data["train"]["straggler"] = {"host": worst[0],
+                                          "lag_ms": lag_ms}
+            line += f"  straggler host-{worst[0]} (+{lag_ms:.0f} ms)"
+        lines.append(line)
     # Oldest heartbeat = worst skylet; the freshest would mask a
     # wedged sibling.
     hb = gauge("skytpu_skylet_last_tick_timestamp_seconds", agg="min")
@@ -967,6 +992,53 @@ def why_cmd(rid, target, local, port, as_json):
         click.echo(json_lib.dumps(ledger, indent=2, default=str))
     else:
         click.echo(forensics_lib.render_ledger(ledger))
+
+
+@cli.command(name="train-why")
+@click.option("--step", type=int, default=None,
+              help="Render this step's ledger (default: the newest "
+                   "recorded step).")
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="Emit the raw ledger dict(s) instead of tables.")
+def train_why_cmd(step, as_json):
+    """Explain where a training step's wall time went, phase by phase.
+
+    The goodput ledger (docs/observability.md §Training goodput)
+    decomposes each recorded step's wall into named phases —
+    data_wait, compute, checkpoint save/wait, eval (the loss fetch),
+    anomaly pause — that sum to the step wall exactly; the remainder
+    is host_other, never silence. Built from flushed train_step
+    flight records, so it works on any run that has flushed (the
+    recorder flushes atexit and on its heartbeat).
+
+    Without --step, renders the newest step's ledger plus the
+    aggregate phase distribution over every recorded step — where the
+    RUN's wall went, which is usually the question.
+    """
+    import json as json_lib
+
+    from skypilot_tpu.observability import flight as flight_lib
+    from skypilot_tpu.observability import goodput as goodput_lib
+
+    records = flight_lib.load_records()
+    ledger = goodput_lib.ledger_for_step(records, step=step)
+    if ledger is None:
+        what = f"step {step}" if step is not None else "train_step"
+        raise click.ClickException(
+            f"no {what} records in the flushed flight logs (run "
+            f"still warming up, recorder off, or logs never flushed)")
+    summary = goodput_lib.summarize_steps(records) \
+        if step is None else None
+    if as_json:
+        out = {"ledger": ledger}
+        if summary is not None:
+            out["summary"] = summary
+        click.echo(json_lib.dumps(out, indent=2, default=str))
+        return
+    click.echo(goodput_lib.render_step_ledger(ledger))
+    if summary is not None:
+        click.echo("")
+        click.echo(goodput_lib.render_summary(summary))
 
 
 @cli.group(name="incidents")

@@ -42,17 +42,14 @@ guard — the hot path pays one attribute check, exactly like
 
 from __future__ import annotations
 
-import atexit
-import collections
 import glob
 import json
 import os
-import tempfile
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from skypilot_tpu.observability import metrics, tracing
+from skypilot_tpu.observability import _ringflush, metrics, tracing
 
 COMPILE_SECONDS = metrics.histogram(
     "skytpu_compile_seconds",
@@ -96,18 +93,16 @@ class FlightRecorder:
     returns before touching the lock (the recorder-off no-op guard).
     """
 
-    def __init__(self, capacity: int = _MAX_RECORDS):
+    def __init__(self, capacity: int = _MAX_RECORDS,
+                 file_prefix: str = _FILE_PREFIX):
         self.enabled = enabled()
         self.capacity = capacity
-        self._lock = threading.Lock()
-        # guarded-by: _lock
-        self._records: collections.deque = collections.deque(
-            maxlen=capacity)
-        self._seq = 0            # guarded-by: _lock
-        self._flushed_seq = 0    # guarded-by: _lock
-        self._log_name: Optional[str] = None   # guarded-by: _lock
-        self._registered = False               # guarded-by: _lock
-        self._flush_lock = threading.Lock()
+        self._ring = _ringflush.Ring(
+            capacity,
+            lambda: (f"{file_prefix}{tracing.process_name()}"
+                     f"-{os.getpid()}-{int(time.time() * 1000)}.jsonl"),
+            tracing.events_dir, seq_field="seq",
+            thread_name="flight-flush")
 
     # -- recording (the hot path) ------------------------------------------
 
@@ -124,117 +119,52 @@ class FlightRecorder:
             "proc": tracing.process_name(),
         }
         rec.update(fields)
-        with self._lock:
-            if not self._registered:
-                atexit.register(self._flush_atexit)
-                self._registered = True
-            if self._log_name is None:
-                self._log_name = (
-                    f"{_FILE_PREFIX}{tracing.process_name()}"
-                    f"-{os.getpid()}-{int(time.time() * 1000)}.jsonl")
-            self._seq += 1
-            rec["seq"] = self._seq
-            self._records.append(rec)
+        self._ring.append(rec)
 
     # -- introspection -----------------------------------------------------
 
     def seq(self) -> int:
-        with self._lock:
-            return self._seq
+        return self._ring.seq()
 
     def tail(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
         """Snapshot of the newest ``n`` records (all when None),
         oldest first."""
-        with self._lock:
-            recs = list(self._records)
+        recs = self._ring.snapshot()
         return recs[-n:] if n else recs
 
     def since(self, seq: int) -> List[Dict[str, Any]]:
         """Records appended after sequence number ``seq`` that are
         still in the ring (tests/bench window over the shared ring)."""
-        with self._lock:
-            return [r for r in self._records if r["seq"] > seq]
+        return [r for r in self._ring.snapshot() if r["seq"] > seq]
 
-    # -- flushing (the tracing.py atomic-replace idiom) --------------------
+    # -- flushing (the shared _ringflush atomic-replace idiom) -------------
 
     def flush(self) -> None:
         """Atomically rewrite this process's flight log with the whole
         ring. Serialization happens OUTSIDE the ring lock so recorder
         callers (the engine loop) never block on an O(ring) dumps."""
-        with self._lock:
-            if not self._records or self._seq == self._flushed_seq:
-                return
-            seq_snapshot = self._seq
-            snapshot = list(self._records)
-            name = self._log_name
-        lines = [json.dumps(r, default=str) for r in snapshot]
-        with self._flush_lock:
-            with self._lock:
-                if seq_snapshot <= self._flushed_seq:
-                    return       # a newer flush already landed
-            d = tracing.events_dir()
-            os.makedirs(d, exist_ok=True)
-            fd, tmp = tempfile.mkstemp(dir=d, prefix=name + ".")
-            try:
-                with os.fdopen(fd, "w", encoding="utf-8") as f:
-                    f.write("\n".join(lines) + "\n")
-                os.replace(tmp, os.path.join(d, name))
-                with self._lock:
-                    self._flushed_seq = seq_snapshot
-            except BaseException:
-                try:
-                    os.remove(tmp)
-                except OSError:
-                    pass
-                raise
+        self._ring.flush()
 
     def flush_periodic(self, min_new_records: int = 256) -> None:
-        with self._lock:
-            pending = self._seq - self._flushed_seq
-        if pending >= min_new_records:
-            self.flush()
+        self._ring.flush_periodic(min_new_records=min_new_records)
 
-    def _flush_atexit(self) -> None:
-        try:
-            self.flush()
-        except OSError:
-            pass     # best-effort: exit must stay quiet
+    def ensure_flush_thread(self, interval_s: float = 5.0) -> None:
+        """Start (once) a daemon thread flushing this recorder
+        periodically — durability off the owner's hot loop."""
+        self._ring.ensure_flush_thread(interval_s, min_new_records=256)
 
     def _reset_for_tests(self) -> None:
-        with self._lock:
-            self._records.clear()
-            self._seq = 0
-            self._flushed_seq = 0
-            self._log_name = None
+        self._ring.reset_for_tests()
 
 
 RECORDER = FlightRecorder()
-
-_flush_thread: Optional[threading.Thread] = None
-_flush_thread_lock = threading.Lock()
 
 
 def ensure_flush_thread(interval_s: float = 5.0) -> None:
     """Start (once) a daemon thread flushing :data:`RECORDER`
     periodically — the model server's durability heartbeat, off the
     serving loop (same rationale as tracing.ensure_flush_thread)."""
-    global _flush_thread
-    with _flush_thread_lock:
-        if _flush_thread is not None and _flush_thread.is_alive():
-            return
-        t = threading.Thread(target=_flush_loop, args=(interval_s,),
-                             name="flight-flush", daemon=True)
-        _flush_thread = t
-    t.start()
-
-
-def _flush_loop(interval_s: float) -> None:
-    while True:
-        time.sleep(interval_s)
-        try:
-            RECORDER.flush_periodic(min_new_records=256)
-        except OSError:
-            pass     # unwritable events dir: keep trying quietly
+    RECORDER.ensure_flush_thread(interval_s)
 
 
 # ---------------------------------------------------------------------------
@@ -256,9 +186,14 @@ class CompileWatch:
 
     One watch per engine: program identity is engine-scoped (two
     engines in one process legitimately compile the same key twice).
+    ``event_name`` is the typed event a post-warm compile emits — the
+    serving engines keep the default ``engine.unexpected_compile``;
+    the trainer's own watch emits ``train.unexpected_compile`` so the
+    two alarm surfaces stay distinguishable in the event log.
     """
 
-    def __init__(self):
+    def __init__(self, event_name: str = "engine.unexpected_compile"):
+        self.event_name = event_name
         self._lock = threading.Lock()
         self._programs: Dict[str, float] = {}    # guarded-by: _lock
         self._unexpected: List[str] = []         # guarded-by: _lock
@@ -312,7 +247,7 @@ class CompileWatch:
             if warm:
                 UNEXPECTED_COMPILES.inc()
                 tracing.add_event(
-                    "engine.unexpected_compile",
+                    self.event_name,
                     {"program": key, "compile_s": round(dt, 4)},
                     echo=True)
             return out

@@ -129,6 +129,20 @@ DEFAULT_RULES: List[SloRule] = [
             threshold=1.5, metric="skytpu_train_step_seconds",
             baseline_metric="skytpu_train_step_median_seconds",
             min_events=3.0),
+    # The training goodput floor, expressed as its complement: badput
+    # (unproductive wall, named buckets) over attributed elapsed wall.
+    # Breaching 0.5 in both windows means the run spent the majority
+    # of the last minutes NOT in productive compute — an input-bound
+    # pipeline, a checkpoint stall storm, or restart thrash — with the
+    # named-bucket counters saying which. warmup_compile is excluded
+    # from the numerator (cold-start compile is expected badput, not a
+    # page), and min_events keeps windows with almost no attributed
+    # wall (idle or just-started processes) from paging.
+    SloRule("train-goodput-floor", "ratio", threshold=0.5,
+            metric="skytpu_train_unproductive_seconds_total",
+            denominator="skytpu_train_wall_seconds_total",
+            exclude_labels={"bucket": ["warmup_compile"]},
+            min_events=30.0),
     SloRule("component-alive", "component_dead", threshold=0.0),
     # Analytical HBM pressure from the engine's ledger: capacity
     # components (weights, pools, workspace) summed against the

@@ -27,17 +27,17 @@ Design constraints, in order (same as metrics.py):
 
 from __future__ import annotations
 
-import atexit
 import dataclasses
 import json
 import os
 import re
 import sys
-import tempfile
 import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.observability import _ringflush
 
 ENV_VAR = "SKYTPU_TRACEPARENT"
 EVENTS_DIR_ENV_VAR = "SKYTPU_EVENTS_DIR"
@@ -141,16 +141,9 @@ def process_name() -> str:
 
 # ---------------------------------------------------------------------------
 # The event log: bounded ring buffer + atomic whole-buffer flush.
-
-_lock = threading.Lock()
-_flush_lock = threading.Lock()       # serializes writers of the log file
-_records: List[Dict[str, Any]] = []  # guarded-by: _lock
-_seq = 0                             # guarded-by: _lock
-_flushed_seq = 0                     # guarded-by: _lock
-_last_flush_s = 0.0                  # guarded-by: _lock
-_registered = False                  # guarded-by: _lock
-# Stable per process incarnation.    # guarded-by: _lock
-_log_name: Optional[str] = None
+# The state machine lives in observability/_ringflush.py (shared with
+# the flight and goodput recorders); this module keeps the record
+# shapes and the enablement/suppression policy.
 
 
 def enabled() -> bool:
@@ -166,65 +159,43 @@ def events_dir() -> str:
     return d
 
 
+def _mint_log_name() -> str:
+    # pid + start-ms: unique per process incarnation, so a recycled
+    # pid can never clobber a dead process's log.
+    return (f"{process_name()}-{os.getpid()}"
+            f"-{int(time.time() * 1000)}.jsonl")
+
+
+def _gc_on_exit() -> None:
+    # Self-cleaning: every recording process prunes the dir on the
+    # way out (one cheap listdir against a GC-bounded dir). This is
+    # what keeps the HEAD's events dir bounded too — short-lived
+    # rpc processes are its main writers and nothing else up there
+    # runs a GC loop.
+    gc_event_logs()
+
+
+_RING = _ringflush.Ring(_MAX_RECORDS, _mint_log_name, events_dir,
+                        halve_on_overflow=True,
+                        atexit_extra=_gc_on_exit,
+                        thread_name="tracing-flush")
+
+
 def _append(rec: Dict[str, Any]) -> None:
     if not enabled():
         return
     from skypilot_tpu.observability import metrics
     if metrics.suppressed():
         return   # e.g. the model server's warmup generation
-    global _seq, _registered, _log_name
-    with _lock:
-        if not _registered:
-            atexit.register(_flush_atexit)
-            _registered = True
-        if _log_name is None:
-            # pid + start-ms: unique per process incarnation, so a
-            # recycled pid can never clobber a dead process's log.
-            _log_name = (f"{process_name()}-{os.getpid()}"
-                         f"-{int(time.time() * 1000)}.jsonl")
-        _records.append(rec)
-        _seq += 1
-        if len(_records) > _MAX_RECORDS:
-            del _records[:_MAX_RECORDS // 2]
+    _RING.append(rec)
 
 
 def flush() -> None:
     """Atomically rewrite this process's event-log file with the whole
-    buffer. Crash-safe: a reader (or a racing flush) never sees a torn
-    file — write a sibling temp file, then ``os.replace`` it over."""
-    global _flushed_seq, _last_flush_s
+    buffer (crash-safe tempfile + ``os.replace``; see ``_ringflush``)."""
     if not enabled():
         return
-    with _lock:
-        if not _records or _seq == _flushed_seq:
-            return
-        seq_snapshot = _seq
-        # Snapshot only — serialization happens OUTSIDE the lock so
-        # recorder threads (HTTP handlers, the engine loop) never block
-        # on an O(ring) json.dumps pass.
-        snapshot = list(_records)
-        name = _log_name
-    lines = [json.dumps(r, default=str) for r in snapshot]
-    with _flush_lock:
-        with _lock:
-            if seq_snapshot <= _flushed_seq:
-                return           # a newer flush already landed
-        d = events_dir()
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=name + ".")
-        try:
-            with os.fdopen(fd, "w", encoding="utf-8") as f:
-                f.write("\n".join(lines) + "\n")
-            os.replace(tmp, os.path.join(d, name))
-            with _lock:
-                _flushed_seq = seq_snapshot
-                _last_flush_s = time.monotonic()
-        except BaseException:
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
-            raise
+    _RING.flush()
 
 
 def flush_periodic(min_new_records: int = 128,
@@ -232,17 +203,8 @@ def flush_periodic(min_new_records: int = 128,
     """Throttled :func:`flush` for per-tick daemon callers: every flush
     re-serializes the whole buffer, so flush only once enough records
     accumulated or the last flush went stale."""
-    with _lock:
-        if not _records or _seq == _flushed_seq:
-            return
-        pending = _seq - _flushed_seq
-        fresh = time.monotonic() - _last_flush_s < max_age_s
-    if pending < min_new_records and fresh:
-        return
-    flush()
-
-
-_flush_thread: Optional[threading.Thread] = None  # guarded-by: _lock
+    _RING.flush_periodic(min_new_records=min_new_records,
+                         max_age_s=max_age_s)
 
 
 def ensure_flush_thread(interval_s: float = 5.0) -> None:
@@ -252,36 +214,8 @@ def ensure_flush_thread(interval_s: float = 5.0) -> None:
     and paying tens of ms inline between decode waves is a recurring
     tail-latency spike — off-thread, the same durability costs the hot
     path nothing (the buffer lock is only held to snapshot)."""
-    global _flush_thread
-    with _lock:
-        if _flush_thread is not None and _flush_thread.is_alive():
-            return
-        t = threading.Thread(target=_flush_loop, args=(interval_s,),
-                             name="tracing-flush", daemon=True)
-        _flush_thread = t
-    t.start()
-
-
-def _flush_loop(interval_s: float) -> None:
-    while True:
-        time.sleep(interval_s)
-        try:
-            flush_periodic(min_new_records=256, max_age_s=interval_s)
-        except OSError:
-            pass   # unwritable events dir: keep trying quietly
-
-
-def _flush_atexit() -> None:
-    try:
-        flush()
-        # Self-cleaning: every recording process prunes the dir on the
-        # way out (one cheap listdir against a GC-bounded dir). This is
-        # what keeps the HEAD's events dir bounded too — short-lived
-        # rpc processes are its main writers and nothing else up there
-        # runs a GC loop.
-        gc_event_logs()
-    except OSError:
-        pass   # best-effort: exit must stay quiet on unwritable paths
+    _RING.ensure_flush_thread(interval_s, min_new_records=256,
+                              max_age_s=interval_s)
 
 
 def gc_event_logs(max_files: int = 256,
@@ -453,8 +387,7 @@ def span_summary() -> Dict[str, Dict[str, Any]]:
     """Aggregate the in-memory buffer's spans by name:
     ``{name: {count, total_s, mean_s, max_s}}`` — the per-request span
     summary BENCH artifacts carry under ``--emit-trace``."""
-    with _lock:
-        spans = [r for r in _records if r.get("kind") == "span"]
+    spans = [r for r in _RING.snapshot() if r.get("kind") == "span"]
     out: Dict[str, Dict[str, Any]] = {}
     for s in spans:
         dur = max(float(s["end_s"]) - float(s["start_s"]), 0.0)
@@ -472,19 +405,14 @@ def span_summary() -> Dict[str, Dict[str, Any]]:
 
 def buffered_records() -> List[Dict[str, Any]]:
     """Snapshot of the in-memory buffer (tests)."""
-    with _lock:
-        return [dict(r) for r in _records]
+    return [dict(r) for r in _RING.snapshot()]
 
 
 def _reset_for_tests() -> None:
     """Drop the buffer and per-process log identity (tests only — a
     fresh tmp home must get a fresh log file, not the previous test's
     name)."""
-    global _seq, _flushed_seq, _log_name, _process_name
-    with _lock:
-        _records.clear()
-        _seq = 0
-        _flushed_seq = 0
-        _log_name = None
-        _process_name = None
+    global _process_name
+    _RING.reset_for_tests()
+    _process_name = None
     _tls.stack = []

@@ -22,11 +22,13 @@ the TPU-native upgrade called for by SURVEY.md §7 stage 8.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional
 
 import jax
 
 from skypilot_tpu import chaos
+from skypilot_tpu.observability import attribution
 from skypilot_tpu.observability import metrics as obs_metrics
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.utils import timeline
@@ -43,6 +45,29 @@ CKPT_WAIT_SECONDS = obs_metrics.histogram(
     "CheckpointManager.wait latency (async save durability tail)")
 CKPT_SAVES = obs_metrics.counter(
     "skytpu_checkpoint_saves_total", "Checkpoint saves accepted")
+CKPT_BYTES = obs_metrics.gauge(
+    "skytpu_ckpt_bytes",
+    "Analytical bytes of the last saved checkpoint state by tensor "
+    "family (params, opt_state, total) — nbytes metadata only, never "
+    "a device fetch",
+    labelnames=("kind",))
+CKPT_LAST_DURATION = obs_metrics.gauge(
+    "skytpu_ckpt_last_duration_seconds",
+    "Most recent checkpoint operation wall seconds by op (save = "
+    "async dispatch, wait = durability tail, restore = full restore "
+    "wall) — restore feeds the goodput restart_replay bucket",
+    labelnames=("op",))
+
+
+def _publish_state_bytes(state: Any) -> None:
+    total = attribution.tensor_bytes(state)
+    CKPT_BYTES.labels(kind="total").set(total)
+    if hasattr(state, "get"):
+        for kind in ("params", "opt_state"):
+            part = state.get(kind)
+            if part is not None:
+                CKPT_BYTES.labels(kind=kind).set(
+                    attribution.tensor_bytes(part))
 
 
 class CheckpointManager:
@@ -82,6 +107,7 @@ class CheckpointManager:
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Queue an async save. Returns False if skipped by interval."""
         chaos.point("train.checkpoint_save", step=int(step))
+        t0 = time.monotonic()
         with tracing.start_span("train.checkpoint_save",
                                 attrs={"step": int(step)}), \
                 timeline.Event("skytpu_checkpoint_save_seconds",
@@ -89,8 +115,10 @@ class CheckpointManager:
             saved = self._mgr.save(
                 step, args=self._ocp.args.StandardSave(state),
                 force=force)
+        CKPT_LAST_DURATION.labels(op="save").set(time.monotonic() - t0)
         if saved:
             CKPT_SAVES.inc()
+            _publish_state_bytes(state)
         return saved
 
     def restore(self, target: Optional[Any] = None,
@@ -104,10 +132,17 @@ class CheckpointManager:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}")
         chaos.point("train.checkpoint_restore", step=int(step))
-        if target is None:
-            return self._mgr.restore(step)
-        return self._mgr.restore(
-            step, args=self._ocp.args.StandardRestore(target))
+        t0 = time.monotonic()
+        with tracing.start_span("train.checkpoint_restore",
+                                attrs={"step": int(step)}):
+            if target is None:
+                out = self._mgr.restore(step)
+            else:
+                out = self._mgr.restore(
+                    step, args=self._ocp.args.StandardRestore(target))
+        CKPT_LAST_DURATION.labels(op="restore").set(
+            time.monotonic() - t0)
+        return out
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -117,10 +152,12 @@ class CheckpointManager:
 
     def wait(self) -> None:
         """Block until queued async saves are durable."""
+        t0 = time.monotonic()
         with tracing.start_span("train.checkpoint_wait"), \
                 timeline.Event("skytpu_checkpoint_wait_seconds",
                                histogram=CKPT_WAIT_SECONDS):
             self._mgr.wait_until_finished()
+        CKPT_LAST_DURATION.labels(op="wait").set(time.monotonic() - t0)
 
     def close(self) -> None:
         self._mgr.close()

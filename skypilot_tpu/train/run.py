@@ -147,6 +147,24 @@ def main() -> None:
     if args.zigzag:
         act_rules = dict(act_rules, seq_layout="zigzag")
 
+    # Goodput forensics: the trainer's own compile watch (a mid-run
+    # retrace is a typed train.unexpected_compile), sampled device-time
+    # calibration, the per-step phase ledger, and the anomaly watchdog
+    # over the losses the logging cadence fetches anyway. The roofline
+    # peak is published here so skytpu top's train MFU has its
+    # denominator in a train-only process.
+    from skypilot_tpu.observability import attribution, flight
+    from skypilot_tpu.observability import goodput as goodput_lib
+    peak_f, peak_bw = attribution.device_peaks()
+    attribution.ROOFLINE_PEAK_FLOPS.set(peak_f * n)
+    attribution.ROOFLINE_PEAK_BW.set(peak_bw * n)
+    watch = flight.CompileWatch(event_name="train.unexpected_compile")
+    watch.calibrator = attribution.DeviceTimeCalibrator()
+    gp = goodput_lib.GoodputRecorder(
+        param_count=cfg.num_params() if hasattr(cfg, "num_params") else 0,
+        watch=watch, calibrator=watch.calibrator)
+    watchdog = goodput_lib.AnomalyWatchdog(goodput=gp)
+
     mgr = None
     start_step = 0
     state = None
@@ -185,9 +203,11 @@ def main() -> None:
             f"{lora_lib.num_trainable_params(cfg, lc):,} trainable over "
             f"an int8 base of {cfg.num_params():,} params")
         if mgr and args.resume and mgr.latest_step() is not None:
+            gp.load_stamps(mgr.directory)
             # The adapter state tree is identical to --lora's.
-            state = mgr.restore(
-                lora_lib.abstract_lora_state(cfg, lc, tc, mesh=None))
+            with gp.account("restart_replay"):
+                state = mgr.restore(
+                    lora_lib.abstract_lora_state(cfg, lc, tc, mesh=None))
             start_step = int(mgr.latest_step())
             log(f"resumed from step {start_step}")
         else:
@@ -205,8 +225,10 @@ def main() -> None:
             f"{lora_lib.num_trainable_params(cfg, lc):,} trainable / "
             f"{cfg.num_params():,} base params (frozen)")
         if mgr and args.resume and mgr.latest_step() is not None:
-            state = mgr.restore(
-                lora_lib.abstract_lora_state(cfg, lc, tc, mesh))
+            gp.load_stamps(mgr.directory)
+            with gp.account("restart_replay"):
+                state = mgr.restore(
+                    lora_lib.abstract_lora_state(cfg, lc, tc, mesh))
             start_step = int(mgr.latest_step())
             log(f"resumed from step {start_step}")
         else:
@@ -218,16 +240,20 @@ def main() -> None:
         step_fn = lambda s, b: raw_step(s, base_params, b)
     else:
         step_fn = trainer.make_train_step(cfg, tc, mesh, model=model,
-                                          act_rules=act_rules)
+                                          act_rules=act_rules,
+                                          watch=watch)
         if mgr and args.resume and mgr.latest_step() is not None:
-            target = trainer.create_abstract_state(cfg, tc, mesh,
-                                                   model=model)
-            state = mgr.restore(target)
+            gp.load_stamps(mgr.directory)
+            with gp.account("restart_replay"):
+                target = trainer.create_abstract_state(cfg, tc, mesh,
+                                                       model=model)
+                state = mgr.restore(target)
             start_step = int(mgr.latest_step())
             log(f"resumed from step {start_step}")
         if state is None:
-            state = trainer.create_train_state(cfg, tc, mesh,
-                                               model=model)
+            with gp.account("warmup_compile"):
+                state = trainer.create_train_state(cfg, tc, mesh,
+                                                   model=model)
 
     if args.packed:
         import jax.numpy as jnp
@@ -260,23 +286,49 @@ def main() -> None:
     sky_callback.init(total_steps=args.steps)
     t0 = time.time()
     for step in range(start_step, args.steps):
+        gp.step_start(step)
         if batches is not None:
-            batch_data = next(batches)
+            with gp.phase("data_wait"):
+                batch_data = next(batches)
         with sky_callback.step():
-            state, metrics = step_fn(state, batch_data)
+            with gp.phase("compute"):
+                state, metrics = step_fn(state, batch_data)
+        if step == start_step:
+            # Every program the loop can reach is compiled now; from
+            # here a new key is a mid-run retrace worth alarming on.
+            watch.declare_warm()
+        loss = grad_norm = None
         if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
-            loss = float(metrics["loss"])
-            trainer.observe_loss(loss)
+            with gp.phase("eval"):
+                # The deliberate host fetch the logging cadence always
+                # paid; grad_norm rides the same sync.
+                loss = float(metrics["loss"])
+                gn = metrics.get("grad_norm") \
+                    if hasattr(metrics, "get") else None
+                grad_norm = float(gn) if gn is not None else None
+                trainer.observe_loss(loss)
+            anomaly = watchdog.observe(step + 1, loss, grad_norm)
+            if anomaly:
+                log(f"step {step + 1}: train.anomaly "
+                    f"{anomaly['kind']} {anomaly}")
             log(f"step {step + 1}/{args.steps} loss={loss:.4f}")
         if mgr and (step + 1) % args.ckpt_every == 0:
-            mgr.save(step + 1, state)
+            with gp.phase("ckpt_save"):
+                mgr.save(step + 1, state)
+                gp.persist(mgr.directory)
+        tokens = getattr(batch_data.get("tokens"), "size", 0) \
+            if hasattr(batch_data, "get") else 0
+        gp.step_end(tokens=tokens, loss=loss, grad_norm=grad_norm)
     loss = float(metrics["loss"])  # host fetch = real sync
     wall = time.time() - t0
     if mgr:
-        if mgr.latest_step() != args.steps:
-            mgr.save(args.steps, state, force=True)
-        mgr.wait()
+        with gp.account("ckpt_stall"):
+            if mgr.latest_step() != args.steps:
+                mgr.save(args.steps, state, force=True)
+            mgr.wait()
+        gp.persist(mgr.directory)
         mgr.close()
+    snap = gp.snapshot()
     tokens_per_s = batch * args.seq * (args.steps - start_step) / wall
     print(json.dumps({
         "final_loss": round(loss, 4),
@@ -284,6 +336,7 @@ def main() -> None:
         "wall_s": round(wall, 2),
         "tokens_per_sec": round(tokens_per_s, 1),
         "tokens_per_sec_per_chip": round(tokens_per_s / n, 1),
+        "goodput": round(snap["goodput_ratio"], 4),
         "mesh": shape.as_dict(),
     }))
 

@@ -234,12 +234,34 @@ def create_abstract_state(cfg: llama.LlamaConfig, tc: TrainConfig,
         shapes, shardings)
 
 
+def _batch_key_fn(args: tuple, kwargs: dict):
+    """Shape-derived program identity for the trainer's compile watch:
+    jit retraces on a new batch shape even under an unchanged entry
+    point, and a mid-run shape change is exactly the silent retrace
+    ``train.unexpected_compile`` exists to expose."""
+    batch = args[1] if len(args) > 1 else kwargs.get("batch")
+    parts = []
+    if hasattr(batch, "items"):
+        for k in sorted(batch):
+            shape = getattr(batch[k], "shape", None)
+            if shape is not None:
+                parts.append((k, "x".join(str(d) for d in shape)))
+    return parts
+
+
 def make_train_step(cfg: llama.LlamaConfig, tc: TrainConfig,
                     mesh: Optional[Mesh],
                     rules: sh.Rules = sh.DEFAULT_RULES,
                     act_rules: sh.Rules = sh.ACT_RULES,
-                    model=llama) -> Callable:
-    """Returns jitted step(state, batch) -> (state, metrics)."""
+                    model=llama, watch=None) -> Callable:
+    """Returns jitted step(state, batch) -> (state, metrics).
+
+    ``watch`` is an optional ``flight.CompileWatch`` (the trainer's
+    own, with ``event_name="train.unexpected_compile"``): the jitted
+    step is wrapped so every distinct batch-shape identity registers
+    as a program, and a post-warmup retrace emits the typed event the
+    goodput ledger and SLO watchdog alarm on.
+    """
     opt = make_optimizer(tc)
     constrain = sh.make_constrain(mesh, act_rules)
 
@@ -259,15 +281,19 @@ def make_train_step(cfg: llama.LlamaConfig, tc: TrainConfig,
         return new_state, metrics
 
     if mesh is None:
-        return _instrument_step(jax.jit(step, donate_argnums=(0,)))
-    shardings = state_shardings(cfg, mesh, rules, model)
-    batch_spec = NamedSharding(mesh, P(("dp", "fsdp")))
-    return _instrument_step(jax.jit(
-        step,
-        donate_argnums=(0,),
-        in_shardings=(shardings, batch_spec),
-        out_shardings=(shardings, None),
-    ))
+        jitted = jax.jit(step, donate_argnums=(0,))
+    else:
+        shardings = state_shardings(cfg, mesh, rules, model)
+        batch_spec = NamedSharding(mesh, P(("dp", "fsdp")))
+        jitted = jax.jit(
+            step,
+            donate_argnums=(0,),
+            in_shardings=(shardings, batch_spec),
+            out_shardings=(shardings, None),
+        )
+    if watch is not None:
+        jitted = watch.wrap("train_step", jitted, key_fn=_batch_key_fn)
+    return _instrument_step(jitted)
 
 
 def synthetic_batch(cfg: llama.LlamaConfig, batch_size: int, seq_len: int,

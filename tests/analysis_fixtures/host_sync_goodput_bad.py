@@ -1,0 +1,30 @@
+# Golden fixture: seeded host-sync violations on the training-goodput
+# step-ledger path (PR 18). step_start/step_end bracket EVERY train
+# step and the watchdog's observe rides every logging tick — all pure
+# host clock/dict arithmetic over values the loop already fetched;
+# consulting the device to attribute time stalls the very step the
+# ledger is measuring. Checked as if it were
+# skypilot_tpu/observability/goodput.py (the goodput step-ledger
+# scope). Never imported.
+import numpy as np
+
+
+class GoodputRecorder:
+    def step_start(self, step):
+        self._step_t0 = float(self._device_clock)    # expect: host-sync
+        self._phases = {}
+
+    def step_end(self, tokens=0, loss=None, grad_norm=None):
+        self._last_state.block_until_ready()         # expect: host-sync
+        wall = np.asarray(self._wall_dev)            # expect: host-sync
+        self._buckets["productive"] += wall[0]
+        self.recorder.record("train_step", dur_s=wall[0], toks=tokens)
+
+
+class AnomalyWatchdog:
+    def observe(self, step, loss, grad_norm=None):
+        cur = loss.item()                            # expect: host-sync
+        if grad_norm is not None:
+            cur = max(cur, float(grad_norm))         # expect: host-sync
+        self._last = cur
+        return None

@@ -1,0 +1,59 @@
+# Clean twin: goodput attribution done right — wall-clock brackets
+# (time.monotonic) around work the loop thread already does, phase
+# dicts and bucket floats are pure host state under the recorder
+# lock, and the watchdog consumes the float the loop fetched once at
+# its own logging cadence. The device is never consulted.
+# Never imported.
+import time
+
+
+class GoodputRecorder:
+    def _credit_locked(self, bucket, dur):
+        if dur <= 0.0:
+            return
+        self._buckets[bucket] += dur
+
+    def _advance_locked(self, now, bucket):
+        self._credit_locked(bucket, now - self._t_last)
+        self._t_last = now
+
+    def step_start(self, step):
+        now = time.monotonic()
+        with self._lock:
+            self._advance_locked(now, "host_other")
+        self._step = step
+        self._step_t0 = now
+        self._phases = {}
+
+    def step_end(self, tokens=0, loss=None, grad_norm=None):
+        now = time.monotonic()
+        wall = now - self._step_t0
+        named = sum(self._phases.values())
+        other = wall - named if wall > named else 0.0
+        with self._lock:
+            for phase, dur in self._phases.items():
+                bucket = ("productive" if phase == "compute"
+                          else "host_other")
+                self._credit_locked(bucket, dur)
+            self._credit_locked("host_other", other)
+            self._t_last = self._step_t0 + wall
+        rec = {"dur_s": wall, "toks": tokens, "host": self._host}
+        if loss is not None:
+            rec["loss"] = loss
+        self.recorder.record("train_step", **rec)
+
+
+class AnomalyWatchdog:
+    def observe(self, step, loss, grad_norm=None):
+        # `loss` is already a host float — the loop fetched it once at
+        # its logging cadence; the watchdog adds zero extra syncs.
+        if loss != loss:
+            if not self._non_finite:
+                self._non_finite = True
+                return {"kind": "non_finite", "step": step}
+            return None
+        self._non_finite = False
+        if self._last is not None:
+            self._deltas.append(abs(loss - self._last))
+        self._last = loss
+        return None

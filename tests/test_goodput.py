@@ -1,0 +1,436 @@
+"""Training goodput forensics: the step-phase ledger's exact
+partition, counter<->record consistency, restart-surviving stamps,
+the loss/grad anomaly watchdog, the train-goodput-floor SLO rule and
+the `skytpu train-why` / `skytpu top` surfaces
+(docs/observability.md §Training goodput forensics)."""
+
+import json
+import math
+import os
+import time
+
+import pytest
+
+from skypilot_tpu.observability import flight as fl
+from skypilot_tpu.observability import forensics
+from skypilot_tpu.observability import goodput as gp_lib
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import slo, tracing
+
+
+def _counter_delta(before, after, name):
+    def total(snap):
+        if name not in snap:
+            return 0.0
+        return sum(s.get("value", s.get("count", 0))
+                   for s in snap[name]["samples"])
+    return total(after) - total(before)
+
+
+def _drive_steps(gp, n_steps=3, tokens=64, sleep=0.004):
+    """Drive the recorder the way run.py does; returns the records."""
+    recs = []
+    for step in range(n_steps):
+        gp.step_start(step)
+        with gp.phase("data_wait"):
+            time.sleep(sleep)
+        with gp.phase("compute"):
+            time.sleep(2 * sleep)
+        with gp.phase("eval"):
+            time.sleep(sleep / 2)
+        rec = gp.step_end(tokens=tokens, loss=2.0 - 0.1 * step)
+        recs.append(rec)
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# The exact-partition invariants.
+
+def test_step_record_phases_sum_to_wall():
+    rec = fl.FlightRecorder()
+    gp = gp_lib.GoodputRecorder(recorder=rec, host="0",
+                                param_count=1000, enable=True)
+    records = _drive_steps(gp, n_steps=4)
+    assert len(records) == 4
+    for r in records:
+        # phases (ms) sum to dur_s exactly — host_other carries the
+        # remainder, never silence.
+        assert sum(r["phases"].values()) == \
+            pytest.approx(r["dur_s"] * 1e3, abs=0.05)
+        assert r["phases"].get("host_other", 0.0) >= 0.0
+        assert {"data_wait", "compute", "eval"} <= set(r["phases"])
+    # Warmup semantics: first step cold, rest warm; only warm steps
+    # carry the device attribution fields.
+    assert records[0]["warm"] is False
+    assert "flops" not in records[0] and "dev_ms_est" not in records[0]
+    for r in records[1:]:
+        assert r["warm"] is True
+        assert r["flops"] == 6 * 1000 * 64
+        assert r["dev_ms_est"] > 0
+
+
+def test_ledger_sums_gate(tmp_path, monkeypatch):
+    """The tier-1 ledger gate: loaded back off disk, every train_step
+    ledger's phases sum to its wall and the named (non-host_other)
+    share dominates a sleep-phased run."""
+    monkeypatch.setenv(tracing.EVENTS_DIR_ENV_VAR, str(tmp_path))
+    rec = fl.FlightRecorder()
+    gp = gp_lib.GoodputRecorder(recorder=rec, host="0", enable=True)
+    _drive_steps(gp, n_steps=3, sleep=0.01)
+    rec.flush()
+    records = fl.load_records(dirs=[str(tmp_path)])
+    train = gp_lib.train_records(records)
+    assert len(train) == 3
+    for r in train:
+        led = gp_lib.ledger_for_step(records, step=r["step"])
+        assert led is not None
+        assert sum(p["ms"] for p in led["phases"]) == \
+            pytest.approx(led["wall_ms"], abs=0.05)
+        assert led["named_ms"] >= 0.90 * led["wall_ms"]
+    summary = gp_lib.summarize_steps(records)
+    assert summary["steps"] == 3
+    assert sum(p["ms"] for p in summary["phases"]) == \
+        pytest.approx(summary["wall_ms"], abs=0.2)
+    # Renderers carry the sum-equals-wall footer.
+    assert "sum (= wall)" in gp_lib.render_step_ledger(
+        gp_lib.ledger_for_step(records))
+    assert "named" in gp_lib.render_summary(summary)
+
+
+def test_counter_deltas_match_record_sums():
+    """Counters and records are incremented on the SAME path with the
+    SAME values — a drift means double counting somewhere."""
+    before = metrics_lib.REGISTRY.snapshot()
+    rec = fl.FlightRecorder()
+    gp = gp_lib.GoodputRecorder(recorder=rec, host="gate-host",
+                                param_count=500, enable=True)
+    records = _drive_steps(gp, n_steps=4, tokens=32)
+    snap = gp.snapshot()
+    after = metrics_lib.REGISTRY.snapshot()
+    flops = sum(r.get("flops", 0) for r in records)
+    assert flops == 3 * 6 * 500 * 32          # warm steps only
+    assert _counter_delta(before, after,
+                          "skytpu_device_flops_total") == flops
+    dev_s = sum(r.get("dev_ms_est", 0.0) for r in records) / 1e3
+    # dev_ms_est is rounded to 1e-4 ms on the record; counters take
+    # the raw value.
+    assert _counter_delta(before, after,
+                          "skytpu_device_seconds_total") == \
+        pytest.approx(dev_s, abs=1e-6)
+    assert snap["tokens"] == sum(r["toks"] for r in records)
+    assert snap["steps"] == len(records)
+    # The counter-level partition: wall == productive + unproductive.
+    wall = _counter_delta(before, after,
+                          "skytpu_train_wall_seconds_total")
+    prod = _counter_delta(before, after,
+                          "skytpu_train_productive_seconds_total")
+    unprod = _counter_delta(
+        before, after, "skytpu_train_unproductive_seconds_total")
+    assert wall == pytest.approx(prod + unprod, abs=1e-9)
+    # Warm compute credited productive; the cold step's compute went
+    # to warmup_compile, so both sides are non-zero.
+    assert prod > 0 and unprod > 0
+
+
+def test_snapshot_buckets_sum_to_elapsed():
+    gp = gp_lib.GoodputRecorder(recorder=fl.FlightRecorder(),
+                                host="0", enable=True)
+    with gp.account("restart_replay"):
+        time.sleep(0.01)
+    _drive_steps(gp, n_steps=2)
+    snap = gp.snapshot()
+    assert sum(snap["buckets"].values()) == \
+        pytest.approx(snap["elapsed_s"], abs=1e-6)
+    assert snap["buckets"]["restart_replay"] >= 0.01
+    assert 0.0 <= snap["goodput_ratio"] <= 1.0
+    assert snap["goodput_ratio"] == pytest.approx(
+        snap["buckets"]["productive"] / snap["elapsed_s"])
+
+
+def test_disabled_recorder_is_noop():
+    rec = fl.FlightRecorder()
+    gp = gp_lib.GoodputRecorder(recorder=rec, enable=False)
+    gp.step_start(0)
+    with gp.phase("compute"):
+        pass
+    with gp.account("ckpt_stall"):
+        pass
+    assert gp.step_end(tokens=8) is None
+    assert rec.tail() == []
+    monkey_state = gp.snapshot()
+    assert monkey_state["buckets"]["productive"] == 0.0
+
+
+def test_env_disable(monkeypatch):
+    monkeypatch.setenv("SKYTPU_GOODPUT", "0")
+    assert gp_lib.GoodputRecorder(recorder=fl.FlightRecorder()) \
+        .enabled is False
+    monkeypatch.delenv("SKYTPU_GOODPUT")
+    assert gp_lib.GoodputRecorder(recorder=fl.FlightRecorder()) \
+        .enabled is True
+
+
+def test_unknown_phase_and_bucket_rejected():
+    gp = gp_lib.GoodputRecorder(recorder=fl.FlightRecorder(),
+                                enable=True)
+    with pytest.raises(ValueError):
+        with gp.phase("mystery"):
+            pass
+    with pytest.raises(ValueError):
+        with gp.account("mystery"):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Restart-surviving stamps.
+
+def test_stamps_persist_and_fold_across_restart(tmp_path):
+    gp = gp_lib.GoodputRecorder(recorder=fl.FlightRecorder(),
+                                host="0", enable=True)
+    _drive_steps(gp, n_steps=2, tokens=16)
+    assert gp.persist(str(tmp_path)) is True
+    stamps = json.load(open(tmp_path / gp_lib.STAMPS_FILE))
+    assert stamps["steps"] == 2 and stamps["tokens"] == 32
+    # The next incarnation folds the priors in additively.
+    gp2 = gp_lib.GoodputRecorder(recorder=fl.FlightRecorder(),
+                                 host="0", enable=True)
+    assert gp2.load_stamps(str(tmp_path)) is True
+    _drive_steps(gp2, n_steps=1, tokens=16)
+    snap = gp2.snapshot()
+    assert snap["steps"] == 3 and snap["tokens"] == 48
+    assert snap["elapsed_s"] > stamps["elapsed_s"]
+    assert sum(snap["buckets"].values()) == \
+        pytest.approx(snap["elapsed_s"], abs=1e-6)
+
+
+def test_stamps_corrupt_or_missing_is_fresh_start(tmp_path):
+    gp = gp_lib.GoodputRecorder(recorder=fl.FlightRecorder(),
+                                enable=True)
+    assert gp.load_stamps(str(tmp_path)) is False
+    (tmp_path / gp_lib.STAMPS_FILE).write_text("{not json")
+    assert gp.load_stamps(str(tmp_path)) is False
+    (tmp_path / gp_lib.STAMPS_FILE).write_text("[1, 2]")
+    assert gp.load_stamps(str(tmp_path)) is False
+    # Disabled recorders never write.
+    off = gp_lib.GoodputRecorder(recorder=fl.FlightRecorder(),
+                                 enable=False)
+    assert off.persist(str(tmp_path / "off")) is False
+    assert not (tmp_path / "off").exists()
+
+
+# ---------------------------------------------------------------------------
+# The anomaly watchdog.
+
+@pytest.fixture
+def fresh_events(tmp_path, monkeypatch):
+    monkeypatch.setenv(tracing.EVENTS_DIR_ENV_VAR, str(tmp_path))
+    monkeypatch.delenv(tracing.ENV_VAR, raising=False)
+    monkeypatch.setenv("SKYPILOT_TPU_HOME", str(tmp_path / "home"))
+    monkeypatch.setenv("SKYTPU_INCIDENT_MIN_INTERVAL_S", "0")
+    forensics._last_capture_s = 0.0
+    tracing._reset_for_tests()
+    yield str(tmp_path)
+    tracing._reset_for_tests()
+
+
+def _anomaly_events():
+    return [r for r in tracing.buffered_records()
+            if r.get("name") == "train.anomaly"]
+
+
+def test_nan_latch_exactly_one_event_and_bundle(fresh_events):
+    rec = fl.FlightRecorder()
+    rec.record("train_step", step=1, dur_s=0.01)
+    wd = gp_lib.AnomalyWatchdog(recorder=rec)
+    before = metrics_lib.REGISTRY.snapshot()
+    for step in range(5):
+        wd.observe(step, 2.0 - 0.01 * step)
+    # One NaN excursion spanning three logging ticks: ONE event, ONE
+    # bundle, ONE counter inc — however long the excursion lasts.
+    info = wd.observe(5, float("nan"))
+    assert info["kind"] == "non_finite" and info["signal"] == "loss"
+    assert wd.observe(6, float("nan")) is None
+    assert wd.observe(7, float("inf")) is None
+    after = metrics_lib.REGISTRY.snapshot()
+    assert _counter_delta(before, after,
+                          "skytpu_train_anomalies_total") == 1
+    assert len(_anomaly_events()) == 1
+    base = forensics.incidents_dir()
+    bundles = [n for n in os.listdir(base)
+               if n.endswith("train-anomaly-non_finite")]
+    assert len(bundles) == 1
+    assert info["incident"] in bundles
+    # The bundle froze the ring tail from before the divergence.
+    flight_tail = open(os.path.join(
+        base, bundles[0], "flight.jsonl")).read()
+    assert json.loads(flight_tail.splitlines()[0])["step"] == 1
+    # Finite values re-arm the latch; the NEXT excursion fires again.
+    assert wd.observe(8, 1.9) is None
+    info2 = wd.observe(9, float("nan"))
+    assert info2 is not None and info2["kind"] == "non_finite"
+    assert len(_anomaly_events()) == 2
+
+
+def test_nan_grad_fires_and_never_poisons_estimators(fresh_events):
+    wd = gp_lib.AnomalyWatchdog(recorder=fl.FlightRecorder())
+    wd.observe(0, 2.0, grad_norm=1.0)
+    info = wd.observe(1, 2.0, grad_norm=float("inf"))
+    assert info["kind"] == "non_finite" and info["signal"] == "grad_norm"
+    # The poisoned sample never entered the last-value state.
+    assert wd._last_grad == 1.0
+    assert math.isfinite(wd._last_loss)
+
+
+def test_spike_detection_and_cooldown(fresh_events):
+    wd = gp_lib.AnomalyWatchdog(min_samples=5, cooldown_steps=10,
+                                spike_factor=4.0,
+                                recorder=fl.FlightRecorder())
+    step = 0
+    for _ in range(12):                # stable deltas ~0.01
+        wd.observe(step, 2.0 + 0.01 * (step % 2))
+        step += 1
+    info = wd.observe(step, 12.0)      # |delta| ~10 >> 4 x p99
+    assert info is not None and info["kind"] == "loss_spike"
+    assert info["delta"] > info["threshold"]
+    # Inside the cooldown a second excursion is suppressed.
+    assert wd.observe(step + 1, 30.0) is None
+    assert len(_anomaly_events()) == 1
+
+
+def test_spike_needs_min_samples(fresh_events):
+    wd = gp_lib.AnomalyWatchdog(min_samples=50,
+                                recorder=fl.FlightRecorder())
+    for step in range(10):
+        wd.observe(step, 2.0)
+    # A huge delta before the estimator warms up never fires.
+    assert wd.observe(10, 100.0) is None
+
+
+def test_anomaly_pause_lands_in_open_step_ledger(fresh_events):
+    rec = fl.FlightRecorder()
+    gp = gp_lib.GoodputRecorder(recorder=rec, host="0", enable=True)
+    wd = gp_lib.AnomalyWatchdog(recorder=rec, goodput=gp)
+    gp.step_start(0)
+    with gp.phase("compute"):
+        pass
+    assert wd.observe(0, float("nan"))["kind"] == "non_finite"
+    r = gp.step_end(tokens=1)
+    assert r["phases"].get("anomaly_pause", 0.0) >= 0.0
+    assert "anomaly_pause" in r["phases"]
+
+
+# ---------------------------------------------------------------------------
+# The train-goodput-floor SLO rule.
+
+def test_goodput_floor_rule_registered():
+    (rule,) = [r for r in slo.DEFAULT_RULES
+               if r.name == "train-goodput-floor"]
+    assert rule.kind == "ratio"
+    assert rule.metric == "skytpu_train_unproductive_seconds_total"
+    assert rule.denominator == "skytpu_train_wall_seconds_total"
+    assert rule.exclude_labels == {"bucket": ["warmup_compile"]}
+
+
+def _goodput_fams(wall, input_bound, warmup):
+    return {
+        "skytpu_train_wall_seconds_total": {
+            "type": "counter", "samples": [({}, float(wall))]},
+        "skytpu_train_unproductive_seconds_total": {
+            "type": "counter", "samples": [
+                ({"bucket": "input_bound"}, float(input_bound)),
+                ({"bucket": "warmup_compile"}, float(warmup))]},
+    }
+
+
+def test_goodput_floor_breach_and_warmup_exclusion():
+    (base,) = [r for r in slo.DEFAULT_RULES
+               if r.name == "train-goodput-floor"]
+    rule = slo.SloRule.from_dict({**base.to_dict(),
+                                  "short_window_s": 10,
+                                  "long_window_s": 30})
+    # Sustained input-bound badput above half of wall: breach.
+    wd = slo.Watchdog(rules=[rule])
+    t0 = time.time() - 100
+    wd.observe(_goodput_fams(100, 10, 50), [], ts=t0)
+    wd.observe(_goodput_fams(140, 20, 50), [], ts=t0 + 35)
+    ev = wd.observe(_goodput_fams(240, 95, 50), [], ts=t0 + 70)
+    assert [e["event"] for e in ev] == ["slo.breach"]
+    # The same wall dominated by warmup compile never pages — a cold
+    # start is expected badput, not an incident.
+    wd2 = slo.Watchdog(rules=[rule])
+    wd2.observe(_goodput_fams(100, 1, 10), [], ts=t0)
+    wd2.observe(_goodput_fams(140, 3, 40), [], ts=t0 + 35)
+    assert wd2.observe(_goodput_fams(200, 5, 90), [],
+                       ts=t0 + 70) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces: `skytpu train-why` and the `skytpu top` train columns.
+
+def test_train_why_cli(fresh_events):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    rec = fl.FlightRecorder()
+    gp = gp_lib.GoodputRecorder(recorder=rec, host="0", enable=True)
+    _drive_steps(gp, n_steps=3)
+    rec.flush()
+    runner = CliRunner()
+    res = runner.invoke(cli_mod.cli, ["train-why"])
+    assert res.exit_code == 0, res.output
+    assert "train step 2" in res.output
+    assert "sum (= wall)" in res.output
+    assert "compute" in res.output
+    # A specific step, and the machine-readable form.
+    res = runner.invoke(cli_mod.cli, ["train-why", "--step", "1"])
+    assert res.exit_code == 0 and "train step 1" in res.output
+    res = runner.invoke(cli_mod.cli, ["train-why", "--json"])
+    assert res.exit_code == 0
+    payload = json.loads(res.output)
+    assert payload["ledger"]["step"] == 2
+    assert payload["summary"]["steps"] == 3
+    # An unrecorded step is a clear error, not an empty table.
+    res = runner.invoke(cli_mod.cli, ["train-why", "--step", "99"])
+    assert res.exit_code != 0
+
+
+def test_train_why_cli_no_records(fresh_events):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    res = CliRunner().invoke(cli_mod.cli, ["train-why"])
+    assert res.exit_code != 0
+
+
+def test_top_train_goodput_and_straggler_columns():
+    from skypilot_tpu.client import cli as cli_mod
+
+    def fams(flops):
+        return {
+            "skytpu_train_step_last_seconds": {
+                "type": "gauge", "samples": [({}, 0.050)]},
+            "skytpu_train_step_median_seconds": {
+                "type": "gauge", "samples": [({}, 0.048)]},
+            "skytpu_train_tokens_per_second": {
+                "type": "gauge", "samples": [({}, 1000.0)]},
+            "skytpu_train_goodput_ratio": {
+                "type": "gauge", "samples": [
+                    ({"host": "0"}, 0.91), ({"host": "3"}, 0.62)]},
+            "skytpu_roofline_peak_flops": {
+                "type": "gauge", "samples": [({}, 0.5e12)]},
+            "skytpu_device_flops_total": {
+                "type": "counter", "samples": [({}, float(flops))]},
+            "skytpu_train_host_step_seconds": {
+                "type": "gauge", "samples": [
+                    ({"host": "0"}, 0.050), ({"host": "3"}, 0.091)]},
+        }
+
+    payload = {"components": [], "alerts": []}
+    now = 1000.0
+    frame = cli_mod._render_top_frame(
+        fams(0), now - 10.0, fams(0.4 * 0.5e12 * 10), now, payload)
+    train = next(l for l in frame.splitlines()
+                 if l.startswith("train"))
+    # Worst host's goodput (min), windowed MFU, and the straggler's
+    # lag over the fastest host.
+    assert "goodput 62.0%" in train
+    assert "mfu 40.0%" in train
+    assert "straggler host-3 (+41 ms)" in train

@@ -188,6 +188,13 @@ _GOLDEN = [
     ("host-sync", "host_sync_attr_bad.py",
      "host_sync_attr_clean.py",
      "skypilot_tpu/observability/attribution.py"),
+    # Training goodput (PR 18): step_start/step_end bracket every
+    # train step and the anomaly watchdog rides the loop's own loss
+    # fetch — wall clocks and host dicts only; a device fetch inside
+    # the ledger stalls the step it is measuring (v12).
+    ("host-sync", "host_sync_goodput_bad.py",
+     "host_sync_goodput_clean.py",
+     "skypilot_tpu/observability/goodput.py"),
     ("lock-discipline", "locks_bad.py", "locks_clean.py",
      "skypilot_tpu/utils/fixture_locks.py"),
     ("typed-errors", "typed_errors_bad.py", "typed_errors_clean.py",
