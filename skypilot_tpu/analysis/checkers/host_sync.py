@@ -31,6 +31,12 @@ _SCOPES: Dict[str, Set[str]] = {
         "prefill_chunk_step", "run_to_completion", "_admit", "admit",
         "_dispatch_wave", "_complete_wave", "_claim_chunked",
         "_store_prefix",
+        # Crash recovery (PR 19): the dispatch seams grew thin
+        # failure-boundary wrappers; the hot-loop bodies moved to
+        # *_impl and stay in scope under their new names.
+        "_admit_impl", "_prefill_chunk_impl", "_spec_decode_burst_impl",
+        "_dispatch_decode_burst_impl", "_complete_decode_burst_impl",
+        "recover",
         # Paged-KV block management (PR 7): all host-side numpy/list
         # bookkeeping — a device fetch here would drain the dispatch
         # pipeline once per claim/retire.
@@ -201,7 +207,10 @@ class HostSyncChecker(Checker):
     #     path (observability/goodput.py) and the trainer's compile-
     #     watch key function joined the scope; the calibrator's
     #     sampled block_until_ready bracket stays baselined from v10.
-    version = 12
+    # v13: crash recovery (PR 19) — the dispatch-seam bodies moved to
+    #     *_impl names and recover() joined the scope; the bump
+    #     rescans the renamed hot paths cold.
+    version = 13
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         scoped = _SCOPES.get(ctx.rel)

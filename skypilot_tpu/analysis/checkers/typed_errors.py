@@ -27,7 +27,12 @@ _SCOPE_FILES = {
     "skypilot_tpu/runtime/rpc.py",
     "skypilot_tpu/runtime/rpc_client.py",
     "skypilot_tpu/serve/load_balancer.py",
+    "skypilot_tpu/serve/replica_managers.py",
     "skypilot_tpu/infer/server.py",
+    # Crash-recovery/drain paths: an engine failure that reaches a
+    # client must ride a typed error (EngineDispatchError,
+    # KvPoolWedgedError), never a bare RuntimeError.
+    "skypilot_tpu/infer/engine.py",
 }
 _GENERIC = {"Exception", "RuntimeError", "BaseException"}
 

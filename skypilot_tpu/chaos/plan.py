@@ -57,6 +57,22 @@ KNOWN_POINTS: Dict[str, str] = {
         "pool install); a transient fault retries via utils/retry, "
         "exhaustion fails the request typed adapter_load_failed — "
         "never a silent fall-through to the base model (ctx: adapter)",
+    "engine.dispatch":
+        "inference-engine device dispatch seam (admission wave, "
+        "prefill chunk, decode burst, spec verify); a fault surfaces "
+        "as a recoverable EngineDispatchError — the server resets the "
+        "engine and re-admits every in-flight request through the "
+        "preemption resume path, greedy output bit-identical "
+        "(ctx: seam=admit|chunk|decode|verify)",
+    "kv.alloc":
+        "paged KV block allocation (admission claim, lazy per-burst "
+        "growth); a fault rides the enclosing dispatch seam's "
+        "recovery path (ctx: need)",
+    "replica.kill":
+        "model-server streaming response mid-flight; a fault drops "
+        "the client connection with no terminal chunk — the replica "
+        "looks SIGKILLed to the LB, which fails the stream over to a "
+        "surviving replica (ctx: route)",
     "train.checkpoint_save":
         "checkpoint save dispatch (ctx: step)",
     "train.checkpoint_restore":

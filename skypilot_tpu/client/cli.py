@@ -192,7 +192,8 @@ def _fleet_fetch(need_metrics: bool = True):
     return families, payload
 
 
-_HEALTH_MARK = {"healthy": "+", "degraded": "~", "dead": "x"}
+_HEALTH_MARK = {"healthy": "+", "draining": "-", "degraded": "~",
+                "dead": "x"}
 
 
 def _health_lines(payload) -> list:
@@ -277,7 +278,11 @@ def status(refresh, show_ip, show_metrics, show_health, raw, clusters):
         _, payload = _fleet_fetch(need_metrics=False)
         for line in _health_lines(payload):
             click.echo(line)
-        if payload.get("status") != "healthy":
+        # Draining within its deadline is a PLANNED state (rolling
+        # update in progress), not an incident: exit 0. A replica
+        # draining past its deadline self-reports degraded, which
+        # rolls up here and exits 2.
+        if payload.get("status") not in ("healthy", "draining"):
             sys.exit(2)
         return
     if show_metrics:
@@ -544,6 +549,24 @@ def _top_frame(prev, prev_ts, fams, now, payload):
             line += f"  compiles {comp:.0f}"
             line += (f" (! {unexp:.0f} unexpected)" if unexp
                      else " (0 unexpected)")
+        # Fault tolerance (docs/robustness.md §Replica loss & rolling
+        # update): replicas mid-drain (summed per-replica gauge), the
+        # engine crash-recovery rate, and the LB mid-stream failover
+        # rate — a rolling update or a crash storm shows on the serve
+        # line WHILE it happens, not in a postmortem. Columns appear
+        # only when non-zero: steady state stays uncluttered.
+        draining = gauge("skytpu_server_draining")
+        if draining:
+            serve["replicas_draining"] = draining
+            line += f"  drain {draining:.0f}"
+        rec_rate = rate("skytpu_engine_recoveries_total")
+        if rec_rate:
+            serve["recoveries_per_s"] = rec_rate
+            line += f"  recov {rec_rate:.2f}/s"
+        fo_rate = rate("skytpu_lb_failovers_total")
+        if fo_rate:
+            serve["failovers_per_s"] = fo_rate
+            line += f"  failover {fo_rate:.2f}/s"
         # Device-truth roofline (docs/observability.md §Device-truth
         # attribution): windowed MFU and HBM-bandwidth utilization —
         # the fleet's analytical FLOPs/bytes rates over its summed

@@ -127,7 +127,8 @@ def _mixed_prompts(rng, vocab, requests, lo=512, hi=1024):
     return prompts, (lo, hi)
 
 
-def _client_wave(host, port, payloads, timeout=600.0, stagger_s=0.0):
+def _client_wave(host, port, payloads, timeout=600.0, stagger_s=0.0,
+                 bodies=None):
     """Fire every payload concurrently from ONE thread (raw sockets +
     a selector). A thread-per-request client adds GIL scheduling jitter
     that rivals the TTFTs being measured on a single-core host — the
@@ -140,6 +141,9 @@ def _client_wave(host, port, payloads, timeout=600.0, stagger_s=0.0):
     Returns [(ttft_s, n_tokens, total_s)] aligned with payloads.
     TTFT is wall time from request send to the first BODY byte (the
     response headers go out before any token and don't count).
+    ``bodies``, if a list, collects each raw response body (chunked
+    framing included) in payload order — the failover gate parses the
+    NDJSON token lines out of it for bit-identity checks.
     """
     import re
     import selectors
@@ -236,6 +240,8 @@ def _client_wave(host, port, payloads, timeout=600.0, stagger_s=0.0):
         n_tok = int(m.group(1)) if m else 0
         out.append((st["first"] - st["t0"], n_tok,
                     st["done"] - st["t0"]))
+        if bodies is not None:
+            bodies.append(body)
     return out
 
 
@@ -2040,6 +2046,231 @@ def run_qos_smoke() -> dict:
     return run_qos(smoke=True)
 
 
+def _ndjson_objs(body):
+    """The NDJSON objects in a raw chunked response body. The server
+    writes one JSON line per chunk, so splitting on newlines recovers
+    the lines; the hex chunk-size framing lines are dropped (some hex
+    strings parse as JSON numbers — only dicts survive)."""
+    objs = []
+    for line in body.split(b"\n"):
+        line = line.strip()
+        if not line.startswith(b"{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            objs.append(obj)
+    return objs
+
+
+def run_failover(config=None, requests=None, slots=4, new_tokens=None,
+                 max_burst=8, kv_int8=False, weights_int8=False,
+                 smoke=False) -> dict:
+    """Serving fault-tolerance gate, chaos-verified end to end over
+    HTTP through the real LB against two live replicas
+    (docs/robustness.md §Replica loss & rolling update):
+
+    1. **Engine crash recovery** — a seeded ``engine.dispatch`` fault
+       (seam=decode) crashes one replica's engine mid-wave; the model
+       server resets the engine and re-admits every in-flight request
+       through the resume path. Gates: every stream completes cleanly,
+       tokens BIT-IDENTICAL to the fault-free control, and >= 1
+       recovery observed (``skytpu_engine_recoveries_total`` plus the
+       done-line ``recoveries`` trailer).
+
+    2. **Mid-stream failover** — a seeded ``replica.kill`` fault drops
+       one stream's connection with no terminal chunk (to the LB that
+       replica was SIGKILLed mid-stream); the LB replays
+       prompt + committed tokens on the surviving replica with the
+       budget reduced by what already streamed. Gates: the client sees
+       ONE gapless duplicate-free stream bit-identical to the control,
+       and >= 1 failover counted (``skytpu_lb_failovers_total``).
+
+    Zero lost requests is asserted structurally: :func:`_client_wave`
+    raises on any non-200, in-stream error line, or unterminated
+    stream, so a passing wave IS the zero-shed/zero-truncation gate.
+    """
+    import json as _json
+    import socket
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from skypilot_tpu import chaos
+
+    on_cpu = jax.default_backend() == "cpu"
+    if config is None:
+        config = "llama3-tiny" if on_cpu else "llama3-400m"
+    small = smoke or on_cpu
+    requests = requests or (6 if small else 16)
+    new_tokens = new_tokens or (12 if small else 32)
+    prompt_len = 12
+    # A failover replay's prompt is prompt + committed (up to one token
+    # short of the full budget): the bucket must fit the longest
+    # replay, not just the original prompts.
+    max_prompt = prompt_len + new_tokens
+    buckets = (max_prompt,)
+    log(f"failover gate: {config} replicas=2 slots={slots} "
+        f"requests={requests} new_tokens={new_tokens}")
+
+    home = tempfile.mkdtemp(prefix="skytpu-bench-failover-")
+    os.environ["SKYPILOT_TPU_HOME"] = home
+
+    from skypilot_tpu.infer import engine as eng_mod
+    from skypilot_tpu.infer import server as srv
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve import load_balancer, serve_state
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+
+    cfg = llama.CONFIGS[config]
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(requests)]
+
+    chaos.deactivate()   # warmup + control must run fault-free
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    lb_port = free_port()
+    serve_state.add_service("bench-failover", {}, {}, lb_port)
+    models, httpds = [], []
+    for i in range(2):
+        # Same seed -> identical weights: a resumed suffix from the
+        # surviving replica must be what the dead one would have
+        # produced. Chunked prefill + a prefix pool put the crash
+        # resume on the warm path (contexts stay > prefill_chunk, the
+        # parity-covered regime).
+        _, engine = _build_engine(config, slots, max_prompt,
+                                  new_tokens, kv_int8, weights_int8,
+                                  max_wave=4, buckets=buckets,
+                                  pad_waves=True, prefill_chunk=8,
+                                  prefix_pool=8)
+        port = free_port()
+        model, httpd = srv.serve(engine, host="127.0.0.1", port=port,
+                                 max_burst=max_burst, open_burst=4,
+                                 coalesce_s=0.0)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        models.append(model)
+        httpds.append(httpd)
+        serve_state.upsert_replica("bench-failover", i + 1,
+                                   f"bench-failover-{i + 1}",
+                                   ReplicaStatus.READY,
+                                   f"http://127.0.0.1:{port}")
+    for model in models:
+        assert model._ready.wait(timeout=600), "model warmup timed out"
+    lb = load_balancer._ThreadingServer(
+        ("127.0.0.1", lb_port),
+        load_balancer.make_handler("bench-failover",
+                                   load_balancer.LeastLoadPolicy()))
+    threading.Thread(target=lb.serve_forever, daemon=True).start()
+
+    payloads = [_json.dumps({"tokens": p, "max_new_tokens": new_tokens,
+                             "stream": True}).encode()
+                for p in prompts]
+
+    def wave():
+        """One concurrent wave; returns (token sequences, done-line
+        trailers), both in payload order."""
+        bodies = []
+        _client_wave("127.0.0.1", lb_port, payloads, bodies=bodies)
+        seqs, trailers = [], []
+        for body in bodies:
+            objs = _ndjson_objs(body)
+            toks = []
+            for o in objs:
+                toks.extend(int(t) for t in o.get("tokens") or [])
+            done = [o for o in objs if o.get("done")]
+            assert done, f"stream ended without a done line: {objs!r}"
+            seqs.append(toks)
+            trailers.append(done[-1])
+        return seqs, trailers
+
+    def _total(metric):
+        return sum(child.value for _, child in metric.children())
+
+    try:
+        wave()                        # warm: compiles outside the gate
+        want, _ = wave()              # fault-free control
+        assert all(len(s) == new_tokens for s in want), (
+            f"control wave short: {[len(s) for s in want]}")
+
+        # Phase 1: engine crash recovery. One decode dispatch fault;
+        # the wave must come back bit-identical with >= 1 recovery.
+        rec0 = _total(eng_mod.ENGINE_RECOVERIES)
+        chaos.configure({"seed": 7, "faults": [
+            {"point": "engine.dispatch", "match": {"seam": "decode"},
+             "times": 1}]})
+        crash_seqs, crash_trailers = wave()
+        crash_fired = len(chaos.injector().fired)
+        chaos.deactivate()
+        recoveries = _total(eng_mod.ENGINE_RECOVERIES) - rec0
+        trailer_recoveries = sum(t.get("recoveries", 0)
+                                 for t in crash_trailers)
+        crash_parity = crash_seqs == want
+        log(f"failover phase 1 (engine crash): parity={crash_parity} "
+            f"fired={crash_fired} recoveries={recoveries} "
+            f"rode_through={trailer_recoveries}")
+
+        # Phase 2: replica death mid-stream. The kill fires on the 3rd
+        # chunk write (after=2: past connect, tokens committed); the
+        # LB stitches the suffix from the surviving replica.
+        fo0 = _total(load_balancer.LB_FAILOVERS)
+        chaos.configure({"seed": 11, "faults": [
+            {"point": "replica.kill", "times": 1, "after": 2}]})
+        kill_seqs, kill_trailers = wave()
+        kill_fired = len(chaos.injector().fired)
+        chaos.deactivate()
+        failovers = _total(load_balancer.LB_FAILOVERS) - fo0
+        trailer_failovers = sum(t.get("failovers", 0)
+                                for t in kill_trailers)
+        kill_parity = kill_seqs == want
+        log(f"failover phase 2 (replica kill): parity={kill_parity} "
+            f"fired={kill_fired} failovers={failovers} "
+            f"stitched={trailer_failovers}")
+    finally:
+        chaos.deactivate()
+        lb.shutdown()
+        for httpd in httpds:
+            httpd.shutdown()
+        for model in models:
+            model.shutdown()
+
+    gate_ok = (crash_parity and kill_parity
+               and crash_fired >= 1 and recoveries >= 1
+               and kill_fired >= 1 and failovers >= 1)
+    return {
+        "gate_ok": bool(gate_ok),
+        "crash_parity_ok": bool(crash_parity),
+        "kill_parity_ok": bool(kill_parity),
+        "recoveries": int(recoveries),
+        "trailer_recoveries": int(trailer_recoveries),
+        "failovers": int(failovers),
+        "trailer_failovers": int(trailer_failovers),
+        # Structural: _client_wave raised on any lost/short stream.
+        "lost_requests": 0,
+        "requests": requests,
+        "new_tokens": new_tokens,
+        "config": config,
+        "kv_int8": kv_int8,
+        "weights_int8": weights_int8,
+    }
+
+
+def run_failover_smoke() -> dict:
+    """CI-sized fault-tolerance pass (tier-1 wiring: tests/
+    test_serve_recovery.py asserts gate_ok; wall-clock is never
+    gated on CPU)."""
+    return run_failover(smoke=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None)
@@ -2135,7 +2366,34 @@ def main() -> None:
                          "window, per-burst record coverage, and the "
                          "recorder-off no-op guard (combine with "
                          "--smoke for the CI-sized pass)")
+    ap.add_argument("--failover", action="store_true",
+                    help="serving fault-tolerance gate: two live "
+                         "replicas behind the real LB; a seeded "
+                         "engine.dispatch fault (crash -> reset -> "
+                         "bit-identical resume) then a seeded "
+                         "replica.kill mid-stream (LB failover -> "
+                         "gapless stitched stream) — gates parity "
+                         "with the fault-free control and zero lost "
+                         "requests (combine with --smoke for the "
+                         "CI-sized pass)")
     args = ap.parse_args()
+    if args.failover:
+        r = run_failover(config=args.config, kv_int8=args.kv_int8,
+                         weights_int8=args.weights_int8,
+                         smoke=args.smoke)
+        print(json.dumps({
+            "metric": "serve_failover_gate",
+            "value": 1.0 if r["gate_ok"] else 0.0,
+            "unit": "bool",
+            **{k: r[k] for k in (
+                "crash_parity_ok", "kill_parity_ok", "recoveries",
+                "trailer_recoveries", "failovers",
+                "trailer_failovers", "lost_requests", "requests",
+                "new_tokens", "config")},
+        }))
+        if not r["gate_ok"]:
+            sys.exit(1)
+        return
     if args.adapters:
         r = run_adapters(config=args.config,
                          n_adapters=args.n_adapters,

@@ -16,6 +16,7 @@ TPU (reference: examples/tpu/v6e/README.md §Serve — 11.42 req/s,
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import functools
 import hashlib
@@ -27,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu import chaos
 from skypilot_tpu.infer import adapters as adapters_lib
 from skypilot_tpu.infer import kvcache, sampling
 from skypilot_tpu.infer import qos as qos_lib
@@ -176,6 +178,12 @@ QOS_KV_BLOCKS = metrics.gauge(
     "references, shared prefix blocks charged to every referencing "
     "tenant) — the quantity max_kv_blocks caps",
     labelnames=("tenant",))
+ENGINE_RECOVERIES = metrics.counter(
+    "skytpu_engine_recoveries_total",
+    "Engine crash recoveries: a device dispatch seam raised, the "
+    "engine reset (allocator/table/index wiped) and every in-flight "
+    "request was re-admitted through the preemption resume path, "
+    "by the seam that failed", labelnames=("seam",))
 
 
 @dataclasses.dataclass
@@ -228,6 +236,11 @@ class Request:
     priority: int = 0
     preemptions: int = 0
     resumed_len: int = 0
+    # Engine crash recoveries this request survived: each one is an
+    # involuntary preemption — the request was re-admitted through the
+    # same prompt+committed-tokens resume path eviction uses, so the
+    # greedy output stays bit-identical (surfaced in the trailer).
+    recoveries: int = 0
     # Per-tenant KV-block quota: True while this request sits queued
     # because its tenant is at max_kv_blocks — the typed stall event
     # and counter fire once per episode, not once per admission pass.
@@ -320,6 +333,62 @@ class KvQuotaUnsatisfiableError(ValueError):
             "need_blocks": need,
             "max_kv_blocks": quota,
         }
+
+
+class EngineDispatchError(RuntimeError):
+    """A device dispatch seam (admission wave, prefill chunk, decode
+    burst, spec verify) raised. The engine's host bookkeeping may
+    disagree with device state, so the only safe move is a full
+    ``reset()`` — but every in-flight request is recoverable through
+    the preemption resume path (``recover()``): a crash is just an
+    involuntary preemption. ``recoverable`` is the duck-typed flag the
+    server loop keys recovery on."""
+
+    recoverable = True
+
+    def __init__(self, seam: str, cause: BaseException):
+        super().__init__(f"engine dispatch failed at {seam}: {cause}")
+        self.seam = seam
+        self.cause = cause
+        self.typed_error = {
+            "type": "engine_dispatch_failed",
+            "message": str(self),
+            "seam": seam,
+        }
+
+
+class KvPoolWedgedError(RuntimeError):
+    """The paged KV pool is exhausted and nothing can make progress:
+    every block is held by an active slot (lazy growth has no victim
+    to evict). Admission sizing should make this unreachable — hitting
+    it means the pool is undersized for the configured slot count, an
+    operator error, not a transient."""
+
+    def __init__(self, detail: str):
+        super().__init__(detail)
+        self.typed_error = {
+            "type": "kv_pool_wedged",
+            "message": detail,
+        }
+
+
+@contextlib.contextmanager
+def _dispatch_boundary(seam: str):
+    """Typed failure boundary around one device dispatch seam.
+
+    Chaos point ``engine.dispatch`` fires inside the try so an injected
+    fault takes the same wrap path a real device error would. Typed
+    client errors (prompt too long, unsatisfiable quota) pass through
+    unwrapped — they are the caller's fault, not a crash — as do
+    already-wrapped dispatch errors from a nested seam."""
+    try:
+        chaos.point("engine.dispatch", seam=seam)
+        yield
+    except (EngineDispatchError, PromptTooLongError,
+            KvQuotaUnsatisfiableError):
+        raise
+    except Exception as e:
+        raise EngineDispatchError(seam, e) from e
 
 
 def _bucket(n: int, buckets) -> int:
@@ -987,6 +1056,9 @@ class InferenceEngine:
         self.waiting: Deque[Request] = collections.deque()
         self.chunking: Deque[_ChunkState] = collections.deque()
         self.finished: List[Request] = []
+        # Requests a crashed admission pass was holding in locals
+        # (crash safety; see _rescue_admit_limbo).
+        self._admit_limbo: List[Request] = []
         self._next_rid = 0
         # Tokens dispatched to the device but not yet committed
         # host-side (one outstanding async burst at a time is the
@@ -1783,6 +1855,7 @@ class InferenceEngine:
         None when the pool stays too dry — the caller leaves the
         request queued; retirements free blocks and admission retries
         next pass."""
+        chaos.point("kv.alloc", need=n)
         alloc = self.allocator
         idx = self._prefix_index
         while alloc.available < n and idx is not None:
@@ -2151,6 +2224,42 @@ class InferenceEngine:
         return evicted_any
 
     def _admit(self, on_wave=None) -> None:
+        """Admission pass behind the ``admit`` dispatch boundary: a
+        device error anywhere in wave dispatch/completion or a chunk
+        claim's block allocation surfaces as a recoverable
+        :class:`EngineDispatchError` (typed client errors pass
+        through). Exception-safe: requests the pass had popped off
+        ``waiting`` but not yet landed in ``chunking``/``slot_req``
+        (mid-claim, mid-wave, quota-held) go back to the queue head
+        BEFORE the error crosses the boundary — otherwise
+        :meth:`recover`'s snapshot cannot see them and a crash would
+        silently drop in-flight requests."""
+        self._admit_limbo = []
+        try:
+            with _dispatch_boundary("admit"):
+                self._admit_impl(on_wave)
+        except EngineDispatchError:
+            self._rescue_admit_limbo()
+            raise
+
+    def _rescue_admit_limbo(self) -> None:
+        """Re-queue every request the crashed admission pass was
+        holding in locals. Membership by rid (Request __eq__ is
+        field-wise): anything already reachable from ``waiting``,
+        ``chunking``, ``slot_req``, or ``finished`` stays put — limbo
+        restore must never duplicate a request."""
+        reachable = {r.rid for r in self.waiting}
+        reachable.update(st.req.rid for st in self.chunking)
+        reachable.update(r.rid for r in self.slot_req.values())
+        reachable.update(r.rid for r in self.finished)
+        lost = [r for r in self._admit_limbo
+                if r.rid not in reachable]
+        self._admit_limbo = []
+        for r in reversed(lost):     # earliest pop back at the head
+            self.waiting.appendleft(r)
+        ENGINE_WAITING.set(len(self.waiting))
+
+    def _admit_impl(self, on_wave=None) -> None:
         # Waves are grouped by prompt bucket (prefill is O(S^2): one
         # long prompt must not drag every co-admitted short prompt up
         # to its bucket) and capped at max_wave, then padded to the
@@ -2194,11 +2303,21 @@ class InferenceEngine:
         # unblocks them: it frees the tenant's blocks / unpins an
         # adapter slot).
         quota_held: List[Request] = []
+        limbo = self._admit_limbo
+
+        def pop_waiting() -> Request:
+            # Every admission pop is limbo-tracked until the request
+            # lands somewhere recover() can see (crash safety; see
+            # _rescue_admit_limbo).
+            req = self.waiting.popleft()
+            limbo.append(req)
+            return req
+
         while self.waiting and self.free_slots and not stalled:
             dispatched = []
             while self.waiting and self.free_slots and not stalled:
                 if self._kv_quota_blocked(self.waiting[0]):
-                    quota_held.append(self.waiting.popleft())
+                    quota_held.append(pop_waiting())
                     continue
                 # Chunk-path requests (prompt longer than the chunk —
                 # which also covers every possible prefix-cache hit)
@@ -2211,7 +2330,7 @@ class InferenceEngine:
                 # pinned — it steps aside and everyone behind it keeps
                 # admitting.
                 if self._use_chunked(self.waiting[0]):
-                    req = self.waiting.popleft()
+                    req = pop_waiting()
                     cst = self._claim_chunked(req)
                     if cst == "stall":
                         stalled = True
@@ -2227,7 +2346,7 @@ class InferenceEngine:
                         not stalled and \
                         (self.max_wave is None
                          or len(wave) < self.max_wave):
-                    req = self.waiting.popleft()
+                    req = pop_waiting()
                     if self._kv_quota_blocked(req):
                         quota_held.append(req)
                     elif self._use_chunked(req):
@@ -2399,9 +2518,15 @@ class InferenceEngine:
         scheduler deliberately alternates chunk -> decode burst, so the
         chunk's device time is the decode stall it causes — recorded
         into skytpu_decode_stall_seconds when slots were decoding).
-        Returns True if a chunk ran."""
+        Returns True if a chunk ran. Runs behind the ``chunk`` dispatch
+        boundary: a device failure mid-chunk surfaces as a recoverable
+        :class:`EngineDispatchError`."""
         if not self.chunking:
             return False
+        with _dispatch_boundary("chunk"):
+            return self._prefill_chunk_impl()
+
+    def _prefill_chunk_impl(self) -> bool:
         st = self.chunking[0]
         req = st.req
         ctx = st.ctx if st.ctx is not None else req.prompt
@@ -2851,6 +2976,73 @@ class InferenceEngine:
             self.draft_engine.reset()
         self._update_gauges()
 
+    def recover(self, exc: Optional[BaseException] = None) -> int:
+        """Crash recovery: full :meth:`reset` (device/host bookkeeping
+        may disagree after a failed dispatch — nothing narrower is
+        safe), then re-admit every request that was queued or in
+        flight through the preemption resume path. A crash is an
+        involuntary preemption of EVERY resident at once: each victim
+        re-queues with its prompt + committed tokens, re-prefills that
+        context via the ordinary (now-cold) chunk admission path, and
+        its greedy continuation is bit-identical to an uncrashed run
+        (same guard rail as :meth:`preempt_slot` — contexts that still
+        fit a wave re-admit through the wave program, which the parity
+        matrix does not cover).
+
+        Returns the number of requests re-queued. Requests already
+        retired with output stay finished; the server keeps streaming
+        the SAME Request objects, so open streams continue gapless.
+        """
+        # Snapshot before the wipe: residents (decode slots), chunkers
+        # (mid-chunked-prefill — disjoint from residents until the
+        # final chunk), and the untouched queue. Order within each
+        # class is deterministic (rid = arrival order) so a recovered
+        # engine admits in the same order every time.
+        residents = sorted(self.slot_req.values(), key=lambda r: r.rid)
+        chunkers = [st.req for st in self.chunking]
+        chunker_rids = {r.rid for r in chunkers}
+        queued = list(self.waiting)
+        finished = list(self.finished)
+        self.reset()
+        self.finished.extend(finished)   # retired output survives
+        seam = getattr(exc, "seam", None) or "unknown"
+        now = time.time()
+        victims: List[Request] = []
+        seen = set()
+        for req in residents + chunkers + queued:
+            if req.done or req.rid in seen:
+                continue
+            seen.add(req.rid)
+            victims.append(req)
+        for req in victims:
+            in_flight = (req.slot is not None
+                         or req.rid in chunker_rids)
+            # reset() wiped the tables/pins wholesale — scrub the
+            # per-request mirrors WITHOUT the release paths (a decref
+            # or unpin now would double-free against the wiped state).
+            req.slot = None
+            req.adapter_pinned = False
+            req.adapter_slot = 0
+            if in_flight:
+                req.recoveries += 1
+                # The re-prefill wait is a named stall episode: the
+                # ledger's queue-ish gaps consume it into the
+                # ``stall_recover`` phase, closed by the next claim.
+                self._mark_stall(req, "recover")
+            self._requeue(req)
+        self.waiting.reverse()           # _requeue prepends; restore order
+        ENGINE_RECOVERIES.labels(seam=seam).inc()
+        fl = self.flight
+        if fl is not None and fl.enabled:
+            fl.record(
+                "recover", ts_s=now, dur_s=0.0,
+                program={"layout": "paged" if self.paged else "contig",
+                         "seam": seam},
+                slots=[], rids=[r.rid for r in victims],
+                toks=0, n_victims=len(victims))
+        self._update_gauges()
+        return len(victims)
+
     def step_burst(self, max_burst: int = 8,
                    on_wave=None) -> Dict[int, List[int]]:
         """Admit, run ONE prefill chunk if any are queued (chunk ->
@@ -2964,6 +3156,11 @@ class InferenceEngine:
         K = self.spec_k
         if not self.slot_req or K <= 0:
             return None
+        with _dispatch_boundary("verify"):
+            return self._spec_decode_burst_impl()
+
+    def _spec_decode_burst_impl(self) -> Optional[Dict[int, List[int]]]:
+        K = self.spec_k
         draft = np.zeros((self.n_slots + 1, K), np.int32)
         n_draft = np.zeros((self.n_slots + 1,), np.int32)
         dlen: Dict[int, int] = {}
@@ -3169,6 +3366,11 @@ class InferenceEngine:
         """
         if not self.slot_req:
             return None
+        with _dispatch_boundary("decode"):
+            return self._dispatch_decode_burst_impl(max_burst)
+
+    def _dispatch_decode_burst_impl(self, max_burst: int
+                                    ) -> Optional["BurstHandle"]:
         # Cap the burst so no active slot's cache can overflow (counting
         # dispatched-but-uncommitted tokens), then round down to a power
         # of two: each distinct k compiles its own program, so the
@@ -3227,6 +3429,11 @@ class InferenceEngine:
         completion are skipped (their surplus tokens are discarded);
         slots a lazy dry pool kept out of the burst simply have no
         part and emit nothing this round."""
+        with _dispatch_boundary("decode"):
+            return self._complete_decode_burst_impl(handle)
+
+    def _complete_decode_burst_impl(self, handle: "BurstHandle"
+                                    ) -> Dict[int, List[int]]:
         fetched = [(np.asarray(toks_dev), slots)
                    for toks_dev, slots in handle.parts]
         if handle.span is not None:
@@ -3292,7 +3499,7 @@ class InferenceEngine:
             # completion could free blocks, so an all-slots-unbackable
             # round is a genuine wedge — raise like run_to_completion,
             # never spin silently.
-            raise RuntimeError(
+            raise KvPoolWedgedError(
                 "KV block pool exhausted: lazy growth cannot back any "
                 "active slot — size SKYTPU_KV_BLOCKS for the live "
                 "working set or disable SKYTPU_KV_LAZY")
@@ -3346,7 +3553,7 @@ class InferenceEngine:
                         or len(self.finished) > before)
             stalled = 0 if progress else stalled + 1
             if self.kv_lazy and self.slot_req and stalled > 2:
-                raise RuntimeError(
+                raise KvPoolWedgedError(
                     "KV block pool exhausted: lazy growth cannot back "
                     "any active slot and nothing can retire — size "
                     "SKYTPU_KV_BLOCKS for the live working set or "

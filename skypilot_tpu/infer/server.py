@@ -37,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 from socketserver import ThreadingMixIn
 from typing import Dict, Optional
 
+from skypilot_tpu import chaos
 from skypilot_tpu.infer import qos as qos_lib
 from skypilot_tpu.observability import flight as flight_lib
 from skypilot_tpu.observability import health as health_lib
@@ -65,6 +66,11 @@ BURST_FLUSHES = metrics.counter(
 WAVE_FLUSH_SECONDS = metrics.histogram(
     "skytpu_server_wave_flush_seconds",
     "Post-admission-wave flush (stream first tokens + re-drain inbox)")
+SERVER_DRAINING = metrics.gauge(
+    "skytpu_server_draining",
+    "1 while this replica is draining (POST /drain received: new "
+    "admissions get a typed 503, in-flight requests finish, /healthz "
+    "reports 'draining' so the LB and controller stop routing here)")
 
 
 class _Pending:
@@ -158,6 +164,24 @@ class ModelServer:
         self._pending: Dict[int, _Pending] = {}   # loop-thread only
         self._ready = threading.Event()
         self._stop = threading.Event()
+        # Graceful drain (docs/robustness.md §Replica loss & rolling
+        # update): once draining, new admissions get a typed 503 and
+        # in-flight requests run to completion; past the deadline the
+        # replica self-reports DEGRADED so `skytpu status --health`
+        # exits 2. Flags are written by handler threads and read
+        # everywhere — benign un-locked reads, same as queue_depth().
+        self._draining = False
+        self._drain_deadline_s = 0.0
+        # Engine crash-recovery storm guard: recover at most
+        # ``_storm_limit`` times per ``_storm_window_s`` rolling
+        # window, then fall back to fail-all + reset (a device that
+        # keeps crashing needs replacement, not an infinite
+        # recover/crash loop that never fails a request visibly).
+        self._storm_limit = int(os.environ.get(
+            "SKYTPU_RECOVERY_STORM_LIMIT", "3"))
+        self._storm_window_s = float(os.environ.get(
+            "SKYTPU_RECOVERY_STORM_WINDOW_S", "30"))
+        self._recover_times: list = []    # loop-thread only
         # Off-thread event-log heartbeat: engine spans become durable
         # (visible to a separate-process `skytpu trace`) within ~5s of
         # recording, and the O(ring) flush serialization never runs on
@@ -175,6 +199,34 @@ class ModelServer:
         check needs no exactness, and taking the loop's locks here
         would serialize admission behind decode."""
         return len(self._inbox) + len(self._pending)
+
+    # -- graceful drain ----------------------------------------------------
+
+    def start_drain(self, grace_s: float = 30.0) -> Dict:
+        """Enter (or re-poll) the draining state: idempotent — the
+        first call stamps the deadline, repeats just report progress,
+        so the controller polls `POST /drain` until ``drained``."""
+        if not self._draining:
+            self._draining = True
+            self._drain_deadline_s = time.time() + max(grace_s, 0.0)
+            SERVER_DRAINING.set(1)
+            tracing.add_event(
+                "server.draining",
+                {"in_flight": self.queue_depth(),
+                 "grace_s": grace_s}, echo=True)
+        return self.drain_status()
+
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain_status(self) -> Dict:
+        depth = self.queue_depth()
+        return {
+            "draining": self._draining,
+            "in_flight": depth,
+            "drained": self._draining and depth == 0,
+            "deadline_s": round(self._drain_deadline_s, 3),
+        }
 
     def _add(self, tokens, max_new_tokens: int,
              stream: bool = False, trace_ctx=None,
@@ -265,15 +317,27 @@ class ModelServer:
             except Exception as e:  # noqa: BLE001 — fail the in-flight
                 # requests loudly; never let the serving thread die
                 # while /health reports ok.
-                # The engine's waiting/slot_req still hold the poisoned
-                # requests — left in place, every subsequent step would
-                # re-drive them and fail all future traffic with the
-                # same error (advisor r3). Reset the slot state; if even
-                # that fails the device is gone: flip /health to 503 so
-                # the LB stops routing here. Health flips BEFORE the
-                # pending events fire: a client reacting to its failed
-                # request must not race a still-green /health.
                 self._burst = None   # poisoned in-flight burst, if any
+                # Crash RECOVERY first (docs/robustness.md): a typed
+                # recoverable dispatch failure resets the engine and
+                # re-queues every in-flight request through the
+                # preemption resume path — the _pending entries (and
+                # their Request objects) survive, so open streams
+                # continue gapless and greedy output stays
+                # bit-identical. The storm guard keeps a persistently
+                # dying device from recover-looping forever.
+                if self._try_recover(e):
+                    continue
+                # Unrecoverable (or storming): fail the in-flight
+                # requests. The engine's waiting/slot_req still hold
+                # the poisoned requests — left in place, every
+                # subsequent step would re-drive them and fail all
+                # future traffic with the same error (advisor r3).
+                # Reset the slot state; if even that fails the device
+                # is gone: flip /health to 503 so the LB stops routing
+                # here. Health flips BEFORE the pending events fire: a
+                # client reacting to its failed request must not race
+                # a still-green /health.
                 try:
                     self.engine.reset()
                 except Exception as e2:  # noqa: BLE001
@@ -294,6 +358,39 @@ class ModelServer:
                 busy = False
             if not busy:
                 time.sleep(0.002)
+
+    def _try_recover(self, e: BaseException) -> bool:
+        """Attempt engine crash recovery for a typed recoverable
+        dispatch failure. Loop-thread only. Returns True when the
+        engine reset and re-queued its in-flight requests (the step
+        loop just continues); False routes to the fail-all path."""
+        if not (getattr(e, "recoverable", False)
+                and hasattr(self.engine, "recover")):
+            return False
+        now = time.monotonic()
+        self._recover_times = [
+            t for t in self._recover_times
+            if now - t < self._storm_window_s]
+        if len(self._recover_times) >= self._storm_limit:
+            tracing.add_event(
+                "server.recovery_storm",
+                {"recoveries": len(self._recover_times),
+                 "window_s": self._storm_window_s,
+                 "error": str(e)}, echo=True)
+            return False
+        self._recover_times.append(now)
+        try:
+            n = self.engine.recover(e)
+        except Exception as e2:  # noqa: BLE001 — reset itself failed;
+            # the fail-all path will retry it and flip health.
+            tracing.add_event("server.engine_recover_failed",
+                              {"error": str(e2)}, echo=True)
+            return False
+        tracing.add_event(
+            "server.engine_recovered",
+            {"seam": getattr(e, "seam", None), "victims": n,
+             "error": str(e)}, echo=True)
+        return True
 
     def _drain_inbox(self) -> None:
         with self._inbox_lock:
@@ -467,6 +564,10 @@ class ModelServer:
                 # QoS: how often this request was preempted-by-
                 # eviction and resumed (0 on the single-tenant path).
                 "preemptions": getattr(req, "preemptions", 0),
+                # Fault tolerance: engine crash recoveries this
+                # request rode through (re-admitted via the same
+                # resume path, output bit-identical).
+                "recoveries": getattr(req, "recoveries", 0),
                 # Adapter catalog: which fine-tune generated this
                 # (None = the base model).
                 "model": getattr(req, "adapter", None),
@@ -484,7 +585,9 @@ class ModelServer:
                                   getattr(req, "spec_mode", None)
                                   or "off",
                               "preemptions":
-                                  getattr(req, "preemptions", 0)})
+                                  getattr(req, "preemptions", 0),
+                              "recoveries":
+                                  getattr(req, "recoveries", 0)})
             p.event.set()
         if self.engine.finished:
             PENDING_REQUESTS.set(len(self._pending))
@@ -504,7 +607,7 @@ class _Threading(ThreadingMixIn, HTTPServer):
 
 
 _KNOWN_ROUTES = frozenset({"/health", "/healthz", "/metrics",
-                           "/generate", "/debug/flight",
+                           "/generate", "/drain", "/debug/flight",
                            "/debug/forensics"})
 
 
@@ -539,12 +642,29 @@ def make_handler(model: ModelServer):
         def do_GET(self):
             self._t0 = time.monotonic()
             if self.path == "/health":
+                if model._draining:
+                    # 503 stops the LB/controller routing here — the
+                    # point of the drain; in-flight work continues.
+                    return self._json(503, {"status": "draining"},
+                                      headers={"Retry-After": "1"})
                 if model._ready.is_set():
                     return self._json(200, {"status": "ok"})
                 return self._json(503, {"status": "warming"})
             if self.path == "/healthz":
                 # The fleet health model's shape: always 200 (the
                 # probe succeeded), status carries the verdict.
+                if model._draining:
+                    depth = model.queue_depth()
+                    past = (depth > 0
+                            and time.time() > model._drain_deadline_s)
+                    health_lib.write_healthz(
+                        self,
+                        health_lib.DEGRADED if past
+                        else health_lib.DRAINING,
+                        reason=(f"draining past deadline "
+                                f"({depth} in flight)" if past
+                                else f"draining ({depth} in flight)"))
+                    return self._observe(200)
                 ready = model._ready.is_set()
                 health_lib.write_healthz(
                     self,
@@ -678,7 +798,17 @@ def make_handler(model: ModelServer):
             code = 200
             try:
                 for chunk in chunks:
+                    # Chaos: a replica.kill fault here drops the
+                    # connection mid-stream with NO terminal chunk —
+                    # to the LB this replica just got SIGKILLed, which
+                    # is exactly what the mid-stream failover path
+                    # must recover from.
+                    chaos.point("replica.kill", route="/generate")
                     write_chunk(json.dumps(chunk).encode() + b"\n")
+            except chaos.ChaosError:
+                code = 500
+                self.close_connection = True
+                return
             except ConnectionError:
                 # Client went away mid-stream (broken pipe OR a reset —
                 # flaky LBs produce both): count it as 499 (client
@@ -694,8 +824,46 @@ def make_handler(model: ModelServer):
 
         def do_POST(self):
             self._t0 = time.monotonic()
+            # Chunked request bodies have no Content-Length; reading
+            # them is unimplemented, and NOT reading them would leave
+            # unread bytes on a keep-alive socket — the next request
+            # on the connection would parse the stale body as its
+            # request line. 411 + close is the honest answer.
+            if "chunked" in (self.headers.get("Transfer-Encoding")
+                             or "").lower():
+                self.close_connection = True
+                return self._json(411, {"error": {
+                    "type": "length_required",
+                    "message": "chunked request bodies are not "
+                               "supported; send Content-Length"}})
+            if self.path == "/drain":
+                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(length)
+                                      or b"{}")
+                    grace = float(body.get("grace_s", 30.0))
+                except (ValueError, TypeError, AttributeError):
+                    return self._json(
+                        400, {"error": "bad drain request"})
+                return self._json(200, model.start_drain(grace))
             if self.path != "/generate":
                 return self._json(404, {"error": "not found"})
+            if model._draining:
+                # Typed drain shed: the LB treats the 503 as a
+                # connection-level failure and retries the request on
+                # a surviving replica; direct clients back off per
+                # Retry-After. Consume the body first — an unread
+                # body on a keep-alive socket corrupts the NEXT
+                # request on the connection.
+                self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0))
+                return self._json(
+                    503,
+                    {"error": {
+                        "type": "draining",
+                        "message": "replica is draining; retry "
+                                   "against another replica"}},
+                    headers={"Retry-After": "1"})
             length = int(self.headers.get("Content-Length") or 0)
             try:
                 body = json.loads(self.rfile.read(length) or b"{}")

@@ -86,7 +86,8 @@ def forensics_enabled() -> bool:
 # coverage to >= 90% of the wall.
 PHASE_ORDER: Tuple[str, ...] = (
     "queue_wait", "stall_pool_dry", "stall_kv_quota",
-    "stall_adapter_pin", "preempt_requeue", "prefill_wave",
+    "stall_adapter_pin", "stall_recover", "preempt_requeue",
+    "prefill_wave",
     "prefill_chunk", "prefill_interleave", "decode_device",
     "decode_host", "spec_draft", "spec_verify_device",
     "spec_verify_host", "deliver", "host_other")
@@ -97,7 +98,10 @@ _UNNAMED = frozenset({"host_other"})
 # order queue-ish gaps consume them.
 STALL_PHASES = {"pool_dry": "stall_pool_dry",
                 "kv_quota": "stall_kv_quota",
-                "adapter_pin": "stall_adapter_pin"}
+                "adapter_pin": "stall_adapter_pin",
+                # Engine crash recovery: the request sat requeued while
+                # the engine reset and re-admitted its cohort.
+                "recover": "stall_recover"}
 
 _DECODEISH = frozenset({"decode", "decode1", "verify", "draft"})
 
@@ -162,7 +166,7 @@ def build_ledger(retire: Dict[str, Any],
                         if c in STALL_PHASES}
 
     def add_queueish(gap_ms: float, phase: str) -> None:
-        for cause in ("pool_dry", "kv_quota", "adapter_pin"):
+        for cause in ("pool_dry", "kv_quota", "adapter_pin", "recover"):
             left = remaining_stalls.get(cause, 0.0)
             if left <= 0.0 or gap_ms <= 0.0:
                 continue
@@ -192,7 +196,10 @@ def build_ledger(retire: Dict[str, Any],
         if gap_ms > 0.0:
             if prev_kind is None:
                 add_queueish(gap_ms, "queue_wait")
-            elif prev_kind == "preempt":
+            elif prev_kind in ("preempt", "recover"):
+                # Crash recovery rides the preemption resume path, so
+                # its post-reset wait classifies the same way (the
+                # recover stall total is consumed first above).
                 add_queueish(gap_ms, "preempt_requeue")
             elif prev_kind == "chunk" and kind == "chunk":
                 # Interleaved decode bursts of OTHER slots ran between

@@ -30,6 +30,12 @@ import urllib.request
 from typing import Any, Dict, List, Optional
 
 HEALTHY = "healthy"
+# Draining: deliberately refusing NEW work while in-flight requests
+# finish (graceful drain ahead of a rolling-update kill). Healthier
+# than degraded — it is a planned state, not an impairment — but the
+# rollup still surfaces it so an operator sees the drain in progress.
+# A replica draining PAST its deadline self-reports degraded instead.
+DRAINING = "draining"
 DEGRADED = "degraded"
 DEAD = "dead"
 
@@ -78,7 +84,7 @@ def probe_http(url: str, timeout: float = 2.0,
         except ValueError:
             payload = {}
         status = payload.get("status", HEALTHY)
-        if status not in (HEALTHY, DEGRADED, DEAD):
+        if status not in (HEALTHY, DRAINING, DEGRADED, DEAD):
             # /health-style {"status": "ok"} answers map onto the model.
             status = HEALTHY if status in ("ok", "healthy") else DEGRADED
         return component(comp, instance, status,
@@ -186,6 +192,16 @@ def _probe_replica(r: Dict[str, Any], name: str,
     if status_v in ("FAILED", "PREEMPTED", "SHUTDOWN", "SHUTTING_DOWN"):
         return component("model-server", inst, DEAD,
                          reason=status_v.lower())
+    if status_v == "DRAINING":
+        # Probe the replica itself: within its drain deadline it
+        # self-reports "draining"; past it, "degraded" — which is what
+        # flips `skytpu status --health` to exit 2. A gone replica
+        # reads dead as usual.
+        if not r["url"]:
+            return component("model-server", inst, DRAINING,
+                             reason="draining")
+        return probe_http(f"{r['url']}/healthz", timeout=timeout,
+                          comp="model-server", instance=inst)
     if not r["url"]:
         return component("model-server", inst, DEGRADED,
                          reason="no url yet")
@@ -261,8 +277,11 @@ def fleet_health(api_self: Optional[Dict[str, Any]] = None,
 
 
 def worst(components: List[Dict[str, Any]]) -> str:
-    """Fleet-level rollup: dead beats degraded beats healthy."""
-    rank = {HEALTHY: 0, DEGRADED: 1, DEAD: 2}
+    """Fleet-level rollup: dead beats degraded beats draining beats
+    healthy. A fleet whose worst component is merely draining is
+    executing a planned drain — visible, but not an incident (the CLI
+    exits 0 on it; degraded/dead exit 2)."""
+    rank = {HEALTHY: 0, DRAINING: 1, DEGRADED: 2, DEAD: 3}
     status = HEALTHY
     for c in components:
         if rank.get(c["status"], 0) > rank[status]:

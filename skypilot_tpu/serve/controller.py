@@ -130,7 +130,8 @@ def run(service_name: str) -> int:
                         and r["status"] not in (ReplicaStatus.FAILED,
                                                 ReplicaStatus.SHUTDOWN,
                                                 ReplicaStatus.PREEMPTED,
-                                                ReplicaStatus.SHUTTING_DOWN)]
+                                                ReplicaStatus.SHUTTING_DOWN,
+                                                ReplicaStatus.DRAINING)]
             target = apply_scaling(autoscaler, manager,
                                    serve_state.qps(service_name),
                                    len(ready), len(alive), cur_live)

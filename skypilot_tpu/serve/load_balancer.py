@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import hashlib
+import http.client
 import http.server
 import itertools
 import json
@@ -48,6 +49,14 @@ LB_RETRIES = metrics.counter(
     "Forward attempts that failed and triggered failover to another "
     "replica (or the terminal 503), by backend",
     labelnames=("backend",))
+LB_FAILOVERS = metrics.counter(
+    "skytpu_lb_failovers_total",
+    "Streaming /generate upstreams lost and failed over to a "
+    'surviving replica, by phase ("connect" = died before any token '
+    'streamed, "mid_stream" = died with tokens already committed; '
+    "the resume replays prompt + committed tokens with the budget "
+    "reduced, so the client sees one gapless sequence)",
+    labelnames=("phase",))
 
 
 class _UpstreamPool:
@@ -116,6 +125,73 @@ class _ChunkedTracker:
                 self._last = True
             else:
                 self._data = size + 2   # chunk data + trailing CRLF
+
+
+class _UpstreamError(Exception):
+    """Deterministic non-200 answer on the failover stream path (a
+    validation 4xx or a typed shed): carried out of the generator so
+    the caller can forward it verbatim when nothing has streamed yet."""
+
+    def __init__(self, status: int, headers, body: bytes):
+        super().__init__(f"upstream {status}")
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+
+class _ClientGone(Exception):
+    """The DOWNSTREAM client hung up mid-stream: abort without burning
+    failover attempts on replicas that cannot help."""
+
+
+def _upstream_ndjson(base_url: str, path: str, payload: bytes,
+                     req_headers):
+    """POST a streaming ``/generate`` to one replica and yield parsed
+    NDJSON objects as they arrive. ``http.client`` decodes the chunked
+    framing here — the splice path's raw tracker never extracts payload
+    bytes, and failover needs the token VALUES, not just the framing.
+    Raises ``ConnectionError`` on connect failure, 5xx, or a connection
+    that dies before the terminal chunk (a replica SIGKILL mid-stream
+    surfaces as exactly that); :class:`_UpstreamError` carries any
+    other non-200 so the caller can forward it. Sockets are not
+    pooled on this path: a streaming generation holds its connection
+    for the whole decode, so the handshake is noise next to it."""
+    parts = urlsplit(base_url)
+    conn = http.client.HTTPConnection(parts.hostname or "",
+                                      parts.port or 80, timeout=120)
+    try:
+        hdrs = {k: v for k, v in req_headers.items()
+                if k.lower() not in _HOP_HEADERS | {"content-length"}}
+        try:
+            conn.request("POST", path, body=payload, headers=hdrs)
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            raise ConnectionError(
+                f"upstream connect failed: {e}") from e
+        if resp.status >= 500:
+            raise ConnectionError(f"upstream {resp.status}")
+        if resp.status != 200:
+            raise _UpstreamError(resp.status, resp.getheaders(),
+                                 resp.read())
+        while True:
+            try:
+                line = resp.readline()
+            except (OSError, http.client.HTTPException) as e:
+                # Premature close before the terminal chunk: the
+                # replica died mid-generation (IncompleteRead).
+                raise ConnectionError(
+                    f"upstream died mid-stream: {e}") from e
+            if not line:
+                return
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue
+    finally:
+        conn.close()
 
 
 # Adapter-catalog routing (docs/serving.md §Adapter catalog): the
@@ -208,6 +284,14 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
     # injected sheds match on the body tenant in tests).
     qos_rates_body_tenant = qos is not None and (
         bool(qos.cfg.tenants) or qos.cfg.default_rate > 0)
+    # Mid-stream failover: streaming /generate requests leave the
+    # raw-splice path (which can never retry once bytes have moved)
+    # for a decoded NDJSON proxy that can resume a died-mid-stream
+    # generation on a surviving replica. Greedy-only semantics — the
+    # resume replays prompt + committed tokens, which is bit-identical
+    # only under greedy decoding. On by default; SKYTPU_LB_FAILOVER=0
+    # restores pure splice for streams.
+    failover_on = os.environ.get("SKYTPU_LB_FAILOVER", "1") != "0"
 
     class ProxyHandler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -219,6 +303,11 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
             Retry-After for retryable sheds), counted under
             backend="none" so fleet dashboards see LB-minted rejects
             next to replica answers."""
+            if code in (429, 503) and retry_after_s is None:
+                # Every retryable LB-minted shed carries Retry-After:
+                # a 429/503 without it strands well-behaved clients on
+                # their slowest default backoff.
+                retry_after_s = 1.0
             LB_PROXIED.labels(backend="none", code=str(code)).inc()
             body = json.dumps({"error": typed}).encode()
             self.send_response(code)
@@ -247,6 +336,18 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                         reason=f"{n_ready} ready replicas")
                 return health_lib.write_healthz(
                     self, health_lib.DEGRADED, reason="no ready replicas")
+            # Chunked request bodies have no Content-Length; reading
+            # them is unimplemented, and NOT reading them would leave
+            # unread bytes on the keep-alive connection — the next
+            # request would parse the stale body as its request line.
+            if "chunked" in (self.headers.get("Transfer-Encoding")
+                             or "").lower():
+                self.close_connection = True
+                return self._typed_reject(411, {
+                    "type": "length_required",
+                    "message": "chunked request bodies are not "
+                               "supported; send Content-Length",
+                }, retry_after_s=None)
             body = None
             length = int(self.headers.get("Content-Length") or 0)
             if length:
@@ -323,6 +424,16 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                         }, retry_after_s=None)
             serve_state.record_request(service)
             urls = serve_state.ready_urls(service)
+            if (failover_on and self.command == "POST"
+                    and route == "/generate" and body
+                    and b'"stream"' in body):
+                # Cheap byte scan first (the hot path must not JSON-
+                # decode every body), then a real parse to confirm.
+                fields = _body_json()
+                if (isinstance(fields, dict) and fields.get("stream")
+                        and isinstance(fields.get("tokens"), list)):
+                    return self._proxy_stream(urls, fields, model_name,
+                                              tenant)
             tried = []
             self._response_started = False
             for _ in range(min(max_retries, max(len(urls), 1))):
@@ -362,6 +473,178 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
             # bounce IS a shed — the qos-shed-rate SLO rule and the
             # `skytpu top` shed column must see the lb tier go dark,
             # not just its token-bucket rejects.
+            if qos is not None:
+                qos_lib.QOS_SHED.labels(
+                    tenant=qos_lib.tenant_label(tenant, qos.cfg),
+                    reason="overloaded", where="lb").inc()
+            self._typed_reject(503, {
+                "type": "overloaded",
+                "message": "no ready replicas",
+                "service": service,
+            })
+
+        def _proxy_stream(self, urls: List[str], fields: dict,
+                          model_name: Optional[str],
+                          tenant: str) -> None:
+            """Streaming ``/generate`` with MID-STREAM failover.
+
+            The splice path drops the connection when a replica dies
+            after the first forwarded byte — the client eats a
+            truncated stream. Here the LB decodes the NDJSON lines,
+            tracks every token that reached the client (``committed``),
+            and when the upstream dies it replays ``prompt + committed``
+            on a surviving replica with ``max_new_tokens`` reduced by
+            what already streamed. Greedy decoding makes the resumed
+            suffix bit-identical to what the dead replica would have
+            produced, so the client sees ONE gapless, duplicate-free
+            token sequence; the done line is patched to the stitched
+            total and carries the failover count.
+            """
+            try:
+                prompt = [int(t) for t in fields["tokens"]]
+                budget = int(fields.get("max_new_tokens", 64))
+            except (ValueError, TypeError):
+                # The replica tier owns request validation: let one
+                # replica mint the 400 (non-stream framing is fine —
+                # a malformed body never streams).
+                prompt, budget = [], 0
+            committed: List[int] = []
+            tried: List[str] = []
+            failovers = 0
+            headers_sent = False
+            self._response_started = False
+
+            def emit(obj: dict) -> None:
+                nonlocal headers_sent
+                data = json.dumps(obj).encode() + b"\n"
+                try:
+                    if not headers_sent:
+                        headers_sent = True
+                        self._response_started = True
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(data),
+                                                        data))
+                except ConnectionError as e:
+                    raise _ClientGone() from e
+
+            def finish(obj: dict, url: Optional[str]) -> None:
+                obj["n_tokens"] = len(committed)
+                obj["failovers"] = failovers
+                emit(obj)
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except ConnectionError:
+                    pass
+                LB_PROXIED.labels(backend=url or "none",
+                                  code="200").inc()
+
+            try:
+                for _ in range(min(max_retries, max(len(urls), 1))):
+                    cand = [u for u in urls if u not in tried]
+                    used_policy = not (model_name and len(cand) > 1)
+                    if used_policy:
+                        url = policy.select(cand)
+                    else:
+                        url = _affinity_url(model_name, cand)
+                    if url is None:
+                        break
+                    tried.append(url)
+                    replay = dict(fields)
+                    if committed:
+                        replay["tokens"] = prompt + committed
+                        replay["max_new_tokens"] = (budget
+                                                    - len(committed))
+                    try:
+                        # Same literal point as the splice path: one
+                        # chaos rule faults both forward flavors.
+                        chaos.point("serve.lb.forward", backend=url)
+                        for obj in _upstream_ndjson(
+                                url, self.path,
+                                json.dumps(replay).encode(),
+                                self.headers):
+                            if "done" in obj or "error" in obj:
+                                if used_policy:
+                                    policy.done(url)
+                                return finish(obj, url)
+                            toks = obj.get("tokens")
+                            if toks:
+                                committed.extend(int(t) for t in toks)
+                            emit(obj)
+                        # Stream ended with no done line and no
+                        # exception: the replica still died on us.
+                        raise ConnectionError(
+                            "upstream ended without done line")
+                    except _UpstreamError as e:
+                        if used_policy:
+                            policy.done(url)
+                        if not headers_sent:
+                            # Deterministic non-stream answer (4xx
+                            # validation / typed shed): forward
+                            # verbatim, same contract as the splice
+                            # path.
+                            LB_PROXIED.labels(
+                                backend=url, code=str(e.status)).inc()
+                            ebody = e.body
+                            self.send_response(e.status)
+                            for k, v in e.headers:
+                                if (k.lower() not in _HOP_HEADERS
+                                        and k.lower()
+                                        != "content-length"):
+                                    self.send_header(k, v)
+                            self.send_header("Content-Length",
+                                             str(len(ebody)))
+                            self.end_headers()
+                            self.wfile.write(ebody)
+                            return
+                        # A 4xx on the REPLAY (started stream): this
+                        # candidate cannot resume us — treat it as
+                        # lost and walk on.
+                        LB_RETRIES.labels(backend=url).inc()
+                        LB_FAILOVERS.labels(phase="mid_stream").inc()
+                        failovers += 1
+                    except ConnectionError:
+                        if used_policy:
+                            policy.done(url)
+                        LB_RETRIES.labels(backend=url).inc()
+                        LB_FAILOVERS.labels(
+                            phase=("mid_stream" if committed
+                                   or headers_sent else
+                                   "connect")).inc()
+                        failovers += 1
+                        if committed and len(committed) >= budget:
+                            # The dead replica delivered the full
+                            # budget but lost its done line: mint the
+                            # trailer here rather than replaying a
+                            # zero-budget generation.
+                            return finish({"done": True,
+                                           "lb_minted": True}, None)
+            except _ClientGone:
+                # Downstream hung up: nothing a surviving replica can
+                # do. 499 mirrors the replica-side accounting.
+                LB_PROXIED.labels(backend="none", code="499").inc()
+                self.close_connection = True
+                return
+            if headers_sent:
+                # Candidates exhausted mid-stream: end the chunked
+                # body CLEANLY with an in-stream typed error line, so
+                # the client sees a parseable failure, not a
+                # truncation it must infer from framing.
+                try:
+                    emit({"error": {
+                        "type": "upstream_lost",
+                        "message": "replica lost mid-stream; no "
+                                   "surviving replica could resume",
+                        "n_streamed": len(committed),
+                        "failovers": failovers}})
+                    self.wfile.write(b"0\r\n\r\n")
+                except (_ClientGone, ConnectionError):
+                    pass
+                LB_PROXIED.labels(backend="none", code="200").inc()
+                return
             if qos is not None:
                 qos_lib.QOS_SHED.labels(
                     tenant=qos_lib.tenant_label(tenant, qos.cfg),

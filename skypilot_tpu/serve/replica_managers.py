@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -97,6 +98,11 @@ class ReplicaManager:
         # decisions (preempted spot may come back as on-demand); the
         # probe loop then only marks/terminates, never relaunches.
         self.auto_replace = True
+        # Graceful-drain budget per terminated replica: how long the
+        # manager waits for in-flight requests to finish after the
+        # replica goes unroutable, before the actual kill.
+        self.drain_grace_s = float(
+            os.environ.get("SKYTPU_SERVE_DRAIN_GRACE_S", "30"))
 
     # -- rolling updates ---------------------------------------------------
     def apply_update(self, spec: SkyServiceSpec, task_config: dict,
@@ -125,11 +131,14 @@ class ReplicaManager:
 
     # -- scaling -----------------------------------------------------------
     def _live_replicas(self):
+        # DRAINING is already on its way out: it must not count toward
+        # capacity (scale decisions) nor be re-terminated every tick.
         return [r for r in serve_state.list_replicas(self.service)
                 if r["status"] not in (ReplicaStatus.SHUTTING_DOWN,
                                        ReplicaStatus.SHUTDOWN,
                                        ReplicaStatus.FAILED,
-                                       ReplicaStatus.PREEMPTED)]
+                                       ReplicaStatus.PREEMPTED,
+                                       ReplicaStatus.DRAINING)]
 
     def scale_to(self, target: int) -> None:
         # Launch decisions count only CURRENT-version replicas, so an
@@ -260,11 +269,30 @@ class ReplicaManager:
         ip = info.head.external_ip or info.head.internal_ip
         return f"http://{ip}:{port}"
 
-    def _terminate_replica(self, rid: int) -> None:
-        serve_state.set_replica_status(self.service, rid,
-                                       ReplicaStatus.SHUTTING_DOWN)
+    def _terminate_replica(self, rid: int, drain: bool = True) -> None:
+        """Drain-before-kill: a routable replica flips to DRAINING
+        first (instantly out of ``ready_urls``, so the LB stops
+        sending work BEFORE the kill), finishes its in-flight requests
+        via ``POST /drain`` polling, and only then tears down. Callers
+        whose replica cannot usefully drain (preempted — the cluster
+        is already gone; service teardown — the endpoint is going
+        away) pass ``drain=False`` for the immediate kill."""
+        row = [r for r in serve_state.list_replicas(self.service)
+               if r["replica_id"] == rid]
+        url = row[0]["url"] if row else None
+        do_drain = (drain and bool(url)
+                    and row[0]["status"] in (ReplicaStatus.READY,
+                                             ReplicaStatus.DRAINING))
+        serve_state.set_replica_status(
+            self.service, rid,
+            ReplicaStatus.DRAINING if do_drain
+            else ReplicaStatus.SHUTTING_DOWN)
 
         def do():
+            if do_drain:
+                self._drain_replica(url)
+                serve_state.set_replica_status(
+                    self.service, rid, ReplicaStatus.SHUTTING_DOWN)
             cluster = f"sky-serve-{self.service}-{rid}"
             rec = cluster_state.get_cluster(cluster)
             if rec is not None:
@@ -276,15 +304,45 @@ class ReplicaManager:
 
         self._pool.submit(do)
 
+    def _drain_replica(self, url: str) -> bool:
+        """``POST /drain`` and poll until the replica reports drained
+        or the grace budget runs out. Any transport/endpoint failure
+        returns False immediately — a replica that cannot answer
+        ``/drain`` gains nothing from the manager waiting on it."""
+        deadline = time.monotonic() + self.drain_grace_s
+
+        def poll() -> Optional[dict]:
+            try:
+                req = urllib.request.Request(
+                    url + "/drain",
+                    data=json.dumps(
+                        {"grace_s": self.drain_grace_s}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except Exception:  # noqa: BLE001 — no drain answer: kill
+                return None
+
+        st = poll()
+        if st is None:
+            return False
+        while not st.get("drained") and time.monotonic() < deadline:
+            time.sleep(0.2)
+            st = poll()
+            if st is None:
+                return False
+        return bool(st.get("drained"))
+
     def terminate_all(self) -> None:
         for r in serve_state.list_replicas(self.service):
-            self._terminate_replica(r["replica_id"])
+            self._terminate_replica(r["replica_id"], drain=False)
         self._pool.shutdown(wait=True)
 
     # -- probing -----------------------------------------------------------
     def probe_all(self) -> None:
         for r in serve_state.list_replicas(self.service):
             if r["status"] in (ReplicaStatus.PROVISIONING,
+                               ReplicaStatus.DRAINING,
                                ReplicaStatus.SHUTTING_DOWN,
                                ReplicaStatus.SHUTDOWN,
                                ReplicaStatus.FAILED):
@@ -296,7 +354,7 @@ class ReplicaManager:
                 # replacement's type instead (on-demand backfill).
                 serve_state.set_replica_status(self.service, rid,
                                                ReplicaStatus.PREEMPTED)
-                self._terminate_replica(rid)
+                self._terminate_replica(rid, drain=False)
                 if self.auto_replace:
                     self._launch_replica(
                         use_spot=r.get("is_spot") or None)

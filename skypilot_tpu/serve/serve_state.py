@@ -36,6 +36,11 @@ class ReplicaStatus(enum.Enum):
     STARTING = "STARTING"
     READY = "READY"
     NOT_READY = "NOT_READY"
+    # Graceful drain ahead of a rolling-update / scale-down kill: the
+    # replica finishes its in-flight requests while the LB no longer
+    # routes to it (ready_urls is READY-only, so the flip to DRAINING
+    # is instantly unroutable — before the kill, not after).
+    DRAINING = "DRAINING"
     FAILED = "FAILED"
     PREEMPTED = "PREEMPTED"
     SHUTTING_DOWN = "SHUTTING_DOWN"
