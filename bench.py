@@ -649,6 +649,62 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — train metric must print
             log(f"flight bench failed: {e}")
             out["serve_flight_error"] = str(e)[:200]
+        # Fleet prefix-affinity phase: consistent-hash routing on the
+        # chunk-aligned prefix digest through the real LB. Gates:
+        # fleet prefix hit rate >= 0.8 under affinity (the least-load
+        # control lands near 1/N), warm TTFT >= 30% below cold, and
+        # greedy parity between the cold and warm passes.
+        try:
+            from skypilot_tpu.infer import bench_serve as _bs
+            af = _bs.run_affinity(config=serve_cfg, weights_int8=big,
+                                  kv_int8=big)
+            out["serve_affinity_hit_rate"] = af["affinity_hit_rate"]
+            out["serve_affinity_control_hit_rate"] = \
+                af["control_hit_rate"]
+            out["serve_affinity_cold_ttft_ms"] = af["cold_ttft_ms"]
+            out["serve_affinity_warm_ttft_ms"] = af["warm_ttft_ms"]
+            out["serve_affinity_parity_ok"] = af["parity_ok"]
+            out["serve_affinity_regressed"] = not af["gate_ok"]
+            if not af["gate_ok"]:
+                log("SERVE AFFINITY REGRESSION: hit rate "
+                    f"{af['affinity_hit_rate']} (< 0.8) or warm "
+                    f"{af['warm_ttft_ms']}ms vs cold "
+                    f"{af['cold_ttft_ms']}ms (< 30% saving) or "
+                    f"parity broken ({af['parity_ok']})")
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"affinity bench failed: {e}")
+            out["serve_affinity_error"] = str(e)[:200]
+        # Disaggregated prefill/decode phase: 1-prefill + 2-decode
+        # fleet behind the real LB. Gates: two-tier output
+        # bit-identical to single-tier across {fp32, int8 KV} x
+        # {spec on/off}, decode-tier TPOT under heavy prefill <= 1.1x
+        # idle (TPU only; the single-tier interleave ratio rides
+        # along as the contrast), zero unexpected compiles on either
+        # tier, and the handoff.transfer chaos retry with zero lost
+        # requests and zero leaked prefill-tier blocks.
+        try:
+            from skypilot_tpu.infer import bench_serve as _bs
+            dg = _bs.run_disagg(config=serve_cfg)
+            out["serve_disagg_parity_ok"] = dg["parity_ok"]
+            out["serve_disagg_isolation_ratio"] = \
+                dg["isolation_ratio"]
+            out["serve_disagg_single_tier_ratio"] = \
+                dg["single_tier_ratio"]
+            out["serve_disagg_chaos_parity_ok"] = \
+                dg["chaos_parity_ok"]
+            out["serve_disagg_leaked_blocks"] = dg["leaked_blocks"]
+            out["serve_disagg_unexpected_compiles"] = \
+                dg["unexpected_compiles"]
+            out["serve_disagg_regressed"] = not dg["gate_ok"]
+            if not dg["gate_ok"]:
+                log("SERVE DISAGG REGRESSION: parity "
+                    f"{dg['parity_ok']}/{dg['chaos_parity_ok']}, "
+                    f"isolation x{dg['isolation_ratio']} (> 1.1), "
+                    f"leaked={dg['leaked_blocks']}, "
+                    f"unexpected={dg['unexpected_compiles']}")
+        except Exception as e:  # noqa: BLE001 — train metric must print
+            log(f"disagg bench failed: {e}")
+            out["serve_disagg_error"] = str(e)[:200]
     if args.emit_metrics:
         from skypilot_tpu.observability import metrics as obs_metrics
         # Only families something actually recorded into: a bench run

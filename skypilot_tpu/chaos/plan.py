@@ -68,6 +68,13 @@ KNOWN_POINTS: Dict[str, str] = {
         "paged KV block allocation (admission claim, lazy per-burst "
         "growth); a fault rides the enclosing dispatch seam's "
         "recovery path (ctx: need)",
+    "handoff.transfer":
+        "disaggregated prefill->decode KV handoff, per decode-replica "
+        "attempt at the load balancer; a fault simulates the decode "
+        "replica dying mid-transfer — the export (held in LB memory) "
+        "retries on a surviving decode replica, the prefill tier "
+        "keeps its refcounted copy, zero requests lost and zero "
+        "blocks leaked (ctx: backend)",
     "replica.kill":
         "model-server streaming response mid-flight; a fault drops "
         "the client connection with no terminal chunk — the replica "

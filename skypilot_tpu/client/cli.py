@@ -524,6 +524,19 @@ def _top_frame(prev, prev_ts, fams, now, payload):
             if cache_rate is not None:
                 serve["prefix_cache_hit_rate"] = cache_rate
                 line += f"  cache {cache_rate:4.0%}"
+            # Per-replica spread of the lifetime hit ratio (the
+            # skytpu_prefix_cache_hit_ratio GAUGE keeps instance
+            # labels through federation, unlike the summed counters):
+            # prefix-affinity routing is supposed to close this
+            # spread — a wide one means families are landing on cold
+            # replicas. Shown only when replicas actually disagree.
+            lo = gauge("skytpu_prefix_cache_hit_ratio", agg="min")
+            hi = gauge("skytpu_prefix_cache_hit_ratio", agg="max")
+            if lo is not None and hi is not None:
+                serve["prefix_cache_hit_min"] = lo
+                serve["prefix_cache_hit_max"] = hi
+                if hi - lo >= 0.01:
+                    line += f" [{lo:.0%}..{hi:.0%}]"
         # Adapter catalog (docs/serving.md §Adapter catalog): resident
         # fine-tunes / pool capacity fleet-wide, plus the hot-load
         # rate when demand loads happened between frames — catalog
@@ -652,6 +665,23 @@ def _top_frame(prev, prev_ts, fams, now, payload):
         lines.append(
             f"lb      proxied {f_rate(proxied)}"
             f"  retries {f_rate(retries)}")
+    # Disaggregated serving tiers (docs/serving.md §Disaggregated
+    # serving): per-tier request rates, the prefill->decode handoff
+    # rate, and the handoff p95 — the line appears only once a
+    # disaggregated service has routed traffic.
+    if "skytpu_lb_tier_requests_total" in have:
+        pf = rate("skytpu_lb_tier_requests_total",
+                  match={"tier": "prefill"})
+        dc = rate("skytpu_lb_tier_requests_total",
+                  match={"tier": "decode"})
+        ho = rate("skytpu_lb_handoffs_total", match={"result": "ok"})
+        hp95 = aggregate.histogram_quantile(
+            prev, fams, "skytpu_handoff_seconds", 0.95)
+        data["tiers"] = {"prefill_per_s": pf, "decode_per_s": dc,
+                         "handoff_per_s": ho, "handoff_p95_s": hp95}
+        lines.append(
+            f"tiers   prefill {f_rate(pf)}  decode {f_rate(dc)}"
+            f"  handoff {f_rate(ho)}  p95 {f_ms(hp95)}")
     if "skytpu_api_requests_total" in have:
         busy = gauge("skytpu_api_workers_busy")
         api_rate = rate("skytpu_api_requests_total")

@@ -88,6 +88,12 @@ PREFIX_MISSES = metrics.counter(
 PREFIX_EVICTIONS = metrics.counter(
     "skytpu_prefix_cache_evictions_total",
     "Prefix-pool rows evicted (LRU) to admit a new prefix")
+PREFIX_HIT_RATIO = metrics.gauge(
+    "skytpu_prefix_cache_hit_ratio",
+    "Lifetime fraction of prefix-eligible admissions that reused a "
+    "resident prefix (hits / (hits + misses); 0 until the first "
+    "eligible admission) — a gauge so fleet aggregation keeps the "
+    "per-replica spread affinity routing is supposed to close")
 PREFILL_CHUNKS = metrics.counter(
     "skytpu_prefill_chunks_total",
     "Chunked-prefill device calls (one fixed-size chunk each, "
@@ -889,6 +895,13 @@ class InferenceEngine:
         self._fl_cow = 0
         self._fl_evictions = 0
         self._fl_lazy_grows = 0
+        # Lifetime prefix-cache hit/miss tallies (loop-thread only)
+        # backing the skytpu_prefix_cache_hit_ratio gauge — a gauge,
+        # not two counters, so the fleet aggregator can show the
+        # per-replica min/max spread that makes affinity skew visible
+        # (counters are summed across instances; gauges keep theirs).
+        self._prefix_hit_n = 0
+        self._prefix_miss_n = 0
         # Request forensics (observability/forensics.py): one
         # retirement record per request (the ledger's anchor) plus
         # streaming P2 tail detection on TTFT/TPOT that pins crossing
@@ -1197,6 +1210,20 @@ class InferenceEngine:
         def _copy_block(cache, src, dst):
             return kvcache.copy_block(cache, src, dst)
 
+        # Cross-replica KV handoff (docs/serving.md §Disaggregated
+        # serving): gather a stored prefix's physical blocks to host,
+        # scatter them into a receiving replica's pool. The index
+        # vector is FIXED-width (blocks_per_slot, sentinel-padded), so
+        # each direction is one compiled program for the engine's
+        # lifetime — a handoff can never hit a mid-traffic compile.
+        @jax.jit
+        def _export_blocks(cache, idx):
+            return kvcache.export_blocks(cache, idx)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _import_blocks(cache, idx, vals):
+            return kvcache.import_blocks(cache, idx, vals)
+
         # Adapter hot-load: scatter one fine-tune's stacked (A, B)
         # weights into a pool slot (pool donated — the install is in
         # place). Weight shapes are pool constants, so ONE program
@@ -1229,6 +1256,8 @@ class InferenceEngine:
         self._pool_load_fn = watch("pool_load", _pool_load)
         self._pool_store_fn = watch("pool_store", _pool_store)
         self._copy_block_fn = watch("copy_block", _copy_block)
+        self._export_blocks_fn = watch("export_blocks", _export_blocks)
+        self._import_blocks_fn = watch("import_blocks", _import_blocks)
         self._adapter_install_fn = watch("adapter_load",
                                          _adapter_install)
         if self.adapters is not None:
@@ -1263,7 +1292,8 @@ class InferenceEngine:
                     trace_ctx: Optional[tracing.SpanContext] = None,
                     tenant: str = qos_lib.DEFAULT_TENANT,
                     priority: int = 0,
-                    adapter: Optional[str] = None) -> int:
+                    adapter: Optional[str] = None,
+                    committed: Optional[List[int]] = None) -> int:
         _bucket(len(prompt), self.buckets)   # validate length up front
         self.check_kv_quota(tenant, len(prompt), max_new_tokens)
         self.check_adapter(adapter)          # unknown name -> typed 404
@@ -1271,6 +1301,15 @@ class InferenceEngine:
                       max_new_tokens=max_new_tokens, submit_s=time.time(),
                       eos_id=self.eos_id, tenant=tenant,
                       priority=priority, adapter=adapter)
+        if committed:
+            # Disaggregated handoff: tokens another replica already
+            # committed (and streamed) ride in pre-seeded, so this
+            # request admits through the SAME prompt+committed resume
+            # path preemption and crash recovery use — the suffix it
+            # decodes is bit-identical to finishing on the origin
+            # replica, and max_new_tokens keeps its original meaning
+            # (the budget counts the committed tokens).
+            req.tokens = [int(t) for t in committed]
         # Per-request span identity, minted at submit so child spans
         # recorded before retirement can already parent to it. The
         # parent comes from the caller's explicit context (the HTTP
@@ -1289,6 +1328,9 @@ class InferenceEngine:
     def _update_gauges(self) -> None:
         SLOTS_ACTIVE.set(len(self.slot_req))
         ENGINE_WAITING.set(len(self.waiting))
+        seen = self._prefix_hit_n + self._prefix_miss_n
+        if seen:
+            PREFIX_HIT_RATIO.set(self._prefix_hit_n / seen)
         if self.paged:
             KV_BLOCKS_USED.set(self.allocator.used)
         self._refresh_hbm_ledger()
@@ -1611,6 +1653,15 @@ class InferenceEngine:
                 self.cache = self._copy_block_fn(
                     self.cache, jnp.asarray(0, jnp.int32),
                     jnp.asarray(0, jnp.int32))
+                # Handoff export/import: warm against an all-sentinel
+                # index — the gather clamps (garbage nobody reads), the
+                # scatter drops every write (out of bounds), so the
+                # sweep leaves the pool untouched.
+                ids = jnp.full((self.blocks_per_slot,),
+                               self.n_kv_blocks, jnp.int32)
+                vals = self._export_blocks_fn(self.cache, ids)
+                self.cache = self._import_blocks_fn(self.cache, ids,
+                                                    vals)
             if self.adapters is not None:
                 # Warm the hot-load program by installing the all-zero
                 # weights into the base slot (values unchanged): a
@@ -2468,6 +2519,7 @@ class InferenceEngine:
             if hit is not None:
                 reused = cached
                 PREFIX_HITS.inc()
+                self._prefix_hit_n += 1
                 row[:n_shared] = shared   # pinned above
                 if partial:
                     # COW the partial shared block BEFORE the suffix
@@ -2481,6 +2533,7 @@ class InferenceEngine:
                     self._fl_cow += 1
             elif idx is not None and idx.eligible(ctx):
                 PREFIX_MISSES.inc()
+                self._prefix_miss_n += 1
             row[n_shared:n_shared + len(new_blocks)] = new_blocks
             self._table_dirty = True
             self._sync_kv_charge(slot, req.tenant)
@@ -2490,12 +2543,14 @@ class InferenceEngine:
             payload, cached = hit
             reused = cached
             PREFIX_HITS.inc()
+            self._prefix_hit_n += 1
             self.cache = self._pool_load_fn(
                 self.cache, self.pool, jnp.asarray(payload, jnp.int32),
                 jnp.asarray(slot, jnp.int32), claim_len)
         else:
             if idx is not None and idx.eligible(ctx):
                 PREFIX_MISSES.inc()
+                self._prefix_miss_n += 1
             self.cache = self._claim_fn(
                 self.cache, jnp.asarray(slot, jnp.int32), claim_len)
         if req.tokens:
@@ -2684,6 +2739,121 @@ class InferenceEngine:
                     self.allocator.decref(b)
         idx.clear()
         self._update_gauges()
+
+    # -- cross-replica KV handoff (disaggregated serving) ------------------
+
+    def handoff_eligible(self, prompt: List[int],
+                         max_new_tokens: int) -> bool:
+        """Whether a request prefilled HERE can hand its KV off to
+        another replica: paged layout + prefix cache on, and the
+        resumed context (prompt + the one committed token) must take
+        the chunk-path resume on the receiving tier — the same
+        ``_resumable`` conditions preemption requires, because a
+        handoff IS a preemption with a network hop. Single-token
+        budgets stay single-tier: there is nothing left to decode."""
+        return (self.paged
+                and self._prefix_index is not None
+                and self._prefix_index.eligible(prompt)
+                and max_new_tokens > 1
+                and self._resumable(len(prompt) + 1))
+
+    def export_prefix_for(self, req: Request) -> Optional[Dict[str, Any]]:
+        """Host-side snapshot of the retired request's stored prefix —
+        block contents + lengths — for transfer to a decode-tier
+        replica. The chunk path stored the prefix at final-chunk
+        completion (:meth:`_store_prefix`), so this is a PrefixIndex
+        lookup plus ONE fixed-shape device gather; the entry's blocks
+        stay ref-counted LRU residents here (nothing to leak — a
+        handoff leaves the donor exactly as warm as any cached serve).
+        Returns None when no chunk-aligned prefix is resident (the
+        caller falls back to single-tier)."""
+        idx = self._prefix_index
+        if not self.paged or idx is None:
+            return None
+        ctx = self._ctx(req)
+        salt = self._prefix_salt(req)
+        hit = idx.lookup(ctx, salt)
+        if hit is None:
+            return None
+        payload, cached = hit
+        nb = len(payload)
+        ids = np.full((self.blocks_per_slot,), self.n_kv_blocks,
+                      np.int32)
+        ids[:nb] = payload
+        vals = self._export_blocks_fn(self.cache, jnp.asarray(ids))
+        tensors = {}
+        for name, v in vals.items():
+            arr = np.ascontiguousarray(np.asarray(v)[:, :nb])
+            tensors[name] = arr
+        # The salt rides the export: an adapter-scoped prefix must be
+        # re-inserted on the decode tier under the SAME content digest
+        # its claim-time lookup will use (the fleet shares one catalog,
+        # so the decode replica's hot-load reproduces the digest).
+        return {"cached_len": cached, "kv_block": self.kv_block,
+                "n_blocks": nb, "salt": salt, "tensors": tensors}
+
+    def import_prefix(self, ctx: List[int], export: Dict[str, Any],
+                      salt: bytes = b"") -> int:
+        """Install another replica's exported prefix into this
+        engine's pool + PrefixIndex so the handed-off request resumes
+        through the ordinary prefix-hit suffix prefill. Returns the
+        cached rows now resident for ``ctx`` (0 = nothing imported —
+        layout/geometry mismatch or a dry pool; the caller's request
+        still runs correctly, just cold). Loop-thread only: allocates
+        blocks and swaps the donated cache."""
+        idx = self._prefix_index
+        if not self.paged or idx is None:
+            return 0
+        if export.get("kv_block") != self.kv_block:
+            return 0            # geometry mismatch: resume cold
+        cached = int(export["cached_len"])
+        nb = int(export["n_blocks"])
+        tensors = export["tensors"]
+        for name in ("k", "v"):
+            want = self.cache[name]
+            have = tensors.get(name)
+            # The wire widens sub-fp32 float planes to float32 (exact;
+            # the scatter casts back), so a float32 payload matches a
+            # bfloat16 pool; int8-vs-float is a REAL quant-config
+            # mismatch and resumes cold.
+            ok_dtype = (str(have.dtype) == str(want.dtype)
+                        if have is not None else False) or (
+                have is not None
+                and str(have.dtype) == "float32"
+                and jnp.issubdtype(want.dtype, jnp.floating))
+            if (have is None or have.shape[0] != want.shape[0]
+                    or have.shape[2:] != want.shape[2:]
+                    or not ok_dtype):
+                return 0        # model/dtype mismatch: resume cold
+        if ("k_scale" in self.cache) != ("k_scale" in tensors):
+            return 0
+        covered = idx.lookup(ctx, salt)
+        if covered is not None and covered[1] >= cached:
+            return covered[1]   # already at least as warm
+        blocks = self._alloc_blocks(nb)
+        if blocks is None:
+            return 0            # pool dry: resume cold
+        ids = np.full((self.blocks_per_slot,), self.n_kv_blocks,
+                      np.int32)
+        ids[:nb] = blocks
+        pad = self.blocks_per_slot - nb
+        vals = {}
+        for name, arr in tensors.items():
+            if pad:
+                arr = np.concatenate(
+                    [arr, np.zeros((arr.shape[0], pad) + arr.shape[2:],
+                                   arr.dtype)], axis=1)
+            vals[name] = jnp.asarray(arr)
+        self.cache = self._import_blocks_fn(
+            self.cache, jnp.asarray(ids), vals)
+        for payload in idx.insert_entry(ctx, cached, tuple(blocks),
+                                        salt):
+            PREFIX_EVICTIONS.inc()
+            self._fl_evictions += 1
+            for b in payload:
+                self.allocator.decref(b)
+        self._update_gauges()
+        return cached
 
     def _dispatch_wave(self, wave: List["Request"], slots: List[int],
                        bucket: int

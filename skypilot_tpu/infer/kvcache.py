@@ -850,6 +850,33 @@ def claim_slot(cache: Cache, slot: jax.Array,
                 length=cache["length"].at[slot].set(claim_len))
 
 
+def export_blocks(cache: Cache, idx: jax.Array) -> Dict[str, jax.Array]:
+    """Gather ``idx``-selected physical blocks' K/V rows (+ scales) out
+    of the paged pool: [L, NB, block_len, G, hd] per tensor, the
+    device half of a cross-replica KV handoff. ``idx`` is a FIXED-width
+    [NB] vector (NB = blocks per slot) padded with the sentinel
+    (== n_blocks); gathers CLAMP out-of-bounds indices, so padding rows
+    come back as garbage the host masks by the true block count — one
+    compiled program regardless of how many blocks transfer."""
+    return {name: cache[name][:, idx]
+            for name in ("k", "v", "k_scale", "v_scale")
+            if name in cache}
+
+
+def import_blocks(cache: Cache, idx: jax.Array,
+                  vals: Dict[str, jax.Array]) -> Cache:
+    """Scatter exported block rows into freshly allocated physical
+    blocks — the receive half of a cross-replica KV handoff. Same
+    fixed-width padded ``idx`` as :func:`export_blocks`: sentinel
+    positions scatter out of bounds and DROP (the block-table garbage
+    net), so padding never corrupts the pool."""
+    out = dict(cache)
+    for name, v in vals.items():
+        out[name] = cache[name].at[:, idx].set(
+            v.astype(cache[name].dtype))
+    return out
+
+
 def sync_slots(cache: Cache, active: jax.Array, lengths: jax.Array,
                tokens: jax.Array) -> Cache:
     """Force selected slots' (length, last_token) bookkeeping to

@@ -84,6 +84,11 @@ class _Pending:
         self.req = req            # engine Request (tokens grow in place)
         self.cursor = 0           # tokens already pushed to the stream
         self.chunks: queue.Queue = queue.Queue()
+        # Disaggregated prefill tier: when set, the finished-request
+        # pass attaches the retired request's stored-prefix export
+        # (block contents + lengths) to the result for the /prefill
+        # response — the payload the LB hands to a decode replica.
+        self.export_prefix = False
 
 
 class ModelServer:
@@ -232,7 +237,9 @@ class ModelServer:
              stream: bool = False, trace_ctx=None,
              tenant: str = qos_lib.DEFAULT_TENANT,
              priority: int = 0,
-             adapter: Optional[str] = None) -> _Pending:
+             adapter: Optional[str] = None,
+             export_prefix: bool = False,
+             handoff: Optional[Dict] = None) -> _Pending:
         from skypilot_tpu.infer import engine as eng
         # Validate eagerly (oversized prompt / unsatisfiable KV quota /
         # unknown adapter -> clean 400/404) without touching the
@@ -248,22 +255,27 @@ class ModelServer:
                 check_ad(adapter)
         p = _Pending()
         p.stream = stream
+        p.export_prefix = export_prefix
         with self._inbox_lock:
             # The caller's trace context rides the inbox tuple: the
             # loop thread (which has no ambient context) hands it to
             # add_request so the engine's per-request spans join the
             # HTTP caller's trace.
             self._inbox.append((list(tokens), max_new_tokens, p,
-                                trace_ctx, tenant, priority, adapter))
+                                trace_ctx, tenant, priority, adapter,
+                                handoff))
             self._last_arrival = time.monotonic()
             INBOX_DEPTH.set(len(self._inbox))
         return p
 
     def submit(self, tokens, max_new_tokens: int, trace_ctx=None,
                tenant: str = qos_lib.DEFAULT_TENANT,
-               priority: int = 0, adapter: Optional[str] = None) -> Dict:
+               priority: int = 0, adapter: Optional[str] = None,
+               export_prefix: bool = False,
+               handoff: Optional[Dict] = None) -> Dict:
         p = self._add(tokens, max_new_tokens, trace_ctx=trace_ctx,
-                      tenant=tenant, priority=priority, adapter=adapter)
+                      tenant=tenant, priority=priority, adapter=adapter,
+                      export_prefix=export_prefix, handoff=handoff)
         t0 = time.time()
         p.event.wait()
         out = dict(p.result or {})
@@ -272,7 +284,8 @@ class ModelServer:
 
     def submit_stream(self, tokens, max_new_tokens: int, trace_ctx=None,
                       tenant: str = qos_lib.DEFAULT_TENANT,
-                      priority: int = 0, adapter: Optional[str] = None):
+                      priority: int = 0, adapter: Optional[str] = None,
+                      handoff: Optional[Dict] = None):
         """Iterator of chunk dicts: {"tokens": [...]} as decoded, then
         one {"done": true, "ttft_ms": ...} (or {"error": ...}).
 
@@ -283,7 +296,8 @@ class ModelServer:
         """
         p = self._add(tokens, max_new_tokens, stream=True,
                       trace_ctx=trace_ctx, tenant=tenant,
-                      priority=priority, adapter=adapter)
+                      priority=priority, adapter=adapter,
+                      handoff=handoff)
 
         def gen():
             while True:
@@ -396,8 +410,8 @@ class ModelServer:
         with self._inbox_lock:
             new, self._inbox = self._inbox, []
             INBOX_DEPTH.set(0)
-        for tokens, max_new, p, trace_ctx, tenant, priority, adapter \
-                in new:
+        for tokens, max_new, p, trace_ctx, tenant, priority, adapter, \
+                handoff in new:
             # Optional kwargs only when they carry signal: simple
             # engine doubles (and older engines) without the kwargs
             # keep working.
@@ -410,6 +424,27 @@ class ModelServer:
                 kwargs["priority"] = priority
             if adapter is not None:
                 kwargs["adapter"] = adapter
+            if handoff is not None:
+                # Disaggregated decode tier: install the prefill
+                # tier's exported KV blocks into this engine's prefix
+                # cache (loop thread — the only engine toucher), then
+                # admit prompt + committed through the ordinary
+                # preemption-resume path. A failed/skipped import
+                # (dry pool, geometry mismatch) is a COLD resume, not
+                # an error: the output is bit-identical either way.
+                committed = list(handoff.get("committed") or [])
+                export = handoff.get("export")
+                imp = getattr(self.engine, "import_prefix", None)
+                if export is not None and imp is not None:
+                    try:
+                        imp(list(tokens) + committed, export,
+                            salt=export.get("salt", b""))
+                    except Exception as e:  # noqa: BLE001 — cold
+                        # resume; the request must still run.
+                        tracing.add_event(
+                            "server.handoff_import_failed",
+                            {"error": str(e)}, echo=True)
+                kwargs["committed"] = committed
             rid = self.engine.add_request(tokens, max_new, **kwargs)
             # add_request appends to engine.waiting; keep the Request so
             # emitted tokens can be diffed without a rid->req search.
@@ -572,6 +607,19 @@ class ModelServer:
                 # (None = the base model).
                 "model": getattr(req, "adapter", None),
             }
+            if p.export_prefix:
+                # Disaggregated prefill tier: snapshot the stored
+                # prefix's blocks for the /prefill response. Runs on
+                # the loop thread (one fixed-shape gather + host
+                # fetch); the entry stays a ref-counted LRU resident
+                # here, so a lost handoff leaks nothing. None when no
+                # prefix is resident (evicted under pool pressure
+                # between store and retire) — the LB falls back to
+                # single-tier.
+                exp_fn = getattr(self.engine, "export_prefix_for",
+                                 None)
+                p.result["export"] = (exp_fn(req)
+                                      if exp_fn is not None else None)
             if p.stream:
                 p.chunks.put({"done": True, "ttft_ms": ttft,
                               "n_tokens": len(req.tokens),
@@ -607,8 +655,56 @@ class _Threading(ThreadingMixIn, HTTPServer):
 
 
 _KNOWN_ROUTES = frozenset({"/health", "/healthz", "/metrics",
-                           "/generate", "/drain", "/debug/flight",
+                           "/generate", "/prefill", "/handoff",
+                           "/drain", "/debug/flight",
                            "/debug/forensics"})
+
+
+def encode_export(export: Dict) -> Dict:
+    """JSON-safe wire form of an engine prefix export (the /prefill
+    response body's ``export`` field): block tensors as base64 raw
+    bytes + shape/dtype, the adapter salt as base64. bfloat16 scale
+    planes widen to float32 on the wire (exact, and the receiver's
+    scatter casts back), so every wire dtype is plain numpy."""
+    import base64
+
+    import numpy as np
+    tensors = {}
+    for name, arr in export["tensors"].items():
+        arr = np.ascontiguousarray(arr)
+        if str(arr.dtype) == "bfloat16":
+            arr = arr.astype(np.float32)
+        tensors[name] = {
+            "shape": list(arr.shape), "dtype": str(arr.dtype),
+            "data": base64.b64encode(arr.tobytes()).decode()}
+    return {"cached_len": int(export["cached_len"]),
+            "kv_block": int(export["kv_block"]),
+            "n_blocks": int(export["n_blocks"]),
+            "salt": base64.b64encode(export.get("salt")
+                                     or b"").decode(),
+            "tensors": tensors}
+
+
+def decode_export(wire: Dict) -> Dict:
+    """Inverse of :func:`encode_export` — the dict
+    ``InferenceEngine.import_prefix`` consumes. Raises ValueError /
+    KeyError / TypeError on malformed wire payloads (the /handoff
+    handler maps those to a 400)."""
+    import base64
+
+    import numpy as np
+    tensors = {}
+    for name, spec in wire["tensors"].items():
+        arr = np.frombuffer(
+            base64.b64decode(spec["data"]),
+            dtype=np.dtype(str(spec["dtype"]))).reshape(
+                [int(d) for d in spec["shape"]])
+        tensors[str(name)] = arr
+    return {"cached_len": int(wire["cached_len"]),
+            "kv_block": int(wire["kv_block"]),
+            "n_blocks": int(wire["n_blocks"]),
+            "salt": base64.b64decode(wire.get("salt") or ""),
+            "tensors": tensors}
 
 
 def make_handler(model: ModelServer):
@@ -846,7 +942,7 @@ def make_handler(model: ModelServer):
                     return self._json(
                         400, {"error": "bad drain request"})
                 return self._json(200, model.start_drain(grace))
-            if self.path != "/generate":
+            if self.path not in ("/generate", "/prefill", "/handoff"):
                 return self._json(404, {"error": "not found"})
             if model._draining:
                 # Typed drain shed: the LB treats the 503 as a
@@ -914,6 +1010,84 @@ def make_handler(model: ModelServer):
                 return self._json(
                     getattr(e, "http_status", 400),
                     {"error": getattr(e, "typed_error", None) or str(e)})
+
+            if self.path == "/prefill":
+                # Disaggregated prefill tier (docs/serving.md
+                # §Disaggregated serving): run chunked admission to
+                # completion (ONE committed token), export the stored
+                # prefix's blocks, and return both — the LB hands them
+                # to a decode replica. Blocking JSON only; the decode
+                # tier owns streaming. An ineligible request (or a
+                # prefix evicted under pool pressure before export) is
+                # a typed 409 the LB answers by falling back to
+                # ordinary single-tier routing — never an error the
+                # client sees.
+                elig = getattr(model.engine, "handoff_eligible", None)
+                if elig is None or not elig(tokens, max_new):
+                    return self._json(409, {"error": {
+                        "type": "handoff_ineligible",
+                        "message": "request cannot hand off (prompt "
+                                   "shorter than one prefill chunk, "
+                                   "single-token budget, or prefix "
+                                   "cache off); route single-tier"}})
+                try:
+                    out = model.submit(tokens, 1, trace_ctx=trace_ctx,
+                                       tenant=tenant,
+                                       priority=priority,
+                                       adapter=model_name,
+                                       export_prefix=True)
+                except ValueError as e:
+                    return _bad_request(e)
+                if "error" in out:
+                    return self._json(out.pop("http_status", 500), out)
+                export = out.pop("export", None)
+                if export is None:
+                    return self._json(409, {"error": {
+                        "type": "handoff_ineligible",
+                        "message": "prefix evicted before export "
+                                   "(pool pressure); route "
+                                   "single-tier"}})
+                out["committed"] = out.pop("tokens")
+                out["export"] = encode_export(export)
+                return self._json(200, out)
+
+            if self.path == "/handoff":
+                # Disaggregated decode tier: import the prefill tier's
+                # exported blocks, then resume prompt + committed
+                # through the ordinary prefix-resume path — a
+                # preemption with a network hop. The committed tokens
+                # stream immediately (cursor starts at 0), so the
+                # client's TTFT is the prefill tier's.
+                try:
+                    committed = [int(t) for t in
+                                 body.get("committed") or []]
+                    export = (decode_export(body["export"])
+                              if body.get("export") else None)
+                except (ValueError, TypeError, KeyError) as e:
+                    return self._json(
+                        400, {"error": f"bad handoff: {e}"})
+                handoff = {"committed": committed, "export": export}
+                if stream:
+                    try:
+                        chunks = model.submit_stream(
+                            tokens, max_new, trace_ctx=trace_ctx,
+                            tenant=tenant, priority=priority,
+                            adapter=model_name, handoff=handoff)
+                    except ValueError as e:
+                        return _bad_request(e)
+                    return self._stream(chunks)
+                try:
+                    out = model.submit(tokens, max_new,
+                                       trace_ctx=trace_ctx,
+                                       tenant=tenant,
+                                       priority=priority,
+                                       adapter=model_name,
+                                       handoff=handoff)
+                except ValueError as e:
+                    return _bad_request(e)
+                if "error" in out:
+                    return self._json(out.pop("http_status", 500), out)
+                return self._json(200, out)
 
             if stream:
                 try:

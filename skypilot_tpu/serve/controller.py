@@ -30,6 +30,11 @@ TARGET_REPLICAS = metrics.gauge(
     "skytpu_serve_target_replicas",
     "Autoscaler's current overall replica target, per service",
     labelnames=("service",))
+READY_TIER_REPLICAS = metrics.gauge(
+    "skytpu_serve_ready_tier_replicas",
+    "Replicas currently READY per disaggregation tier (prefill/"
+    "decode); only published for services with a disaggregation "
+    "spec", labelnames=("service", "tier"))
 
 
 def _publish_metrics(service_name: str) -> None:
@@ -138,6 +143,12 @@ def run(service_name: str) -> int:
             manager.drain_old_versions(target)
             READY_REPLICAS.labels(service=service_name).set(len(ready))
             TARGET_REPLICAS.labels(service=service_name).set(target)
+            if getattr(spec, "disaggregation", None):
+                for tier in ("prefill", "decode"):
+                    READY_TIER_REPLICAS.labels(
+                        service=service_name, tier=tier).set(
+                            sum(1 for r in ready
+                                if r.get("tier") == tier))
             _publish_metrics(service_name)
     finally:
         lb.terminate()

@@ -27,7 +27,7 @@ from skypilot_tpu import chaos
 from skypilot_tpu.infer import adapters as adapters_lib
 from skypilot_tpu.infer import qos as qos_lib
 from skypilot_tpu.observability import health as health_lib
-from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import metrics, tracing
 from skypilot_tpu.serve import serve_state
 
 _HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "host",
@@ -57,6 +57,30 @@ LB_FAILOVERS = metrics.counter(
     "the resume replays prompt + committed tokens with the budget "
     "reduced, so the client sees one gapless sequence)",
     labelnames=("phase",))
+LB_HANDOFFS = metrics.counter(
+    "skytpu_lb_handoffs_total",
+    "Disaggregated prefill->decode handoffs by outcome: ok (a decode-"
+    "tier replica accepted the KV transfer), retry (a decode replica "
+    "died mid-transfer and the export — held in LB memory — retried "
+    "on a survivor), fallback (the two-tier flow was skipped: a tier "
+    "was dark, the prefill replica answered a typed 409 "
+    "handoff_ineligible, or the prefill tier was exhausted — the "
+    "request served single-tier instead), failed (every decode "
+    "replica refused; the client saw a typed 503)",
+    labelnames=("result",))
+HANDOFF_SECONDS = metrics.histogram(
+    "skytpu_handoff_seconds",
+    "Wall time of the LB->decode-tier handoff hop: POST /handoff to "
+    "first upstream evidence (the first streamed chunk — the decode "
+    "replica streams the committed tokens as soon as the import "
+    "admits — or the full response for blocking requests)",
+    buckets=metrics.latency_buckets())
+LB_TIER_REQUESTS = metrics.counter(
+    "skytpu_lb_tier_requests_total",
+    'Requests the LB routed per disaggregation tier ("prefill" and '
+    '"decode" tick once each for a completed two-tier request; '
+    '"single" counts requests on a disaggregated service that fell '
+    "back to the single-tier path)", labelnames=("tier",))
 
 
 class _UpstreamPool:
@@ -194,6 +218,31 @@ def _upstream_ndjson(base_url: str, path: str, payload: bytes,
         conn.close()
 
 
+def _post_json(base_url: str, path: str, payload: bytes,
+               headers: Dict[str, str]):
+    """One blocking JSON POST to a replica (the two-tier flow's
+    ``/prefill`` and ``/handoff`` hops). Returns (status, headers,
+    body); raises ``ConnectionError`` on connect failure or 5xx so the
+    caller's retry loop walks to the next replica. Unpooled on
+    purpose: a handoff payload is a one-shot multi-MB KV transfer,
+    not TTFT-critical keep-alive traffic."""
+    parts = urlsplit(base_url)
+    conn = http.client.HTTPConnection(parts.hostname or "",
+                                      parts.port or 80, timeout=120)
+    try:
+        try:
+            conn.request("POST", path, body=payload, headers=headers)
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            raise ConnectionError(
+                f"upstream connect failed: {e}") from e
+        if resp.status >= 500:
+            raise ConnectionError(f"upstream {resp.status}")
+        return resp.status, resp.getheaders(), resp.read()
+    finally:
+        conn.close()
+
+
 # Adapter-catalog routing (docs/serving.md §Adapter catalog): the
 # service's published fine-tune names come from its spec (`service.
 # adapters`), read off the serve DB with a short TTL so the proxy hot
@@ -219,26 +268,142 @@ def _service_adapters(service: str) -> Optional[frozenset]:
     return names
 
 
+# Disaggregated prefill/decode serving (docs/serving.md §Disaggregated
+# serving): the service's tier split comes from its spec, read off the
+# serve DB with the same short TTL as the adapter catalog. None = the
+# service is single-tier and /generate proxies as always.
+_disagg_cache: Dict[str, Tuple[float, Optional[Dict[str, int]]]] = {}
+
+
+def _service_disagg(service: str) -> Optional[Dict[str, int]]:
+    now = time.monotonic()
+    hit = _disagg_cache.get(service)
+    if hit is not None and now - hit[0] < _ADAPTER_TTL_S:
+        return hit[1]
+    d = None
+    rec = serve_state.get_service(service)
+    if rec is not None:
+        raw = (rec.get("spec") or {}).get("disaggregation")
+        if isinstance(raw, dict) and raw:
+            try:
+                d = {str(k): int(v) for k, v in raw.items()}
+            except (TypeError, ValueError):
+                d = None
+    _disagg_cache[service] = (now, d)
+    return d
+
+
+# Prefix-affinity routing: the LB computes the SAME chunk-aligned
+# prefix digest the engine's PrefixIndex keys its resident KV prefixes
+# by (infer/engine.py PrefixIndex._digest — blake2b-128 over
+# salt + int64 token bytes), and rendezvous-hashes it onto a replica.
+# Requests sharing a prompt-prefix family land on the replica that
+# already holds that family's KV blocks, so the fleet-wide prefix hit
+# rate approaches a single replica's instead of decaying ~1/N under
+# load-spread routing. The chunk must match the replicas' prefill
+# chunk for the digest to name a boundary the engine actually caches
+# at — SKYTPU_PREFILL_CHUNK configures the fleet-wide value.
+DEFAULT_PREFIX_CHUNK = 512
+
+
+def prefix_affinity_key(tokens, chunk: Optional[int] = None,
+                        salt: bytes = b"") -> Optional[bytes]:
+    """Digest of the LONGEST chunk-aligned proper prefix of
+    ``tokens`` — byte-for-byte the engine PrefixIndex digest of the
+    same prefix under the same ``salt``. None = ineligible (prompt no
+    longer than one chunk), mirroring ``PrefixIndex.eligible``.
+    ``salt`` namespaces by adapter identity, exactly like the engine:
+    two fine-tunes sharing a prompt must not share a routing family
+    (their cached KV rows differ)."""
+    if chunk is None:
+        try:
+            chunk = int(os.environ.get("SKYTPU_PREFILL_CHUNK",
+                                       str(DEFAULT_PREFIX_CHUNK)))
+        except ValueError:
+            chunk = DEFAULT_PREFIX_CHUNK
+    if chunk <= 0 or len(tokens) <= chunk:
+        return None
+    n = ((len(tokens) - 1) // chunk) * chunk
+    import numpy as np
+    return hashlib.blake2b(
+        salt + np.asarray([int(t) for t in tokens[:n]],
+                          np.int64).tobytes(),
+        digest_size=16).digest()
+
+
+def _ranked_urls(key: str, urls: List[str]) -> List[str]:
+    """Rendezvous (highest-random-weight) order for ``key``: a stable
+    per-key replica ranking that only reshuffles the keys owned by a
+    replica that joins or leaves."""
+    return sorted(urls, key=lambda u: hashlib.blake2b(
+        (key + "|" + u).encode(), digest_size=8).digest(), reverse=True)
+
+
 def _affinity_url(model_name: str, urls: List[str]) -> str:
-    """Rendezvous (highest-random-weight) pick: one fine-tune's
-    traffic lands on the same replica while it is up — its device
-    adapter pool stays warm — and fails over deterministically to the
-    next-highest weight when it dies. Composes with the policy: only
-    adapter-naming requests route this way."""
-    return max(urls, key=lambda u: hashlib.blake2b(
-        (model_name + "|" + u).encode(), digest_size=8).digest())
+    """Rendezvous pick: one fine-tune's traffic lands on the same
+    replica while it is up — its device adapter pool stays warm — and
+    fails over deterministically to the next-highest weight when it
+    dies."""
+    return _ranked_urls(model_name, urls)[0]
+
+
+def _spill_margin() -> int:
+    try:
+        return max(0, int(os.environ.get("SKYTPU_LB_SPILL", "4")))
+    except ValueError:
+        return 4
+
+
+def _affinity_pick(key: str, urls: List[str], policy: "Policy") -> str:
+    """Rendezvous pick with LOAD SPILL: affinity pins a key's traffic
+    to one replica, which under a hot key means one replica melts
+    while its neighbours idle. Walk the rendezvous ranking and take
+    the first replica whose live in-flight count is within
+    ``SKYTPU_LB_SPILL`` of the least-loaded candidate — the pinned
+    replica wins while healthy, and a hot spot spills to the NEXT
+    deterministic choice (so spilled traffic still concentrates,
+    keeping its cache-warmth second-best rather than random). Shared
+    by adapter affinity and prefix affinity."""
+    ranked = _ranked_urls(key, urls)
+    floor = min(policy.load(u) for u in ranked)
+    margin = _spill_margin()
+    for u in ranked:
+        if policy.load(u) <= floor + margin:
+            return u
+    return ranked[0]
 
 
 class Policy:
+    """Backend selection + live in-flight accounting. The load map
+    lives on the BASE class because every pick path — policy select,
+    adapter affinity, prefix affinity — must see one truth: the proxy
+    ``acquire``s every pick and ``done``s it when the request
+    finishes, and the affinity spill rule reads ``load`` to decide
+    when a pinned replica is hot enough to spill past."""
+
+    def __init__(self):
+        self._load: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
     def select(self, urls: List[str]) -> Optional[str]:
         raise NotImplementedError
 
+    def acquire(self, url: str) -> None:
+        with self._lock:
+            self._load[url] = self._load.get(url, 0) + 1
+
     def done(self, url: str) -> None:
-        pass
+        with self._lock:
+            self._load[url] = max(0, self._load.get(url, 1) - 1)
+
+    def load(self, url: str) -> int:
+        with self._lock:
+            return self._load.get(url, 0)
 
 
 class RoundRobinPolicy(Policy):
     def __init__(self):
+        super().__init__()
         self._counter = itertools.count()
 
     def select(self, urls):
@@ -249,11 +414,12 @@ class RoundRobinPolicy(Policy):
 
 class LeastLoadPolicy(Policy):
     """Pick the replica with the fewest in-flight requests; break ties
-    round-robin so sequential (zero-concurrency) traffic still spreads."""
+    round-robin so sequential (zero-concurrency) traffic still
+    spreads. ``select`` only READS the load map — the proxy acquires
+    after any pick (policy or affinity), so both paths count."""
 
     def __init__(self):
-        self._load: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        super().__init__()
         self._rr = itertools.count()
 
     def select(self, urls):
@@ -262,13 +428,7 @@ class LeastLoadPolicy(Policy):
         with self._lock:
             lowest = min(self._load.get(u, 0) for u in urls)
             tied = [u for u in urls if self._load.get(u, 0) == lowest]
-            url = tied[next(self._rr) % len(tied)]
-            self._load[url] = self._load.get(url, 0) + 1
-        return url
-
-    def done(self, url):
-        with self._lock:
-            self._load[url] = max(0, self._load.get(url, 1) - 1)
+            return tied[next(self._rr) % len(tied)]
 
 
 POLICIES = {"round_robin": RoundRobinPolicy, "least_load": LeastLoadPolicy}
@@ -424,6 +584,42 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                         }, retry_after_s=None)
             serve_state.record_request(service)
             urls = serve_state.ready_urls(service)
+            # Prefix-affinity routing key (adapter-salted, like the
+            # engine's index): parsed only for POST /generate bodies
+            # with a token list — the family digest is worth one JSON
+            # decode because landing on the warm replica saves the
+            # whole prefill recompute.
+            prefix_key = None
+            if (self.command == "POST" and route == "/generate"
+                    and body and os.environ.get(
+                        "SKYTPU_LB_PREFIX_AFFINITY", "1") != "0"):
+                fields = _body_json()
+                if (isinstance(fields, dict)
+                        and isinstance(fields.get("tokens"), list)):
+                    try:
+                        prefix_key = prefix_affinity_key(
+                            fields["tokens"],
+                            salt=(model_name or "").encode())
+                    except (TypeError, ValueError):
+                        prefix_key = None
+            # Disaggregated two-tier flow: prefill tier computes the
+            # prompt to one committed token, the KV export hops to a
+            # decode-tier replica, the client sees one ordinary
+            # /generate answer. Any ineligibility falls back to the
+            # single-tier path below (preferring the decode tier).
+            if (_service_disagg(service) is not None
+                    and self.command == "POST"
+                    and route == "/generate" and body):
+                fields = _body_json()
+                if (isinstance(fields, dict)
+                        and isinstance(fields.get("tokens"), list)):
+                    handled, fallback = self._proxy_disagg(
+                        fields, model_name, prefix_key)
+                    if handled:
+                        return
+                    LB_TIER_REQUESTS.labels(tier="single").inc()
+                    if fallback:
+                        urls = fallback
             if (failover_on and self.command == "POST"
                     and route == "/generate" and body
                     and b'"stream"' in body):
@@ -433,33 +629,22 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                 if (isinstance(fields, dict) and fields.get("stream")
                         and isinstance(fields.get("tokens"), list)):
                     return self._proxy_stream(urls, fields, model_name,
-                                              tenant)
+                                              tenant, prefix_key)
             tried = []
             self._response_started = False
             for _ in range(min(max_retries, max(len(urls), 1))):
                 cand = [u for u in urls if u not in tried]
-                used_policy = not (model_name and len(cand) > 1)
-                if used_policy:
-                    url = policy.select(cand)
-                else:
-                    # Adapter affinity composes with backend
-                    # selection: adapter-naming requests rendezvous-
-                    # hash onto a stable replica (warm pool), all
-                    # other traffic keeps the configured policy, and
-                    # failover still walks the remaining candidates.
-                    url = _affinity_url(model_name, cand)
+                url = self._pick_backend(cand, model_name, prefix_key)
                 if url is None:
                     break
                 tried.append(url)
                 try:
                     code = self._forward(url, body)
-                    if used_policy:
-                        policy.done(url)
+                    policy.done(url)
                     LB_PROXIED.labels(backend=url, code=str(code)).inc()
                     return
                 except Exception:  # noqa: BLE001 — try next replica
-                    if used_policy:
-                        policy.done(url)
+                    policy.done(url)
                     LB_RETRIES.labels(backend=url).inc()
                     if self._response_started:
                         # Bytes already reached the client: a retry
@@ -483,9 +668,42 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                 "service": service,
             })
 
-        def _proxy_stream(self, urls: List[str], fields: dict,
+        def _pick_backend(self, cand: List[str],
                           model_name: Optional[str],
-                          tenant: str) -> None:
+                          prefix_key: Optional[bytes]) -> Optional[str]:
+            """One pick for every routing flavor. Precedence: prefix
+            affinity (a warm KV family is the costliest thing to
+            recompute) > adapter affinity > the configured policy;
+            both affinities share the load-spill walk. Every
+            successful pick is ``acquire``d — the caller owns the
+            matching ``policy.done(url)``."""
+            if not cand:
+                return None
+            if prefix_key is not None and len(cand) > 1:
+                url = _affinity_pick(prefix_key.hex(), cand, policy)
+            elif model_name and len(cand) > 1:
+                url = _affinity_pick(model_name, cand, policy)
+            else:
+                url = policy.select(cand)
+            if url is not None:
+                policy.acquire(url)
+            return url
+
+        def _send_raw(self, status: int, headers, body: bytes) -> None:
+            """Forward an upstream's buffered answer verbatim (minus
+            hop headers; Content-Length recomputed)."""
+            self.send_response(status)
+            for k, v in headers:
+                if (k.lower() not in _HOP_HEADERS
+                        and k.lower() != "content-length"):
+                    self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _proxy_stream(self, urls: List[str], fields: dict,
+                          model_name: Optional[str], tenant: str,
+                          prefix_key: Optional[bytes] = None) -> None:
             """Streaming ``/generate`` with MID-STREAM failover.
 
             The splice path drops the connection when a replica dies
@@ -545,11 +763,8 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
             try:
                 for _ in range(min(max_retries, max(len(urls), 1))):
                     cand = [u for u in urls if u not in tried]
-                    used_policy = not (model_name and len(cand) > 1)
-                    if used_policy:
-                        url = policy.select(cand)
-                    else:
-                        url = _affinity_url(model_name, cand)
+                    url = self._pick_backend(cand, model_name,
+                                             prefix_key)
                     if url is None:
                         break
                     tried.append(url)
@@ -567,8 +782,7 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                                 json.dumps(replay).encode(),
                                 self.headers):
                             if "done" in obj or "error" in obj:
-                                if used_policy:
-                                    policy.done(url)
+                                policy.done(url)
                                 return finish(obj, url)
                             toks = obj.get("tokens")
                             if toks:
@@ -579,8 +793,7 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                         raise ConnectionError(
                             "upstream ended without done line")
                     except _UpstreamError as e:
-                        if used_policy:
-                            policy.done(url)
+                        policy.done(url)
                         if not headers_sent:
                             # Deterministic non-stream answer (4xx
                             # validation / typed shed): forward
@@ -588,17 +801,7 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                             # path.
                             LB_PROXIED.labels(
                                 backend=url, code=str(e.status)).inc()
-                            ebody = e.body
-                            self.send_response(e.status)
-                            for k, v in e.headers:
-                                if (k.lower() not in _HOP_HEADERS
-                                        and k.lower()
-                                        != "content-length"):
-                                    self.send_header(k, v)
-                            self.send_header("Content-Length",
-                                             str(len(ebody)))
-                            self.end_headers()
-                            self.wfile.write(ebody)
+                            self._send_raw(e.status, e.headers, e.body)
                             return
                         # A 4xx on the REPLAY (started stream): this
                         # candidate cannot resume us — treat it as
@@ -607,8 +810,7 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
                         LB_FAILOVERS.labels(phase="mid_stream").inc()
                         failovers += 1
                     except ConnectionError:
-                        if used_policy:
-                            policy.done(url)
+                        policy.done(url)
                         LB_RETRIES.labels(backend=url).inc()
                         LB_FAILOVERS.labels(
                             phase=("mid_stream" if committed
@@ -652,6 +854,296 @@ def make_handler(service: str, policy: Policy, max_retries: int = 3,
             self._typed_reject(503, {
                 "type": "overloaded",
                 "message": "no ready replicas",
+                "service": service,
+            })
+
+        def _proxy_disagg(self, fields: dict,
+                          model_name: Optional[str],
+                          prefix_key: Optional[bytes]):
+            """Two-tier disaggregated ``/generate``: POST ``/prefill``
+            to a prefill-tier replica (chunked admission to ONE
+            committed token + a paged-KV export), then hand the export
+            to a decode-tier replica's ``/handoff``, which imports the
+            blocks and resumes through the ordinary prefix-resume path
+            — greedy output is bit-identical to single-tier. Both hops
+            carry the same traceparent (minted here if the client sent
+            none) so ``skytpu trace`` stitches one tree across tiers.
+
+            Returns ``(handled, fallback_urls)``: handled=True means a
+            response (or typed reject) went out; handled=False means
+            the caller should run the single-tier path — over
+            ``fallback_urls`` (the decode tier) when non-empty, so a
+            fallback still avoids loading the prefill tier with
+            decode work."""
+            prefill_urls = serve_state.ready_urls(service,
+                                                 tier="prefill")
+            decode_urls = serve_state.ready_urls(service, tier="decode")
+            single = decode_urls or None
+            try:
+                prompt = [int(t) for t in fields["tokens"]]
+                budget = int(fields.get("max_new_tokens", 64))
+            except (TypeError, ValueError):
+                # The replica tier owns request validation.
+                return False, single
+            if not prefill_urls or not decode_urls:
+                LB_HANDOFFS.labels(result="fallback").inc()
+                return False, single
+            if budget <= 1 or prefix_affinity_key(prompt) is None:
+                # Cheap mirror of the replica's handoff_eligible
+                # (prompt must exceed one chunk; >1 token budget) —
+                # the replica's typed 409 stays authoritative for
+                # what this can't see (paged pool off, index sizing).
+                return False, single
+            # One trace across both tiers: reuse the client's
+            # traceparent, mint one otherwise, and send the SAME
+            # header on the prefill and handoff hops.
+            tp = self.headers.get("traceparent")
+            if tracing.parse_traceparent(tp or "") is None:
+                tp = tracing.format_traceparent(tracing.SpanContext(
+                    tracing.new_trace_id(), tracing.new_span_id()))
+            hdrs = {"Content-Type": "application/json",
+                    "traceparent": tp}
+            tenant_hdr = self.headers.get(qos_lib.tenant_header())
+            if tenant_hdr:
+                hdrs[qos_lib.tenant_header()] = tenant_hdr
+
+            pre_payload = {"tokens": prompt, "max_new_tokens": budget}
+            if model_name:
+                pre_payload["model"] = model_name
+            pre_body = json.dumps(pre_payload).encode()
+            pre = None
+            tried: List[str] = []
+            for _ in range(min(max_retries, len(prefill_urls))):
+                cand = [u for u in prefill_urls if u not in tried]
+                url = self._pick_backend(cand, model_name, prefix_key)
+                if url is None:
+                    break
+                tried.append(url)
+                try:
+                    chaos.point("serve.lb.forward", backend=url)
+                    status, rhdrs, rbody = _post_json(
+                        url, "/prefill", pre_body, hdrs)
+                except (ConnectionError, chaos.ChaosError):
+                    policy.done(url)
+                    LB_RETRIES.labels(backend=url).inc()
+                    continue
+                policy.done(url)
+                if status == 409:
+                    # Typed handoff_ineligible (short prompt for the
+                    # replica's chunk, prefix evicted before export,
+                    # no paged pool): single-tier fallback.
+                    LB_HANDOFFS.labels(result="fallback").inc()
+                    return False, single
+                if status != 200:
+                    # Deterministic 4xx / typed shed: forward verbatim.
+                    LB_PROXIED.labels(backend=url,
+                                      code=str(status)).inc()
+                    self._send_raw(status, rhdrs, rbody)
+                    return True, None
+                try:
+                    pre = json.loads(rbody)
+                except ValueError:
+                    LB_RETRIES.labels(backend=url).inc()
+                    continue
+                break
+            if not isinstance(pre, dict) or pre.get("export") is None:
+                LB_HANDOFFS.labels(result="fallback").inc()
+                return False, single
+            LB_TIER_REQUESTS.labels(tier="prefill").inc()
+            committed = [int(t) for t in pre.get("committed") or []]
+            hbody = {"tokens": prompt, "committed": committed,
+                     "export": pre["export"],
+                     "max_new_tokens": budget}
+            if model_name:
+                hbody["model"] = model_name
+            if fields.get("stream"):
+                hbody["stream"] = True
+                self._handoff_stream(decode_urls, hbody, model_name,
+                                     prefix_key, hdrs)
+                return True, None
+            tried = []
+            for _ in range(min(max_retries, len(decode_urls))):
+                cand = [u for u in decode_urls if u not in tried]
+                url = self._pick_backend(cand, model_name, prefix_key)
+                if url is None:
+                    break
+                tried.append(url)
+                t_hand = time.monotonic()
+                try:
+                    # The transfer itself is a chaos point: plans kill
+                    # the decode replica mid-handoff and the export —
+                    # held HERE in LB memory — retries on a survivor;
+                    # the prefill tier keeps its refcounted copy
+                    # either way (zero loss, zero leak).
+                    chaos.point("handoff.transfer", backend=url)
+                    status, rhdrs, rbody = _post_json(
+                        url, "/handoff",
+                        json.dumps(hbody).encode(), hdrs)
+                except (ConnectionError, chaos.ChaosError):
+                    policy.done(url)
+                    LB_RETRIES.labels(backend=url).inc()
+                    LB_HANDOFFS.labels(result="retry").inc()
+                    continue
+                policy.done(url)
+                HANDOFF_SECONDS.observe(time.monotonic() - t_hand)
+                LB_HANDOFFS.labels(result="ok").inc()
+                LB_TIER_REQUESTS.labels(tier="decode").inc()
+                LB_PROXIED.labels(backend=url, code=str(status)).inc()
+                self._send_raw(status, rhdrs, rbody)
+                return True, None
+            LB_HANDOFFS.labels(result="failed").inc()
+            self._typed_reject(503, {
+                "type": "overloaded",
+                "message": "no decode-tier replica accepted the "
+                           "handoff",
+                "service": service,
+            })
+            return True, None
+
+        def _handoff_stream(self, decode_urls: List[str], hbody: dict,
+                            model_name: Optional[str],
+                            prefix_key: Optional[bytes],
+                            hdrs: Dict[str, str]) -> None:
+            """Streaming decode leg of the two-tier flow, with the
+            same mid-stream failover contract as ``_proxy_stream`` —
+            except the resume replays ``/handoff`` (the export payload
+            lives HERE), with ``committed`` grown to every token the
+            client has seen and the survivor's re-streamed seed tokens
+            suppressed, so the client sees ONE duplicate-free
+            sequence. The decode replica streams committed tokens from
+            cursor zero, which is why the client's TTFT is the prefill
+            tier's."""
+            budget = int(hbody.get("max_new_tokens", 64))
+            committed0 = [int(t) for t in hbody.get("committed") or []]
+            received: List[int] = []   # tokens the client has
+            tried: List[str] = []
+            failovers = 0
+            headers_sent = False
+            obs_done = False
+            self._response_started = False
+
+            def emit(obj: dict) -> None:
+                nonlocal headers_sent
+                data = json.dumps(obj).encode() + b"\n"
+                try:
+                    if not headers_sent:
+                        headers_sent = True
+                        self._response_started = True
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.send_header("Transfer-Encoding",
+                                         "chunked")
+                        self.end_headers()
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(data),
+                                                        data))
+                except ConnectionError as e:
+                    raise _ClientGone() from e
+
+            def finish(obj: dict, url: Optional[str]) -> None:
+                obj = dict(obj)
+                obj["n_tokens"] = len(received)
+                obj["failovers"] = failovers
+                emit(obj)
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except ConnectionError:
+                    pass
+                LB_PROXIED.labels(backend=url or "none",
+                                  code="200").inc()
+
+            try:
+                for _ in range(min(max_retries, len(decode_urls))):
+                    cand = [u for u in decode_urls if u not in tried]
+                    url = self._pick_backend(cand, model_name,
+                                             prefix_key)
+                    if url is None:
+                        break
+                    tried.append(url)
+                    replay = dict(hbody)
+                    replay["committed"] = (list(received) if received
+                                           else list(committed0))
+                    # The survivor streams its committed seeds from
+                    # cursor zero; the client already has these.
+                    skip = len(received)
+                    t_hand = time.monotonic()
+                    try:
+                        chaos.point("handoff.transfer", backend=url)
+                        for obj in _upstream_ndjson(
+                                url, "/handoff",
+                                json.dumps(replay).encode(), hdrs):
+                            if not obs_done:
+                                obs_done = True
+                                HANDOFF_SECONDS.observe(
+                                    time.monotonic() - t_hand)
+                                LB_HANDOFFS.labels(result="ok").inc()
+                                LB_TIER_REQUESTS.labels(
+                                    tier="decode").inc()
+                            if "done" in obj or "error" in obj:
+                                policy.done(url)
+                                return finish(obj, url)
+                            toks = [int(t) for t in
+                                    obj.get("tokens") or []]
+                            if skip and toks:
+                                drop = min(skip, len(toks))
+                                skip -= drop
+                                toks = toks[drop:]
+                            if not toks:
+                                continue
+                            received.extend(toks)
+                            out = dict(obj)
+                            out["tokens"] = toks
+                            emit(out)
+                        raise ConnectionError(
+                            "upstream ended without done line")
+                    except _UpstreamError as e:
+                        policy.done(url)
+                        if not headers_sent:
+                            LB_PROXIED.labels(
+                                backend=url, code=str(e.status)).inc()
+                            self._send_raw(e.status, e.headers, e.body)
+                            return
+                        LB_RETRIES.labels(backend=url).inc()
+                        LB_FAILOVERS.labels(phase="mid_stream").inc()
+                        LB_HANDOFFS.labels(result="retry").inc()
+                        failovers += 1
+                    except (ConnectionError, chaos.ChaosError):
+                        policy.done(url)
+                        LB_RETRIES.labels(backend=url).inc()
+                        LB_FAILOVERS.labels(
+                            phase=("mid_stream" if headers_sent
+                                   else "connect")).inc()
+                        LB_HANDOFFS.labels(result="retry").inc()
+                        failovers += 1
+                        if received and len(received) >= budget:
+                            # Full budget delivered, done line lost:
+                            # mint the trailer rather than replaying
+                            # a zero-budget generation.
+                            return finish({"done": True,
+                                           "lb_minted": True}, None)
+            except _ClientGone:
+                LB_PROXIED.labels(backend="none", code="499").inc()
+                self.close_connection = True
+                return
+            LB_HANDOFFS.labels(result="failed").inc()
+            if headers_sent:
+                try:
+                    emit({"error": {
+                        "type": "upstream_lost",
+                        "message": "decode replica lost mid-stream; "
+                                   "no surviving replica could resume "
+                                   "the handoff",
+                        "n_streamed": len(received),
+                        "failovers": failovers}})
+                    self.wfile.write(b"0\r\n\r\n")
+                except (_ClientGone, ConnectionError):
+                    pass
+                LB_PROXIED.labels(backend="none", code="200").inc()
+                return
+            self._typed_reject(503, {
+                "type": "overloaded",
+                "message": "no decode-tier replica accepted the "
+                           "handoff",
                 "service": service,
             })
 

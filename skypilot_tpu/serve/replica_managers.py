@@ -93,6 +93,7 @@ class ReplicaManager:
         self._probe_failures: Dict[int, int] = {}
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
         self._launching: Dict[int, bool] = {}   # rid -> is_spot; guarded-by: _lock
+        self._launch_tier: Dict[int, str] = {}  # rid -> tier; guarded-by: _lock
         self._lock = threading.Lock()
         # With a mixed-fleet autoscaler the controller owns replacement
         # decisions (preempted spot may come back as on-demand); the
@@ -181,29 +182,62 @@ class ReplicaManager:
                 for r in self._scale_down_order(sub)[:len(sub) - target]:
                     self._terminate_replica(r["replica_id"])
 
+    def _tier_counts(self) -> Dict[str, int]:
+        """Live + in-flight-launch replicas per disaggregation tier.
+        Guarded-by: _lock (reads _launch_tier)."""
+        counts: Dict[str, int] = {}
+        for r in self._live_replicas():
+            t = r.get("tier") or ""
+            counts[t] = counts.get(t, 0) + 1
+        for t in self._launch_tier.values():
+            counts[t] = counts.get(t, 0) + 1
+        return counts
+
     def _launch_replica(self, use_spot: Optional[bool] = None) -> None:
         with self._lock:
             rid = self._next_replica_id
             self._next_replica_id += 1
             self._launching[rid] = bool(use_spot)
+            # Disaggregated tier assignment (docs/serving.md
+            # §Disaggregated serving): fill the prefill tier to its
+            # spec'd count first, decode gets the rest. A replaced
+            # replica (preemption relaunch) lands back in whichever
+            # tier is short, so the split self-heals.
+            tier = ""
+            disagg = getattr(self.spec, "disaggregation", None)
+            if disagg:
+                counts = self._tier_counts()
+                tier = ("prefill"
+                        if counts.get("prefill", 0)
+                        < int(disagg.get("prefill_replicas", 0))
+                        else "decode")
+            self._launch_tier[rid] = tier
         cluster = f"sky-serve-{self.service}-{rid}"
         version = self.version
         serve_state.upsert_replica(self.service, rid, cluster,
                                    ReplicaStatus.PROVISIONING, None,
                                    version=version,
-                                   is_spot=bool(use_spot))
+                                   is_spot=bool(use_spot), tier=tier)
         self._pool.submit(self._launch_replica_blocking, rid, cluster,
-                          version, dict(self.task_config), use_spot)
+                          version, dict(self.task_config), use_spot,
+                          tier)
 
     def _launch_replica_blocking(self, rid: int, cluster: str,
                                  version: int, task_config: dict,
-                                 use_spot: Optional[bool] = None) -> None:
+                                 use_spot: Optional[bool] = None,
+                                 tier: str = "") -> None:
         try:
             task_config = _apply_resource_overrides(
                 task_config, use_spot, self._port(rid))
             task = Task.from_yaml_config(task_config)
             task.update_envs({"SKYTPU_REPLICA_ID": str(rid),
                               "SKYTPU_REPLICA_PORT": str(self._port(rid))})
+            if tier:
+                # The replica's own processes see their tier (ops
+                # tooling, logs); routing stays LB-side off the serve
+                # DB — a replica serves whatever endpoint is asked of
+                # it, so a tier flip is a relaunch, not a config skew.
+                task.update_envs({"SKYTPU_TIER": tier})
             if getattr(self.spec, "adapters", None):
                 # Adapter-catalog distribution: each replica's model
                 # server registers the service's fine-tunes from this
@@ -231,7 +265,7 @@ class ReplicaManager:
             serve_state.upsert_replica(self.service, rid, cluster,
                                        ReplicaStatus.STARTING, url,
                                        version=version,
-                                       is_spot=bool(use_spot))
+                                       is_spot=bool(use_spot), tier=tier)
         except Exception as e:  # noqa: BLE001 — replica failure is a state
             tracing.add_event(
                 "serve.replica_launch_failed",
@@ -240,10 +274,11 @@ class ReplicaManager:
             serve_state.upsert_replica(self.service, rid, cluster,
                                        ReplicaStatus.FAILED, None,
                                        version=version,
-                                       is_spot=bool(use_spot))
+                                       is_spot=bool(use_spot), tier=tier)
         finally:
             with self._lock:
                 self._launching.pop(rid, None)
+                self._launch_tier.pop(rid, None)
 
     def _port(self, rid: int) -> int:
         # Local replicas share one machine: unique port per replica.

@@ -67,6 +67,7 @@ CREATE TABLE IF NOT EXISTS replicas (
     launched_at REAL,
     version INTEGER DEFAULT 1,
     is_spot INTEGER DEFAULT 0,
+    tier TEXT DEFAULT '',
     PRIMARY KEY (service, replica_id)
 );
 CREATE TABLE IF NOT EXISTS lb_requests (
@@ -86,6 +87,7 @@ _MIGRATIONS = (
     "ALTER TABLE services ADD COLUMN version INTEGER DEFAULT 1",
     "ALTER TABLE replicas ADD COLUMN version INTEGER DEFAULT 1",
     "ALTER TABLE replicas ADD COLUMN is_spot INTEGER DEFAULT 0",
+    "ALTER TABLE replicas ADD COLUMN tier TEXT DEFAULT ''",
 )
 
 
@@ -176,18 +178,19 @@ def remove_service(name: str) -> None:
 
 def upsert_replica(service: str, replica_id: int, cluster_name: str,
                    status: ReplicaStatus, url: Optional[str],
-                   version: int = 1, is_spot: bool = False) -> None:
+                   version: int = 1, is_spot: bool = False,
+                   tier: str = "") -> None:
     with _db() as c:
         c.execute(
             "INSERT INTO replicas (service, replica_id, cluster_name,"
-            " status, url, launched_at, version, is_spot)"
-            " VALUES (?,?,?,?,?,?,?,?)"
+            " status, url, launched_at, version, is_spot, tier)"
+            " VALUES (?,?,?,?,?,?,?,?,?)"
             " ON CONFLICT(service, replica_id) DO UPDATE SET"
             " cluster_name=excluded.cluster_name, status=excluded.status,"
             " url=excluded.url, version=excluded.version,"
-            " is_spot=excluded.is_spot",
+            " is_spot=excluded.is_spot, tier=excluded.tier",
             (service, replica_id, cluster_name, status.value, url,
-             time.time(), version, int(is_spot)))
+             time.time(), version, int(is_spot), tier or ""))
 
 
 def set_replica_status(service: str, replica_id: int,
@@ -207,18 +210,22 @@ def list_replicas(service: str) -> List[Dict[str, Any]]:
     with _db() as c:
         rows = c.execute(
             "SELECT replica_id, cluster_name, status, url, launched_at,"
-            " version, is_spot FROM replicas WHERE service=?"
+            " version, is_spot, tier FROM replicas WHERE service=?"
             " ORDER BY replica_id",
             (service,)).fetchall()
     return [{"replica_id": r[0], "cluster_name": r[1],
              "status": ReplicaStatus(r[2]), "url": r[3],
              "launched_at": r[4], "version": r[5],
-             "is_spot": bool(r[6])} for r in rows]
+             "is_spot": bool(r[6]), "tier": r[7] or ""} for r in rows]
 
 
-def ready_urls(service: str) -> List[str]:
+def ready_urls(service: str, tier: Optional[str] = None) -> List[str]:
+    """READY replica URLs; ``tier`` filters to one disaggregation tier
+    ("prefill"/"decode"). None returns every tier — the single-tier
+    path and the disagg fallback both route over the whole fleet."""
     return [r["url"] for r in list_replicas(service)
-            if r["status"] == ReplicaStatus.READY and r["url"]]
+            if r["status"] == ReplicaStatus.READY and r["url"]
+            and (tier is None or r["tier"] == tier)]
 
 
 # -- request stats (LB -> autoscaler channel) -------------------------------

@@ -45,6 +45,15 @@ class SkyServiceSpec:
     # demand, and the LB routes `model=` names (unknown -> typed 404
     # at BOTH tiers, affinity for known names).
     adapters: Optional[Dict[str, str]] = None
+    # Disaggregated prefill/decode serving (docs/serving.md
+    # §Disaggregated serving): {"prefill_replicas": P,
+    # "decode_replicas": D} splits the fleet into a prefill tier
+    # (chunked admission to one committed token, then a paged-KV
+    # handoff) and a decode tier (imports the blocks and resumes
+    # through the ordinary prefix-resume path). P + D must equal the
+    # replica count, and autoscaling is fixed-count only — moving a
+    # replica between tiers is a relaunch, not a probe flip.
+    disaggregation: Optional[Dict[str, int]] = None
     # Spot/on-demand mixed fleet (reference: sky/serve/autoscalers.py
     # FallbackRequestRateAutoscaler:546): keep this many always-on
     # on-demand replicas under the spot fleet...
@@ -89,6 +98,26 @@ class SkyServiceSpec:
                 raise exceptions.ServeError(
                     "service.adapters must map non-empty adapter "
                     "names to checkpoint paths")
+        if self.disaggregation is not None:
+            d = self.disaggregation
+            if (not isinstance(d, dict)
+                    or set(d) != {"prefill_replicas", "decode_replicas"}
+                    or not all(isinstance(v, int) and v >= 1
+                               for v in d.values())):
+                raise exceptions.ServeError(
+                    "service.disaggregation needs integer "
+                    "prefill_replicas >= 1 and decode_replicas >= 1")
+            total = d["prefill_replicas"] + d["decode_replicas"]
+            if self.min_replicas != self.max_replicas:
+                raise exceptions.ServeError(
+                    "service.disaggregation requires a fixed replica "
+                    "count (replicas: N, no autoscaling policy) — "
+                    "tier membership is assigned at launch")
+            if total != self.min_replicas:
+                raise exceptions.ServeError(
+                    f"disaggregation tiers must cover the fleet: "
+                    f"prefill_replicas + decode_replicas = {total} "
+                    f"!= replicas = {self.min_replicas}")
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> "SkyServiceSpec":
@@ -127,6 +156,15 @@ class SkyServiceSpec:
         if adapters is not None:
             kwargs["adapters"] = {str(k): str(v)
                                   for k, v in dict(adapters).items()}
+        disagg = config.pop("disaggregation", None)
+        if disagg is not None:
+            try:
+                kwargs["disaggregation"] = {
+                    str(k): int(v) for k, v in dict(disagg).items()}
+            except (TypeError, ValueError):
+                raise exceptions.ServeError(
+                    "service.disaggregation must map tier names to "
+                    "integer replica counts")
         tls = config.pop("tls", None) or {}
         if tls:
             if not (tls.get("keyfile") and tls.get("certfile")):
@@ -151,6 +189,8 @@ class SkyServiceSpec:
             out["readiness_probe"]["post_data"] = self.post_data
         if self.adapters:
             out["adapters"] = dict(self.adapters)
+        if self.disaggregation:
+            out["disaggregation"] = dict(self.disaggregation)
         if self.tls_certfile:
             out["tls"] = {"keyfile": self.tls_keyfile,
                           "certfile": self.tls_certfile}

@@ -1,0 +1,470 @@
+"""Fleet-scale cache-aware serving: prefix-affinity routing +
+disaggregated prefill/decode tiers.
+
+Covers the routing key's byte-parity with the engine's PrefixIndex
+digest (same blake2b-128, same adapter salting), the load-spill rule
+shared by adapter and prefix affinity, the disaggregation service
+spec, tier-labeled replica state, and the end-to-end two-tier flow
+over real model servers: a /prefill on the prefill tier, a paged-KV
+handoff to the decode tier, greedy output bit-identical to
+single-tier, one stitched trace across both tiers, and the
+``handoff.transfer`` chaos point retrying a mid-transfer decode death
+on a survivor with zero lost requests and zero leaked blocks.
+"""
+
+import http.server
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from skypilot_tpu import chaos, exceptions
+from skypilot_tpu.infer import engine as eng
+from skypilot_tpu.infer import server as srv
+from skypilot_tpu.models import llama
+from skypilot_tpu.serve import load_balancer, serve_state
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+CFG = llama.CONFIGS["llama3-tiny"]
+CHUNK = 8
+PROMPT_BASE = list(range(5, 21))        # 16 tokens = 2 prefill chunks
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _home(tmp_path_factory):
+    import os
+    home = str(tmp_path_factory.mktemp("home"))
+    old = {k: os.environ.get(k)
+           for k in ("SKYPILOT_TPU_HOME", "SKYTPU_PREFILL_CHUNK")}
+    os.environ["SKYPILOT_TPU_HOME"] = home
+    os.environ["SKYTPU_PREFILL_CHUNK"] = str(CHUNK)
+    load_balancer._disagg_cache.clear()
+    load_balancer._adapter_cache.clear()
+    yield home
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+# -- routing key parity -----------------------------------------------------
+
+def test_lb_digest_matches_engine_prefix_index():
+    """The LB's routing key is byte-for-byte the engine PrefixIndex
+    digest of the longest chunk-aligned proper prefix — including the
+    salt namespace — so affinity routing pins exactly the families the
+    engine caches."""
+    idx = eng.PrefixIndex(rows=4, block=CHUNK)
+    for prompt in (list(range(100, 130)),        # 30 -> n=24
+                   list(range(7, 23)),           # 16 -> n=8 (proper!)
+                   list(range(50, 59))):         # 9  -> n=8
+        n = ((len(prompt) - 1) // CHUNK) * CHUNK
+        for salt in (b"", b"\x01adapter-content-digest\xff"):
+            assert load_balancer.prefix_affinity_key(
+                prompt, chunk=CHUNK, salt=salt) \
+                == idx._digest(prompt, n, salt)
+    # Ineligibility mirrors PrefixIndex.eligible: a prompt no longer
+    # than one chunk has no cacheable proper prefix.
+    short = list(range(CHUNK))
+    assert load_balancer.prefix_affinity_key(short, chunk=CHUNK) is None
+    assert not idx.eligible(short)
+
+
+def test_lb_digest_adapter_content_salt_parity():
+    """With a REAL adapter-content digest as the salt (what the engine
+    feeds its index), the LB function still reproduces the engine
+    digest — and different salts split the same prompt into different
+    routing families (two fine-tunes must not share a replica pin for
+    cache reasons: their KV rows differ)."""
+    import numpy as np
+    from skypilot_tpu.infer import adapters as adapters_lib
+    digest = adapters_lib._content_digest(
+        {"attn_q": {"a": np.ones((4, 2), np.float32),
+                    "b": np.zeros((2, 4), np.float32)}}, alpha=32.0)
+    assert digest and len(digest) == 16
+    idx = eng.PrefixIndex(rows=4, block=CHUNK)
+    prompt = list(range(60, 90))
+    n = ((len(prompt) - 1) // CHUNK) * CHUNK
+    assert load_balancer.prefix_affinity_key(
+        prompt, chunk=CHUNK, salt=digest) == idx._digest(prompt, n,
+                                                         digest)
+    assert load_balancer.prefix_affinity_key(prompt, chunk=CHUNK,
+                                             salt=b"ft-a") \
+        != load_balancer.prefix_affinity_key(prompt, chunk=CHUNK,
+                                             salt=b"ft-b")
+
+
+# -- affinity load spill ----------------------------------------------------
+
+def test_affinity_pick_spills_on_load(monkeypatch):
+    """Rendezvous affinity pins a key to one replica; once that
+    replica's live load exceeds the least-loaded candidate by more
+    than SKYTPU_LB_SPILL, the pick spills to the NEXT ranked replica
+    (deterministic second choice, not random), and returns home when
+    the load drains."""
+    monkeypatch.delenv("SKYTPU_LB_SPILL", raising=False)
+    pol = load_balancer.LeastLoadPolicy()
+    urls = [f"http://r{i}" for i in range(3)]
+    ranked = load_balancer._ranked_urls("hot-key", urls)
+    assert load_balancer._affinity_pick("hot-key", urls, pol) \
+        == ranked[0]
+    for _ in range(4):                   # load == margin: still home
+        pol.acquire(ranked[0])
+    assert load_balancer._affinity_pick("hot-key", urls, pol) \
+        == ranked[0]
+    pol.acquire(ranked[0])               # load > floor + margin
+    assert load_balancer._affinity_pick("hot-key", urls, pol) \
+        == ranked[1]
+    for _ in range(5):
+        pol.done(ranked[0])
+    assert load_balancer._affinity_pick("hot-key", urls, pol) \
+        == ranked[0]
+
+
+def test_policy_load_accounting_shared_by_all_pick_paths():
+    """The in-flight load map lives on the Policy BASE class —
+    acquire/done from any pick path (policy or affinity) feeds the
+    same numbers LeastLoadPolicy.select and the spill rule read."""
+    pol = load_balancer.LeastLoadPolicy()
+    pol.acquire("a")
+    pol.acquire("a")
+    pol.acquire("b")
+    assert pol.load("a") == 2 and pol.load("b") == 1
+    assert pol.select(["a", "b"]) == "b"   # select READS, no increment
+    assert pol.load("b") == 1
+    pol.done("a")
+    pol.done("a")
+    pol.done("a")                          # over-done clamps at zero
+    assert pol.load("a") == 0
+
+
+# -- service spec + tier state ----------------------------------------------
+
+def test_disaggregation_spec_validation_and_roundtrip():
+    cfg = {"replicas": 3,
+           "disaggregation": {"prefill_replicas": 1,
+                              "decode_replicas": 2}}
+    spec = SkyServiceSpec.from_yaml_config(dict(cfg))
+    assert spec.disaggregation == {"prefill_replicas": 1,
+                                   "decode_replicas": 2}
+    again = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert again.disaggregation == spec.disaggregation
+    # Tiers must cover the fleet exactly.
+    with pytest.raises(exceptions.ServeError):
+        SkyServiceSpec.from_yaml_config({
+            "replicas": 2,
+            "disaggregation": {"prefill_replicas": 1,
+                               "decode_replicas": 2}})
+    # Autoscaling is incompatible: tier membership is launch-time.
+    with pytest.raises(exceptions.ServeError):
+        SkyServiceSpec.from_yaml_config({
+            "replica_policy": {"min_replicas": 1, "max_replicas": 3,
+                               "target_qps_per_replica": 1},
+            "disaggregation": {"prefill_replicas": 1,
+                               "decode_replicas": 2}})
+    # Exact key set, integer counts >= 1.
+    for bad in ({"prefill_replicas": 1},
+                {"prefill_replicas": 0, "decode_replicas": 3},
+                {"prefill_replicas": 1, "decode_replicas": 1,
+                 "extra": 1}):
+        with pytest.raises(exceptions.ServeError):
+            SkyServiceSpec(min_replicas=3, max_replicas=3,
+                           disaggregation=bad)
+
+
+def test_replica_tier_state_and_filtered_ready_urls():
+    serve_state.add_service("tiertest", {}, {}, 0)
+    up = serve_state.upsert_replica
+    up("tiertest", 1, "c1", serve_state.ReplicaStatus.READY,
+       "http://p1", tier="prefill")
+    up("tiertest", 2, "c2", serve_state.ReplicaStatus.READY,
+       "http://d1", tier="decode")
+    up("tiertest", 3, "c3", serve_state.ReplicaStatus.STARTING,
+       "http://d2", tier="decode")
+    assert serve_state.ready_urls("tiertest") == ["http://p1",
+                                                  "http://d1"]
+    assert serve_state.ready_urls("tiertest", tier="prefill") \
+        == ["http://p1"]
+    assert serve_state.ready_urls("tiertest", tier="decode") \
+        == ["http://d1"]
+    # A status flip through set_replica_status keeps the tier.
+    serve_state.set_replica_status("tiertest", 3,
+                                   serve_state.ReplicaStatus.READY)
+    assert serve_state.ready_urls("tiertest", tier="decode") \
+        == ["http://d1", "http://d2"]
+    replicas = {r["replica_id"]: r
+                for r in serve_state.list_replicas("tiertest")}
+    assert replicas[1]["tier"] == "prefill"
+    assert replicas[3]["tier"] == "decode"
+    serve_state.remove_service("tiertest")
+
+
+# -- prefix-affinity routing over fake replicas -----------------------------
+
+def _spawn_counting_replica(counts):
+    class _Fake(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(n)
+            port = self.server.server_address[1]
+            counts[port] = counts.get(port, 0) + 1
+            out = json.dumps({"tokens": [1], "done": True}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Fake)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_prefix_affinity_concentrates_family_on_one_replica():
+    """Requests sharing a chunk-aligned prompt prefix all land on ONE
+    replica (the family's rendezvous pick) instead of spreading — the
+    property that turns per-replica prefix caches into a fleet-wide
+    cache. Least-load alone would spread 6 sequential requests across
+    the tie."""
+    counts = {}
+    fakes = [_spawn_counting_replica(counts) for _ in range(3)]
+    try:
+        serve_state.add_service("afftest", {}, {}, 0)
+        for i, (_, url) in enumerate(fakes):
+            serve_state.upsert_replica(
+                "afftest", i + 1, f"r{i+1}",
+                serve_state.ReplicaStatus.READY, url)
+        lb = load_balancer._ThreadingServer(
+            ("127.0.0.1", 0),
+            load_balancer.make_handler(
+                "afftest", load_balancer.LeastLoadPolicy()))
+        threading.Thread(target=lb.serve_forever, daemon=True).start()
+        lb_url = f"http://127.0.0.1:{lb.server_address[1]}"
+        family = list(range(200, 240))         # 40 tokens, 5 chunks
+        try:
+            for i in range(6):
+                code, _ = _post(f"{lb_url}/generate",
+                                {"tokens": family + [i],
+                                 "max_new_tokens": 4})
+                assert code == 200
+        finally:
+            lb.shutdown()
+        assert sorted(counts.values()) == [6]  # one replica took all
+    finally:
+        serve_state.remove_service("afftest")
+        for httpd, _ in fakes:
+            httpd.shutdown()
+
+
+# -- end-to-end two-tier fleet ----------------------------------------------
+
+def _mk_engine(params, **kw):
+    base = dict(n_slots=4, max_len=64, prompt_buckets=(48,),
+                prefill_chunk=CHUNK, prefix_pool=8, kv_block=CHUNK)
+    base.update(kw)
+    return eng.InferenceEngine(params, CFG, **base)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def fleet(params, _home):
+    """1 prefill + 2 decode replicas behind a real LB, registered as a
+    disaggregated service."""
+    servers, urls = [], []
+    for _ in range(3):
+        engine = _mk_engine(params)
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        model, httpd = srv.serve(engine, host="127.0.0.1", port=port)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        assert model._ready.wait(timeout=300)
+        servers.append((model, httpd, engine))
+        urls.append(f"http://127.0.0.1:{port}")
+    spec = {"disaggregation": {"prefill_replicas": 1,
+                               "decode_replicas": 2}}
+    serve_state.add_service("disagg", spec, {}, 0)
+    for i, tier in enumerate(("prefill", "decode", "decode")):
+        serve_state.upsert_replica("disagg", i + 1, f"r{i+1}",
+                                   serve_state.ReplicaStatus.READY,
+                                   urls[i], tier=tier)
+    load_balancer._disagg_cache.clear()
+    lb_httpd = load_balancer._ThreadingServer(
+        ("127.0.0.1", 0),
+        load_balancer.make_handler("disagg",
+                                   load_balancer.LeastLoadPolicy()))
+    threading.Thread(target=lb_httpd.serve_forever,
+                     daemon=True).start()
+    yield (f"http://127.0.0.1:{lb_httpd.server_address[1]}",
+           servers, urls)
+    lb_httpd.shutdown()
+    for model, httpd, _ in servers:
+        model.shutdown()
+        httpd.shutdown()
+    serve_state.remove_service("disagg")
+
+
+def _resident_blocks(engine):
+    idx = engine._prefix_index
+    return sum(len(p) for p in idx.payloads()) if idx else 0
+
+
+def test_two_tier_blocking_parity_and_no_leaks(fleet):
+    """A blocking /generate through the LB on a disaggregated service
+    runs prefill-tier admission + KV handoff + decode-tier resume and
+    returns tokens BIT-IDENTICAL to the single-tier path; the prefill
+    tier afterwards holds exactly its refcounted resident prefixes
+    (zero leaked blocks)."""
+    lb_url, servers, urls = fleet
+    prompt = PROMPT_BASE + [31, 32, 33]
+    ok_before = load_balancer.LB_HANDOFFS.labels(result="ok").value
+    code, out = _post(f"{lb_url}/generate",
+                      {"tokens": prompt, "max_new_tokens": 6})
+    assert code == 200 and "error" not in out
+    # Single-tier reference, direct to a decode replica.
+    ref_code, ref = _post(f"{urls[2]}/generate",
+                          {"tokens": prompt, "max_new_tokens": 6})
+    assert ref_code == 200
+    assert out["tokens"] == ref["tokens"]
+    assert len(out["tokens"]) == 6
+    assert load_balancer.LB_HANDOFFS.labels(result="ok").value \
+        == ok_before + 1
+    # Donor audit: every block the prefill engine holds is owned by a
+    # resident prefix entry — the handoff left it exactly as warm as
+    # any cached serve, nothing dangling.
+    pf_engine = servers[0][2]
+    assert pf_engine.blocks_used == _resident_blocks(pf_engine)
+
+
+def test_two_tier_short_prompt_falls_back_single_tier(fleet):
+    """A prompt no longer than one chunk can't hand off (no cacheable
+    prefix) — the LB serves it single-tier on the decode tier, and the
+    'single' tier counter records the fallback."""
+    lb_url, _, urls = fleet
+    single_before = load_balancer.LB_TIER_REQUESTS.labels(
+        tier="single").value
+    prompt = [3, 1, 4]
+    code, out = _post(f"{lb_url}/generate",
+                      {"tokens": prompt, "max_new_tokens": 4})
+    assert code == 200 and len(out["tokens"]) == 4
+    ref = _post(f"{urls[1]}/generate",
+                {"tokens": prompt, "max_new_tokens": 4})[1]
+    assert out["tokens"] == ref["tokens"]
+    assert load_balancer.LB_TIER_REQUESTS.labels(
+        tier="single").value == single_before + 1
+
+
+def test_two_tier_streaming_parity(fleet):
+    """The streaming flavor: the decode tier streams the committed
+    token first (the client's TTFT is the prefill tier's), the full
+    sequence is duplicate-free and bit-identical to single-tier, and
+    the done line carries the stitched token count."""
+    lb_url, _, urls = fleet
+    prompt = PROMPT_BASE + [71, 72]
+    ref = _post(f"{urls[1]}/generate",
+                {"tokens": prompt, "max_new_tokens": 6})[1]
+    req = urllib.request.Request(
+        f"{lb_url}/generate",
+        data=json.dumps({"tokens": prompt, "max_new_tokens": 6,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    toks, done = [], None
+    with urllib.request.urlopen(req, timeout=120) as r:
+        for line in r:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            assert "error" not in obj
+            if "done" in obj:
+                done = obj
+                break
+            toks.extend(obj.get("tokens") or [])
+    assert toks == ref["tokens"]
+    assert done is not None and done["n_tokens"] == len(toks)
+
+
+def test_two_tier_trace_stitched_across_tiers(fleet):
+    """Both tiers' engine spans land in ONE trace: the LB propagates
+    the same traceparent to /prefill and /handoff (minting one when
+    the client sends none), so `skytpu trace` and the perfetto export
+    render a single tree spanning two request ids."""
+    from skypilot_tpu.observability import trace_view, tracing
+    lb_url, _, _ = fleet
+    trace_id = tracing.new_trace_id()
+    tp = tracing.format_traceparent(
+        tracing.SpanContext(trace_id, tracing.new_span_id()))
+    prompt = PROMPT_BASE + [81, 82, 83, 84]
+    code, out = _post(f"{lb_url}/generate",
+                      {"tokens": prompt, "max_new_tokens": 5},
+                      headers={"traceparent": tp})
+    assert code == 200 and "error" not in out
+    tracing.flush()          # spans sit in the in-process ring buffer
+    records = trace_view.load_trace(trace_id)
+    spans = [r for r in records if r.get("kind") == "span"]
+    rids = {(r.get("attrs") or {}).get("rid") for r in spans
+            if (r.get("attrs") or {}).get("rid") is not None}
+    # Two requests (prefill-tier rid + decode-tier rid) in one trace.
+    assert len(rids) >= 2
+    rendered = trace_view.render(records, trace_id)
+    assert "engine.prefill" in rendered
+    perfetto = trace_view.to_perfetto(records)
+    assert any(e.get("ph") == "X" for e in perfetto["traceEvents"])
+
+
+def test_handoff_chaos_decode_death_retries_on_survivor(fleet):
+    """A seeded ``handoff.transfer`` fault (decode replica dies
+    mid-transfer) retries the export — held in LB memory — on the
+    surviving decode replica: the request completes bit-identical
+    (zero lost requests), and the prefill tier's block pool still
+    holds exactly its resident prefixes (zero leaked blocks)."""
+    lb_url, servers, urls = fleet
+    prompt = PROMPT_BASE + [91, 92, 93, 94]
+    ref = _post(f"{urls[1]}/generate",
+                {"tokens": prompt, "max_new_tokens": 6})[1]
+    retry_before = load_balancer.LB_HANDOFFS.labels(
+        result="retry").value
+    ok_before = load_balancer.LB_HANDOFFS.labels(result="ok").value
+    chaos.configure({"seed": 3, "faults": [
+        {"point": "handoff.transfer", "times": 1}]})
+    try:
+        code, out = _post(f"{lb_url}/generate",
+                          {"tokens": prompt, "max_new_tokens": 6})
+        fired = chaos.injector().fired
+    finally:
+        chaos.deactivate()
+    assert len(fired) == 1
+    assert fired[0]["point"] == "handoff.transfer"
+    assert code == 200 and out["tokens"] == ref["tokens"]
+    assert load_balancer.LB_HANDOFFS.labels(result="retry").value \
+        == retry_before + 1
+    assert load_balancer.LB_HANDOFFS.labels(result="ok").value \
+        == ok_before + 1
+    pf_engine = servers[0][2]
+    assert pf_engine.blocks_used == _resident_blocks(pf_engine)
